@@ -1,0 +1,18 @@
+"""kube_arbitrator_tpu — a TPU-native batch scheduling framework.
+
+A ground-up rebuild of kube-batch (scostache/kube-arbitrator) where the
+per-cycle scheduling math — predicates, fairness (DRF/proportion), gang
+semantics, bin-packing allocation, preemption/reclaim, backfill — runs as a
+fused JAX/XLA tensor program on TPU, fed by a host-side snapshot plane.
+
+Layering (bottom → top):
+  api/        data model (Resource epsilon math, status lattice, infos)
+  cache/      cluster cache, snapshot tensorization, sim cluster + binder
+  ops/        JAX kernels: predicates, fairness, allocate, gang, preempt
+  framework/  session, plugin/action registries, YAML conf parity
+  parallel/   device mesh + node-axis sharded cycle
+  models/     prebuilt policy pipelines (the "flagship" fused cycle)
+  utils/      timing, logging
+"""
+
+__version__ = "0.1.0"

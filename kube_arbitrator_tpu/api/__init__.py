@@ -1,0 +1,38 @@
+"""Scheduler API data model (snapshot-plane)."""
+from . import resource
+from .info import (
+    ClusterInfo,
+    JobInfo,
+    NodeInfo,
+    QueueInfo,
+    Taint,
+    TaskInfo,
+    Toleration,
+)
+from .types import (
+    ALLOCATED_STATUSES,
+    COND_UNSCHEDULABLE,
+    PodGroupPhase,
+    TaskStatus,
+    counts_as_ready,
+    counts_as_valid,
+    is_allocated_status,
+)
+
+__all__ = [
+    "resource",
+    "ClusterInfo",
+    "JobInfo",
+    "NodeInfo",
+    "QueueInfo",
+    "Taint",
+    "TaskInfo",
+    "Toleration",
+    "TaskStatus",
+    "PodGroupPhase",
+    "ALLOCATED_STATUSES",
+    "COND_UNSCHEDULABLE",
+    "counts_as_ready",
+    "counts_as_valid",
+    "is_allocated_status",
+]

@@ -1,0 +1,217 @@
+"""Host-side in-memory cluster model: Task/Job/Node/Queue/Cluster info.
+
+Semantics parity: reference ``pkg/scheduler/api/{job_info,node_info,
+queue_info,cluster_info}.go``.  This is the *snapshot plane* data model: it
+owns identity, labels, and exact accounting; the decision plane only ever
+sees its flattened tensor form (cache/snapshot.py).
+
+Design difference vs the reference (deliberate, TPU-first): tasks/jobs/nodes
+carry integer *ordinals* assigned at snapshot time so every cross-reference
+in the tensor encoding is an int32 index, never a string key.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import resource as res
+from .types import (
+    TaskStatus,
+    counts_as_ready,
+    counts_as_valid,
+    is_allocated_status,
+)
+
+
+@dataclasses.dataclass
+class Toleration:
+    """Subset of v1.Toleration the reference's taint predicate consults."""
+
+    key: str = ""
+    operator: str = "Equal"  # "Equal" | "Exists"
+    value: str = ""
+    effect: str = ""  # "" matches all effects
+
+    def tolerates(self, taint: "Taint") -> bool:
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.operator == "Exists":
+            return self.key == "" or self.key == taint.key
+        return self.key == taint.key and self.value == taint.value
+
+
+@dataclasses.dataclass(frozen=True)
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = "NoSchedule"  # NoSchedule | PreferNoSchedule | NoExecute
+
+
+@dataclasses.dataclass
+class TaskInfo:
+    """Reference api/job_info.go:36-89 (TaskInfo)."""
+
+    uid: str
+    job_uid: str
+    name: str = ""
+    namespace: str = "default"
+    resreq: np.ndarray = dataclasses.field(default_factory=res.zeros)
+    node_name: str = ""
+    status: TaskStatus = TaskStatus.PENDING
+    priority: int = 1
+    # Predicate inputs (tensorized via equivalence classes in the snapshot):
+    node_selector: Dict[str, str] = dataclasses.field(default_factory=dict)
+    tolerations: List[Toleration] = dataclasses.field(default_factory=list)
+    host_ports: Tuple[int, ...] = ()
+    affinity_terms: Tuple = ()  # reserved for pod-affinity (later stage)
+    # Assigned by the snapshot flattener:
+    ordinal: int = -1
+
+    @property
+    def best_effort(self) -> bool:
+        return res.is_empty(self.resreq)
+
+    def clone(self) -> "TaskInfo":
+        return dataclasses.replace(self, resreq=self.resreq.copy())
+
+
+@dataclasses.dataclass
+class JobInfo:
+    """Reference api/job_info.go:117-358 (JobInfo). Gang unit == PodGroup."""
+
+    uid: str
+    name: str = ""
+    namespace: str = "default"
+    queue_uid: str = "default"
+    priority: int = 0
+    min_available: int = 0
+    creation_ts: float = 0.0
+    tasks: Dict[str, TaskInfo] = dataclasses.field(default_factory=dict)
+    ordinal: int = -1
+
+    def add_task(self, t: TaskInfo) -> None:
+        self.tasks[t.uid] = t
+
+    def tasks_with_status(self, *statuses: TaskStatus) -> List[TaskInfo]:
+        want = set(statuses)
+        return [t for t in self.tasks.values() if t.status in want]
+
+    @property
+    def allocated(self) -> np.ndarray:
+        return res.sum_resources(
+            t.resreq for t in self.tasks.values() if is_allocated_status(t.status)
+        )
+
+    @property
+    def total_request(self) -> np.ndarray:
+        return res.sum_resources(t.resreq for t in self.tasks.values())
+
+    def ready_task_num(self) -> int:
+        """gang.go:44-70: allocated-status + Succeeded + Pipelined."""
+        return sum(1 for t in self.tasks.values() if counts_as_ready(t.status))
+
+    def valid_task_num(self) -> int:
+        return sum(1 for t in self.tasks.values() if counts_as_valid(t.status))
+
+    def is_ready(self) -> bool:
+        return self.ready_task_num() >= self.min_available
+
+    def is_valid(self) -> bool:
+        """gang JobValidFn (gang.go:81-102)."""
+        return self.valid_task_num() >= self.min_available
+
+    def pending_tasks(self) -> List[TaskInfo]:
+        return self.tasks_with_status(TaskStatus.PENDING)
+
+
+@dataclasses.dataclass
+class NodeInfo:
+    """Reference api/node_info.go:26-157 with exact Idle/Used/Releasing
+    accounting."""
+
+    name: str
+    allocatable: np.ndarray = dataclasses.field(default_factory=res.zeros)
+    capability: np.ndarray = dataclasses.field(default_factory=res.zeros)
+    max_tasks: int = 110
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    taints: List[Taint] = dataclasses.field(default_factory=list)
+    unschedulable: bool = False
+    ordinal: int = -1
+
+    idle: np.ndarray = dataclasses.field(default_factory=res.zeros)
+    used: np.ndarray = dataclasses.field(default_factory=res.zeros)
+    releasing: np.ndarray = dataclasses.field(default_factory=res.zeros)
+    tasks: Dict[str, TaskInfo] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        if res.is_empty(self.idle) and not res.is_empty(self.allocatable):
+            self.idle = self.allocatable.copy()
+        if res.is_empty(self.capability) and not res.is_empty(self.allocatable):
+            self.capability = self.allocatable.copy()
+
+    def add_task(self, task: TaskInfo) -> None:
+        """node_info.go:101-127: status-aware accounting."""
+        if task.uid in self.tasks:
+            raise ValueError(f"task {task.uid} already on node {self.name}")
+        t = task.clone()
+        if t.status == TaskStatus.RELEASING:
+            self.releasing = self.releasing + t.resreq
+            self.idle = res.sub_checked(self.idle, t.resreq)
+        elif t.status == TaskStatus.PIPELINED:
+            self.releasing = res.sub_checked(self.releasing, t.resreq)
+        else:
+            self.idle = res.sub_checked(self.idle, t.resreq)
+        self.used = self.used + t.resreq
+        self.tasks[t.uid] = t
+
+    def remove_task(self, task: TaskInfo) -> None:
+        """node_info.go:130-157 (inverse accounting)."""
+        t = self.tasks.pop(task.uid, None)
+        if t is None:
+            raise ValueError(f"task {task.uid} not on node {self.name}")
+        if t.status == TaskStatus.RELEASING:
+            self.releasing = res.sub_checked(self.releasing, t.resreq)
+            self.idle = self.idle + t.resreq
+        elif t.status == TaskStatus.PIPELINED:
+            self.releasing = self.releasing + t.resreq
+        else:
+            self.idle = self.idle + t.resreq
+        self.used = res.sub_checked(self.used, t.resreq)
+
+    def update_task(self, task: TaskInfo) -> None:
+        self.remove_task(task)
+        self.add_task(task)
+
+
+@dataclasses.dataclass
+class QueueInfo:
+    """Reference api/queue_info.go:25-54 + Queue CRD (weight)."""
+
+    uid: str
+    name: str = ""
+    weight: int = 1
+    ordinal: int = -1
+
+    def __post_init__(self) -> None:
+        if not self.name:
+            self.name = self.uid
+
+
+@dataclasses.dataclass
+class ClusterInfo:
+    """Reference api/cluster_info.go:21-29: one cycle's snapshot input."""
+
+    jobs: Dict[str, JobInfo] = dataclasses.field(default_factory=dict)
+    nodes: Dict[str, NodeInfo] = dataclasses.field(default_factory=dict)
+    queues: Dict[str, QueueInfo] = dataclasses.field(default_factory=dict)
+    # Running tasks owned by other schedulers; their usage is subtracted from
+    # the proportion plugin's total (proportion.go:61-63).
+    others: List[TaskInfo] = dataclasses.field(default_factory=list)
+
+    def task_by_uid(self, uid: str) -> Optional[TaskInfo]:
+        for job in self.jobs.values():
+            if uid in job.tasks:
+                return job.tasks[uid]
+        return None

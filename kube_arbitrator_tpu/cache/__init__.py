@@ -1,0 +1,16 @@
+"""Cluster cache, snapshot tensorization, and simulation harness."""
+from .sim import BindIntent, EvictIntent, FakeBinder, FakeEvictor, SimCluster, generate_cluster
+from .snapshot import Snapshot, SnapshotIndex, SnapshotTensors, build_snapshot
+
+__all__ = [
+    "BindIntent",
+    "EvictIntent",
+    "FakeBinder",
+    "FakeEvictor",
+    "SimCluster",
+    "generate_cluster",
+    "Snapshot",
+    "SnapshotIndex",
+    "SnapshotTensors",
+    "build_snapshot",
+]

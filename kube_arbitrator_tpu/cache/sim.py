@@ -1,0 +1,255 @@
+"""Simulated cluster + fake binder/evictor: the actuation plane in sim mode.
+
+Plays the role of the reference's cache with fake backends that its unit
+tests construct (``actions/allocate/allocate_test.go:99-138``: fakeBinder
+records binds into a map; fakeEvictor deletes pods) and of the e2e fixture
+library (``test/e2e/util.go``) that fabricates gang jobs and nodes.
+
+The SimCluster owns ClusterInfo state, applies committed decisions
+(bind/evict intents) back into the model with the exact NodeInfo accounting,
+and can generate synthetic clusters at benchmark scale.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api import resource as res
+from ..api.info import ClusterInfo, JobInfo, NodeInfo, QueueInfo, Taint, TaskInfo, Toleration
+from ..api.types import TaskStatus
+
+
+@dataclasses.dataclass
+class BindIntent:
+    task_uid: str
+    node_name: str
+
+
+@dataclasses.dataclass
+class EvictIntent:
+    task_uid: str
+
+
+@dataclasses.dataclass
+class FakeBinder:
+    """Records binds, mirroring allocate_test.go's fakeBinder."""
+
+    binds: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def bind(self, task_uid: str, node_name: str) -> None:
+        self.binds[task_uid] = node_name
+
+
+@dataclasses.dataclass
+class FakeEvictor:
+    evicts: List[str] = dataclasses.field(default_factory=list)
+
+    def evict(self, task_uid: str) -> None:
+        self.evicts.append(task_uid)
+
+
+class SimCluster:
+    """Mutable cluster state + declarative builders + decision application."""
+
+    def __init__(self) -> None:
+        self.cluster = ClusterInfo()
+        self.binder = FakeBinder()
+        self.evictor = FakeEvictor()
+        self._task_counter = 0
+
+    # ---- builders (e2e util.go fixture equivalents) ----
+
+    def add_queue(self, name: str, weight: int = 1) -> QueueInfo:
+        q = QueueInfo(uid=name, name=name, weight=weight)
+        self.cluster.queues[name] = q
+        return q
+
+    def add_node(
+        self,
+        name: str,
+        cpu_milli: float = 4000,
+        memory: float = 8 * 1024**3,
+        gpu_milli: float = 0,
+        max_tasks: int = 110,
+        labels: Optional[Dict[str, str]] = None,
+        taints: Sequence[Taint] = (),
+        unschedulable: bool = False,
+    ) -> NodeInfo:
+        n = NodeInfo(
+            name=name,
+            allocatable=res.make(cpu_milli, memory, gpu_milli),
+            max_tasks=max_tasks,
+            labels=dict(labels or {}),
+            taints=list(taints),
+            unschedulable=unschedulable,
+        )
+        self.cluster.nodes[name] = n
+        return n
+
+    def add_job(
+        self,
+        name: str,
+        queue: str = "default",
+        min_available: int = 0,
+        priority: int = 0,
+        creation_ts: float = 0.0,
+        namespace: str = "default",
+    ) -> JobInfo:
+        j = JobInfo(
+            uid=name,
+            name=name,
+            namespace=namespace,
+            queue_uid=queue,
+            min_available=min_available,
+            priority=priority,
+            creation_ts=creation_ts,
+        )
+        self.cluster.jobs[name] = j
+        return j
+
+    def add_task(
+        self,
+        job: JobInfo,
+        cpu_milli: float = 0,
+        memory: float = 0,
+        gpu_milli: float = 0,
+        status: TaskStatus = TaskStatus.PENDING,
+        node: str = "",
+        priority: int = 1,
+        name: str = "",
+        node_selector: Optional[Dict[str, str]] = None,
+        tolerations: Sequence[Toleration] = (),
+        host_ports: Sequence[int] = (),
+    ) -> TaskInfo:
+        self._task_counter += 1
+        uid = name or f"{job.uid}-task-{self._task_counter:06d}"
+        t = TaskInfo(
+            uid=uid,
+            job_uid=job.uid,
+            name=uid,
+            namespace=job.namespace,
+            resreq=res.make(cpu_milli, memory, gpu_milli),
+            status=status,
+            node_name=node,
+            priority=priority,
+            node_selector=dict(node_selector or {}),
+            tolerations=list(tolerations),
+            host_ports=tuple(host_ports),
+        )
+        # Node placement first: if accounting rejects the task we must not
+        # leave a phantom entry in job.tasks.
+        if node:
+            self.cluster.nodes[node].add_task(t)
+        job.add_task(t)
+        return t
+
+    def add_other_task(
+        self, node: str, cpu_milli: float = 0, memory: float = 0, gpu_milli: float = 0
+    ) -> TaskInfo:
+        """A running task owned by another scheduler (ClusterInfo.Others)."""
+        self._task_counter += 1
+        t = TaskInfo(
+            uid=f"other-{self._task_counter:06d}",
+            job_uid="",
+            resreq=res.make(cpu_milli, memory, gpu_milli),
+            status=TaskStatus.RUNNING,
+            node_name=node,
+        )
+        self.cluster.others.append(t)
+        self.cluster.nodes[node].add_task(t)
+        return t
+
+    # ---- actuation ----
+
+    def _task_index(self) -> Dict[str, TaskInfo]:
+        return {uid: t for j in self.cluster.jobs.values() for uid, t in j.tasks.items()}
+
+    def apply_binds(self, binds: Sequence[BindIntent]) -> None:
+        """Commit bind intents: task -> Bound on node, with accounting."""
+        index = self._task_index()
+        for b in binds:
+            task = index.get(b.task_uid)
+            if task is None:
+                raise KeyError(b.task_uid)
+            node = self.cluster.nodes[b.node_name]
+            task.status = TaskStatus.BOUND
+            task.node_name = b.node_name
+            node.add_task(task)
+            self.binder.bind(b.task_uid, b.node_name)
+
+    def apply_evicts(self, evicts: Sequence[EvictIntent]) -> None:
+        """Evict: running task -> Releasing on its node (cache.go:369-405)."""
+        index = self._task_index()
+        for e in evicts:
+            task = index.get(e.task_uid)
+            if task is None:
+                raise KeyError(e.task_uid)
+            if task.node_name:
+                node = self.cluster.nodes[task.node_name]
+                node.remove_task(task)
+                task.status = TaskStatus.RELEASING
+                node.add_task(task)
+            else:
+                task.status = TaskStatus.RELEASING
+            self.evictor.evict(e.task_uid)
+
+
+def generate_cluster(
+    num_nodes: int,
+    num_jobs: int,
+    tasks_per_job: int,
+    num_queues: int = 1,
+    seed: int = 0,
+    node_cpu_milli: float = 32000,
+    node_memory: float = 128 * 1024**3,
+    node_gpu_milli: float = 8000,
+    gang_fraction: float = 0.5,
+    gpu_fraction: float = 0.25,
+    running_fraction: float = 0.0,
+) -> SimCluster:
+    """Synthetic cluster generator for the BASELINE configs (1k×100 …
+    100k×10k).  Task shapes drawn from a small set of realistic request
+    profiles; a fraction of jobs are gangs; optionally pre-populates running
+    tasks to exercise fairness/preemption state."""
+    rng = np.random.default_rng(seed)
+    sim = SimCluster()
+    for q in range(num_queues):
+        sim.add_queue(f"queue-{q:03d}", weight=int(rng.integers(1, 5)))
+    for n in range(num_nodes):
+        sim.add_node(
+            f"node-{n:05d}",
+            cpu_milli=node_cpu_milli,
+            memory=node_memory,
+            gpu_milli=node_gpu_milli,
+            max_tasks=110,
+        )
+    profiles = [
+        (500, 1 * 1024**3, 0),
+        (1000, 2 * 1024**3, 0),
+        (2000, 4 * 1024**3, 0),
+        (4000, 8 * 1024**3, 1000),
+        (8000, 16 * 1024**3, 2000),
+    ]
+    node_names = list(sim.cluster.nodes)
+    for ji in range(num_jobs):
+        queue = f"queue-{int(rng.integers(0, num_queues)):03d}"
+        gang = rng.random() < gang_fraction
+        min_avail = int(tasks_per_job * 0.5) if gang else 0
+        job = sim.add_job(
+            f"job-{ji:05d}", queue=queue, min_available=min_avail, creation_ts=float(ji)
+        )
+        cpu, mem, gpu = profiles[int(rng.integers(0, len(profiles)))]
+        if rng.random() > gpu_fraction:
+            gpu = 0
+        for _ in range(tasks_per_job):
+            if running_fraction > 0 and rng.random() < running_fraction:
+                node = node_names[int(rng.integers(0, len(node_names)))]
+                try:
+                    sim.add_task(job, cpu, mem, gpu, status=TaskStatus.RUNNING, node=node)
+                    continue
+                except ValueError:
+                    pass  # node full; fall through to pending
+            sim.add_task(job, cpu, mem, gpu)
+    return sim

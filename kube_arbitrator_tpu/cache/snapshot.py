@@ -1,0 +1,348 @@
+"""Snapshot plane: flatten a ClusterInfo into dense, padded device tensors.
+
+This replaces the reference's per-cycle deep-copy Snapshot
+(``pkg/scheduler/cache/cache.go:549-597``) + the per-(task,node) predicate
+object churn (``plugins/predicates/predicates.go:121-201``).  Instead of
+cloning object graphs, we produce one pytree of dense arrays sized to
+padded buckets so a single compiled XLA program serves every cycle.
+
+Design decisions (TPU-first):
+
+* **Device resource units** are ``[milli-cpu, MiB, milli-gpu]`` — with those
+  units the reference's epsilon slack (10m CPU / 10 MiB / 10m GPU,
+  ``resource_info.go:54-56``) is uniformly ``10.0`` and all magnitudes fit
+  comfortably in float32.
+* **Relational predicates factor through equivalence classes.**  Node
+  selector matching and taint toleration depend only on (task constraint
+  signature, node property signature).  Distinct signatures are few even at
+  100k pods, so the host computes a small ``class_fit[CT, CN]`` bool table
+  and the device does an O(1) gather per (task, node) instead of the
+  reference's O(predicates) object walk.
+* **Host ports** are dynamic (placing a task occupies its ports on the
+  node), so they become bitmasks over the snapshot's port universe, updated
+  inside the allocate kernel.
+* **Padding buckets**: node axis pads to multiples of 128 (TPU lane width),
+  task axis to multiples of 8 (sublane), so recompilation only happens when
+  a bucket boundary is crossed.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Tuple
+
+import jax
+import numpy as np
+
+from ..api import resource as res
+from ..api.info import ClusterInfo, JobInfo, NodeInfo, QueueInfo, TaskInfo
+from ..api.types import TaskStatus
+
+# Device-side units per resource axis: cpu milli (x1), memory bytes -> MiB,
+# gpu milli (x1).
+DEVICE_SCALE = np.array([1.0, 1.0 / (1024.0 * 1024.0), 1.0], dtype=np.float64)
+# In device units the epsilon is uniform (10m cpu / 10MiB / 10m gpu).
+DEVICE_EPSILON = 10.0
+
+MAX_PORT_WORDS = 2  # 31 usable bits per int32 word -> 62 distinct host ports/snapshot
+
+
+def _bucket(n: int, multiple: int, minimum: int) -> int:
+    n = max(n, 1)
+    b = ((n + multiple - 1) // multiple) * multiple
+    return max(b, minimum)
+
+
+def to_device_units(vec_bytes: np.ndarray) -> np.ndarray:
+    return (vec_bytes * DEVICE_SCALE).astype(np.float32)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SnapshotTensors:
+    """One cycle's dense state. All fields are arrays (a valid jit input)."""
+
+    # ---- tasks [T] ----
+    task_resreq: jax.Array      # f32[T, R] (device units)
+    task_job: jax.Array         # i32[T] job ordinal (0 for padding; see task_valid)
+    task_status: jax.Array      # i32[T] TaskStatus
+    task_priority: jax.Array    # i32[T] pod priority
+    task_uid_rank: jax.Array    # i32[T] rank of UID among tasks (tiebreak)
+    task_klass: jax.Array       # i32[T] predicate equivalence class
+    task_node: jax.Array        # i32[T] current node ordinal, -1 if none
+    task_ports: jax.Array       # i32[T, W] host-port bitmask
+    task_valid: jax.Array       # bool[T] not padding
+    task_best_effort: jax.Array  # bool[T] resreq empty (epsilon-wise)
+    # ---- nodes [N] ----
+    node_idle: jax.Array        # f32[N, R]
+    node_releasing: jax.Array   # f32[N, R]
+    node_alloc: jax.Array       # f32[N, R] allocatable
+    node_max_tasks: jax.Array   # i32[N]
+    node_num_tasks: jax.Array   # i32[N]
+    node_klass: jax.Array       # i32[N]
+    node_ports: jax.Array       # i32[N, W] ports in use
+    node_unsched: jax.Array     # bool[N]
+    node_valid: jax.Array       # bool[N]
+    # ---- jobs [J] ----
+    job_queue: jax.Array        # i32[J] queue ordinal
+    job_min_available: jax.Array  # i32[J] gang minMember
+    job_priority: jax.Array     # i32[J]
+    job_creation_rank: jax.Array  # i32[J] rank by (creation_ts, uid)
+    job_valid: jax.Array        # bool[J]
+    # ---- queues [Q] ----
+    queue_weight: jax.Array     # f32[Q]
+    queue_uid_rank: jax.Array   # i32[Q]
+    queue_valid: jax.Array      # bool[Q]
+    # ---- predicate class table [CT, CN] ----
+    class_fit: jax.Array        # bool[CT, CN]
+    # ---- cluster-level ----
+    others_used: jax.Array      # f32[R] usage by other schedulers' tasks
+
+    @property
+    def num_tasks(self) -> int:
+        return self.task_resreq.shape[0]
+
+    @property
+    def num_nodes(self) -> int:
+        return self.node_idle.shape[0]
+
+    @property
+    def num_jobs(self) -> int:
+        return self.job_queue.shape[0]
+
+    @property
+    def num_queues(self) -> int:
+        return self.queue_weight.shape[0]
+
+
+@dataclasses.dataclass
+class SnapshotIndex:
+    """Host-side decode tables: ordinal -> object, for actuation."""
+
+    tasks: List[TaskInfo]
+    nodes: List[NodeInfo]
+    jobs: List[JobInfo]
+    queues: List[QueueInfo]
+    port_universe: List[int]
+
+
+@dataclasses.dataclass
+class Snapshot:
+    tensors: SnapshotTensors
+    index: SnapshotIndex
+
+
+def _constraint_signature(t: TaskInfo) -> Tuple:
+    return (
+        tuple(sorted(t.node_selector.items())),
+        tuple(sorted((tl.key, tl.operator, tl.value, tl.effect) for tl in t.tolerations)),
+    )
+
+
+def _property_signature(n: NodeInfo) -> Tuple:
+    return (
+        tuple(sorted(n.labels.items())),
+        tuple(sorted((tn.key, tn.value, tn.effect) for tn in n.taints)),
+    )
+
+
+def _selector_matches(selector: Dict[str, str], labels: Dict[str, str]) -> bool:
+    """PodMatchNodeSelector subset: every selector k=v present in labels
+    (predicates.go:130-141; full affinity expressions arrive with the
+    pod-affinity stage)."""
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+def _tolerates_all(task: TaskInfo, node: NodeInfo) -> bool:
+    """PodToleratesNodeTaints: every NoSchedule/NoExecute taint must be
+    tolerated (predicates.go:172-183)."""
+    for taint in node.taints:
+        if taint.effect == "PreferNoSchedule":
+            continue
+        if not any(tol.tolerates(taint) for tol in task.tolerations):
+            return False
+    return True
+
+
+def _ports_mask(ports, universe_pos: Dict[int, int]) -> np.ndarray:
+    mask = np.zeros(MAX_PORT_WORDS, dtype=np.int32)
+    for p in ports:
+        pos = universe_pos[p]
+        mask[pos // 31] |= np.int32(1 << (pos % 31))
+    return mask
+
+
+def build_snapshot(cluster: ClusterInfo) -> Snapshot:
+    """Flatten ClusterInfo into SnapshotTensors + decode index."""
+    queues = sorted(cluster.queues.values(), key=lambda q: q.uid)
+    jobs = sorted(cluster.jobs.values(), key=lambda j: j.uid)
+    nodes = sorted(cluster.nodes.values(), key=lambda n: n.name)
+    tasks: List[TaskInfo] = []
+    for j in jobs:
+        tasks.extend(sorted(j.tasks.values(), key=lambda t: t.uid))
+
+    for i, q in enumerate(queues):
+        q.ordinal = i
+    for i, j in enumerate(jobs):
+        j.ordinal = i
+    for i, n in enumerate(nodes):
+        n.ordinal = i
+    for i, t in enumerate(tasks):
+        t.ordinal = i
+
+    queue_ord = {q.uid: q.ordinal for q in queues}
+    node_ord = {n.name: n.ordinal for n in nodes}
+
+    T = _bucket(len(tasks), 8, 8)
+    N = _bucket(len(nodes), 128, 128)
+    J = _bucket(len(jobs), 8, 8)
+    Q = _bucket(len(queues), 8, 8)
+    R = res.NUM_RESOURCES
+    W = MAX_PORT_WORDS
+
+    # --- predicate equivalence classes ---
+    task_sigs: Dict[Tuple, int] = {}
+    task_klass = np.zeros(T, dtype=np.int32)
+    t_rep: Dict[int, TaskInfo] = {}
+    for t in tasks:
+        sig = _constraint_signature(t)
+        c = task_sigs.setdefault(sig, len(task_sigs))
+        t_rep.setdefault(c, t)
+        task_klass[t.ordinal] = c
+    node_sigs: Dict[Tuple, int] = {}
+    node_klass = np.zeros(N, dtype=np.int32)
+    n_rep: Dict[int, NodeInfo] = {}
+    for n in nodes:
+        sig = _property_signature(n)
+        c = node_sigs.setdefault(sig, len(node_sigs))
+        n_rep.setdefault(c, n)
+        node_klass[n.ordinal] = c
+
+    CT, CN = max(1, len(task_sigs)), max(1, len(node_sigs))
+    # one representative per class is enough — that is the whole point
+    class_fit = np.ones((CT, CN), dtype=bool)
+    for ct, trep in t_rep.items():
+        for cn, nrep in n_rep.items():
+            class_fit[ct, cn] = _selector_matches(trep.node_selector, nrep.labels) and _tolerates_all(
+                trep, nrep
+            )
+
+    # --- host-port universe ---
+    universe: List[int] = sorted(
+        {p for t in tasks for p in t.host_ports}
+        | {p for n in nodes for tt in n.tasks.values() for p in tt.host_ports}
+    )
+    if len(universe) > MAX_PORT_WORDS * 31:
+        raise ValueError(
+            f"snapshot uses {len(universe)} distinct host ports; max {MAX_PORT_WORDS * 31}"
+        )
+    upos = {p: i for i, p in enumerate(universe)}
+
+    # --- task tensors ---
+    task_resreq = np.zeros((T, R), dtype=np.float32)
+    task_job = np.zeros(T, dtype=np.int32)
+    task_status = np.full(T, int(TaskStatus.UNKNOWN), dtype=np.int32)
+    task_priority = np.zeros(T, dtype=np.int32)
+    task_uid_rank = np.zeros(T, dtype=np.int32)
+    task_node = np.full(T, -1, dtype=np.int32)
+    task_ports = np.zeros((T, W), dtype=np.int32)
+    task_valid = np.zeros(T, dtype=bool)
+    task_best_effort = np.zeros(T, dtype=bool)
+
+    uid_sorted = sorted(tasks, key=lambda t: t.uid)
+    for rank, t in enumerate(uid_sorted):
+        task_uid_rank[t.ordinal] = rank
+    job_of_task: Dict[str, int] = {}
+    for j in jobs:
+        for t in j.tasks.values():
+            job_of_task[t.uid] = j.ordinal
+    for t in tasks:
+        i = t.ordinal
+        task_resreq[i] = to_device_units(t.resreq)
+        task_job[i] = job_of_task[t.uid]
+        task_status[i] = int(t.status)
+        task_priority[i] = t.priority
+        task_node[i] = node_ord.get(t.node_name, -1)
+        task_ports[i] = _ports_mask(t.host_ports, upos)
+        task_valid[i] = True
+        task_best_effort[i] = t.best_effort
+
+    # --- node tensors ---
+    node_idle = np.zeros((N, R), dtype=np.float32)
+    node_releasing = np.zeros((N, R), dtype=np.float32)
+    node_alloc = np.zeros((N, R), dtype=np.float32)
+    node_max_tasks = np.zeros(N, dtype=np.int32)
+    node_num_tasks = np.zeros(N, dtype=np.int32)
+    node_ports = np.zeros((N, W), dtype=np.int32)
+    node_unsched = np.zeros(N, dtype=bool)
+    node_valid = np.zeros(N, dtype=bool)
+    for n in nodes:
+        i = n.ordinal
+        node_idle[i] = to_device_units(n.idle)
+        node_releasing[i] = to_device_units(n.releasing)
+        node_alloc[i] = to_device_units(n.allocatable)
+        node_max_tasks[i] = n.max_tasks
+        node_num_tasks[i] = len(n.tasks)
+        for t in n.tasks.values():
+            node_ports[i] |= _ports_mask(t.host_ports, upos)
+        node_unsched[i] = n.unschedulable
+        node_valid[i] = True
+
+    # --- job tensors ---
+    job_queue = np.zeros(J, dtype=np.int32)
+    job_min_available = np.zeros(J, dtype=np.int32)
+    job_priority = np.zeros(J, dtype=np.int32)
+    job_creation_rank = np.zeros(J, dtype=np.int32)
+    job_valid = np.zeros(J, dtype=bool)
+    for rank, j in enumerate(sorted(jobs, key=lambda j: (j.creation_ts, j.uid))):
+        job_creation_rank[j.ordinal] = rank
+    for j in jobs:
+        i = j.ordinal
+        job_queue[i] = queue_ord.get(j.queue_uid, 0)
+        job_min_available[i] = j.min_available
+        job_priority[i] = j.priority
+        job_valid[i] = j.queue_uid in queue_ord
+
+    # --- queue tensors ---
+    queue_weight = np.zeros(Q, dtype=np.float32)
+    # queues were ordinal-assigned in uid order, so uid rank == ordinal
+    queue_uid_rank = np.arange(Q, dtype=np.int32)
+    queue_valid = np.zeros(Q, dtype=bool)
+    for q in queues:
+        queue_weight[q.ordinal] = float(q.weight)
+        queue_valid[q.ordinal] = True
+
+    others_used = to_device_units(res.sum_resources(t.resreq for t in cluster.others)) if cluster.others else np.zeros(R, dtype=np.float32)
+
+    tensors = SnapshotTensors(
+        task_resreq=task_resreq,
+        task_job=task_job,
+        task_status=task_status,
+        task_priority=task_priority,
+        task_uid_rank=task_uid_rank,
+        task_klass=task_klass,
+        task_node=task_node,
+        task_ports=task_ports,
+        task_valid=task_valid,
+        task_best_effort=task_best_effort,
+        node_idle=node_idle,
+        node_releasing=node_releasing,
+        node_alloc=node_alloc,
+        node_max_tasks=node_max_tasks,
+        node_num_tasks=node_num_tasks,
+        node_klass=node_klass,
+        node_ports=node_ports,
+        node_unsched=node_unsched,
+        node_valid=node_valid,
+        job_queue=job_queue,
+        job_min_available=job_min_available,
+        job_priority=job_priority,
+        job_creation_rank=job_creation_rank,
+        job_valid=job_valid,
+        queue_weight=queue_weight,
+        queue_uid_rank=queue_uid_rank,
+        queue_valid=queue_valid,
+        class_fit=class_fit,
+        others_used=others_used,
+    )
+    index = SnapshotIndex(tasks=tasks, nodes=nodes, jobs=jobs, queues=queues, port_universe=universe)
+    return Snapshot(tensors=tensors, index=index)

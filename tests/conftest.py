@@ -1,0 +1,11 @@
+"""Test config: force an 8-device virtual CPU platform before JAX import.
+
+Multi-chip sharding is tested on a virtual CPU mesh (the driver separately
+dry-runs the multi-chip path); the real TPU chip is only used by bench.py.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,90 @@
+"""Data-model accounting tests.
+
+Ports the intent of the reference's ``api/job_info_test.go`` /
+``api/node_info_test.go``: Add/Remove task arithmetic on Idle/Used/
+Releasing, epsilon comparison behavior, and gang readiness counting.
+"""
+import numpy as np
+import pytest
+
+from kube_arbitrator_tpu.api import TaskStatus, resource as res
+from kube_arbitrator_tpu.cache import SimCluster
+
+
+def test_epsilon_less_equal():
+    a = res.make(1000, 1024**3, 0)
+    assert res.less_equal(a, a)  # equal fits (within epsilon)
+    # 9 milli-cpu over: still fits (eps = 10 milli)
+    assert res.less_equal(res.make(1009, 1024**3, 0), a)
+    # 11 milli-cpu over: does not fit
+    assert not res.less_equal(res.make(1011, 1024**3, 0), a)
+    # 9 MiB of memory over: fits
+    assert res.less_equal(res.make(1000, 1024**3 + 9 * 1024**2, 0), a)
+    assert not res.less_equal(res.make(1000, 1024**3 + 11 * 1024**2, 0), a)
+
+
+def test_is_empty_epsilon():
+    assert res.is_empty(res.make(9, 9 * 1024**2, 9))
+    assert not res.is_empty(res.make(11, 0, 0))
+
+
+def test_sub_checked_panics_like_reference():
+    with pytest.raises(ValueError):
+        res.sub_checked(res.make(100, 0, 0), res.make(200, 0, 0))
+
+
+def test_node_add_remove_task_accounting():
+    sim = SimCluster()
+    n = sim.add_node("n1", cpu_milli=8000, memory=16 * 1024**3)
+    q = sim.add_queue("default")
+    j = sim.add_job("j1")
+    t = sim.add_task(j, 2000, 4 * 1024**3, status=TaskStatus.RUNNING, node="n1")
+    np.testing.assert_allclose(n.idle, res.make(6000, 12 * 1024**3, 0))
+    np.testing.assert_allclose(n.used, res.make(2000, 4 * 1024**3, 0))
+    n.remove_task(t)
+    np.testing.assert_allclose(n.idle, res.make(8000, 16 * 1024**3, 0))
+    np.testing.assert_allclose(n.used, res.zeros())
+
+
+def test_node_releasing_accounting():
+    """Releasing tasks subtract idle AND count releasing; pipelined tasks
+    consume releasing (node_info.go:101-127)."""
+    sim = SimCluster()
+    n = sim.add_node("n1", cpu_milli=8000, memory=16 * 1024**3)
+    j = sim.add_job("j1")
+    t = sim.add_task(j, 2000, 4 * 1024**3, status=TaskStatus.RELEASING, node="n1")
+    np.testing.assert_allclose(n.releasing, res.make(2000, 4 * 1024**3, 0))
+    np.testing.assert_allclose(n.idle, res.make(6000, 12 * 1024**3, 0))
+    # a pipelined task consumes the releasing budget
+    t2 = sim.add_task(j, 2000, 4 * 1024**3, status=TaskStatus.PIPELINED, node="n1")
+    np.testing.assert_allclose(n.releasing, res.zeros())
+
+
+def test_node_oversubscription_raises():
+    sim = SimCluster()
+    sim.add_node("n1", cpu_milli=1000, memory=1024**3)
+    j = sim.add_job("j1")
+    with pytest.raises(ValueError):
+        sim.add_task(j, 2000, 0, status=TaskStatus.RUNNING, node="n1")
+
+
+def test_gang_ready_and_valid_counts():
+    sim = SimCluster()
+    sim.add_node("n1", cpu_milli=8000, memory=16 * 1024**3)
+    j = sim.add_job("j1", min_available=3)
+    sim.add_task(j, 1000, 1024**3)  # pending: valid, not ready
+    sim.add_task(j, 1000, 1024**3, status=TaskStatus.RUNNING, node="n1")
+    sim.add_task(j, 1000, 1024**3, status=TaskStatus.SUCCEEDED)
+    assert j.ready_task_num() == 2
+    assert j.valid_task_num() == 3
+    assert not j.is_ready()
+    assert j.is_valid()
+
+
+def test_dominant_share():
+    total = res.make(10000, 100 * 1024**3, 10000)
+    alloc = res.make(1000, 50 * 1024**3, 0)
+    assert res.dominant_share(alloc, total) == pytest.approx(0.5)
+    # zero-total resource: share = 1 if allocated (helpers.go:38-48)
+    total0 = res.make(10000, 100 * 1024**3, 0)
+    assert res.dominant_share(res.make(0, 0, 1), total0) == 1.0

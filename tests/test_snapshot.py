@@ -1,0 +1,105 @@
+"""Snapshot tensorization tests: padding, units, equivalence classes."""
+import numpy as np
+
+from kube_arbitrator_tpu.api import TaskStatus, Taint, Toleration
+from kube_arbitrator_tpu.cache import SimCluster, build_snapshot
+
+
+def _mk_basic():
+    sim = SimCluster()
+    sim.add_queue("default", weight=2)
+    sim.add_node("n1", cpu_milli=4000, memory=8 * 1024**3)
+    sim.add_node("n2", cpu_milli=2000, memory=4 * 1024**3)
+    j = sim.add_job("j1", queue="default", min_available=2)
+    sim.add_task(j, 1000, 1024**3)
+    sim.add_task(j, 1000, 1024**3)
+    return sim
+
+
+def test_shapes_and_padding():
+    snap = build_snapshot(_mk_basic().cluster)
+    t = snap.tensors
+    assert t.num_nodes == 128  # padded to lane width
+    assert t.num_tasks == 8
+    assert int(t.node_valid.sum()) == 2
+    assert int(t.task_valid.sum()) == 2
+    assert bool(t.task_valid[0]) and not bool(t.task_valid[2])
+
+
+def test_device_units_and_idle():
+    snap = build_snapshot(_mk_basic().cluster)
+    t = snap.tensors
+    # memory is in MiB on device
+    np.testing.assert_allclose(t.node_alloc[0], [4000.0, 8192.0, 0.0])
+    np.testing.assert_allclose(t.task_resreq[0], [1000.0, 1024.0, 0.0])
+
+
+def test_running_task_affects_idle_and_counts():
+    sim = _mk_basic()
+    j2 = sim.add_job("j2")
+    sim.add_task(j2, 1000, 1024**3, status=TaskStatus.RUNNING, node="n1")
+    snap = build_snapshot(sim.cluster)
+    t = snap.tensors
+    n1 = next(n.ordinal for n in snap.index.nodes if n.name == "n1")
+    np.testing.assert_allclose(t.node_idle[n1], [3000.0, 7168.0, 0.0])
+    assert int(t.node_num_tasks[n1]) == 1
+    # the running task's node ordinal is recorded
+    running = [i for i, ti in enumerate(snap.index.tasks) if ti.status == TaskStatus.RUNNING]
+    assert len(running) == 1
+    assert int(t.task_node[running[0]]) == n1
+
+
+def test_equivalence_classes_selector_taints():
+    sim = SimCluster()
+    sim.add_queue("default")
+    sim.add_node("gpu-node", labels={"accel": "tpu"}, taints=[Taint("dedicated", "ml", "NoSchedule")])
+    sim.add_node("plain-node")
+    j = sim.add_job("j1")
+    t_sel = sim.add_task(j, 100, 0, node_selector={"accel": "tpu"})
+    t_tol = sim.add_task(
+        j, 100, 0, node_selector={"accel": "tpu"},
+        tolerations=[Toleration(key="dedicated", operator="Equal", value="ml", effect="NoSchedule")],
+    )
+    t_plain = sim.add_task(j, 100, 0)
+    snap = build_snapshot(sim.cluster)
+    t = snap.tensors
+    cf = np.asarray(t.class_fit)
+    ords = {ti.uid: ti.ordinal for ti in snap.index.tasks}
+    nords = {ni.name: ni.ordinal for ni in snap.index.nodes}
+    tk = np.asarray(t.task_klass)
+    nk = np.asarray(t.node_klass)
+
+    def fits(task, node):
+        return bool(cf[tk[ords[task.uid]], nk[nords[node]]])
+
+    # selector matches gpu-node but taint not tolerated -> no fit
+    assert not fits(t_sel, "gpu-node")
+    # toleration + selector -> fits gpu-node only
+    assert fits(t_tol, "gpu-node")
+    assert not fits(t_tol, "plain-node")  # selector mismatch
+    # plain task fits the plain node, not the tainted one
+    assert fits(t_plain, "plain-node")
+    assert not fits(t_plain, "gpu-node")
+
+
+def test_host_ports_bitmasks():
+    sim = SimCluster()
+    sim.add_queue("default")
+    sim.add_node("n1")
+    j = sim.add_job("j1")
+    t1 = sim.add_task(j, 100, 0, host_ports=[8080])
+    t2 = sim.add_task(j, 100, 0, host_ports=[8080, 9090], status=TaskStatus.RUNNING, node="n1")
+    snap = build_snapshot(sim.cluster)
+    t = snap.tensors
+    o1 = next(ti.ordinal for ti in snap.index.tasks if ti.uid == t1.uid)
+    n1 = next(ni.ordinal for ni in snap.index.nodes if ni.name == "n1")
+    # node n1's port mask includes t2's ports; t1 conflicts on 8080
+    conflict = np.bitwise_and(np.asarray(t.task_ports[o1]), np.asarray(t.node_ports[n1]))
+    assert conflict.any()
+
+
+def test_others_usage():
+    sim = _mk_basic()
+    sim.add_other_task("n2", cpu_milli=500, memory=1024**3)
+    snap = build_snapshot(sim.cluster)
+    np.testing.assert_allclose(snap.tensors.others_used, [500.0, 1024.0, 0.0])
