@@ -1,0 +1,28 @@
+"""Decode device decisions back into host-side intents (actuation plane)."""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+from .sim import BindIntent, EvictIntent
+from .snapshot import Snapshot
+
+
+def decode_decisions(snap: Snapshot, decisions) -> Tuple[List[BindIntent], List[EvictIntent]]:
+    """CycleDecisions tensors -> bind/evict intents keyed by task uid."""
+    bind_mask = np.asarray(decisions.bind_mask)
+    evict_mask = np.asarray(decisions.evict_mask)
+    task_node = np.asarray(decisions.task_node)
+    binds: List[BindIntent] = []
+    evicts: List[EvictIntent] = []
+    for i in np.nonzero(bind_mask)[0]:
+        binds.append(
+            BindIntent(
+                task_uid=snap.index.tasks[i].uid,
+                node_name=snap.index.nodes[task_node[i]].name,
+            )
+        )
+    for i in np.nonzero(evict_mask)[0]:
+        evicts.append(EvictIntent(task_uid=snap.index.tasks[i].uid))
+    return binds, evicts

@@ -72,6 +72,23 @@ class SnapshotTensors:
     task_ports: jax.Array       # i32[T, W] host-port bitmask
     task_valid: jax.Array       # bool[T] not padding
     task_best_effort: jax.Array  # bool[T] resreq empty (epsilon-wise)
+    # ---- task groups [G] ----
+    # Tasks of one job with identical (resreq, predicate class, ports,
+    # priority, best-effort) are interchangeable; the allocate kernel places
+    # groups by *count*, which is what makes placement O(G·N) instead of
+    # O(T·N).  task_group_rank orders tasks within a group by UID so the
+    # count → concrete-task decode is deterministic.
+    task_group: jax.Array       # i32[T] group ordinal
+    task_group_rank: jax.Array  # i32[T] rank within group (by uid)
+    group_job: jax.Array        # i32[G] job ordinal
+    group_resreq: jax.Array     # f32[G, R]
+    group_klass: jax.Array      # i32[G]
+    group_ports: jax.Array      # i32[G, W]
+    group_size: jax.Array       # i32[G] number of PENDING tasks in group
+    group_priority: jax.Array   # i32[G]
+    group_uid_rank: jax.Array   # i32[G] min task uid rank (tiebreak)
+    group_best_effort: jax.Array  # bool[G]
+    group_valid: jax.Array      # bool[G]
     # ---- nodes [N] ----
     node_idle: jax.Array        # f32[N, R]
     node_releasing: jax.Array   # f32[N, R]
@@ -104,6 +121,10 @@ class SnapshotTensors:
     @property
     def num_nodes(self) -> int:
         return self.node_idle.shape[0]
+
+    @property
+    def num_groups(self) -> int:
+        return self.group_job.shape[0]
 
     @property
     def num_jobs(self) -> int:
@@ -266,6 +287,53 @@ def build_snapshot(cluster: ClusterInfo) -> Snapshot:
         task_valid[i] = True
         task_best_effort[i] = t.best_effort
 
+    # --- task groups (pending tasks only; the allocate unit) ---
+    group_key_to_ord: Dict[Tuple, int] = {}
+    group_members: List[List[TaskInfo]] = []
+    for t in tasks:
+        if t.status != TaskStatus.PENDING:
+            continue
+        key = (
+            job_of_task[t.uid],
+            tuple(np.round(t.resreq, 6)),
+            int(task_klass[t.ordinal]),
+            t.host_ports,
+            t.priority,
+            t.best_effort,
+        )
+        g = group_key_to_ord.setdefault(key, len(group_members))
+        if g == len(group_members):
+            group_members.append([])
+        group_members[g].append(t)
+
+    G = _bucket(len(group_members), 8, 8)
+    task_group = np.full(T, -1, dtype=np.int32)
+    task_group_rank = np.zeros(T, dtype=np.int32)
+    group_job = np.zeros(G, dtype=np.int32)
+    group_resreq = np.zeros((G, R), dtype=np.float32)
+    group_klass = np.zeros(G, dtype=np.int32)
+    group_ports_arr = np.zeros((G, W), dtype=np.int32)
+    group_size = np.zeros(G, dtype=np.int32)
+    group_priority = np.zeros(G, dtype=np.int32)
+    group_uid_rank = np.zeros(G, dtype=np.int32)
+    group_best_effort = np.zeros(G, dtype=bool)
+    group_valid = np.zeros(G, dtype=bool)
+    for g, members in enumerate(group_members):
+        members.sort(key=lambda t: task_uid_rank[t.ordinal])
+        for rank, t in enumerate(members):
+            task_group[t.ordinal] = g
+            task_group_rank[t.ordinal] = rank
+        rep = members[0]
+        group_job[g] = job_of_task[rep.uid]
+        group_resreq[g] = to_device_units(rep.resreq)
+        group_klass[g] = task_klass[rep.ordinal]
+        group_ports_arr[g] = _ports_mask(rep.host_ports, upos)
+        group_size[g] = len(members)
+        group_priority[g] = rep.priority
+        group_uid_rank[g] = task_uid_rank[rep.ordinal]
+        group_best_effort[g] = rep.best_effort
+        group_valid[g] = True
+
     # --- node tensors ---
     node_idle = np.zeros((N, R), dtype=np.float32)
     node_releasing = np.zeros((N, R), dtype=np.float32)
@@ -324,6 +392,17 @@ def build_snapshot(cluster: ClusterInfo) -> Snapshot:
         task_ports=task_ports,
         task_valid=task_valid,
         task_best_effort=task_best_effort,
+        task_group=task_group,
+        task_group_rank=task_group_rank,
+        group_job=group_job,
+        group_resreq=group_resreq,
+        group_klass=group_klass,
+        group_ports=group_ports_arr,
+        group_size=group_size,
+        group_priority=group_priority,
+        group_uid_rank=group_uid_rank,
+        group_best_effort=group_best_effort,
+        group_valid=group_valid,
         node_idle=node_idle,
         node_releasing=node_releasing,
         node_alloc=node_alloc,

@@ -1,0 +1,24 @@
+"""Decision-plane kernels (JAX/XLA)."""
+from .allocate import AllocState, SessionCtx, allocate_action, backfill_action
+from .cycle import CycleDecisions, open_session, schedule_cycle
+from .fairness import drf_shares, overused, proportion_deserved, queue_shares
+from .ordering import DEFAULT_ACTIONS, DEFAULT_TIERS, PluginOption, Tier, Tiers
+
+__all__ = [
+    "AllocState",
+    "SessionCtx",
+    "allocate_action",
+    "backfill_action",
+    "CycleDecisions",
+    "open_session",
+    "schedule_cycle",
+    "drf_shares",
+    "overused",
+    "proportion_deserved",
+    "queue_shares",
+    "DEFAULT_ACTIONS",
+    "DEFAULT_TIERS",
+    "PluginOption",
+    "Tier",
+    "Tiers",
+]

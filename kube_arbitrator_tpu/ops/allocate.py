@@ -1,0 +1,348 @@
+"""The allocate action as a batched-greedy XLA kernel.
+
+Reference behavior (``actions/allocate/allocate.go:41-176``): a strictly
+sequential loop — pop min-share queue, pop best job, pop best task, linear
+scan of all nodes, allocate one task, reorder, repeat.  O(tasks × nodes)
+with Python^W Go-level sequencing.
+
+TPU-first re-design: **fairness-budgeted group rounds**.
+
+* Tasks are pre-grouped (snapshot) into interchangeable (job, resreq,
+  class, ports, priority) groups, so placement is count-based.
+* Each *round* processes every schedulable queue once (in current
+  share order — the tensor analog of the queue priority-queue).  For a
+  queue, the top job and its top group are selected by the tiered
+  lexicographic keys, then up to B tasks are placed at once, where B is the
+  *fairness budget*: the number of tasks the sequential loop would have
+  granted this job before the ordering would switch away from it —
+  min(tasks-to-gang-ready, tasks-until-DRF-share-crosses-the-next-job,
+  tasks-until-queue-hits-its-deserved, group remainder, S_MAX).
+* Multi-placement across nodes is closed-form: per node the copy capacity
+  k_n = min_r floor((idle+eps)/req_r) (also pod-count and port caps), and a
+  prefix-sum over the node order admits p_n = clip(B - cum_before, 0, k_n)
+  copies — no per-task loop anywhere.
+* If nothing idle-fits, the round falls back to *releasing* capacity and
+  marks tasks Pipelined (session.go:205-241's ssn.Pipeline), which counts
+  toward gang readiness and fairness shares exactly like Allocate
+  (both fire AllocateFunc — session.go:232-241,275-281).
+
+Equivalence with the sequential loop is invariant-based (no
+oversubscription, gang atomicity, fairness monotonicity, determinism), not
+bind-for-bind; SURVEY §7 "hard parts" discusses why.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..api.types import TaskStatus
+from ..cache.snapshot import SnapshotTensors
+from .common import BIG, EPS, ceil_div_pos, lex_argmin, safe_share
+from .fairness import drf_shares, overused, queue_shares
+from .ordering import Tiers, group_order_keys, job_order_keys, queue_order_keys
+
+ALLOCATED = jnp.int32(int(TaskStatus.ALLOCATED))
+PIPELINED = jnp.int32(int(TaskStatus.PIPELINED))
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class AllocState:
+    """Mutable per-cycle scheduling state threaded through rounds."""
+
+    task_status: jax.Array   # i32[T]
+    task_node: jax.Array     # i32[T]
+    node_idle: jax.Array     # f32[N, R]
+    node_releasing: jax.Array  # f32[N, R]
+    node_ports: jax.Array    # i32[N, W]
+    node_num_tasks: jax.Array  # i32[N]
+    job_alloc: jax.Array     # f32[J, R] allocated (incl. pipelined) by job
+    queue_alloc: jax.Array   # f32[Q, R] ditto by queue
+    job_ready_cnt: jax.Array  # i32[J] tasks counting toward gang readiness
+    group_placed: jax.Array  # i32[G] pending tasks placed this cycle
+    # Groups proven unplaceable in the current action.  Resources only
+    # shrink during allocate, so a group that cannot place its budget (even
+    # via the releasing fallback) can never place later this action — the
+    # tensor analog of the sequential loop discarding popped-but-unassigned
+    # tasks for the cycle (allocate.go:105-171).
+    group_unfit: jax.Array   # bool[G]
+    progress: jax.Array      # bool scalar — placements in current round
+    rounds: jax.Array        # i32 scalar
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SessionCtx:
+    """Quantities fixed for the whole cycle (OnSessionOpen equivalents)."""
+
+    drf_total: jax.Array      # f32[R] sum of node allocatable (drf.go:55-58)
+    deserved: jax.Array       # f32[Q, R] proportion water-fill result
+    job_sched_valid: jax.Array  # bool[J] gang JobValid filter (session.go:85-106)
+    # Effective gang minMember: zeros when the gang plugin is disabled
+    # (JobReadyFn then trivially passes — session_plugins.go:158-176).
+    min_avail: jax.Array      # i32[J]
+
+
+def _status_in(status: jax.Array, members) -> jax.Array:
+    m = jnp.zeros_like(status, dtype=bool)
+    for s in members:
+        m = m | (status == int(s))
+    return m
+
+
+def _node_capacity(
+    avail: jax.Array,  # f32[N, R] idle or releasing
+    req: jax.Array,  # f32[R]
+    ok: jax.Array,  # bool[N] static feasibility
+    pods_head: jax.Array,  # i32[N]
+    single_per_node: jax.Array,  # bool scalar (host-port groups)
+) -> jax.Array:
+    """i32[N]: copies of ``req`` placeable per node."""
+    per_r = jnp.where(req[None, :] > 0, (avail + EPS) / jnp.maximum(req[None, :], 1e-30), BIG)
+    k = jnp.floor(jnp.min(per_r, axis=-1))
+    k = jnp.minimum(k, pods_head.astype(jnp.float32))
+    k = jnp.where(single_per_node, jnp.minimum(k, 1.0), k)
+    k = jnp.where(ok, k, 0.0)
+    return jnp.maximum(k, 0.0).astype(jnp.int32)
+
+
+def _process_queue(
+    q: jax.Array,
+    st: SnapshotTensors,
+    sess: SessionCtx,
+    state: AllocState,
+    tiers: Tiers,
+    s_max: int,
+    best_effort_pass: bool,
+) -> AllocState:
+    """One queue's turn within a round. All control flow is mask-based so a
+    skipped queue is a no-op state pass-through."""
+    J = st.num_jobs
+    G = st.num_groups
+
+    if best_effort_pass:
+        # backfill has no queue-fairness gating (backfill.go:40-71)
+        q_ok = st.queue_valid[q]
+    else:
+        q_over = overused(state.queue_alloc, sess.deserved)[q]
+        q_ok = st.queue_valid[q] & ~q_over
+
+    # ---- job selection (ssn.JobOrderFn over the queue's jobs) ----
+    job_ready = state.job_ready_cnt >= sess.min_avail
+    grp_remaining = st.group_size - state.group_placed
+    grp_elig = (
+        st.group_valid
+        & (st.group_best_effort == best_effort_pass)
+        & (grp_remaining > 0)
+        & ~state.group_unfit
+        & sess.job_sched_valid[st.group_job]
+    )
+    job_has_pending = jnp.zeros(J, dtype=bool).at[st.group_job].max(grp_elig)
+    jmask = (st.job_queue == q) & job_has_pending & st.job_valid & q_ok
+    job_share = drf_shares(state.job_alloc, sess.drf_total)
+    jkeys = job_order_keys(
+        tiers, st.job_priority, job_ready, st.job_creation_rank, job_share
+    )
+    j, has_job = lex_argmin(jkeys, jmask)
+
+    # ---- group selection (ssn.TaskOrderFn within the job) ----
+    gmask = (st.group_job == j) & grp_elig & has_job
+    gkeys = group_order_keys(tiers, st.group_priority, st.group_uid_rank)
+    g, has_grp = lex_argmin(gkeys, gmask)
+
+    req = st.group_resreq[g]  # [R]
+
+    # ---- fairness budget B ----
+    if best_effort_pass:
+        budget = jnp.int32(s_max)
+    else:
+        b_gang = jnp.where(
+            job_ready[j],
+            s_max,
+            jnp.maximum(sess.min_avail[j] - state.job_ready_cnt[j], 1),
+        )
+        # DRF: tasks until this job's share reaches the next contender's.
+        others = (
+            jmask
+            & (jnp.arange(J) != j)
+            & (st.job_priority == st.job_priority[j])
+            & (job_ready == job_ready[j])
+        )
+        s2 = jnp.min(jnp.where(others, job_share, BIG))
+        delta = jnp.max(safe_share(req, sess.drf_total))
+        b_drf = jnp.where(
+            (s2 >= BIG / 2) | (delta <= 0),
+            s_max,
+            ceil_div_pos(jnp.maximum(s2 - job_share[j], 0.0), delta) + 1,
+        )
+        # proportion: the t-th task is granted iff the queue is not yet
+        # overused before it, i.e. some resource still has
+        # deserved >= alloc + (t-1)*req + eps (check-before-pop,
+        # allocate.go:71-74 + proportion.go:188-193).  Max t is
+        # 1 + max_r floor((deserved - alloc - eps)/req_r); resources the
+        # group doesn't request keep the queue un-overused forever.
+        d_minus_a = sess.deserved[q] - state.queue_alloc[q]
+        f_r = jnp.where(
+            req > 0,
+            jnp.floor((d_minus_a - EPS) / jnp.maximum(req, 1e-30)),
+            jnp.where(d_minus_a >= EPS, BIG, -1.0),
+        )
+        t_max = jnp.max(f_r) + 1.0
+        b_queue = jnp.where(t_max >= BIG / 2, s_max, jnp.maximum(t_max, 1.0)).astype(jnp.int32)
+        budget = jnp.minimum(jnp.minimum(b_gang, b_drf), b_queue)
+    budget = jnp.clip(budget, 0, s_max)
+    budget = jnp.where(has_grp, jnp.minimum(budget, grp_remaining[g]), 0)
+
+    # ---- static feasibility on nodes (predicates minus resources) ----
+    # The predicates plugin owns selector/taint/port/max-pod/unschedulable
+    # checks (predicates.go:34-204); disabling it leaves only node validity
+    # and the resource fit that allocate itself performs.
+    preds_on = any(
+        p.name == "predicates" and not p.predicate_disabled
+        for tier in tiers
+        for p in tier.plugins
+    )
+    if preds_on:
+        static_ok = (
+            st.class_fit[st.group_klass[g], st.node_klass]
+            & st.node_valid
+            & ~st.node_unsched
+        )
+        ports_ok = jnp.all((st.group_ports[g][None, :] & state.node_ports) == 0, axis=-1)
+        pods_head = st.node_max_tasks - state.node_num_tasks
+        ok = static_ok & ports_ok & (pods_head > 0)
+        has_ports = jnp.any(st.group_ports[g] != 0)
+    else:
+        pods_head = jnp.full_like(state.node_num_tasks, s_max)
+        ok = st.node_valid
+        has_ports = jnp.array(False)
+
+    if best_effort_pass:
+        # backfill: no resource constraint (backfill.go:40-71)
+        k_idle = jnp.where(ok, jnp.minimum(pods_head, jnp.where(has_ports, 1, s_max)), 0).astype(
+            jnp.int32
+        )
+        use_rel = jnp.array(False)
+        k_eff = k_idle
+    else:
+        k_idle = _node_capacity(state.node_idle, req, ok, pods_head, has_ports)
+        total_idle_cap = jnp.sum(k_idle)
+        # pipeline fallback: only when nothing idle-fits anywhere
+        use_rel = (total_idle_cap == 0) & (budget > 0)
+        k_rel = _node_capacity(state.node_releasing, req, ok, pods_head, has_ports)
+        k_eff = jnp.where(use_rel, k_rel, k_idle)
+
+    cum = jnp.cumsum(k_eff)
+    placed_total = jnp.minimum(budget, cum[-1])
+    p = jnp.clip(placed_total - (cum - k_eff), 0, k_eff)  # i32[N]
+
+    # ---- decode: assign concrete tasks (group ranks) to node slots ----
+    placed_before = state.group_placed[g]
+    slots = jnp.arange(s_max)
+    node_of_slot = jnp.searchsorted(cum, slots, side="right").astype(jnp.int32)
+    slot_of_task = st.task_group_rank - placed_before
+    assigned = (
+        (st.task_group == g)
+        & (slot_of_task >= 0)
+        & (slot_of_task < placed_total)
+        & st.task_valid
+    )
+    tnode = node_of_slot[jnp.clip(slot_of_task, 0, s_max - 1)]
+    new_status = jnp.where(use_rel, PIPELINED, ALLOCATED)
+
+    # ---- state updates (no-ops when placed_total == 0) ----
+    pf = p.astype(jnp.float32)[:, None] * req[None, :]
+    ptf = placed_total.astype(jnp.float32) * req
+    port_upd = jnp.where(
+        ((p > 0) & has_ports)[:, None], state.node_ports | st.group_ports[g][None, :], state.node_ports
+    )
+    # capacity-limited (not budget-limited) groups can never place again
+    if best_effort_pass:
+        unfit_now = has_grp & (placed_total < budget)
+    else:
+        unfit_now = has_grp & use_rel & (placed_total < budget)
+    return AllocState(
+        task_status=jnp.where(assigned, new_status, state.task_status),
+        task_node=jnp.where(assigned, tnode, state.task_node),
+        node_idle=jnp.where(use_rel, state.node_idle, state.node_idle - pf),
+        node_releasing=jnp.where(use_rel, state.node_releasing - pf, state.node_releasing),
+        node_ports=port_upd,
+        node_num_tasks=state.node_num_tasks + p,
+        job_alloc=state.job_alloc.at[j].add(ptf),
+        queue_alloc=state.queue_alloc.at[q].add(ptf),
+        job_ready_cnt=state.job_ready_cnt.at[j].add(placed_total),
+        group_placed=state.group_placed.at[g].add(placed_total),
+        group_unfit=state.group_unfit.at[g].set(state.group_unfit[g] | unfit_now),
+        progress=state.progress | (placed_total > 0),
+        rounds=state.rounds,
+    )
+
+
+def _round(
+    st: SnapshotTensors,
+    sess: SessionCtx,
+    state: AllocState,
+    tiers: Tiers,
+    s_max: int,
+    best_effort_pass: bool,
+) -> AllocState:
+    Q = st.num_queues
+    # queue processing order from the tiered key stack (the tensor analog
+    # of allocate.go:45's queue priority-queue over ssn.QueueOrderFn)
+    q_share = queue_shares(state.queue_alloc, sess.deserved)
+    keys = queue_order_keys(tiers, q_share, st.queue_uid_rank)
+    keys = [jnp.where(st.queue_valid, k, BIG) for k in keys]
+    # jnp.lexsort treats the LAST key as primary
+    perm = jnp.lexsort(tuple(reversed(keys)))
+
+    def body(qi, s):
+        return _process_queue(perm[qi], st, sess, s, tiers, s_max, best_effort_pass)
+
+    state = jax.lax.fori_loop(0, Q, body, state)
+    return dataclasses.replace(state, rounds=state.rounds + 1)
+
+
+@partial(jax.jit, static_argnames=("tiers", "s_max", "max_rounds", "best_effort_pass"))
+def allocate_action(
+    st: SnapshotTensors,
+    sess: SessionCtx,
+    state: AllocState,
+    tiers: Tiers,
+    s_max: int = 4096,
+    max_rounds: int = 100_000,
+    best_effort_pass: bool = False,
+) -> AllocState:
+    """Run rounds until a full round places nothing (queues drained)."""
+
+    def cond(s: AllocState):
+        return s.progress & (s.rounds < max_rounds)
+
+    def body(s: AllocState):
+        s = dataclasses.replace(s, progress=jnp.array(False))
+        return _round(st, sess, s, tiers, s_max, best_effort_pass)
+
+    state = dataclasses.replace(
+        state,
+        progress=jnp.array(True),
+        rounds=jnp.int32(0),
+        group_unfit=jnp.zeros_like(state.group_unfit),
+    )
+    return jax.lax.while_loop(cond, body, state)
+
+
+def backfill_action(
+    st: SnapshotTensors,
+    sess: SessionCtx,
+    state: AllocState,
+    tiers: Tiers,
+    s_max: int = 4096,
+    max_rounds: int = 100_000,
+) -> AllocState:
+    """backfill.go:40-71: place BestEffort (empty-resreq) pending tasks on
+    any node passing the non-resource predicates."""
+    return allocate_action(
+        st, sess, state, tiers, s_max=s_max, max_rounds=max_rounds, best_effort_pass=True
+    )

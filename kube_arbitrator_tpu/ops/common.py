@@ -1,0 +1,60 @@
+"""Shared kernel utilities: epsilon math on device, lexicographic selection.
+
+Device-side mirror of api/resource.py's epsilon semantics (reference
+``resource_info.go:138-146``): in device units the slack is uniformly 10.0.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from ..cache.snapshot import DEVICE_EPSILON
+
+EPS = DEVICE_EPSILON
+BIG = jnp.float32(3.0e38)  # effectively +inf for f32 mins
+
+
+def fits(req: jnp.ndarray, avail: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    """Epsilon-slacked LessEqual: all(req < avail + EPS) along ``axis``."""
+    return jnp.all(req < avail + EPS, axis=axis)
+
+
+def is_empty_res(r: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    return jnp.all(r < EPS, axis=axis)
+
+
+def safe_share(alloc: jnp.ndarray, total: jnp.ndarray) -> jnp.ndarray:
+    """share with the reference's zero-total convention
+    (api/helpers/helpers.go:38-48)."""
+    return jnp.where(total > 0, alloc / jnp.maximum(total, 1e-30), jnp.where(alloc > 0, 1.0, 0.0))
+
+
+def dominant_share(alloc: jnp.ndarray, total: jnp.ndarray) -> jnp.ndarray:
+    """max_r share(alloc_r, total_r); alloc [..., R], total broadcastable."""
+    return jnp.max(safe_share(alloc, total), axis=-1)
+
+
+def lex_argmin(keys: Sequence[jnp.ndarray], mask: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Index of the lexicographically-smallest entry among ``mask``.
+
+    ``keys`` is an ordered sequence of equal-shape arrays — the tensor form
+    of the reference's tiered order functions (first non-zero comparison
+    wins, ``session_plugins.go:196-276``).  Works batched: keys may be
+    [..., M]; mask [..., M]; reduction along the last axis.
+
+    Returns (index, any_valid).  index is arbitrary (0) when no entry is
+    masked; callers must check any_valid.
+    """
+    cand = mask
+    for k in keys:
+        k = k.astype(jnp.float32)
+        kmin = jnp.min(jnp.where(cand, k, BIG), axis=-1, keepdims=True)
+        cand = cand & (jnp.where(cand, k, BIG) <= kmin)
+    any_valid = jnp.any(mask, axis=-1)
+    return jnp.argmax(cand, axis=-1), any_valid
+
+
+def ceil_div_pos(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """ceil(a/b) for positive b, as int32, clipped at >= 0."""
+    return jnp.maximum(jnp.ceil(a / jnp.maximum(b, 1e-30)), 0.0).astype(jnp.int32)

@@ -1,0 +1,169 @@
+"""The fused scheduling cycle: open session → actions → gang-masked commit.
+
+This is the decision-plane top level, the XLA program replacing the
+reference's ``Scheduler.runOnce`` (``scheduler.go:83-93``):
+OpenSession (plugin OnSessionOpen aggregates) → ordered actions → commit.
+
+The Statement/rollback machinery (``framework/statement.go``) disappears:
+decisions are computed speculatively in tensors and *committed by masking*
+— a job's new allocations produce bind intents only if the job ends the
+cycle gang-ready (session.go:283-290's dispatch-when-JobReady).  Nothing is
+actuated before the mask, so there is nothing to roll back.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..api.types import TaskStatus
+from ..cache.snapshot import SnapshotTensors
+from .allocate import (
+    AllocState,
+    SessionCtx,
+    _status_in,
+    allocate_action,
+    backfill_action,
+)
+from .fairness import proportion_deserved
+from .ordering import DEFAULT_ACTIONS, DEFAULT_TIERS, Tiers
+
+_READY_STATUSES = (
+    TaskStatus.ALLOCATED,
+    TaskStatus.BINDING,
+    TaskStatus.BOUND,
+    TaskStatus.RUNNING,
+    TaskStatus.SUCCEEDED,
+    TaskStatus.PIPELINED,
+)
+_ALLOC_STATUSES = (
+    TaskStatus.ALLOCATED,
+    TaskStatus.BINDING,
+    TaskStatus.BOUND,
+    TaskStatus.RUNNING,
+)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class CycleDecisions:
+    """Output of one cycle, ready for host-side actuation."""
+
+    task_node: jax.Array     # i32[T] assigned node ordinal (-1 none)
+    task_status: jax.Array   # i32[T] end-of-cycle session status
+    bind_mask: jax.Array     # bool[T] committed binds (gang-masked)
+    evict_mask: jax.Array    # bool[T] committed evictions (preempt/reclaim)
+    job_ready: jax.Array     # bool[J] gang readiness at close (jobStatus input)
+    # Diagnostics for the "why unschedulable" channel (job_info.go:329-358):
+    unready_alloc: jax.Array  # bool[T] allocated this cycle but uncommitted
+
+
+def _plugin_enabled(tiers: Tiers, name: str) -> bool:
+    return any(p.name == name for tier in tiers for p in tier.plugins)
+
+
+def open_session(st: SnapshotTensors, tiers: Tiers) -> Tuple[SessionCtx, AllocState]:
+    """OnSessionOpen equivalents: totals, water-fill, validity, initial
+    aggregates — all segment reductions over the snapshot."""
+    J, Q, R = st.num_jobs, st.num_queues, st.task_resreq.shape[1]
+
+    nv = st.node_valid[:, None]
+    drf_total = jnp.sum(jnp.where(nv, st.node_alloc, 0.0), axis=0)
+    # proportion subtracts other schedulers' usage (proportion.go:61-63)
+    prop_total = drf_total - st.others_used
+
+    tv = st.task_valid
+    alloc_now = _status_in(st.task_status, _ALLOC_STATUSES) & tv
+    ready_now = _status_in(st.task_status, _READY_STATUSES) & tv
+    valid_now = (ready_now | ((st.task_status == int(TaskStatus.PENDING)) & tv))
+    pending_now = (st.task_status == int(TaskStatus.PENDING)) & tv
+
+    res_or_0 = lambda m: jnp.where(m[:, None], st.task_resreq, 0.0)
+    job_alloc = jnp.zeros((J, R)).at[st.task_job].add(res_or_0(alloc_now))
+    job_req = jnp.zeros((J, R)).at[st.task_job].add(res_or_0(alloc_now | pending_now))
+    job_ready_cnt = jnp.zeros(J, jnp.int32).at[st.task_job].add(ready_now.astype(jnp.int32))
+    job_valid_cnt = jnp.zeros(J, jnp.int32).at[st.task_job].add(valid_now.astype(jnp.int32))
+
+    queue_alloc = jnp.zeros((Q, R)).at[st.job_queue].add(jnp.where(st.job_valid[:, None], job_alloc, 0.0))
+    queue_req = jnp.zeros((Q, R)).at[st.job_queue].add(jnp.where(st.job_valid[:, None], job_req, 0.0))
+
+    gang_ready_on = any(
+        p.name == "gang" and not p.job_ready_disabled for t in tiers for p in t.plugins
+    )
+    if _plugin_enabled(tiers, "gang"):
+        job_sched_valid = st.job_valid & (job_valid_cnt >= st.job_min_available)
+    else:
+        job_sched_valid = st.job_valid
+    if gang_ready_on:
+        min_avail = st.job_min_available
+    else:
+        # JobReadyFn absent -> trivially ready (session_plugins.go:158-176)
+        min_avail = jnp.zeros(J, jnp.int32)
+
+    if _plugin_enabled(tiers, "proportion"):
+        deserved = proportion_deserved(st.queue_weight, queue_req, prop_total, st.queue_valid)
+    else:
+        # no proportion plugin: queues are never overused, shares are 0
+        deserved = jnp.full((Q, R), jnp.float32(3.0e38))
+
+    sess = SessionCtx(
+        drf_total=drf_total,
+        deserved=deserved,
+        job_sched_valid=job_sched_valid,
+        min_avail=min_avail,
+    )
+    state = AllocState(
+        task_status=st.task_status,
+        task_node=st.task_node,
+        node_idle=st.node_idle,
+        node_releasing=st.node_releasing,
+        node_ports=st.node_ports,
+        node_num_tasks=st.node_num_tasks,
+        job_alloc=job_alloc,
+        queue_alloc=queue_alloc,
+        job_ready_cnt=job_ready_cnt,
+        group_placed=jnp.zeros(st.num_groups, jnp.int32),
+        group_unfit=jnp.zeros(st.num_groups, bool),
+        progress=jnp.array(False),
+        rounds=jnp.int32(0),
+    )
+    return sess, state
+
+
+@partial(jax.jit, static_argnames=("tiers", "actions", "s_max", "max_rounds"))
+def schedule_cycle(
+    st: SnapshotTensors,
+    tiers: Tiers = DEFAULT_TIERS,
+    actions: Tuple[str, ...] = DEFAULT_ACTIONS,
+    s_max: int = 4096,
+    max_rounds: int = 100_000,
+) -> CycleDecisions:
+    """One full scheduling cycle as a single jitted program."""
+    sess, state = open_session(st, tiers)
+
+    for action in actions:  # static unroll — the conf's ordered action list
+        if action == "allocate":
+            state = allocate_action(st, sess, state, tiers, s_max=s_max, max_rounds=max_rounds)
+        elif action == "backfill":
+            state = backfill_action(st, sess, state, tiers, s_max=s_max, max_rounds=max_rounds)
+        elif action in ("preempt", "reclaim"):
+            # staged next; see ops/preempt.py
+            pass
+        else:
+            raise ValueError(f"unknown action: {action}")
+
+    job_ready = state.job_ready_cnt >= sess.min_avail
+    was_pending = (st.task_status == int(TaskStatus.PENDING)) & st.task_valid
+    newly_alloc = was_pending & (state.task_status == int(TaskStatus.ALLOCATED))
+    bind_mask = newly_alloc & job_ready[st.task_job]
+    return CycleDecisions(
+        task_node=state.task_node,
+        task_status=state.task_status,
+        bind_mask=bind_mask,
+        evict_mask=jnp.zeros_like(bind_mask),
+        job_ready=job_ready,
+        unready_alloc=newly_alloc & ~job_ready[st.task_job],
+    )

@@ -1,0 +1,82 @@
+"""Fairness kernels: DRF dominant shares and proportion water-filling.
+
+Re-expresses the reference's per-object Go loops as fixed-shape array
+programs:
+
+* DRF (``plugins/drf/drf.go:31-172``): a job's share is the max over
+  resources of allocated/total.  Here shares for ALL jobs come from one
+  [J, R] division + max — recomputed every allocate round from the running
+  allocation state (replacing the reference's incremental event handlers).
+
+* Proportion (``plugins/proportion/proportion.go:102-144``): weighted
+  max-min fair queue shares via iterative water-filling.  The reference
+  subtracts each iteration's *cumulative* deserved from the remainder,
+  which can over-subtract (and panic via Resource.Sub) when queues cap at
+  their request; we implement the intended fixed point — distribute the
+  remainder by weight among unmet queues, cap at request, subtract only the
+  increment actually granted.  Invariants preserved: never exceeds request;
+  weighted max-min fair; monotone in weight.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .common import EPS, dominant_share, is_empty_res
+
+
+def drf_shares(job_alloc: jnp.ndarray, total: jnp.ndarray) -> jnp.ndarray:
+    """[J] dominant shares from [J, R] allocations and [R] cluster total."""
+    return dominant_share(job_alloc, total[None, :])
+
+
+def proportion_deserved(
+    queue_weight: jnp.ndarray,  # f32[Q]
+    queue_request: jnp.ndarray,  # f32[Q, R] allocated + pending demand
+    total: jnp.ndarray,  # f32[R] cluster total minus others' usage
+    queue_valid: jnp.ndarray,  # bool[Q]
+) -> jnp.ndarray:
+    """Water-filled deserved[Q, R].
+
+    Runs Q+1 fixed iterations (each iteration either caps >=1 queue at its
+    request or consumes the whole remainder, so Q+1 always reaches the
+    fixed point); masking replaces the reference's ``meet`` set.
+    """
+    Q = queue_weight.shape[0]
+    deserved0 = jnp.zeros_like(queue_request)
+    remaining0 = total
+    met0 = ~queue_valid
+
+    def body(_, carry):
+        deserved, remaining, met = carry
+        active_w = jnp.where(met, 0.0, queue_weight)
+        total_w = jnp.sum(active_w)
+        stop = (total_w <= 0) | is_empty_res(remaining)
+        frac = jnp.where(total_w > 0, active_w / jnp.maximum(total_w, 1e-30), 0.0)
+        inc = frac[:, None] * remaining[None, :]
+        new_deserved = deserved + inc
+        # a queue meets when deserved no longer epsilon-fits under request
+        newly_met = ~met & ~jnp.all(new_deserved < queue_request + EPS, axis=-1)
+        capped = jnp.minimum(new_deserved, queue_request)
+        new_deserved = jnp.where(newly_met[:, None], capped, new_deserved)
+        granted = jnp.sum(new_deserved - deserved, axis=0)
+        return (
+            jnp.where(stop, deserved, new_deserved),
+            jnp.where(stop, remaining, jnp.maximum(remaining - granted, 0.0)),
+            jnp.where(stop, met, met | newly_met),
+        )
+
+    deserved, _, _ = jax.lax.fori_loop(0, Q + 1, body, (deserved0, remaining0, met0))
+    return deserved
+
+
+def queue_shares(queue_alloc: jnp.ndarray, deserved: jnp.ndarray) -> jnp.ndarray:
+    """[Q] proportion share = max_r allocated/deserved
+    (proportion.go:225-237)."""
+    return dominant_share(queue_alloc, deserved)
+
+
+def overused(queue_alloc: jnp.ndarray, deserved: jnp.ndarray) -> jnp.ndarray:
+    """[Q] OverusedFn: deserved epsilon-LessEqual allocated
+    (proportion.go:188-193)."""
+    return jnp.all(deserved < queue_alloc + EPS, axis=-1)

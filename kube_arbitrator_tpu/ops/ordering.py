@@ -1,0 +1,114 @@
+"""Tiered order functions as lexicographic key stacks.
+
+The reference dispatches job/queue/task ordering through tiers of plugin
+callbacks — first non-zero comparison wins, UID/creation tiebreak last
+(``framework/session_plugins.go:196-276``).  The tensor re-expression:
+each enabled plugin contributes one or more key *columns*; ordering is a
+lexicographic argmin over the stacked columns (ops/common.lex_argmin).
+
+Columns per plugin (ascending = preferred):
+
+* priority  — job: -priority (priority.go:59-77); task: -pod priority
+* gang      — two columns (gang.go:129-165): [ready? 1 : 0] (not-ready jobs
+              first), then [ready? 0 : creation_rank+1] (among not-ready
+              pairs creation/uid decides *within this tier*; ready pairs tie
+              and fall through)
+* drf       — job dominant share ascending (drf.go:109-127)
+* proportion— queue share ascending (proportion.go:146-159)
+
+The creation/UID fallback (session_plugins.go:212-220) is always the last
+column.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Tuple
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class PluginOption:
+    """Per-plugin enable flags (reference conf/scheduler_conf.go:33-50)."""
+
+    name: str
+    job_order_disabled: bool = False
+    task_order_disabled: bool = False
+    queue_order_disabled: bool = False
+    preemptable_disabled: bool = False
+    reclaimable_disabled: bool = False
+    predicate_disabled: bool = False
+    job_ready_disabled: bool = False
+
+    @classmethod
+    def of(cls, name: str, **kw) -> "PluginOption":
+        return cls(name=name, **kw)
+
+
+@dataclasses.dataclass(frozen=True)
+class Tier:
+    plugins: Tuple[PluginOption, ...]
+
+
+Tiers = Tuple[Tier, ...]
+
+# Default configuration (reference pkg/scheduler/util.go:30-40).
+DEFAULT_TIERS: Tiers = (
+    Tier(plugins=(PluginOption.of("priority"), PluginOption.of("gang"))),
+    Tier(
+        plugins=(
+            PluginOption.of("drf"),
+            PluginOption.of("predicates"),
+            PluginOption.of("proportion"),
+        )
+    ),
+)
+DEFAULT_ACTIONS: Tuple[str, ...] = ("allocate", "backfill")
+
+
+def job_order_keys(
+    tiers: Tiers,
+    job_priority: jnp.ndarray,
+    job_ready: jnp.ndarray,
+    job_creation_rank: jnp.ndarray,
+    job_share: jnp.ndarray,
+) -> List[jnp.ndarray]:
+    keys: List[jnp.ndarray] = []
+    for tier in tiers:
+        for p in tier.plugins:
+            if p.job_order_disabled:
+                continue
+            if p.name == "priority":
+                keys.append(-job_priority.astype(jnp.float32))
+            elif p.name == "gang":
+                ready_f = job_ready.astype(jnp.float32)
+                keys.append(ready_f)
+                keys.append(jnp.where(job_ready, 0.0, job_creation_rank + 1.0))
+            elif p.name == "drf":
+                keys.append(job_share)
+    keys.append(job_creation_rank.astype(jnp.float32))
+    return keys
+
+
+def queue_order_keys(
+    tiers: Tiers, queue_share: jnp.ndarray, queue_uid_rank: jnp.ndarray
+) -> List[jnp.ndarray]:
+    keys: List[jnp.ndarray] = []
+    for tier in tiers:
+        for p in tier.plugins:
+            if p.name == "proportion" and not p.queue_order_disabled:
+                keys.append(queue_share)
+    keys.append(queue_uid_rank.astype(jnp.float32))
+    return keys
+
+
+def group_order_keys(
+    tiers: Tiers, group_priority: jnp.ndarray, group_uid_rank: jnp.ndarray
+) -> List[jnp.ndarray]:
+    keys: List[jnp.ndarray] = []
+    for tier in tiers:
+        for p in tier.plugins:
+            if p.name == "priority" and not p.task_order_disabled:
+                keys.append(-group_priority.astype(jnp.float32))
+    keys.append(group_uid_rank.astype(jnp.float32))
+    return keys

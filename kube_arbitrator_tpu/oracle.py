@@ -1,0 +1,271 @@
+"""Sequential oracle: a faithful host-side re-implementation of the
+reference scheduling cycle, used for property testing and as the
+performance baseline.
+
+Mirrors the Go control flow exactly (``actions/allocate/allocate.go:41-176``
+with the session dispatch semantics of ``framework/session.go``), with one
+determinism fix: nodes are scanned in name order (Go map iteration order is
+randomized, so the reference's node choice is not well-defined; tests that
+assert exact binds only do so where the choice is forced or symmetric).
+
+This is NOT the TPU path — it is the "Go loop" stand-in that bench.py
+measures the kernel against, per BASELINE.md.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .api import resource as res
+from .api.info import ClusterInfo, JobInfo, NodeInfo, TaskInfo
+from .api.types import TaskStatus, is_allocated_status
+from .ops.ordering import DEFAULT_TIERS, Tiers
+
+
+@dataclasses.dataclass
+class OracleResult:
+    binds: Dict[str, str]             # committed task uid -> node name
+    session_alloc: Dict[str, str]     # all session placements (incl. uncommitted)
+    pipelined: Dict[str, str]
+    job_ready: Dict[str, bool]
+
+
+def _water_fill(
+    weights: Dict[str, int], request: Dict[str, np.ndarray], total: np.ndarray
+) -> Dict[str, np.ndarray]:
+    """Proportion deserved fixed point (see ops/fairness.py for the
+    deviation note vs proportion.go:102-144)."""
+    deserved = {q: res.zeros() for q in weights}
+    remaining = total.copy()
+    met: set = set()
+    for _ in range(len(weights) + 1):
+        active = [q for q in weights if q not in met]
+        total_w = sum(weights[q] for q in active)
+        if total_w == 0 or res.is_empty(remaining):
+            break
+        granted = res.zeros()
+        for q in active:
+            inc = remaining * (weights[q] / total_w)
+            new = deserved[q] + inc
+            if not res.less_equal(new, request[q]):
+                new = res.res_min(new, request[q])
+                met.add(q)
+            granted += new - deserved[q]
+            deserved[q] = new
+        remaining = np.maximum(remaining - granted, 0.0)
+    return deserved
+
+
+class SequentialScheduler:
+    """One cycle of the sequential algorithm over host objects."""
+
+    def __init__(self, cluster: ClusterInfo, tiers: Tiers = DEFAULT_TIERS):
+        self.cluster = cluster
+        self.tiers = tiers
+        self.plugins = {p.name for t in tiers for p in t.plugins}
+
+    def run_cycle(self, actions: Tuple[str, ...] = ("allocate", "backfill")) -> OracleResult:
+        c = self.cluster
+        self.nodes: List[NodeInfo] = sorted(c.nodes.values(), key=lambda n: n.name)
+        self.jobs = sorted(c.jobs.values(), key=lambda j: j.uid)
+        self.queues = sorted(c.queues.values(), key=lambda q: q.uid)
+
+        # --- session open ---
+        self.total = res.sum_resources(n.allocatable for n in self.nodes)
+        prop_total = self.total - res.sum_resources(t.resreq for t in c.others)
+        self.idle = {n.name: n.idle.copy() for n in self.nodes}
+        self.releasing = {n.name: n.releasing.copy() for n in self.nodes}
+        self.numtasks = {n.name: len(n.tasks) for n in self.nodes}
+        self.ports: Dict[str, set] = {
+            n.name: {p for t in n.tasks.values() for p in t.host_ports} for n in self.nodes
+        }
+        self.job_alloc = {j.uid: j.allocated for j in self.jobs}
+        self.job_ready_cnt = {j.uid: j.ready_task_num() for j in self.jobs}
+        self.session_alloc: Dict[str, str] = {}
+        self.pipelined: Dict[str, str] = {}
+
+        gang = "gang" in self.plugins
+        self.min_avail = {j.uid: (j.min_available if gang else 0) for j in self.jobs}
+        self.sched_valid = {
+            j.uid: (j.valid_task_num() >= j.min_available if gang else True) for j in self.jobs
+        }
+
+        if "proportion" in self.plugins:
+            q_request = {q.uid: res.zeros() for q in self.queues}
+            q_alloc = {q.uid: res.zeros() for q in self.queues}
+            for j in self.jobs:
+                if j.queue_uid not in q_request:
+                    continue
+                for t in j.tasks.values():
+                    if is_allocated_status(t.status):
+                        q_request[j.queue_uid] += t.resreq
+                        q_alloc[j.queue_uid] += t.resreq
+                    elif t.status == TaskStatus.PENDING:
+                        q_request[j.queue_uid] += t.resreq
+            self.deserved = _water_fill(
+                {q.uid: q.weight for q in self.queues}, q_request, prop_total
+            )
+            self.queue_alloc = q_alloc
+        else:
+            self.deserved = {q.uid: np.full(res.NUM_RESOURCES, 3e38) for q in self.queues}
+            self.queue_alloc = {q.uid: res.zeros() for q in self.queues}
+
+        for action in actions:
+            if action == "allocate":
+                self._allocate(best_effort=False)
+            elif action == "backfill":
+                self._allocate(best_effort=True)
+
+        # --- close: gang-masked commit ---
+        job_ready = {j.uid: self.job_ready_cnt[j.uid] >= self.min_avail[j.uid] for j in self.jobs}
+        binds = {
+            uid: node
+            for uid, node in self.session_alloc.items()
+            if job_ready[self._job_of(uid)]
+        }
+        return OracleResult(
+            binds=binds,
+            session_alloc=dict(self.session_alloc),
+            pipelined=dict(self.pipelined),
+            job_ready=job_ready,
+        )
+
+    # --- ordering (session_plugins.go tier semantics) ---
+
+    def _job_share(self, j: JobInfo) -> float:
+        return res.dominant_share(self.job_alloc[j.uid], self.total)
+
+    def _job_key(self, j: JobInfo):
+        key = []
+        ready = self.job_ready_cnt[j.uid] >= self.min_avail[j.uid]
+        for tier in self.tiers:
+            for p in tier.plugins:
+                if p.job_order_disabled:
+                    continue
+                if p.name == "priority":
+                    key.append(-j.priority)
+                elif p.name == "gang":
+                    key.append(1.0 if ready else 0.0)
+                    key.append(0.0 if ready else self._creation_rank[j.uid] + 1.0)
+                elif p.name == "drf":
+                    key.append(self._job_share(j))
+        key.append(self._creation_rank[j.uid])
+        return tuple(key)
+
+    def _queue_share(self, quid: str) -> float:
+        return res.dominant_share(self.queue_alloc[quid], self.deserved[quid])
+
+    def _overused(self, quid: str) -> bool:
+        return res.less_equal(self.deserved[quid], self.queue_alloc[quid])
+
+    def _task_key(self, t: TaskInfo):
+        key = []
+        for tier in self.tiers:
+            for p in tier.plugins:
+                if p.name == "priority" and not p.task_order_disabled:
+                    key.append(-t.priority)
+        key.append(t.uid)
+        return tuple(key)
+
+    def _job_of(self, task_uid: str) -> str:
+        return self._task_job[task_uid]
+
+    # --- predicates (non-resource) ---
+
+    def _predicate(self, t: TaskInfo, n: NodeInfo) -> bool:
+        if n.unschedulable:
+            return False
+        if self.numtasks[n.name] >= n.max_tasks:
+            return False
+        if any(n.labels.get(k) != v for k, v in t.node_selector.items()):
+            return False
+        for taint in n.taints:
+            if taint.effect == "PreferNoSchedule":
+                continue
+            if not any(tol.tolerates(taint) for tol in t.tolerations):
+                return False
+        if any(p in self.ports[n.name] for p in t.host_ports):
+            return False
+        return True
+
+    # --- the sequential loop ---
+
+    def _allocate(self, best_effort: bool) -> None:
+        self._creation_rank = {}
+        for rank, j in enumerate(sorted(self.jobs, key=lambda j: (j.creation_ts, j.uid))):
+            self._creation_rank[j.uid] = rank
+        self._task_job = {t.uid: j.uid for j in self.jobs for t in j.tasks.values()}
+
+        # pending task lists per job (PQ equivalent; failed tasks discarded)
+        pending: Dict[str, List[TaskInfo]] = {}
+        for j in self.jobs:
+            if not self.sched_valid[j.uid] or j.queue_uid not in self.queue_alloc:
+                continue
+            ts = [
+                t
+                for t in j.pending_tasks()
+                if t.best_effort == best_effort and t.uid not in self.session_alloc
+            ]
+            ts.sort(key=self._task_key)
+            if ts:
+                pending[j.uid] = ts
+        active_queues = {j.queue_uid for juid, j in ((j.uid, j) for j in self.jobs) if juid in pending}
+
+        while active_queues:
+            quid = min(
+                active_queues, key=lambda q: (self._queue_share(q) if "proportion" in self.plugins else 0, q)
+            )
+            if self._overused(quid):
+                active_queues.discard(quid)
+                continue
+            cand_jobs = [j for j in self.jobs if j.uid in pending and j.queue_uid == quid]
+            if not cand_jobs:
+                active_queues.discard(quid)
+                continue
+            job = min(cand_jobs, key=self._job_key)
+            tasks = pending[job.uid]
+            assigned = False
+            while tasks:
+                t = tasks.pop(0)
+                node = self._try_place(t, best_effort)
+                if node is not None:
+                    assigned = True
+                    break
+            if not tasks:
+                del pending[job.uid]
+            if not assigned and job.uid in pending:
+                # all tasks failed: job dropped for the cycle
+                del pending[job.uid]
+
+    def _try_place(self, t: TaskInfo, best_effort: bool) -> Optional[str]:
+        for n in self.nodes:
+            if not self._predicate(t, n):
+                continue
+            if best_effort or res.less_equal(t.resreq, self.idle[n.name]):
+                self._commit(t, n, pipelined=False)
+                return n.name
+            if res.less_equal(t.resreq, self.releasing[n.name]):
+                self._commit(t, n, pipelined=True)
+                return n.name
+        return None
+
+    def _commit(self, t: TaskInfo, n: NodeInfo, pipelined: bool) -> None:
+        if pipelined:
+            self.releasing[n.name] = self.releasing[n.name] - t.resreq
+            self.pipelined[t.uid] = n.name
+        else:
+            self.idle[n.name] = self.idle[n.name] - t.resreq
+            self.session_alloc[t.uid] = n.name
+        self.numtasks[n.name] += 1
+        self.ports[n.name] |= set(t.host_ports)
+        juid = self._job_of(t.uid)
+        self.job_alloc[juid] = self.job_alloc[juid] + t.resreq
+        self.job_ready_cnt[juid] += 1
+        quid = self._task_queue(juid)
+        if quid in self.queue_alloc:
+            self.queue_alloc[quid] = self.queue_alloc[quid] + t.resreq
+
+    def _task_queue(self, juid: str) -> str:
+        return self.cluster.jobs[juid].queue_uid
