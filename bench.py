@@ -1,0 +1,106 @@
+"""Benchmark driver: one scheduling cycle at BASELINE scale.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config (BASELINE.md #3 by default): 10k pending pods x 1k nodes on the
+available accelerator.  The baseline is the sequential host implementation
+(kube_arbitrator_tpu.oracle) — the faithful stand-in for the reference's Go
+allocate loop — timed on the same snapshot.  Override with env vars
+BENCH_TASKS / BENCH_NODES / BENCH_ORACLE_CAP_S.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    try:
+        jax.devices()
+    except RuntimeError:
+        # the env names a platform whose plugin isn't registered (e.g. a
+        # stripped PYTHONPATH dropped the sitecustomize that registers the
+        # TPU plugin) — fall back to autodetection
+        jax.config.update("jax_platforms", "")
+        jax.devices()
+
+    num_tasks = int(os.environ.get("BENCH_TASKS", 10_000))
+    num_nodes = int(os.environ.get("BENCH_NODES", 1_000))
+    oracle_cap_s = float(os.environ.get("BENCH_ORACLE_CAP_S", 120.0))
+    tasks_per_job = 100
+    num_jobs = max(1, num_tasks // tasks_per_job)
+
+    from kube_arbitrator_tpu.cache import build_snapshot, generate_cluster
+    from kube_arbitrator_tpu.oracle import SequentialScheduler
+    from kube_arbitrator_tpu.ops import schedule_cycle
+
+    sim = generate_cluster(
+        num_nodes=num_nodes,
+        num_jobs=num_jobs,
+        tasks_per_job=tasks_per_job,
+        num_queues=8,
+        seed=42,
+    )
+    snap = build_snapshot(sim.cluster)
+
+    # --- kernel: compile, then time warm cycles (p50 of 5) ---
+    dec = schedule_cycle(snap.tensors)
+    dec.task_node.block_until_ready()
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        dec = schedule_cycle(snap.tensors)
+        dec.task_node.block_until_ready()
+        times.append(time.perf_counter() - t0)
+    cycle_s = float(np.median(times))
+    n_placed = int(np.asarray(dec.bind_mask).sum())
+    pods_per_sec = n_placed / cycle_s if cycle_s > 0 else 0.0
+
+    # --- baseline: sequential oracle on an identical cluster ---
+    # (the oracle mutates shared accounting state, so give it a fresh copy)
+    sim_b = generate_cluster(
+        num_nodes=num_nodes,
+        num_jobs=num_jobs,
+        tasks_per_job=tasks_per_job,
+        num_queues=8,
+        seed=42,
+    )
+    t0 = time.perf_counter()
+    res = SequentialScheduler(sim_b.cluster).run_cycle()
+    oracle_s = time.perf_counter() - t0
+    oracle_placed = len(res.binds)
+    oracle_pods_per_sec = oracle_placed / oracle_s if oracle_s > 0 else 0.0
+
+    vs_baseline = pods_per_sec / oracle_pods_per_sec if oracle_pods_per_sec > 0 else float("inf")
+    print(
+        json.dumps(
+            {
+                "metric": f"pods_scheduled_per_sec@{num_tasks}x{num_nodes}",
+                "value": round(pods_per_sec, 1),
+                "unit": "pods/s",
+                "vs_baseline": round(vs_baseline, 2),
+            }
+        )
+    )
+    print(
+        f"# cycle={cycle_s*1000:.1f}ms placed={n_placed}/{num_tasks} "
+        f"| baseline={oracle_s*1000:.1f}ms placed={oracle_placed} "
+        f"| devices={_device_desc()}",
+        file=sys.stderr,
+    )
+
+
+def _device_desc() -> str:
+    import jax
+
+    return ",".join(str(d) for d in jax.devices())
+
+
+if __name__ == "__main__":
+    main()

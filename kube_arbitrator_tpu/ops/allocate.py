@@ -41,7 +41,7 @@ import jax.numpy as jnp
 from ..api.types import TaskStatus
 from ..cache.snapshot import SnapshotTensors
 from .common import BIG, EPS, ceil_div_pos, lex_argmin, safe_share
-from .fairness import drf_shares, overused, queue_shares
+from .fairness import drf_equilibrium_level, drf_shares, overused, queue_shares
 from .ordering import Tiers, group_order_keys, job_order_keys, queue_order_keys
 
 ALLOCATED = jnp.int32(int(TaskStatus.ALLOCATED))
@@ -84,6 +84,22 @@ class SessionCtx:
     # Effective gang minMember: zeros when the gang plugin is disabled
     # (JobReadyFn then trivially passes — session_plugins.go:158-176).
     min_avail: jax.Array      # i32[J]
+    # DRF equilibrium share level λ* (throughput floor for turn budgets).
+    drf_level: jax.Array      # f32 scalar
+
+
+def _drf_before_gang(tiers: Tiers) -> bool:
+    """True when drf's job order is consulted before gang's (custom tier
+    configs only; the default puts gang first)."""
+    for tier in tiers:
+        for p in tier.plugins:
+            if p.job_order_disabled:
+                continue
+            if p.name == "gang":
+                return False
+            if p.name == "drf":
+                return True
+    return False
 
 
 def _status_in(status: jax.Array, members) -> jax.Array:
@@ -192,7 +208,30 @@ def _process_queue(
         )
         t_max = jnp.max(f_r) + 1.0
         b_queue = jnp.where(t_max >= BIG / 2, s_max, jnp.maximum(t_max, 1.0)).astype(jnp.int32)
-        budget = jnp.minimum(jnp.minimum(b_gang, b_drf), b_queue)
+        # equilibrium floor: grant up to the fair level λ* in one turn (see
+        # fairness.drf_equilibrium_level) instead of one task per turn when
+        # shares are tied; proportion's b_queue still clamps.  The floor
+        # only applies to jobs that are already gang-ready — a not-ready
+        # job must stop at readiness so the gang order flip (ready jobs
+        # yield to not-ready ones, gang.go:129-165) happens at the same
+        # points as in the sequential loop.
+        b_quota = jnp.floor(
+            (sess.drf_level - job_share[j]) / jnp.maximum(delta, 1e-9)
+        ).astype(jnp.int32)
+        # Under the default tiers, gang's creation-rank column strictly
+        # precedes drf for not-ready pairs (gang.go:129-165), so a
+        # not-ready job is served to readiness before any contender and
+        # b_gang alone bounds the turn.  Only when a tier config puts drf's
+        # job order ahead of gang does the share-crossing clamp apply to
+        # not-ready jobs too.
+        if _drf_before_gang(tiers):
+            b_not_ready = jnp.minimum(b_gang, b_drf)
+        else:
+            b_not_ready = b_gang
+        budget = jnp.minimum(
+            jnp.where(job_ready[j], jnp.maximum(b_drf, b_quota), b_not_ready),
+            b_queue,
+        )
     budget = jnp.clip(budget, 0, s_max)
     budget = jnp.where(has_grp, jnp.minimum(budget, grp_remaining[g]), 0)
 

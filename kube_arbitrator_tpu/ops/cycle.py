@@ -28,7 +28,8 @@ from .allocate import (
     allocate_action,
     backfill_action,
 )
-from .fairness import proportion_deserved
+from .common import safe_share
+from .fairness import drf_equilibrium_level, drf_shares, proportion_deserved
 from .ordering import DEFAULT_ACTIONS, DEFAULT_TIERS, Tiers
 
 _READY_STATUSES = (
@@ -109,11 +110,31 @@ def open_session(st: SnapshotTensors, tiers: Tiers) -> Tuple[SessionCtx, AllocSt
         # no proportion plugin: queues are never overused, shares are 0
         deserved = jnp.full((Q, R), jnp.float32(3.0e38))
 
+    # DRF equilibrium level from mean pending-task shapes (throughput floor
+    # for the allocate rounds; see fairness.drf_equilibrium_level).
+    job_pending_cnt = jnp.zeros(J, jnp.int32).at[st.task_job].add(pending_now.astype(jnp.int32))
+    job_pending_req = jnp.zeros((J, R)).at[st.task_job].add(res_or_0(pending_now))
+    mean_req = job_pending_req / jnp.maximum(job_pending_cnt, 1)[:, None]
+    job_share0 = drf_shares(job_alloc, drf_total)
+    job_delta = jnp.max(safe_share(mean_req, drf_total[None, :]), axis=-1)
+    # actual free capacity (accounts for other schedulers' and running
+    # tasks' usage) — λ* must not overestimate the reachable level
+    headroom = jnp.sum(jnp.where(nv, st.node_idle, 0.0), axis=0)
+    drf_level = drf_equilibrium_level(
+        job_share0,
+        job_delta,
+        mean_req,
+        job_pending_cnt,
+        job_sched_valid & (job_pending_cnt > 0),
+        headroom,
+    )
+
     sess = SessionCtx(
         drf_total=drf_total,
         deserved=deserved,
         job_sched_valid=job_sched_valid,
         min_avail=min_avail,
+        drf_level=drf_level,
     )
     state = AllocState(
         task_status=st.task_status,

@@ -70,6 +70,47 @@ def proportion_deserved(
     return deserved
 
 
+def drf_equilibrium_level(
+    job_share0: jnp.ndarray,   # f32[J] current dominant share per job
+    job_delta: jnp.ndarray,    # f32[J] per-task dominant-share increment (mean task)
+    job_mean_req: jnp.ndarray,  # f32[J, R] mean pending per-task resreq
+    job_pending: jnp.ndarray,  # i32[J] pending task count
+    eligible: jnp.ndarray,     # bool[J]
+    headroom: jnp.ndarray,     # f32[R] cluster total minus current allocations
+    iters: int = 30,
+) -> jnp.ndarray:
+    """Scalar fair share level λ*: the highest common dominant share all
+    eligible jobs can be raised to within cluster headroom.
+
+    This is the *fixed point* the sequential DRF interleaving (pick
+    min-share job, give it one task, repeat — drf.go:109-127) converges to.
+    Solving it up front lets the allocate rounds grant each job its
+    equilibrium quota in one turn instead of one task per turn; the exact
+    per-turn budgets still clamp proportion/gang semantics, and the tail
+    beyond λ* (capacity freed by fragmentation) runs through the exact
+    1-by-1 loop.  λ* is a throughput floor, never a correctness bound.
+    """
+
+    def extra_at(lam):
+        k = jnp.floor((lam - job_share0) / jnp.maximum(job_delta, 1e-9))
+        k = jnp.clip(k, 0.0, job_pending.astype(jnp.float32))
+        return jnp.where(eligible, k, 0.0)
+
+    def feasible(lam):
+        k = extra_at(lam)
+        usage = jnp.sum(k[:, None] * job_mean_req, axis=0)
+        return jnp.all(usage <= headroom + EPS)
+
+    def body(_, lohi):
+        lo, hi = lohi
+        mid = 0.5 * (lo + hi)
+        ok = feasible(mid)
+        return (jnp.where(ok, mid, lo), jnp.where(ok, hi, mid))
+
+    lo, _ = jax.lax.fori_loop(0, iters, body, (jnp.float32(0.0), jnp.float32(1.0)))
+    return lo
+
+
 def queue_shares(queue_alloc: jnp.ndarray, deserved: jnp.ndarray) -> jnp.ndarray:
     """[Q] proportion share = max_r allocated/deserved
     (proportion.go:225-237)."""

@@ -1,0 +1,4 @@
+"""Mesh/sharding layer: scale the cycle over TPU chips along the node axis."""
+from .mesh import NODE_AXIS, make_mesh, shard_snapshot, snapshot_shardings
+
+__all__ = ["NODE_AXIS", "make_mesh", "shard_snapshot", "snapshot_shardings"]

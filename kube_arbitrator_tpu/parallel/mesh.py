@@ -1,0 +1,70 @@
+"""Device-mesh scale-out: node-axis sharding of the scheduling cycle.
+
+The reference copes with cluster size by a 1 s cycle cadence and a single
+sequential goroutine (SURVEY §5 "long-context"); nothing is sharded.  Here
+the scaling axis is the *node* dimension of every per-node tensor: idle/
+releasing/allocatable matrices, port masks, capacity vectors.  A cycle
+jitted with NamedSharding over a ``Mesh(("nodes",))`` lets XLA's SPMD
+partitioner run the per-node capacity math shard-local and insert the
+collectives (prefix sums for admission, argmax for selection) over ICI.
+
+Multi-host (DCN) uses the same program — jax.distributed initializes the
+global mesh; shardings are expressed once.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..cache.snapshot import SnapshotTensors
+
+NODE_AXIS = "nodes"
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    devs = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devs), (NODE_AXIS,))
+
+
+# Fields whose leading axis is the node dimension.
+_NODE_SHARDED_FIELDS = frozenset(
+    {
+        "node_idle",
+        "node_releasing",
+        "node_alloc",
+        "node_max_tasks",
+        "node_num_tasks",
+        "node_klass",
+        "node_ports",
+        "node_unsched",
+        "node_valid",
+    }
+)
+
+
+def snapshot_shardings(mesh: Mesh) -> SnapshotTensors:
+    """A SnapshotTensors-shaped pytree of NamedShardings: node-axis arrays
+    sharded over the mesh, everything else replicated."""
+    specs = {}
+    for f in dataclasses.fields(SnapshotTensors):
+        if f.name in _NODE_SHARDED_FIELDS:
+            specs[f.name] = NamedSharding(mesh, P(NODE_AXIS))
+        else:
+            specs[f.name] = NamedSharding(mesh, P())
+    return SnapshotTensors(**specs)
+
+
+def shard_snapshot(st: SnapshotTensors, mesh: Mesh) -> SnapshotTensors:
+    """Device-put a snapshot with node-axis sharding.  Node bucketing pads
+    to multiples of 128, so any mesh of <=128 devices divides evenly."""
+    shardings = snapshot_shardings(mesh)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, s),
+        st,
+        shardings,
+        is_leaf=lambda x: not isinstance(x, SnapshotTensors),
+    )

@@ -1,11 +1,18 @@
-"""Test config: force an 8-device virtual CPU platform before JAX import.
+"""Test config: force an 8-device virtual CPU platform.
 
 Multi-chip sharding is tested on a virtual CPU mesh (the driver separately
 dry-runs the multi-chip path); the real TPU chip is only used by bench.py.
+
+Note: the environment's sitecustomize imports jax at interpreter startup
+(registering the TPU platform plugin), so plain env-var assignment here is
+too late — jax.config.update before first backend use is required.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
