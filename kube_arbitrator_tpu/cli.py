@@ -1,0 +1,88 @@
+"""CLI entry: the single-binary equivalent of cmd/kube-batch.
+
+Mirrors the reference's flag surface (``cmd/kube-batch/app/options/
+options.go:58-73``) where it applies to the TPU-native build, plus
+simulation flags for running against a synthetic cluster (the live-cluster
+informer plane is the remaining integration seam).
+
+    python -m kube_arbitrator_tpu --sim-nodes 1000 --sim-jobs 100 \
+        --sim-tasks-per-job 100 --scheduler-conf conf.yaml --cycles 5
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kube-arbitrator-tpu",
+        description="TPU-native batch scheduler (kube-batch capabilities on JAX/XLA)",
+    )
+    # reference flags (options.go)
+    p.add_argument("--scheduler-name", default="kube-batch", help="scheduler identity")
+    p.add_argument("--scheduler-conf", default="", help="YAML action/tier configuration file")
+    p.add_argument("--schedule-period", type=float, default=1.0, help="seconds per cycle")
+    p.add_argument("--default-queue", default="default", help="queue for jobs that name none")
+    p.add_argument("--print-version", action="store_true")
+    # simulation plane
+    p.add_argument("--sim-nodes", type=int, default=100)
+    p.add_argument("--sim-jobs", type=int, default=20)
+    p.add_argument("--sim-tasks-per-job", type=int, default=50)
+    p.add_argument("--sim-queues", type=int, default=4)
+    p.add_argument("--sim-seed", type=int, default=0)
+    p.add_argument("--cycles", type=int, default=0, help="max cycles (0 = until idle)")
+    p.add_argument("--json", action="store_true", help="emit per-cycle stats as JSON lines")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.print_version:
+        from . import __version__
+
+        print(f"kube-arbitrator-tpu {__version__}")
+        return 0
+
+    from .cache.sim import generate_cluster
+    from .framework import Scheduler
+
+    sim = generate_cluster(
+        num_nodes=args.sim_nodes,
+        num_jobs=args.sim_jobs,
+        tasks_per_job=args.sim_tasks_per_job,
+        num_queues=args.sim_queues,
+        seed=args.sim_seed,
+    )
+    try:
+        sched = Scheduler(
+            sim,
+            conf_path=args.scheduler_conf or None,
+            schedule_period_s=args.schedule_period,
+        )
+    except (ValueError, OSError) as e:
+        print(f"error: invalid scheduler conf: {e}", file=sys.stderr)
+        return 1
+    cycles = sched.run(max_cycles=args.cycles)
+    total_binds = sum(s.binds for s in sched.history)
+    total_evicts = sum(s.evicts for s in sched.history)
+    for i, s in enumerate(sched.history):
+        line = {
+            "cycle": i,
+            "cycle_ms": round(s.cycle_ms, 1),
+            "binds": s.binds,
+            "evicts": s.evicts,
+            "pending_before": s.pending_before,
+        }
+        print(json.dumps(line) if args.json else line, file=sys.stderr)
+    print(
+        json.dumps(
+            {"cycles": cycles, "binds": total_binds, "evicts": total_evicts}
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,22 @@
+"""Framework: session, conf, registries, scheduler loop."""
+from .conf import DEFAULT_CONF, SchedulerConfig, load_conf, load_conf_file
+from .registry import get_action, plugin_capabilities, register_action, register_plugin
+from .scheduler import CycleStats, Scheduler
+from .session import CycleResult, PodGroupCondition, PodGroupStatus, Session
+
+__all__ = [
+    "DEFAULT_CONF",
+    "SchedulerConfig",
+    "load_conf",
+    "load_conf_file",
+    "get_action",
+    "register_action",
+    "register_plugin",
+    "plugin_capabilities",
+    "Scheduler",
+    "CycleStats",
+    "Session",
+    "CycleResult",
+    "PodGroupCondition",
+    "PodGroupStatus",
+]

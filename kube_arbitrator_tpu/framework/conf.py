@@ -1,0 +1,92 @@
+"""Scheduler configuration: YAML parity with the reference.
+
+Format (reference ``pkg/scheduler/conf/scheduler_conf.go:20-50``, default
+``pkg/scheduler/util.go:30-40``):
+
+    actions: "allocate, backfill"
+    tiers:
+    - plugins:
+      - name: priority
+      - name: gang
+    - plugins:
+      - name: drf
+        disableJobOrder: true
+      - name: predicates
+      - name: proportion
+
+Parsed into the static, hashable (actions, Tiers) pair that the jitted
+cycle takes as compile-time structure — a conf change recompiles the cycle
+once, then every cycle reuses the compiled program.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+from ..ops.ordering import PluginOption, Tier, Tiers
+
+DEFAULT_CONF = """
+actions: "allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+"""
+
+KNOWN_PLUGINS = ("priority", "gang", "drf", "predicates", "proportion", "nodeorder")
+
+_FLAG_KEYS = {
+    "disableJobOrder": "job_order_disabled",
+    "disableJobReady": "job_ready_disabled",
+    "disableTaskOrder": "task_order_disabled",
+    "disablePreemptable": "preemptable_disabled",
+    "disableReclaimable": "reclaimable_disabled",
+    "disableQueueOrder": "queue_order_disabled",
+    "disablePredicate": "predicate_disabled",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulerConfig:
+    actions: Tuple[str, ...]
+    tiers: Tiers
+
+    @classmethod
+    def default(cls) -> "SchedulerConfig":
+        return load_conf(DEFAULT_CONF)
+
+
+def load_conf(conf_str: str) -> SchedulerConfig:
+    """YAML string -> SchedulerConfig (loadSchedulerConf, util.go:42-64).
+    Unknown actions are an error, like the reference."""
+    import yaml
+
+    from ..ops.cycle import ACTION_KERNELS
+
+    raw = yaml.safe_load(conf_str) or {}
+    action_names = tuple(
+        a.strip() for a in str(raw.get("actions", "allocate, backfill")).split(",") if a.strip()
+    )
+    for a in action_names:
+        if a not in ACTION_KERNELS:
+            raise ValueError(f"failed to find Action {a}")
+    tiers = []
+    for tier_raw in raw.get("tiers", []) or []:
+        plugins = []
+        for p in tier_raw.get("plugins", []) or []:
+            name = p.get("name", "")
+            if name not in KNOWN_PLUGINS:
+                raise ValueError(f"unknown plugin {name}")
+            kwargs = {attr: bool(p[yk]) for yk, attr in _FLAG_KEYS.items() if yk in p}
+            plugins.append(PluginOption(name=name, **kwargs))
+        tiers.append(Tier(plugins=tuple(plugins)))
+    return SchedulerConfig(actions=action_names, tiers=tuple(tiers))
+
+
+def load_conf_file(path: str) -> SchedulerConfig:
+    with open(path) as f:
+        return load_conf(f.read())

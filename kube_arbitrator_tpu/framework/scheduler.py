@@ -1,0 +1,84 @@
+"""The scheduler loop: periodic cycles against a cluster backend.
+
+Reference ``pkg/scheduler/scheduler.go:32-93``: load conf, then
+``wait.Until(runOnce, schedulePeriod)``; each runOnce opens a session, runs
+the configured actions, closes the session (status write-back).  Here the
+backend is the simulation cluster (the informer-driven cache arrives with
+the live-cluster integration); decisions are actuated through the same
+Bind/Evict intent interface the fake binder implements.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+from ..cache.sim import SimCluster
+from .conf import SchedulerConfig, load_conf_file
+from .session import CycleResult, PodGroupStatus, Session
+
+
+@dataclasses.dataclass
+class CycleStats:
+    cycle_ms: float
+    snapshot_ms: float
+    binds: int
+    evicts: int
+    pending_before: int
+
+
+class Scheduler:
+    """Owns the cluster backend + conf; runs cycles."""
+
+    def __init__(
+        self,
+        sim: SimCluster,
+        config: Optional[SchedulerConfig] = None,
+        conf_path: Optional[str] = None,
+        schedule_period_s: float = 1.0,
+    ):
+        # conf is re-loadable per Run like the reference (scheduler.go:66-78)
+        self.sim = sim
+        self.conf_path = conf_path
+        self.config = config or (load_conf_file(conf_path) if conf_path else SchedulerConfig.default())
+        self.schedule_period_s = schedule_period_s
+        self.job_status: Dict[str, PodGroupStatus] = {}
+        self.history: List[CycleStats] = []
+
+    def run_once(self) -> CycleResult:
+        t0 = time.perf_counter()
+        pending = sum(len(j.pending_tasks()) for j in self.sim.cluster.jobs.values())
+        session = Session(self.sim.cluster, self.config)
+        result = session.run()
+        t1 = time.perf_counter()
+        self.sim.apply_binds(result.binds)
+        self.sim.apply_evicts(result.evicts)
+        self.job_status.update(result.job_status)  # cache.UpdateJobStatus equivalent
+        self.history.append(
+            CycleStats(
+                cycle_ms=(t1 - t0) * 1000,
+                snapshot_ms=0.0,
+                binds=len(result.binds),
+                evicts=len(result.evicts),
+                pending_before=pending,
+            )
+        )
+        return result
+
+    def run(self, max_cycles: int = 0, until_idle: bool = True) -> int:
+        """Run cycles at the configured cadence (in sim: back-to-back).
+        Stops after max_cycles (0 = unlimited) or when a cycle makes no
+        progress and nothing is pending."""
+        cycles = 0
+        while True:
+            result = self.run_once()
+            cycles += 1
+            if max_cycles and cycles >= max_cycles:
+                return cycles
+            pending = sum(len(j.pending_tasks()) for j in self.sim.cluster.jobs.values())
+            if until_idle and not result.binds and not result.evicts and pending == 0:
+                return cycles
+            if not result.binds and not result.evicts:
+                # no progress; in a live cluster we'd wait for the next
+                # period — in sim, stop to avoid spinning
+                return cycles

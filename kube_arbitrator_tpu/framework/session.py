@@ -1,0 +1,130 @@
+"""Host-side session: one cycle = snapshot → decision kernel → status.
+
+The reference's OpenSession/CloseSession (``framework/framework.go:26-54``)
+split into: tensor snapshot (cache/snapshot.py), the fused decision program
+(ops/cycle.py — plugin OnSessionOpen aggregates live inside it), and this
+module's close-side bookkeeping: PodGroup status recomputation
+(``session.go:159-197`` jobStatus) and Unschedulable conditions for jobs
+that ended the cycle gang-unready (``gang.go:169-190`` OnSessionClose).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..api.info import ClusterInfo, JobInfo
+from ..api.types import COND_UNSCHEDULABLE, PodGroupPhase, TaskStatus, is_allocated_status
+from ..cache.decode import decode_decisions
+from ..cache.sim import BindIntent, EvictIntent
+from ..cache.snapshot import Snapshot, build_snapshot
+from ..ops.cycle import CycleDecisions, schedule_cycle
+from .conf import SchedulerConfig
+
+
+@dataclasses.dataclass
+class PodGroupCondition:
+    """v1alpha1.PodGroupCondition equivalent (types.go:41-45)."""
+
+    type: str
+    status: bool
+    transition_id: str
+    reason: str = ""
+    message: str = ""
+    last_transition: float = 0.0
+
+
+@dataclasses.dataclass
+class PodGroupStatus:
+    """v1alpha1.PodGroupStatus equivalent."""
+
+    phase: PodGroupPhase = PodGroupPhase.PENDING
+    conditions: List[PodGroupCondition] = dataclasses.field(default_factory=list)
+    running: int = 0
+    succeeded: int = 0
+    failed: int = 0
+
+
+@dataclasses.dataclass
+class CycleResult:
+    session_uid: str
+    snapshot: Snapshot
+    decisions: CycleDecisions
+    binds: List[BindIntent]
+    evicts: List[EvictIntent]
+    job_status: Dict[str, PodGroupStatus]
+
+
+class Session:
+    """One scheduling cycle over a ClusterInfo."""
+
+    def __init__(self, cluster: ClusterInfo, config: Optional[SchedulerConfig] = None):
+        self.cluster = cluster
+        self.config = config or SchedulerConfig.default()
+        self.uid = str(uuid.uuid4())
+
+    def run(self) -> CycleResult:
+        snap = build_snapshot(self.cluster)
+        dec = schedule_cycle(
+            snap.tensors, tiers=self.config.tiers, actions=self.config.actions
+        )
+        binds, evicts = decode_decisions(snap, dec)
+        job_status = self._close(snap, dec)
+        return CycleResult(
+            session_uid=self.uid,
+            snapshot=snap,
+            decisions=dec,
+            binds=binds,
+            evicts=evicts,
+            job_status=job_status,
+        )
+
+    # ---- CloseSession ----
+
+    def _close(self, snap: Snapshot, dec: CycleDecisions) -> Dict[str, PodGroupStatus]:
+        job_ready = np.asarray(dec.job_ready)
+        statuses: Dict[str, PodGroupStatus] = {}
+        now = time.time()
+        for job in snap.index.jobs:
+            unsched_cond = None
+            if not job_ready[job.ordinal] and job.min_available > 0:
+                # gang.go:169-190: stamp Unschedulable for unready gangs
+                missing = job.min_available - job.ready_task_num()
+                unsched_cond = PodGroupCondition(
+                    type=COND_UNSCHEDULABLE,
+                    status=True,
+                    transition_id=self.uid,
+                    reason="NotEnoughResources",
+                    message=f"{missing}/{len(job.tasks)} tasks in gang unschedulable",
+                    last_transition=now,
+                )
+            statuses[job.uid] = self._job_status(job, unsched_cond)
+        return statuses
+
+    def _job_status(
+        self, job: JobInfo, unsched: Optional[PodGroupCondition]
+    ) -> PodGroupStatus:
+        """session.go:159-197 jobStatus semantics (incl. the strict '>'
+        on minMember)."""
+        st = PodGroupStatus()
+        n_running = len(job.tasks_with_status(TaskStatus.RUNNING))
+        if unsched is not None:
+            st.conditions.append(unsched)
+        if n_running != 0 and unsched is not None:
+            st.phase = PodGroupPhase.UNKNOWN
+        else:
+            allocated = sum(
+                1 for t in job.tasks.values() if is_allocated_status(t.status)
+            )
+            st.phase = (
+                PodGroupPhase.RUNNING
+                if allocated > job.min_available
+                else PodGroupPhase.PENDING
+            )
+        st.running = n_running
+        st.succeeded = len(job.tasks_with_status(TaskStatus.SUCCEEDED))
+        st.failed = len(job.tasks_with_status(TaskStatus.FAILED))
+        return st

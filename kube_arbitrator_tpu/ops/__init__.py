@@ -2,6 +2,7 @@
 from .allocate import AllocState, SessionCtx, allocate_action, backfill_action
 from .cycle import CycleDecisions, open_session, schedule_cycle
 from .fairness import drf_shares, overused, proportion_deserved, queue_shares
+from .preempt import preempt_action, reclaim_action
 from .ordering import DEFAULT_ACTIONS, DEFAULT_TIERS, PluginOption, Tier, Tiers
 
 __all__ = [

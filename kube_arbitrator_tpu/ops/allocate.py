@@ -69,6 +69,10 @@ class AllocState:
     # tensor analog of the sequential loop discarding popped-but-unassigned
     # tasks for the cycle (allocate.go:105-171).
     group_unfit: jax.Array   # bool[G]
+    # Eviction attribution (ops/preempt.py): -1 = not evicted; >=0 = evict
+    # committed iff that job ordinal ends the cycle gang-ready; -2 =
+    # unconditional (reclaim / intra-job preemption).
+    evicted_for: jax.Array   # i32[T]
     progress: jax.Array      # bool scalar — placements in current round
     rounds: jax.Array        # i32 scalar
 
@@ -315,6 +319,7 @@ def _process_queue(
         job_ready_cnt=state.job_ready_cnt.at[j].add(placed_total),
         group_placed=state.group_placed.at[g].add(placed_total),
         group_unfit=state.group_unfit.at[g].set(state.group_unfit[g] | unfit_now),
+        evicted_for=state.evicted_for,
         progress=state.progress | (placed_total > 0),
         rounds=state.rounds,
     )

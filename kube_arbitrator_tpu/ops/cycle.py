@@ -31,6 +31,16 @@ from .allocate import (
 from .common import safe_share
 from .fairness import drf_equilibrium_level, drf_shares, proportion_deserved
 from .ordering import DEFAULT_ACTIONS, DEFAULT_TIERS, Tiers
+from .preempt import preempt_action, reclaim_action
+
+# Name -> staged kernel. The framework registry (framework/registry.py)
+# adds custom actions here; the conf loader validates against these keys.
+ACTION_KERNELS = {
+    "allocate": allocate_action,
+    "backfill": backfill_action,
+    "preempt": preempt_action,
+    "reclaim": reclaim_action,
+}
 
 _READY_STATUSES = (
     TaskStatus.ALLOCATED,
@@ -148,6 +158,7 @@ def open_session(st: SnapshotTensors, tiers: Tiers) -> Tuple[SessionCtx, AllocSt
         job_ready_cnt=job_ready_cnt,
         group_placed=jnp.zeros(st.num_groups, jnp.int32),
         group_unfit=jnp.zeros(st.num_groups, bool),
+        evicted_for=jnp.full(st.num_tasks, -1, jnp.int32),
         progress=jnp.array(False),
         rounds=jnp.int32(0),
     )
@@ -166,25 +177,35 @@ def schedule_cycle(
     sess, state = open_session(st, tiers)
 
     for action in actions:  # static unroll — the conf's ordered action list
-        if action == "allocate":
-            state = allocate_action(st, sess, state, tiers, s_max=s_max, max_rounds=max_rounds)
-        elif action == "backfill":
-            state = backfill_action(st, sess, state, tiers, s_max=s_max, max_rounds=max_rounds)
-        elif action in ("preempt", "reclaim"):
-            # staged next; see ops/preempt.py
-            pass
-        else:
-            raise ValueError(f"unknown action: {action}")
+        try:
+            kernel = ACTION_KERNELS[action]
+        except KeyError:
+            raise ValueError(f"unknown action: {action}") from None
+        state = kernel(st, sess, state, tiers, s_max=s_max, max_rounds=max_rounds)
 
     job_ready = state.job_ready_cnt >= sess.min_avail
+    # eviction commit: unconditional (-2) or claimant-job-ready (>=0);
+    # commit decisions use the raw post-action readiness
+    cond_ok = job_ready[jnp.clip(state.evicted_for, 0, None)]
+    evict_mask = (state.evicted_for == -2) | ((state.evicted_for >= 0) & cond_ok)
+    # Statement-discard equivalent for *status*: a discarded eviction must
+    # not leave its victim's job looking degraded at close (the reference
+    # rolls the victim back in-session, statement.go:194-205) — restore
+    # discarded victims' ready counts before reporting readiness.
+    discarded = (state.evicted_for >= 0) & ~cond_ok
+    restored_cnt = state.job_ready_cnt.at[
+        jnp.where(discarded, st.task_job, 0)
+    ].add(discarded.astype(jnp.int32))
+    job_ready_status = restored_cnt >= sess.min_avail
+
     was_pending = (st.task_status == int(TaskStatus.PENDING)) & st.task_valid
     newly_alloc = was_pending & (state.task_status == int(TaskStatus.ALLOCATED))
-    bind_mask = newly_alloc & job_ready[st.task_job]
+    bind_mask = newly_alloc & job_ready_status[st.task_job]
     return CycleDecisions(
         task_node=state.task_node,
         task_status=state.task_status,
         bind_mask=bind_mask,
-        evict_mask=jnp.zeros_like(bind_mask),
-        job_ready=job_ready,
-        unready_alloc=newly_alloc & ~job_ready[st.task_job],
+        evict_mask=evict_mask,
+        job_ready=job_ready_status,
+        unready_alloc=newly_alloc & ~job_ready_status[st.task_job],
     )

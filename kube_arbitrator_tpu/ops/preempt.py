@@ -1,0 +1,357 @@
+"""Preempt and reclaim actions as eviction/pipeline kernels.
+
+Reference behavior:
+
+* preempt (``actions/preempt/preempt.go:43-253``): per queue, jobs with
+  pending tasks preempt RUNNING tasks of *other jobs in the same queue*;
+  victims filtered by the tiered Preemptable verdicts (gang: victim's job
+  keeps readyTaskNum-1 >= minAvailable, gang.go:104-127; drf: preemptor's
+  post-add share stays below victim's post-remove share, drf.go:80-107).
+  Speculative eviction under a Statement, committed only when the
+  preemptor job reaches JobReady, else discarded.  A second phase preempts
+  lower-priority running tasks *within* the same job.
+* reclaim (``actions/reclaim/reclaim.go:41-188``): cross-queue — a
+  non-overused queue's job evicts RUNNING tasks of other queues' jobs,
+  gated by Reclaimable verdicts (proportion: the victim queue stays at or
+  above its deserved after removal, proportion.go:161-186; gang as above).
+  Evictions are direct (no Statement).
+
+TPU-first re-design — **commit by attribution mask** instead of Statement
+rollback: every eviction records which claimant job it serves
+(``evicted_for``); at cycle close an eviction is committed iff its
+claimant ended gang-ready (or unconditionally, for reclaim/intra-job
+preemption).  The claimant's own placements ride the same mask, so a
+failed preemption attempt leaves nothing actuated.  Within-cycle side
+effects of failed attempts (victims transiently unavailable to later
+claimants) are not rolled back mid-cycle — a transient inefficiency the
+next cycle clears, never an invariant violation.
+
+Victim ordering is deterministic (priority asc, UID rank asc) where the
+reference iterates Go maps in randomized order.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ..api.types import TaskStatus
+from ..cache.snapshot import SnapshotTensors
+from .allocate import AllocState, PIPELINED, SessionCtx, _node_capacity
+from .common import BIG, EPS, lex_argmin, safe_share
+from .fairness import drf_shares, overused, queue_shares
+from .ordering import Tiers, group_order_keys, job_order_keys, queue_order_keys
+
+RELEASING = jnp.int32(int(TaskStatus.RELEASING))
+RUNNING = jnp.int32(int(TaskStatus.RUNNING))
+
+SHARE_DELTA = 1e-6  # drf.go:28 shareDelta
+
+
+def _plugin_on(tiers: Tiers, name: str, attr: str) -> bool:
+    return any(
+        p.name == name and not getattr(p, attr) for t in tiers for p in t.plugins
+    )
+
+
+def _victim_verdict(
+    st: SnapshotTensors,
+    state: AllocState,
+    sess: SessionCtx,
+    tiers: Tiers,
+    candidates: jax.Array,  # bool[T]
+    claimant_job: jax.Array,  # scalar job ordinal
+    req: jax.Array,  # f32[R] claimant per-task resreq
+    reclaim: bool,
+) -> jax.Array:
+    """Tiered victim filter: within a tier verdicts intersect; the first
+    tier producing any victim wins (session_plugins.go:59-140)."""
+    attr = "reclaimable_disabled" if reclaim else "preemptable_disabled"
+    vj = st.task_job
+    T = st.num_tasks
+
+    def _seg_rank_and_cum(segment: jax.Array):
+        """Victims grouped by ``segment`` in deterministic (priority asc,
+        uid asc) order: per-victim in-segment rank and *inclusive*
+        cumulative resreq.  Mirrors the reference's per-job/per-queue
+        ``allocations`` maps that subtract victims cumulatively as they
+        are considered (drf.go:86-99, proportion.go:161-186)."""
+        seg = jnp.where(candidates, segment, jnp.int32(2**30))
+        order = jnp.lexsort((st.task_uid_rank, st.task_priority, seg))
+        s_seg = seg[order]
+        s_res = jnp.where(candidates[:, None], st.task_resreq, 0.0)[order]
+        pos = jnp.arange(T)
+        seg_start = jnp.concatenate([jnp.array([True]), s_seg[1:] != s_seg[:-1]])
+        base_idx = jax.lax.associative_scan(jnp.maximum, jnp.where(seg_start, pos, 0))
+        c_incl = jnp.cumsum(s_res, axis=0)
+        c_incl = c_incl - (c_incl[base_idx] - s_res[base_idx])
+        rank_sorted = pos - base_idx
+        inv = jnp.zeros(T, jnp.int32).at[order].set(pos.astype(jnp.int32))
+        return rank_sorted.astype(jnp.int32)[inv], c_incl[inv]
+
+    job_rank, job_cum = _seg_rank_and_cum(vj)
+
+    def gang_ok():
+        # victim's job must stay gang-viable as victims accumulate:
+        # only the sparest (ready_cnt - min_avail) per job are eligible
+        cap = jnp.maximum(state.job_ready_cnt - sess.min_avail, 0)  # i32[J]
+        return candidates & (job_rank < cap[vj])
+
+    def drf_ok():
+        # cumulative: rs is the victim job's share after removing this
+        # victim AND all earlier victims of the same job
+        total = sess.drf_total
+        ls = jnp.max(safe_share(state.job_alloc[claimant_job] + req, total))
+        rs = jnp.max(safe_share(state.job_alloc[vj] - job_cum, total[None, :]), axis=-1)
+        return candidates & ((ls < rs) | (jnp.abs(ls - rs) <= SHARE_DELTA))
+
+    def proportion_ok():
+        # cumulative per victim queue: the queue must stay at/above its
+        # deserved after this and all earlier same-queue victims leave
+        vq = st.job_queue[vj]
+        _, queue_cum = _seg_rank_and_cum(vq)
+        after = state.queue_alloc[vq] - queue_cum
+        return candidates & jnp.all(sess.deserved[vq] < after + EPS, axis=-1)
+
+    verdict_fns = {"gang": gang_ok, "drf": drf_ok}
+    if reclaim:
+        verdict_fns = {"gang": gang_ok, "proportion": proportion_ok}
+
+    # Reference semantics (session_plugins.go:59-140): the verdict is the
+    # intersection of the FIRST tier containing any enabled verdict plugin.
+    # A non-nil tier result returns immediately; a nil one poisons later
+    # tiers (they intersect against nil), so later tiers never contribute.
+    for tier in tiers:
+        masks = [
+            verdict_fns[p.name]()
+            for p in tier.plugins
+            if p.name in verdict_fns and not getattr(p, attr)
+        ]
+        if not masks:
+            continue
+        tier_mask = masks[0]
+        for m in masks[1:]:
+            tier_mask = tier_mask & m
+        return tier_mask
+    return jnp.zeros_like(candidates)
+
+
+def _claim_turn(
+    q: jax.Array,
+    st: SnapshotTensors,
+    sess: SessionCtx,
+    state: AllocState,
+    tiers: Tiers,
+    s_max: int,
+    mode: str,  # "preempt" | "preempt_intra" | "reclaim"
+) -> AllocState:
+    """One queue turn of an eviction-based action: select claimant job and
+    group, select victims, evict the minimal prefix, pipeline claimant
+    tasks onto the freed (releasing) capacity."""
+    J = st.num_jobs
+    reclaim = mode == "reclaim"
+
+    if reclaim:
+        q_ok = st.queue_valid[q] & ~overused(state.queue_alloc, sess.deserved)[q]
+    else:
+        q_ok = st.queue_valid[q]  # preempt has no overused gate
+
+    # ---- claimant selection (same order machinery as allocate) ----
+    job_ready = state.job_ready_cnt >= sess.min_avail
+    grp_remaining = st.group_size - state.group_placed
+    grp_elig = (
+        st.group_valid
+        & ~st.group_best_effort
+        & (grp_remaining > 0)
+        & ~state.group_unfit
+        & sess.job_sched_valid[st.group_job]
+    )
+    job_has_pending = jnp.zeros(J, dtype=bool).at[st.group_job].max(grp_elig)
+    jmask = (st.job_queue == q) & job_has_pending & st.job_valid & q_ok
+    job_share = drf_shares(state.job_alloc, sess.drf_total)
+    jkeys = job_order_keys(tiers, st.job_priority, job_ready, st.job_creation_rank, job_share)
+    j, has_job = lex_argmin(jkeys, jmask)
+
+    gmask = (st.group_job == j) & grp_elig & has_job
+    gkeys = group_order_keys(tiers, st.group_priority, st.group_uid_rank)
+    g, has_grp = lex_argmin(gkeys, gmask)
+    req = st.group_resreq[g]
+
+    # budget: not-ready jobs preempt until ready; ready jobs one per turn
+    b_gang = jnp.where(
+        job_ready[j], 1, jnp.maximum(sess.min_avail[j] - state.job_ready_cnt[j], 1)
+    )
+    budget = jnp.where(has_grp, jnp.minimum(jnp.minimum(b_gang, grp_remaining[g]), s_max), 0)
+
+    # ---- victim candidates by scope ----
+    running = (state.task_status == RUNNING) & st.task_valid & (state.task_node >= 0)
+    vj = st.task_job
+    if mode == "preempt":
+        scope = running & (vj != j) & (st.job_queue[vj] == q)
+    elif mode == "preempt_intra":
+        scope = running & (vj == j) & (st.task_priority < st.group_priority[g])
+    else:  # reclaim: other queues' jobs
+        scope = running & (st.job_queue[vj] != q)
+    victims = _victim_verdict(st, state, sess, tiers, scope, j, req, reclaim) & has_grp
+
+    # ---- per-node victim prefix sums (deterministic order) ----
+    vnode = jnp.where(victims, state.task_node, jnp.int32(2**30))
+    order = jnp.lexsort((st.task_uid_rank, st.task_priority, vnode))
+    s_node = vnode[order]
+    s_res = jnp.where(victims[:, None], st.task_resreq, 0.0)[order]
+    c_incl = jnp.cumsum(s_res, axis=0)
+    seg_start = jnp.concatenate([jnp.array([True]), s_node[1:] != s_node[:-1]])
+    pos = jnp.arange(st.num_tasks)
+    base_idx = jax.lax.associative_scan(jnp.maximum, jnp.where(seg_start, pos, 0))
+    c_base = c_incl[base_idx] - s_res[base_idx]  # cumsum before segment start
+    c_excl = c_incl - s_res - c_base  # per-victim exclusive in-node prefix
+
+    totfree = jnp.zeros_like(state.node_releasing).at[
+        jnp.where(victims, state.task_node, 0)
+    ].add(jnp.where(victims[:, None], st.task_resreq, 0.0))
+
+    # ---- claimant placement capacity on freed+releasing space ----
+    preds_on = _plugin_on(tiers, "predicates", "predicate_disabled")
+    if preds_on:
+        static_ok = (
+            st.class_fit[st.group_klass[g], st.node_klass] & st.node_valid & ~st.node_unsched
+        )
+        ports_ok = jnp.all((st.group_ports[g][None, :] & state.node_ports) == 0, axis=-1)
+        pods_head = st.node_max_tasks - state.node_num_tasks
+        ok = static_ok & ports_ok & (pods_head > 0)
+        has_ports = jnp.any(st.group_ports[g] != 0)
+    else:
+        pods_head = jnp.full_like(state.node_num_tasks, s_max)
+        ok = st.node_valid
+        has_ports = jnp.array(False)
+
+    # Victims keep holding their pod slot and host ports while Releasing —
+    # the reference's stmt.Evict re-adds the task to the node with
+    # Releasing status (statement.go + node_info.go:101-127), so a
+    # max-pods-full node stays unpreemptable there too.
+    avail = state.node_releasing + totfree
+    cap = _node_capacity(avail, req, ok, pods_head, has_ports)
+
+    cum = jnp.cumsum(cap)
+    placed_total = jnp.minimum(budget, cum[-1])
+    p = jnp.clip(placed_total - (cum - cap), 0, cap)  # i32[N]
+
+    # ---- minimal victim prefix per node to cover p_n placements ----
+    needed = p.astype(jnp.float32)[:, None] * req[None, :] - state.node_releasing - EPS
+    needed_of_victim = needed[jnp.where(victims, state.task_node, 0)]
+    evict_sorted_scope = jnp.any(c_excl < needed_of_victim[order], axis=-1)
+    evict = jnp.zeros(st.num_tasks, bool).at[order].set(evict_sorted_scope)
+    evict = evict & victims & (p[jnp.where(victims, state.task_node, 0)] > 0)
+
+    freed = jnp.zeros_like(state.node_releasing).at[
+        jnp.where(evict, state.task_node, 0)
+    ].add(jnp.where(evict[:, None], st.task_resreq, 0.0))
+
+    # ---- decode claimant task assignment (same slot trick as allocate) ----
+    placed_before = state.group_placed[g]
+    slots = jnp.arange(s_max)
+    node_of_slot = jnp.searchsorted(cum, slots, side="right").astype(jnp.int32)
+    slot_of_task = st.task_group_rank - placed_before
+    assigned = (
+        (st.task_group == g) & (slot_of_task >= 0) & (slot_of_task < placed_total) & st.task_valid
+    )
+    tnode = node_of_slot[jnp.clip(slot_of_task, 0, s_max - 1)]
+
+    # ---- apply (scatter updates; no-ops when nothing placed) ----
+    evict_res = jnp.where(evict[:, None], st.task_resreq, 0.0)
+    evict_cnt = evict.astype(jnp.int32)
+    ptf = placed_total.astype(jnp.float32) * req
+    uncond = mode in ("preempt_intra", "reclaim")
+
+    new_status = jnp.where(evict, RELEASING, state.task_status)
+    new_status = jnp.where(assigned, PIPELINED, new_status)
+    evicted_for = jnp.where(
+        evict, jnp.where(uncond, jnp.int32(-2), j.astype(jnp.int32)), state.evicted_for
+    )
+
+    job_alloc = state.job_alloc.at[jnp.where(evict, vj, 0)].add(-evict_res)
+    job_alloc = job_alloc.at[j].add(ptf)
+    queue_alloc = state.queue_alloc.at[jnp.where(evict, st.job_queue[vj], 0)].add(-evict_res)
+    queue_alloc = queue_alloc.at[q].add(ptf)
+    job_ready_cnt = state.job_ready_cnt.at[jnp.where(evict, vj, 0)].add(-evict_cnt)
+    job_ready_cnt = job_ready_cnt.at[j].add(placed_total)
+
+    port_upd = jnp.where(
+        ((p > 0) & has_ports)[:, None],
+        state.node_ports | st.group_ports[g][None, :],
+        state.node_ports,
+    )
+    pipe_consumed = p.astype(jnp.float32)[:, None] * req[None, :]
+
+    return AllocState(
+        task_status=new_status,
+        task_node=jnp.where(assigned, tnode, state.task_node),
+        node_idle=state.node_idle,
+        node_releasing=state.node_releasing + freed - pipe_consumed,
+        node_ports=port_upd,
+        node_num_tasks=state.node_num_tasks + p,
+        job_alloc=job_alloc,
+        queue_alloc=queue_alloc,
+        job_ready_cnt=job_ready_cnt,
+        group_placed=state.group_placed.at[g].add(placed_total),
+        group_unfit=state.group_unfit.at[g].set(
+            state.group_unfit[g] | (has_grp & (placed_total < budget))
+        ),
+        evicted_for=evicted_for,
+        progress=state.progress | (placed_total > 0),
+        rounds=state.rounds,
+    )
+
+
+def _rounds(st, sess, state, tiers, s_max, max_rounds, mode):
+    Q = st.num_queues
+
+    def round_body(s):
+        s = dataclasses.replace(s, progress=jnp.array(False))
+        q_share = queue_shares(s.queue_alloc, sess.deserved)
+        keys = queue_order_keys(tiers, q_share, st.queue_uid_rank)
+        keys = [jnp.where(st.queue_valid, k, BIG) for k in keys]
+        perm = jnp.lexsort(tuple(reversed(keys)))
+
+        def body(qi, ss):
+            return _claim_turn(perm[qi], st, sess, ss, tiers, s_max, mode)
+
+        s = jax.lax.fori_loop(0, Q, body, s)
+        return dataclasses.replace(s, rounds=s.rounds + 1)
+
+    def cond(s):
+        return s.progress & (s.rounds < max_rounds)
+
+    state = dataclasses.replace(
+        state,
+        progress=jnp.array(True),
+        rounds=jnp.int32(0),
+        group_unfit=jnp.zeros_like(state.group_unfit),
+    )
+    return jax.lax.while_loop(cond, round_body, state)
+
+
+def preempt_action(
+    st: SnapshotTensors,
+    sess: SessionCtx,
+    state: AllocState,
+    tiers: Tiers,
+    s_max: int = 4096,
+    max_rounds: int = 100_000,
+) -> AllocState:
+    """Phase 1 (inter-job within queue) then phase 2 (intra-job priority)."""
+    state = _rounds(st, sess, state, tiers, s_max, max_rounds, "preempt")
+    state = _rounds(st, sess, state, tiers, s_max, max_rounds, "preempt_intra")
+    return state
+
+
+def reclaim_action(
+    st: SnapshotTensors,
+    sess: SessionCtx,
+    state: AllocState,
+    tiers: Tiers,
+    s_max: int = 4096,
+    max_rounds: int = 100_000,
+) -> AllocState:
+    return _rounds(st, sess, state, tiers, s_max, max_rounds, "reclaim")

@@ -1,0 +1,103 @@
+"""Framework-layer tests: conf parsing, session status write-back,
+scheduler loop convergence."""
+import pytest
+
+from kube_arbitrator_tpu.api import PodGroupPhase, TaskStatus
+from kube_arbitrator_tpu.cache import SimCluster, generate_cluster
+from kube_arbitrator_tpu.framework import (
+    SchedulerConfig,
+    Scheduler,
+    Session,
+    load_conf,
+)
+
+GB = 1024**3
+
+
+def test_default_conf_matches_reference():
+    cfg = SchedulerConfig.default()
+    assert cfg.actions == ("allocate", "backfill")
+    assert [p.name for p in cfg.tiers[0].plugins] == ["priority", "gang"]
+    assert [p.name for p in cfg.tiers[1].plugins] == ["drf", "predicates", "proportion"]
+
+
+def test_conf_disable_flags_and_full_actions():
+    cfg = load_conf(
+        """
+actions: "reclaim, allocate, backfill, preempt"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+    disablePreemptable: true
+- plugins:
+  - name: drf
+    disableJobOrder: true
+"""
+    )
+    assert cfg.actions == ("reclaim", "allocate", "backfill", "preempt")
+    gang = cfg.tiers[0].plugins[1]
+    assert gang.preemptable_disabled and not gang.reclaimable_disabled
+    assert cfg.tiers[1].plugins[0].job_order_disabled
+
+
+def test_unknown_action_rejected():
+    with pytest.raises(ValueError, match="failed to find Action"):
+        load_conf('actions: "allocate, fnord"')
+
+
+def test_session_status_writeback():
+    sim = SimCluster()
+    sim.add_queue("q")
+    sim.add_node("n1", cpu_milli=2000, memory=4 * GB)
+    # "ok" created first so the unready "blocked" gang (which would hold
+    # session resources — reference gang-blocking semantics) sorts after it
+    ok = sim.add_job("ok", queue="q", min_available=1, creation_ts=1)
+    sim.add_task(ok, 1000, GB)
+    blocked = sim.add_job("blocked", queue="q", min_available=5, creation_ts=2)
+    for _ in range(5):
+        sim.add_task(blocked, 1000, GB)
+    res = Session(sim.cluster).run()
+    # blocked gang gets an Unschedulable condition stamped with this session
+    st = res.job_status["blocked"]
+    assert st.phase == PodGroupPhase.PENDING
+    assert st.conditions and st.conditions[0].type == "Unschedulable"
+    assert st.conditions[0].transition_id == res.session_uid
+    assert res.job_status["ok"].conditions == []
+
+
+def test_job_status_unknown_phase():
+    """session.go:173-175: running tasks + unschedulable => Unknown."""
+    sim = SimCluster()
+    sim.add_queue("q")
+    sim.add_node("n1", cpu_milli=2000, memory=4 * GB)
+    j = sim.add_job("j", queue="q", min_available=4)
+    sim.add_task(j, 1000, GB, status=TaskStatus.RUNNING, node="n1")
+    for _ in range(3):
+        sim.add_task(j, 2000, GB)  # don't fit
+    res = Session(sim.cluster).run()
+    assert res.job_status["j"].phase == PodGroupPhase.UNKNOWN
+    assert res.job_status["j"].running == 1
+
+
+def test_scheduler_loop_drains_cluster():
+    sim = generate_cluster(num_nodes=32, num_jobs=10, tasks_per_job=10, num_queues=2, seed=1)
+    sched = Scheduler(sim)
+    cycles = sched.run(max_cycles=10)
+    total_binds = sum(s.binds for s in sched.history)
+    pending = sum(len(j.pending_tasks()) for j in sim.cluster.jobs.values())
+    bound = sum(
+        1
+        for j in sim.cluster.jobs.values()
+        for t in j.tasks.values()
+        if t.status == TaskStatus.BOUND
+    )
+    assert total_binds == bound
+    assert pending + bound == 100
+    assert cycles <= 10
+
+
+def test_cli_runs():
+    from kube_arbitrator_tpu.cli import main
+
+    assert main(["--sim-nodes", "16", "--sim-jobs", "4", "--sim-tasks-per-job", "5", "--json"]) == 0

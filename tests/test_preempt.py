@@ -1,0 +1,170 @@
+"""Preempt/reclaim action tests (e2e job.go preemption + queue.go reclaim
+scenario analogs)."""
+import numpy as np
+
+from kube_arbitrator_tpu.api import TaskStatus
+from kube_arbitrator_tpu.cache import SimCluster, build_snapshot
+from kube_arbitrator_tpu.cache.decode import decode_decisions
+from kube_arbitrator_tpu.ops import schedule_cycle
+
+GB = 1024**3
+FULL_ACTIONS = ("reclaim", "allocate", "backfill", "preempt")
+
+
+def run(sim, actions=FULL_ACTIONS):
+    snap = build_snapshot(sim.cluster)
+    dec = schedule_cycle(snap.tensors, actions=actions)
+    binds, evicts = decode_decisions(snap, dec)
+    return snap, dec, binds, evicts
+
+
+def _fill_running(sim, job, node, count, cpu=1000, prio=1):
+    for i in range(count):
+        sim.add_task(job, cpu, 0, status=TaskStatus.RUNNING, node=node,
+                     name=f"{job.uid}-r{i}", priority=prio)
+
+
+def test_default_conf_gang_tier_decides_preemption():
+    """Reference tier dispatch (session_plugins.go:100-140): the first tier
+    whose verdict is non-nil wins.  Under the default conf gang sits in
+    tier 1, so with an unprotected victim job (minMember=0) ALL its tasks
+    are preemptable and drf (tier 2) is never consulted — the fair split
+    emerges over subsequent cycles, not within one."""
+    sim = SimCluster()
+    sim.add_queue("q")
+    sim.add_node("n1", cpu_milli=8000, memory=16 * GB)
+    ja = sim.add_job("a", queue="q", creation_ts=1)
+    _fill_running(sim, ja, "n1", 8)
+    jb = sim.add_job("b", queue="q", min_available=1, creation_ts=2)
+    for i in range(8):
+        sim.add_task(jb, 1000, 0, name=f"b-p{i}")
+    snap, dec, binds, evicts = run(sim)
+    assert len(evicts) == 8  # gang verdict: victim job has no minMember floor
+
+
+def test_drf_preemption_converges_to_even_split():
+    """With gang's preemptable verdict disabled (conf flag,
+    scheduler_conf.go:33-50), drf gates preemption: job B preempts job A
+    only until dominant shares equalize (A keeps 4, B gets 4)."""
+    from kube_arbitrator_tpu.ops import PluginOption, Tier
+
+    tiers = (
+        Tier(plugins=(PluginOption.of("priority"),
+                      PluginOption.of("gang", preemptable_disabled=True))),
+        Tier(plugins=(PluginOption.of("drf"), PluginOption.of("predicates"),
+                      PluginOption.of("proportion"))),
+    )
+    sim = SimCluster()
+    sim.add_queue("q")
+    sim.add_node("n1", cpu_milli=8000, memory=16 * GB)
+    ja = sim.add_job("a", queue="q", creation_ts=1)
+    _fill_running(sim, ja, "n1", 8)
+    jb = sim.add_job("b", queue="q", min_available=1, creation_ts=2)
+    for i in range(8):
+        sim.add_task(jb, 1000, 0, name=f"b-p{i}")
+    snap = build_snapshot(sim.cluster)
+    dec = schedule_cycle(snap.tensors, tiers=tiers, actions=FULL_ACTIONS)
+    binds, evicts = decode_decisions(snap, dec)
+    evicted = {e.task_uid for e in evicts}
+    assert len(evicted) == 4, f"expected 4 evictions, got {sorted(evicted)}"
+    assert all(u.startswith("a-") for u in evicted)
+    # B's tasks are pipelined onto the releasing capacity (no binds yet)
+    status = np.asarray(dec.task_status)
+    piped = [t.uid for t in snap.index.tasks
+             if status[t.ordinal] == int(TaskStatus.PIPELINED)]
+    assert len([u for u in piped if u.startswith("b-")]) == 4
+
+
+def test_gang_protects_victims_from_preemption():
+    """gang.go:104-127: a victim job never drops below minMember."""
+    sim = SimCluster()
+    sim.add_queue("q")
+    sim.add_node("n1", cpu_milli=8000, memory=16 * GB)
+    ja = sim.add_job("a", queue="q", min_available=6, creation_ts=1)
+    _fill_running(sim, ja, "n1", 8)
+    jb = sim.add_job("b", queue="q", min_available=1, creation_ts=2)
+    for i in range(8):
+        sim.add_task(jb, 1000, 0, name=f"b-p{i}")
+    snap, dec, binds, evicts = run(sim)
+    # only 2 of A's 8 tasks are preemptable before hitting minMember=6
+    assert len(evicts) == 2
+
+
+def test_preemption_discarded_when_gang_cannot_complete():
+    """Statement-discard equivalent: preemptor needs 6 but only 4 victims
+    are obtainable -> no evictions are committed."""
+    sim = SimCluster()
+    sim.add_queue("q")
+    sim.add_node("n1", cpu_milli=8000, memory=16 * GB)
+    ja = sim.add_job("a", queue="q", min_available=4, creation_ts=1)
+    _fill_running(sim, ja, "n1", 8)
+    jb = sim.add_job("b", queue="q", min_available=6, creation_ts=2)
+    for i in range(6):
+        sim.add_task(jb, 1000, 0, name=f"b-p{i}")
+    snap, dec, binds, evicts = run(sim)
+    assert evicts == [], f"uncommitted preemption leaked: {evicts}"
+
+
+def test_intra_job_priority_preemption():
+    """preempt.go:133-163 phase 2: high-priority pending tasks replace
+    lower-priority running tasks of the same job."""
+    sim = SimCluster()
+    sim.add_queue("q")
+    sim.add_node("n1", cpu_milli=2000, memory=4 * GB)
+    j = sim.add_job("j", queue="q")
+    _fill_running(sim, j, "n1", 2, prio=1)
+    sim.add_task(j, 1000, 0, name="hi0", priority=10)
+    sim.add_task(j, 1000, 0, name="hi1", priority=10)
+    snap, dec, binds, evicts = run(sim)
+    assert len(evicts) == 2  # both low-priority tasks evicted
+    status = np.asarray(dec.task_status)
+    hi = [t.ordinal for t in snap.index.tasks if t.uid.startswith("hi")]
+    assert all(status[o] == int(TaskStatus.PIPELINED) for o in hi)
+
+
+def test_reclaim_cross_queue_to_deserved():
+    """queue.go:27-70: an empty-handed queue reclaims from an overused one
+    until both sit at their (equal-weight) deserved share."""
+    sim = SimCluster()
+    sim.add_queue("qa", weight=1)
+    sim.add_queue("qb", weight=1)
+    sim.add_node("n1", cpu_milli=8000, memory=16 * GB)
+    ja = sim.add_job("a", queue="qa", creation_ts=1)
+    _fill_running(sim, ja, "n1", 8)
+    jb = sim.add_job("b", queue="qb", min_available=1, creation_ts=2)
+    for i in range(8):
+        sim.add_task(jb, 1000, 0, name=f"b-p{i}")
+    snap, dec, binds, evicts = run(sim)
+    assert len(evicts) == 4  # qa reclaimed down to deserved = 4 cpu
+    status = np.asarray(dec.task_status)
+    piped = [t.uid for t in snap.index.tasks
+             if status[t.ordinal] == int(TaskStatus.PIPELINED) and t.uid.startswith("b-")]
+    assert len(piped) == 4
+    # reclaim evictions commit regardless of claimant details (direct Evict)
+    assert all(e.task_uid.startswith("a-") for e in evicts)
+
+
+def test_two_cycle_preemption_settles():
+    """After actuating cycle-1 decisions (evictions -> releasing, next
+    cycle the dying tasks are gone), cycle 2 binds the pipelined tasks.
+    Job A's minMember=4 lets gang protect half its tasks."""
+    sim = SimCluster()
+    sim.add_queue("q")
+    sim.add_node("n1", cpu_milli=8000, memory=16 * GB)
+    ja = sim.add_job("a", queue="q", min_available=4, creation_ts=1)
+    _fill_running(sim, ja, "n1", 8)
+    jb = sim.add_job("b", queue="q", min_available=1, creation_ts=2)
+    for i in range(8):
+        sim.add_task(jb, 1000, 0, name=f"b-p{i}")
+    snap, dec, binds, evicts = run(sim)
+    sim.apply_binds(binds)
+    sim.apply_evicts(evicts)
+    # simulate the evicted pods terminating: remove them from the cluster
+    for e in evicts:
+        t = sim.cluster.task_by_uid(e.task_uid)
+        sim.cluster.nodes[t.node_name].remove_task(t)
+        del sim.cluster.jobs[t.job_uid].tasks[t.uid]
+    snap2, dec2, binds2, evicts2 = run(sim)
+    b_bound = [b.task_uid for b in binds2 if b.task_uid.startswith("b-")]
+    assert len(b_bound) == 4
+    assert evicts2 == []
