@@ -10,19 +10,26 @@ from .snapshot import Snapshot
 
 
 def decode_decisions(snap: Snapshot, decisions) -> Tuple[List[BindIntent], List[EvictIntent]]:
-    """CycleDecisions tensors -> bind/evict intents keyed by task uid."""
+    """CycleDecisions tensors -> bind/evict intents keyed by task uid.
+
+    Works with both index flavors: the object-model SnapshotIndex
+    (``.tasks``/``.nodes`` lists) and the native cache's ordinal-lookup
+    index (``.task_uid()``/``.node_name()`` methods).
+    """
+    index = snap.index
+    if hasattr(index, "tasks"):
+        task_uid = lambda i: index.tasks[i].uid
+        node_name = lambda n: index.nodes[n].name
+    else:
+        task_uid = index.task_uid
+        node_name = index.node_name
     bind_mask = np.asarray(decisions.bind_mask)
     evict_mask = np.asarray(decisions.evict_mask)
     task_node = np.asarray(decisions.task_node)
     binds: List[BindIntent] = []
     evicts: List[EvictIntent] = []
     for i in np.nonzero(bind_mask)[0]:
-        binds.append(
-            BindIntent(
-                task_uid=snap.index.tasks[i].uid,
-                node_name=snap.index.nodes[task_node[i]].name,
-            )
-        )
+        binds.append(BindIntent(task_uid=task_uid(i), node_name=node_name(task_node[i])))
     for i in np.nonzero(evict_mask)[0]:
-        evicts.append(EvictIntent(task_uid=snap.index.tasks[i].uid))
+        evicts.append(EvictIntent(task_uid=task_uid(i)))
     return binds, evicts

@@ -1,0 +1,4 @@
+"""Native hostcache build + ctypes bindings."""
+from .binding import NativeCache, native_available
+
+__all__ = ["NativeCache", "native_available"]

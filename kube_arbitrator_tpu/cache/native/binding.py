@@ -1,0 +1,336 @@
+"""ctypes bindings for the C++ hostcache (no pybind11 in this image).
+
+Builds ``libhostcache.so`` from the adjacent .cpp on first use (g++, cached
+by source mtime); ``native_available()`` reports whether a toolchain exists
+so callers can fall back to the pure-Python snapshot plane.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...api import resource as res
+from ...api.info import Taint, Toleration
+from ..snapshot import (
+    DEVICE_SCALE,
+    Snapshot,
+    SnapshotIndex,
+    SnapshotTensors,
+    _selector_matches,
+    _tolerates_all,
+)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "hostcache.cpp")
+_SO = os.path.join(_HERE, "libhostcache.so")
+
+_lib = None
+_build_error: Optional[str] = None
+
+
+def _build() -> Optional[str]:
+    try:
+        src_m = os.path.getmtime(_SRC)
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < src_m:
+            subprocess.run(
+                ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", _SO, _SRC],
+                check=True,
+                capture_output=True,
+                text=True,
+            )
+        return None
+    except FileNotFoundError:
+        return "g++ not found"
+    except subprocess.CalledProcessError as e:
+        return f"hostcache build failed:\n{e.stderr}"
+
+
+def _load():
+    global _lib, _build_error
+    if _lib is not None or _build_error is not None:
+        return _lib
+    _build_error = _build()
+    if _build_error is not None:
+        return None
+    lib = ctypes.CDLL(_SO)
+    c = ctypes
+    f32p, i32p, i64p, u8p = (
+        c.POINTER(c.c_float),
+        c.POINTER(c.c_int32),
+        c.POINTER(c.c_int64),
+        c.POINTER(c.c_uint8),
+    )
+    lib.hc_new.restype = c.c_void_p
+    lib.hc_free.argtypes = [c.c_void_p]
+    lib.hc_last_error.argtypes = [c.c_void_p]
+    lib.hc_last_error.restype = c.c_char_p
+    lib.hc_upsert_queue.argtypes = [c.c_void_p, c.c_char_p, c.c_float]
+    lib.hc_upsert_node.argtypes = [c.c_void_p, c.c_char_p, f32p, c.c_int32, c.c_int32, c.c_char_p]
+    lib.hc_upsert_job.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p, c.c_int32, c.c_int32, c.c_double]
+    lib.hc_upsert_task.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_char_p, f32p, c.c_int32, c.c_int32,
+        c.c_char_p, c.c_char_p, i32p, c.c_int32,
+    ]
+    lib.hc_delete_task.argtypes = [c.c_void_p, c.c_char_p]
+    lib.hc_delete_node.argtypes = [c.c_void_p, c.c_char_p]
+    lib.hc_delete_job.argtypes = [c.c_void_p, c.c_char_p]
+    lib.hc_set_others_used.argtypes = [c.c_void_p, f32p]
+    lib.hc_snapshot_sizes.argtypes = [c.c_void_p, i64p]
+    lib.hc_snapshot_fill.argtypes = [c.c_void_p] + [f32p, i32p, i32p, i32p, i32p, i32p, i32p, i32p, u8p, u8p, i32p, i32p] + [i32p, f32p, i32p, i32p, i32p, i32p, i32p, u8p, u8p] + [f32p, f32p, f32p, i32p, i32p, i32p, i32p, u8p, u8p] + [i32p, i32p, i32p, i32p, u8p] + [f32p, i32p, u8p] + [f32p]
+    for fn in ("hc_task_uid_at", "hc_node_name_at", "hc_job_uid_at"):
+        getattr(lib, fn).argtypes = [c.c_void_p, c.c_int64, c.c_char_p, c.c_int64]
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+class NativeCache:
+    """Event-driven cluster cache backed by the C++ columnar store.
+
+    Mirrors the reference cache's event-handler surface
+    (event_handlers.go AddPod/UpdatePod/DeletePod, AddNode, AddPodGroup,
+    AddQueue) with device-unit resource vectors. Class signatures for the
+    relational predicates are interned in C++; the small class_fit table is
+    computed here from per-class representatives.
+    """
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native hostcache unavailable: {_build_error}")
+        self._lib = lib
+        self._h = ctypes.c_void_p(lib.hc_new())
+        # class representatives for fit-table computation
+        self._task_class_rep: Dict[str, Tuple[dict, list]] = {}
+        self._node_class_rep: Dict[str, Tuple[dict, list]] = {}
+
+    def __del__(self):
+        try:
+            self._lib.hc_free(self._h)
+        except Exception:
+            pass
+
+    # ---- event surface ----
+
+    def _err(self) -> str:
+        return self._lib.hc_last_error(self._h).decode()
+
+    def upsert_queue(self, uid: str, weight: float = 1.0) -> None:
+        self._lib.hc_upsert_queue(self._h, uid.encode(), ctypes.c_float(weight))
+
+    def upsert_node(
+        self,
+        name: str,
+        allocatable_host_units: np.ndarray,
+        max_tasks: int = 110,
+        unschedulable: bool = False,
+        labels: Optional[Dict[str, str]] = None,
+        taints: Sequence[Taint] = (),
+    ) -> None:
+        labels = dict(labels or {})
+        taints = list(taints)
+        sig = repr((tuple(sorted(labels.items())),
+                    tuple(sorted((t.key, t.value, t.effect) for t in taints))))
+        self._node_class_rep.setdefault(sig, (labels, taints))
+        alloc = (np.asarray(allocatable_host_units, dtype=np.float64) * DEVICE_SCALE).astype(
+            np.float32
+        )
+        self._lib.hc_upsert_node(
+            self._h, name.encode(), _ptr(alloc, ctypes.c_float),
+            max_tasks, int(unschedulable), sig.encode(),
+        )
+
+    def upsert_job(
+        self, uid: str, queue: str, min_available: int = 0, priority: int = 0,
+        creation_ts: float = 0.0,
+    ) -> None:
+        self._lib.hc_upsert_job(
+            self._h, uid.encode(), queue.encode(), min_available, priority, creation_ts
+        )
+
+    def upsert_task(
+        self,
+        uid: str,
+        job_uid: str,
+        resreq_host_units: np.ndarray,
+        status: int,
+        priority: int = 1,
+        node_name: str = "",
+        node_selector: Optional[Dict[str, str]] = None,
+        tolerations: Sequence[Toleration] = (),
+        host_ports: Sequence[int] = (),
+    ) -> None:
+        selector = dict(node_selector or {})
+        tols = list(tolerations)
+        sig = repr((tuple(sorted(selector.items())),
+                    tuple(sorted((t.key, t.operator, t.value, t.effect) for t in tols))))
+        self._task_class_rep.setdefault(sig, (selector, tols))
+        req = (np.asarray(resreq_host_units, dtype=np.float64) * DEVICE_SCALE).astype(np.float32)
+        ports = np.asarray(list(host_ports), dtype=np.int32)
+        rc = self._lib.hc_upsert_task(
+            self._h, uid.encode(), job_uid.encode(), _ptr(req, ctypes.c_float),
+            int(status), priority, node_name.encode(), sig.encode(),
+            _ptr(ports, ctypes.c_int32), len(ports),
+        )
+        if rc < 0:
+            raise ValueError(self._err())
+
+    def delete_task(self, uid: str) -> None:
+        if self._lib.hc_delete_task(self._h, uid.encode()) < 0:
+            raise KeyError(self._err())
+
+    def delete_node(self, name: str) -> None:
+        if self._lib.hc_delete_node(self._h, name.encode()) < 0:
+            raise KeyError(self._err())
+
+    def delete_job(self, uid: str) -> None:
+        if self._lib.hc_delete_job(self._h, uid.encode()) < 0:
+            raise KeyError(self._err())
+
+    def set_others_used(self, used_host_units: np.ndarray) -> None:
+        u = (np.asarray(used_host_units, dtype=np.float64) * DEVICE_SCALE).astype(np.float32)
+        self._lib.hc_set_others_used(self._h, _ptr(u, ctypes.c_float))
+
+    # ---- snapshot ----
+
+    def _class_fit(self, ct: int, cn: int) -> np.ndarray:
+        fit = np.ones((max(ct, 1), max(cn, 1)), dtype=bool)
+        # class ids are assigned in insertion order of the interned sigs
+        class _T:  # minimal shims for the shared matcher helpers
+            pass
+
+        for i, (tsig, (selector, tols)) in enumerate(self._task_class_rep.items()):
+            trep = _T()
+            trep.node_selector = selector
+            trep.tolerations = tols
+            for jn, (nsig, (labels, taints)) in enumerate(self._node_class_rep.items()):
+                nrep = _T()
+                nrep.labels = labels
+                nrep.taints = taints
+                fit[i, jn] = _selector_matches(selector, labels) and _tolerates_all(trep, nrep)
+        return fit
+
+    def snapshot(self) -> Snapshot:
+        lib = self._lib
+        sizes = np.zeros(8, dtype=np.int64)
+        lib.hc_snapshot_sizes(self._h, _ptr(sizes, ctypes.c_int64))
+        T, N, J, Q, G, CT, CN, W = (int(x) for x in sizes)
+        Rr = res.NUM_RESOURCES
+
+        buf = {
+            "task_resreq": np.zeros((T, Rr), np.float32),
+            "task_job": np.zeros(T, np.int32),
+            "task_status": np.full(T, 9, np.int32),
+            "task_priority": np.zeros(T, np.int32),
+            "task_uid_rank": np.zeros(T, np.int32),
+            "task_klass": np.zeros(T, np.int32),
+            "task_node": np.full(T, -1, np.int32),
+            "task_ports": np.zeros((T, W), np.int32),
+            "task_valid": np.zeros(T, np.uint8),
+            "task_best_effort": np.zeros(T, np.uint8),
+            "task_group": np.full(T, -1, np.int32),
+            "task_group_rank": np.zeros(T, np.int32),
+            "group_job": np.zeros(G, np.int32),
+            "group_resreq": np.zeros((G, Rr), np.float32),
+            "group_klass": np.zeros(G, np.int32),
+            "group_ports": np.zeros((G, W), np.int32),
+            "group_size": np.zeros(G, np.int32),
+            "group_priority": np.zeros(G, np.int32),
+            "group_uid_rank": np.zeros(G, np.int32),
+            "group_best_effort": np.zeros(G, np.uint8),
+            "group_valid": np.zeros(G, np.uint8),
+            "node_idle": np.zeros((N, Rr), np.float32),
+            "node_releasing": np.zeros((N, Rr), np.float32),
+            "node_alloc": np.zeros((N, Rr), np.float32),
+            "node_max_tasks": np.zeros(N, np.int32),
+            "node_num_tasks": np.zeros(N, np.int32),
+            "node_klass": np.zeros(N, np.int32),
+            "node_ports": np.zeros((N, W), np.int32),
+            "node_unsched": np.zeros(N, np.uint8),
+            "node_valid": np.zeros(N, np.uint8),
+            "job_queue": np.zeros(J, np.int32),
+            "job_min_available": np.zeros(J, np.int32),
+            "job_priority": np.zeros(J, np.int32),
+            "job_creation_rank": np.zeros(J, np.int32),
+            "job_valid": np.zeros(J, np.uint8),
+            "queue_weight": np.zeros(Q, np.float32),
+            # match the python plane's arange pre-fill (padding included)
+            "queue_uid_rank": np.arange(Q, dtype=np.int32),
+            "queue_valid": np.zeros(Q, np.uint8),
+            "others_used": np.zeros(Rr, np.float32),
+        }
+        order = [
+            "task_resreq", "task_job", "task_status", "task_priority",
+            "task_uid_rank", "task_klass", "task_node", "task_ports",
+            "task_valid", "task_best_effort", "task_group", "task_group_rank",
+            "group_job", "group_resreq", "group_klass", "group_ports",
+            "group_size", "group_priority", "group_uid_rank",
+            "group_best_effort", "group_valid",
+            "node_idle", "node_releasing", "node_alloc", "node_max_tasks",
+            "node_num_tasks", "node_klass", "node_ports", "node_unsched",
+            "node_valid",
+            "job_queue", "job_min_available", "job_priority",
+            "job_creation_rank", "job_valid",
+            "queue_weight", "queue_uid_rank", "queue_valid",
+            "others_used",
+        ]
+        args = []
+        for k in order:
+            a = buf[k]
+            ctype = {np.dtype(np.float32): ctypes.c_float, np.dtype(np.int32): ctypes.c_int32,
+                     np.dtype(np.uint8): ctypes.c_uint8}[a.dtype]
+            args.append(_ptr(a, ctype))
+        lib.hc_snapshot_fill(self._h, *args)
+
+        bools = [k for k, a in buf.items() if a.dtype == np.uint8]
+        for k in bools:
+            buf[k] = buf[k].astype(bool)
+        tensors = SnapshotTensors(class_fit=self._class_fit(CT, CN), **buf)
+        index = NativeSnapshotIndex(self)
+        return Snapshot(tensors=tensors, index=index)
+
+    # ---- decode-by-ordinal (valid until the next snapshot) ----
+
+    def task_uid_at(self, ordinal: int) -> str:
+        return self._name_at("hc_task_uid_at", ordinal)
+
+    def node_name_at(self, ordinal: int) -> str:
+        return self._name_at("hc_node_name_at", ordinal)
+
+    def job_uid_at(self, ordinal: int) -> str:
+        return self._name_at("hc_job_uid_at", ordinal)
+
+    def _name_at(self, fn: str, ordinal: int) -> str:
+        b = ctypes.create_string_buffer(512)
+        rc = getattr(self._lib, fn)(self._h, ordinal, b, 512)
+        if rc < 0:
+            raise IndexError(f"{fn}({ordinal})")
+        return b.value.decode()
+
+
+class NativeSnapshotIndex:
+    """Duck-typed SnapshotIndex backed by ordinal lookups into the native
+    cache (valid until the next snapshot)."""
+
+    def __init__(self, cache: NativeCache):
+        self._cache = cache
+
+    def task_uid(self, ordinal: int) -> str:
+        return self._cache.task_uid_at(ordinal)
+
+    def node_name(self, ordinal: int) -> str:
+        return self._cache.node_name_at(ordinal)
+
+    def job_uid(self, ordinal: int) -> str:
+        return self._cache.job_uid_at(ordinal)

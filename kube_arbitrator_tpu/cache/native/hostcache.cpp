@@ -1,0 +1,579 @@
+// hostcache: columnar, event-driven cluster cache (snapshot plane hot path).
+//
+// Native equivalent of the reference's SchedulerCache
+// (pkg/scheduler/cache/cache.go:55-675 + event_handlers.go): maintains
+// cluster state incrementally from add/update/delete events and emits the
+// dense snapshot arrays the decision plane consumes — replacing the
+// reference's per-cycle deep-copy snapshot with O(changed) event
+// application plus O(entities) buffer fills into caller-owned memory.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this environment).
+// Units follow the device convention: resources are [cpu_milli, mem_MiB,
+// gpu_milli] float32; the epsilon is uniformly 10.0 (resource_info.go:54-56).
+//
+// Status lattice values match api/types.py (TaskStatus).
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int R = 3;
+constexpr float EPS = 10.0f;
+constexpr int PORT_WORDS = 2;
+constexpr int MAX_PORTS = PORT_WORDS * 31;
+
+enum Status : int32_t {
+  PENDING = 0,
+  ALLOCATED = 1,
+  PIPELINED = 2,
+  BINDING = 3,
+  BOUND = 4,
+  RUNNING = 5,
+  RELEASING = 6,
+  SUCCEEDED = 7,
+  FAILED = 8,
+  UNKNOWN = 9,
+};
+
+bool allocated_status(int32_t s) {
+  return s == ALLOCATED || s == BINDING || s == BOUND || s == RUNNING;
+}
+
+struct Task {
+  std::string uid;
+  int32_t job = -1;       // job index
+  float resreq[R] = {0, 0, 0};
+  int32_t status = PENDING;
+  int32_t priority = 1;
+  int32_t node = -1;      // node index, -1 unassigned
+  int32_t klass = 0;      // predicate equivalence class
+  int32_t ports[PORT_WORDS] = {0, 0};
+  std::vector<int32_t> port_list;  // raw ports (masks rebuilt on universe growth)
+  bool best_effort = true;
+  bool alive = true;
+};
+
+struct Node {
+  std::string name;
+  float alloc[R] = {0, 0, 0};
+  float idle[R] = {0, 0, 0};
+  float releasing[R] = {0, 0, 0};
+  int32_t max_tasks = 110;
+  int32_t num_tasks = 0;
+  int32_t klass = 0;
+  int32_t ports[PORT_WORDS] = {0, 0};
+  bool unschedulable = false;
+  bool alive = true;
+};
+
+struct Job {
+  std::string uid;
+  int32_t queue = -1;
+  int32_t min_available = 0;
+  int32_t priority = 0;
+  double creation_ts = 0;
+  bool alive = true;
+};
+
+struct Queue {
+  std::string uid;
+  float weight = 1;
+  bool alive = true;
+};
+
+struct SnapLayout {
+  std::vector<int32_t> live_tasks;   // task indices, ordered (job, uid)
+  std::vector<int32_t> live_nodes;
+  std::vector<int32_t> live_jobs;
+  std::vector<int32_t> live_queues;
+  std::vector<int32_t> group_of_task;   // per live task
+  std::vector<int32_t> group_rank;      // per live task
+  int64_t G = 0;
+};
+
+struct Cache {
+  std::vector<Task> tasks;
+  std::vector<Node> nodes;
+  std::vector<Job> jobs;
+  std::vector<Queue> queues;
+  SnapLayout layout;  // per-cache: valid between snapshot_sizes and lookups
+  std::unordered_map<std::string, int32_t> task_by_uid;
+  std::unordered_map<std::string, int32_t> node_by_name;
+  std::unordered_map<std::string, int32_t> job_by_uid;
+  std::unordered_map<std::string, int32_t> queue_by_uid;
+  // predicate class interning: signature string -> class id
+  std::unordered_map<std::string, int32_t> task_class_by_sig;
+  std::unordered_map<std::string, int32_t> node_class_by_sig;
+  // host-port universe (bit position per distinct port)
+  std::unordered_map<int32_t, int32_t> port_pos;
+  float others_used[R] = {0, 0, 0};
+  std::string error;  // last error message
+};
+
+bool less_equal_eps(const float* a, const float* b) {
+  for (int r = 0; r < R; ++r)
+    if (!(a[r] < b[r] + EPS)) return false;
+  return true;
+}
+
+bool is_empty_res(const float* a) {
+  for (int r = 0; r < R; ++r)
+    if (a[r] >= EPS) return false;
+  return true;
+}
+
+// Status-aware node accounting (node_info.go:101-157).
+bool node_add_task(Cache& c, Node& n, const Task& t) {
+  if (t.status == RELEASING) {
+    for (int r = 0; r < R; ++r) n.releasing[r] += t.resreq[r];
+    if (!less_equal_eps(t.resreq, n.idle)) { c.error = "insufficient idle on " + n.name; return false; }
+    for (int r = 0; r < R; ++r) n.idle[r] -= t.resreq[r];
+  } else if (t.status == PIPELINED) {
+    if (!less_equal_eps(t.resreq, n.releasing)) { c.error = "insufficient releasing on " + n.name; return false; }
+    for (int r = 0; r < R; ++r) n.releasing[r] -= t.resreq[r];
+  } else {
+    if (!less_equal_eps(t.resreq, n.idle)) { c.error = "insufficient idle on " + n.name; return false; }
+    for (int r = 0; r < R; ++r) n.idle[r] -= t.resreq[r];
+  }
+  n.num_tasks += 1;
+  for (int w = 0; w < PORT_WORDS; ++w) n.ports[w] |= t.ports[w];
+  return true;
+}
+
+void node_remove_task(Cache& c, Node& n, const Task& t) {
+  if (t.status == RELEASING) {
+    for (int r = 0; r < R; ++r) { n.releasing[r] -= t.resreq[r]; n.idle[r] += t.resreq[r]; }
+  } else if (t.status == PIPELINED) {
+    for (int r = 0; r < R; ++r) n.releasing[r] += t.resreq[r];
+  } else {
+    for (int r = 0; r < R; ++r) n.idle[r] += t.resreq[r];
+  }
+  n.num_tasks -= 1;
+  // ports are rebuilt lazily at snapshot (removal can't clear shared bits)
+}
+
+void rebuild_node_ports(Cache& c) {
+  for (auto& n : c.nodes) { n.ports[0] = 0; n.ports[1] = 0; }
+  for (auto& t : c.tasks) {
+    if (!t.alive || t.node < 0) continue;
+    Node& n = c.nodes[t.node];
+    for (int w = 0; w < PORT_WORDS; ++w) n.ports[w] |= t.ports[w];
+  }
+}
+
+bool set_ports(Cache& c, Task& t, const int32_t* ports, int n_ports) {
+  t.port_list.assign(ports, ports + n_ports);
+  t.ports[0] = t.ports[1] = 0;
+  for (int i = 0; i < n_ports; ++i) {
+    auto it = c.port_pos.find(ports[i]);
+    int pos;
+    if (it == c.port_pos.end()) {
+      pos = (int)c.port_pos.size();
+      if (pos >= MAX_PORTS) { c.error = "host-port universe exceeded"; return false; }
+      c.port_pos[ports[i]] = pos;
+    } else {
+      pos = it->second;
+    }
+    t.ports[pos / 31] |= (int32_t)(1u << (pos % 31));
+  }
+  return true;
+}
+
+int64_t bucket(int64_t n, int64_t mult, int64_t min) {
+  n = n < 1 ? 1 : n;
+  int64_t b = ((n + mult - 1) / mult) * mult;
+  return b < min ? min : b;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* hc_new() { return new Cache(); }
+void hc_free(void* h) { delete static_cast<Cache*>(h); }
+
+const char* hc_last_error(void* h) { return static_cast<Cache*>(h)->error.c_str(); }
+
+int32_t hc_upsert_queue(void* h, const char* uid, float weight) {
+  Cache& c = *static_cast<Cache*>(h);
+  auto it = c.queue_by_uid.find(uid);
+  if (it != c.queue_by_uid.end()) {
+    c.queues[it->second].weight = weight;
+    c.queues[it->second].alive = true;
+    return it->second;
+  }
+  int32_t idx = (int32_t)c.queues.size();
+  c.queues.push_back(Queue{uid, weight, true});
+  c.queue_by_uid[uid] = idx;
+  return idx;
+}
+
+int32_t hc_upsert_node(void* h, const char* name, const float* alloc,
+                       int32_t max_tasks, int32_t unschedulable,
+                       const char* class_sig) {
+  Cache& c = *static_cast<Cache*>(h);
+  auto it = c.node_by_name.find(name);
+  if (it != c.node_by_name.end()) {
+    Node& n = c.nodes[it->second];
+    // SetNode (node_info.go:82-99): re-derive idle from new allocatable
+    float used[R];
+    for (int r = 0; r < R; ++r) used[r] = n.alloc[r] - n.idle[r];
+    for (int r = 0; r < R; ++r) { n.alloc[r] = alloc[r]; n.idle[r] = alloc[r] - used[r]; }
+    n.max_tasks = max_tasks;
+    n.unschedulable = unschedulable != 0;
+    n.alive = true;
+    auto cit = c.node_class_by_sig.emplace(class_sig, (int32_t)c.node_class_by_sig.size());
+    n.klass = cit.first->second;
+    return it->second;
+  }
+  int32_t idx = (int32_t)c.nodes.size();
+  Node n;
+  n.name = name;
+  for (int r = 0; r < R; ++r) { n.alloc[r] = alloc[r]; n.idle[r] = alloc[r]; }
+  n.max_tasks = max_tasks;
+  n.unschedulable = unschedulable != 0;
+  auto cit = c.node_class_by_sig.emplace(class_sig, (int32_t)c.node_class_by_sig.size());
+  n.klass = cit.first->second;
+  c.nodes.push_back(std::move(n));
+  c.node_by_name[name] = idx;
+  return idx;
+}
+
+int32_t hc_upsert_job(void* h, const char* uid, const char* queue_uid,
+                      int32_t min_available, int32_t priority, double creation_ts) {
+  Cache& c = *static_cast<Cache*>(h);
+  int32_t q = -1;
+  auto qit = c.queue_by_uid.find(queue_uid);
+  if (qit != c.queue_by_uid.end()) q = qit->second;
+  auto it = c.job_by_uid.find(uid);
+  if (it != c.job_by_uid.end()) {
+    Job& j = c.jobs[it->second];
+    j.queue = q; j.min_available = min_available; j.priority = priority;
+    j.creation_ts = creation_ts; j.alive = true;
+    return it->second;
+  }
+  int32_t idx = (int32_t)c.jobs.size();
+  c.jobs.push_back(Job{uid, q, min_available, priority, creation_ts, true});
+  c.job_by_uid[uid] = idx;
+  return idx;
+}
+
+// Add or update a task (event_handlers.go AddPod/UpdatePod path).
+// node_name == "" means unassigned. Returns task index or -1 on error.
+int32_t hc_upsert_task(void* h, const char* uid, const char* job_uid,
+                       const float* resreq, int32_t status, int32_t priority,
+                       const char* node_name, const char* class_sig,
+                       const int32_t* ports, int32_t n_ports) {
+  Cache& c = *static_cast<Cache*>(h);
+  auto jit = c.job_by_uid.find(job_uid);
+  if (jit == c.job_by_uid.end()) { c.error = std::string("unknown job ") + job_uid; return -1; }
+
+  int32_t nidx = -1;
+  if (node_name[0] != '\0') {
+    auto nit = c.node_by_name.find(node_name);
+    if (nit == c.node_by_name.end()) { c.error = std::string("unknown node ") + node_name; return -1; }
+    nidx = nit->second;
+  }
+
+  auto it = c.task_by_uid.find(uid);
+  int32_t idx;
+  bool existed = it != c.task_by_uid.end();
+  if (existed) {
+    idx = it->second;
+  } else {
+    idx = (int32_t)c.tasks.size();
+    c.tasks.push_back(Task{});
+    c.task_by_uid[uid] = idx;
+  }
+  // Build the new record fully, then swap under accounting — a failed
+  // placement must leave the old state intact (an UpdatePod event must
+  // not detach a still-running task on failure).
+  Task old = c.tasks[idx];
+  Task t;
+  t.uid = uid;
+  t.job = jit->second;
+  for (int r = 0; r < R; ++r) t.resreq[r] = resreq[r];
+  t.status = status;
+  t.priority = priority;
+  t.node = nidx;
+  t.alive = true;
+  t.best_effort = is_empty_res(t.resreq);
+  auto cit = c.task_class_by_sig.emplace(class_sig, (int32_t)c.task_class_by_sig.size());
+  t.klass = cit.first->second;
+  if (!set_ports(c, t, ports, n_ports)) return -1;
+
+  if (existed && old.alive && old.node >= 0) node_remove_task(c, c.nodes[old.node], old);
+  if (nidx >= 0 && !node_add_task(c, c.nodes[nidx], t)) {
+    // roll back: restore the previous record and its node accounting
+    if (existed && old.alive && old.node >= 0) node_add_task(c, c.nodes[old.node], old);
+    c.tasks[idx] = old;
+    if (!existed) c.tasks[idx].alive = false;
+    return -1;
+  }
+  c.tasks[idx] = std::move(t);
+  return idx;
+}
+
+int32_t hc_delete_task(void* h, const char* uid) {
+  Cache& c = *static_cast<Cache*>(h);
+  auto it = c.task_by_uid.find(uid);
+  if (it == c.task_by_uid.end()) { c.error = std::string("unknown task ") + uid; return -1; }
+  Task& t = c.tasks[it->second];
+  if (t.alive && t.node >= 0) node_remove_task(c, c.nodes[t.node], t);
+  t.alive = false;
+  t.node = -1;
+  rebuild_node_ports(c);
+  return 0;
+}
+
+int32_t hc_delete_node(void* h, const char* name) {
+  Cache& c = *static_cast<Cache*>(h);
+  auto it = c.node_by_name.find(name);
+  if (it == c.node_by_name.end()) { c.error = std::string("unknown node ") + name; return -1; }
+  c.nodes[it->second].alive = false;
+  for (auto& t : c.tasks)
+    if (t.alive && t.node == it->second) t.node = -1;
+  return 0;
+}
+
+int32_t hc_delete_job(void* h, const char* uid) {
+  Cache& c = *static_cast<Cache*>(h);
+  auto it = c.job_by_uid.find(uid);
+  if (it == c.job_by_uid.end()) { c.error = std::string("unknown job ") + uid; return -1; }
+  int32_t jidx = it->second;
+  c.jobs[jidx].alive = false;
+  for (auto& t : c.tasks) {
+    if (!t.alive || t.job != jidx) continue;
+    if (t.node >= 0) node_remove_task(c, c.nodes[t.node], t);
+    t.alive = false; t.node = -1;
+  }
+  rebuild_node_ports(c);
+  return 0;
+}
+
+void hc_set_others_used(void* h, const float* used) {
+  Cache& c = *static_cast<Cache*>(h);
+  for (int r = 0; r < R; ++r) c.others_used[r] = used[r];
+}
+
+// ---- snapshot ----
+// Sizes: out[0..7] = T, N, J, Q, G, CT, CN, W (padded buckets).
+// A size query must be followed by hc_snapshot_fill with buffers of these
+// shapes; intervening events invalidate the sizes.
+
+void hc_snapshot_sizes(void* h, int64_t* out) {
+  Cache& c = *static_cast<Cache*>(h);
+  SnapLayout& L = c.layout;
+  L = SnapLayout{};
+
+  for (int32_t i = 0; i < (int32_t)c.nodes.size(); ++i)
+    if (c.nodes[i].alive) L.live_nodes.push_back(i);
+  for (int32_t i = 0; i < (int32_t)c.jobs.size(); ++i)
+    if (c.jobs[i].alive) L.live_jobs.push_back(i);
+  for (int32_t i = 0; i < (int32_t)c.queues.size(); ++i)
+    if (c.queues[i].alive) L.live_queues.push_back(i);
+  for (int32_t i = 0; i < (int32_t)c.tasks.size(); ++i)
+    if (c.tasks[i].alive) L.live_tasks.push_back(i);
+
+  std::sort(L.live_nodes.begin(), L.live_nodes.end(),
+            [&](int a, int b) { return c.nodes[a].name < c.nodes[b].name; });
+  std::sort(L.live_jobs.begin(), L.live_jobs.end(),
+            [&](int a, int b) { return c.jobs[a].uid < c.jobs[b].uid; });
+  std::sort(L.live_queues.begin(), L.live_queues.end(),
+            [&](int a, int b) { return c.queues[a].uid < c.queues[b].uid; });
+  std::sort(L.live_tasks.begin(), L.live_tasks.end(), [&](int a, int b) {
+    const Task &ta = c.tasks[a], &tb = c.tasks[b];
+    if (ta.job != tb.job) return c.jobs[ta.job].uid < c.jobs[tb.job].uid;
+    return ta.uid < tb.uid;
+  });
+
+  // task grouping (pending only): key = (job, resreq, klass, ports, prio)
+  std::unordered_map<std::string, int32_t> group_ids;
+  L.group_of_task.assign(L.live_tasks.size(), -1);
+  L.group_rank.assign(L.live_tasks.size(), 0);
+  std::vector<int32_t> group_counts;
+  for (size_t k = 0; k < L.live_tasks.size(); ++k) {
+    const Task& t = c.tasks[L.live_tasks[k]];
+    if (t.status != PENDING) continue;
+    char key[256];
+    snprintf(key, sizeof key, "%d|%.6f|%.6f|%.6f|%d|%d|%d|%d|%d", t.job,
+             t.resreq[0], t.resreq[1], t.resreq[2], t.klass, t.ports[0],
+             t.ports[1], t.priority, (int)t.best_effort);
+    auto ins = group_ids.emplace(key, (int32_t)group_ids.size());
+    int32_t g = ins.first->second;
+    if (ins.second) group_counts.push_back(0);
+    L.group_of_task[k] = g;
+    L.group_rank[k] = group_counts[g]++;  // live_tasks sorted by uid -> rank by uid
+  }
+  L.G = (int64_t)group_ids.size();
+
+  out[0] = bucket((int64_t)L.live_tasks.size(), 8, 8);
+  out[1] = bucket((int64_t)L.live_nodes.size(), 128, 128);
+  out[2] = bucket((int64_t)L.live_jobs.size(), 8, 8);
+  out[3] = bucket((int64_t)L.live_queues.size(), 8, 8);
+  out[4] = bucket(L.G, 8, 8);
+  out[5] = (int64_t)std::max<size_t>(c.task_class_by_sig.size(), 1);
+  out[6] = (int64_t)std::max<size_t>(c.node_class_by_sig.size(), 1);
+  out[7] = PORT_WORDS;
+}
+
+// Buffers must be zero-initialized by the caller; only live entries are
+// written. Validity flags are written as uint8 (numpy bool).
+void hc_snapshot_fill(
+    void* h,
+    // tasks
+    float* task_resreq, int32_t* task_job, int32_t* task_status,
+    int32_t* task_priority, int32_t* task_uid_rank, int32_t* task_klass,
+    int32_t* task_node, int32_t* task_ports, uint8_t* task_valid,
+    uint8_t* task_best_effort, int32_t* task_group, int32_t* task_group_rank,
+    // groups
+    int32_t* group_job, float* group_resreq, int32_t* group_klass,
+    int32_t* group_ports, int32_t* group_size, int32_t* group_priority,
+    int32_t* group_uid_rank, uint8_t* group_best_effort, uint8_t* group_valid,
+    // nodes
+    float* node_idle, float* node_releasing, float* node_alloc,
+    int32_t* node_max_tasks, int32_t* node_num_tasks, int32_t* node_klass,
+    int32_t* node_ports, uint8_t* node_unsched, uint8_t* node_valid,
+    // jobs
+    int32_t* job_queue, int32_t* job_min_available, int32_t* job_priority,
+    int32_t* job_creation_rank, uint8_t* job_valid,
+    // queues
+    float* queue_weight, int32_t* queue_uid_rank, uint8_t* queue_valid,
+    // cluster
+    float* others_used) {
+  Cache& c = *static_cast<Cache*>(h);
+  SnapLayout& L = c.layout;
+
+  // node ordinal remap (cache index -> snapshot ordinal)
+  std::unordered_map<int32_t, int32_t> node_ord, job_ord, queue_ord;
+  for (size_t i = 0; i < L.live_nodes.size(); ++i) node_ord[L.live_nodes[i]] = (int32_t)i;
+  for (size_t i = 0; i < L.live_jobs.size(); ++i) job_ord[L.live_jobs[i]] = (int32_t)i;
+  for (size_t i = 0; i < L.live_queues.size(); ++i) queue_ord[L.live_queues[i]] = (int32_t)i;
+
+  // task uid ranks (global, by uid)
+  std::vector<int32_t> by_uid(L.live_tasks.size());
+  for (size_t i = 0; i < by_uid.size(); ++i) by_uid[i] = (int32_t)i;
+  std::sort(by_uid.begin(), by_uid.end(), [&](int a, int b) {
+    return c.tasks[L.live_tasks[a]].uid < c.tasks[L.live_tasks[b]].uid;
+  });
+  std::vector<int32_t> uid_rank(L.live_tasks.size());
+  for (size_t r = 0; r < by_uid.size(); ++r) uid_rank[by_uid[r]] = (int32_t)r;
+
+  for (size_t i = 0; i < L.live_tasks.size(); ++i) {
+    const Task& t = c.tasks[L.live_tasks[i]];
+    for (int r = 0; r < R; ++r) task_resreq[i * R + r] = t.resreq[r];
+    task_job[i] = job_ord.count(t.job) ? job_ord[t.job] : 0;
+    task_status[i] = t.status;
+    task_priority[i] = t.priority;
+    task_uid_rank[i] = uid_rank[i];
+    task_klass[i] = t.klass;
+    task_node[i] = (t.node >= 0 && node_ord.count(t.node)) ? node_ord[t.node] : -1;
+    for (int w = 0; w < PORT_WORDS; ++w) task_ports[i * PORT_WORDS + w] = t.ports[w];
+    task_valid[i] = 1;
+    task_best_effort[i] = t.best_effort ? 1 : 0;
+    task_group[i] = L.group_of_task[i];
+    task_group_rank[i] = L.group_rank[i];
+    int32_t g = L.group_of_task[i];
+    if (g >= 0) {
+      group_size[g] += 1;
+      if (!group_valid[g]) {
+        group_valid[g] = 1;
+        group_job[g] = task_job[i];
+        for (int r = 0; r < R; ++r) group_resreq[g * R + r] = t.resreq[r];
+        group_klass[g] = t.klass;
+        for (int w = 0; w < PORT_WORDS; ++w) group_ports[g * PORT_WORDS + w] = t.ports[w];
+        group_priority[g] = t.priority;
+        group_uid_rank[g] = uid_rank[i];
+        group_best_effort[g] = t.best_effort ? 1 : 0;
+      } else if (uid_rank[i] < group_uid_rank[g]) {
+        group_uid_rank[g] = uid_rank[i];
+      }
+    }
+  }
+
+  for (size_t i = 0; i < L.live_nodes.size(); ++i) {
+    const Node& n = c.nodes[L.live_nodes[i]];
+    for (int r = 0; r < R; ++r) {
+      node_idle[i * R + r] = n.idle[r];
+      node_releasing[i * R + r] = n.releasing[r];
+      node_alloc[i * R + r] = n.alloc[r];
+    }
+    node_max_tasks[i] = n.max_tasks;
+    node_num_tasks[i] = n.num_tasks;
+    node_klass[i] = n.klass;
+    for (int w = 0; w < PORT_WORDS; ++w) node_ports[i * PORT_WORDS + w] = n.ports[w];
+    node_unsched[i] = n.unschedulable ? 1 : 0;
+    node_valid[i] = 1;
+  }
+
+  // job creation ranks by (creation_ts, uid)
+  std::vector<int32_t> by_creation(L.live_jobs.size());
+  for (size_t i = 0; i < by_creation.size(); ++i) by_creation[i] = (int32_t)i;
+  std::sort(by_creation.begin(), by_creation.end(), [&](int a, int b) {
+    const Job &ja = c.jobs[L.live_jobs[a]], &jb = c.jobs[L.live_jobs[b]];
+    if (ja.creation_ts != jb.creation_ts) return ja.creation_ts < jb.creation_ts;
+    return ja.uid < jb.uid;
+  });
+  for (size_t r = 0; r < by_creation.size(); ++r)
+    job_creation_rank[by_creation[r]] = (int32_t)r;
+
+  for (size_t i = 0; i < L.live_jobs.size(); ++i) {
+    const Job& j = c.jobs[L.live_jobs[i]];
+    bool has_queue = j.queue >= 0 && queue_ord.count(j.queue);
+    job_queue[i] = has_queue ? queue_ord[j.queue] : 0;
+    job_min_available[i] = j.min_available;
+    job_priority[i] = j.priority;
+    job_valid[i] = has_queue ? 1 : 0;
+  }
+
+  for (size_t i = 0; i < L.live_queues.size(); ++i) {
+    queue_weight[i] = c.queues[L.live_queues[i]].weight;
+    queue_uid_rank[i] = (int32_t)i;
+    queue_valid[i] = 1;
+  }
+
+  for (int r = 0; r < R; ++r) others_used[r] = c.others_used[r];
+}
+
+// Decode helpers: entity names by snapshot ordinal (for actuation).
+int32_t hc_task_uid_at(void* h, int64_t ordinal, char* buf, int64_t buflen) {
+  Cache& c = *static_cast<Cache*>(h);
+  if (ordinal < 0 || (size_t)ordinal >= c.layout.live_tasks.size()) return -1;
+  const std::string& s = c.tasks[c.layout.live_tasks[ordinal]].uid;
+  if ((int64_t)s.size() + 1 > buflen) return -1;
+  std::memcpy(buf, s.c_str(), s.size() + 1);
+  return (int32_t)s.size();
+}
+
+int32_t hc_node_name_at(void* h, int64_t ordinal, char* buf, int64_t buflen) {
+  Cache& c = *static_cast<Cache*>(h);
+  if (ordinal < 0 || (size_t)ordinal >= c.layout.live_nodes.size()) return -1;
+  const std::string& s = c.nodes[c.layout.live_nodes[ordinal]].name;
+  if ((int64_t)s.size() + 1 > buflen) return -1;
+  std::memcpy(buf, s.c_str(), s.size() + 1);
+  return (int32_t)s.size();
+}
+
+int32_t hc_job_uid_at(void* h, int64_t ordinal, char* buf, int64_t buflen) {
+  Cache& c = *static_cast<Cache*>(h);
+  if (ordinal < 0 || (size_t)ordinal >= c.layout.live_jobs.size()) return -1;
+  const std::string& s = c.jobs[c.layout.live_jobs[ordinal]].uid;
+  if ((int64_t)s.size() + 1 > buflen) return -1;
+  std::memcpy(buf, s.c_str(), s.size() + 1);
+  return (int32_t)s.size();
+}
+
+int64_t hc_num_task_classes(void* h) {
+  return (int64_t)static_cast<Cache*>(h)->task_class_by_sig.size();
+}
+int64_t hc_num_node_classes(void* h) {
+  return (int64_t)static_cast<Cache*>(h)->node_class_by_sig.size();
+}
+
+}  // extern "C"

@@ -64,6 +64,11 @@ def main(argv=None) -> int:
     except (ValueError, OSError) as e:
         print(f"error: invalid scheduler conf: {e}", file=sys.stderr)
         return 1
+    except Exception as e:  # yaml parse errors (yaml.YAMLError) and kin
+        if type(e).__module__.startswith("yaml"):
+            print(f"error: invalid scheduler conf: {e}", file=sys.stderr)
+            return 1
+        raise
     cycles = sched.run(max_cycles=args.cycles)
     total_binds = sum(s.binds for s in sched.history)
     total_evicts = sum(s.evicts for s in sched.history)

@@ -69,16 +69,15 @@ class Scheduler:
         """Run cycles at the configured cadence (in sim: back-to-back).
         Stops after max_cycles (0 = unlimited) or when a cycle makes no
         progress and nothing is pending."""
+        if not until_idle and not max_cycles:
+            raise ValueError("until_idle=False requires max_cycles > 0")
         cycles = 0
         while True:
             result = self.run_once()
             cycles += 1
             if max_cycles and cycles >= max_cycles:
                 return cycles
-            pending = sum(len(j.pending_tasks()) for j in self.sim.cluster.jobs.values())
-            if until_idle and not result.binds and not result.evicts and pending == 0:
-                return cycles
-            if not result.binds and not result.evicts:
+            if until_idle and not result.binds and not result.evicts:
                 # no progress; in a live cluster we'd wait for the next
-                # period — in sim, stop to avoid spinning
+                # informer event — in sim, stop instead of spinning
                 return cycles

@@ -99,10 +99,27 @@ def _victim_verdict(
         return candidates & (job_rank < cap[vj])
 
     def drf_ok():
-        # cumulative: rs is the victim job's share after removing this
-        # victim AND all earlier victims of the same job
+        # cumulative on BOTH sides (drf.go:80-107 recomputes per preemptor
+        # task and per victim): rs is the victim job's share after removing
+        # this and all earlier same-job victims; ls is the claimant's share
+        # after the claimant tasks the cumulative freed capacity supports —
+        # so a multi-task turn progresses ls exactly like the sequential
+        # evict-one/place-one interleave.
         total = sess.drf_total
-        ls = jnp.max(safe_share(state.job_alloc[claimant_job] + req, total))
+        _, global_cum = _seg_rank_and_cum(jnp.zeros(T, jnp.int32))
+        supported = jnp.min(
+            jnp.where(req[None, :] > 0, global_cum / jnp.maximum(req[None, :], 1e-30), BIG),
+            axis=-1,
+        )
+        supported = jnp.floor(jnp.maximum(supported - 1.0, 0.0))  # tasks placed before this victim
+        ls = jnp.max(
+            safe_share(
+                state.job_alloc[claimant_job][None, :]
+                + (supported[:, None] + 1.0) * req[None, :],
+                total[None, :],
+            ),
+            axis=-1,
+        )
         rs = jnp.max(safe_share(state.job_alloc[vj] - job_cum, total[None, :]), axis=-1)
         return candidates & ((ls < rs) | (jnp.abs(ls - rs) <= SHARE_DELTA))
 

@@ -1,0 +1,125 @@
+"""Native (C++) hostcache tests: equivalence with the Python snapshot
+plane and event-driven behavior."""
+import numpy as np
+import pytest
+
+from kube_arbitrator_tpu.api import TaskStatus, Taint, Toleration, resource as res
+from kube_arbitrator_tpu.cache import SimCluster, build_snapshot, generate_cluster
+from kube_arbitrator_tpu.cache.native import NativeCache, native_available
+
+pytestmark = pytest.mark.skipif(not native_available(), reason="no C++ toolchain")
+
+GB = 1024**3
+
+
+def mirror_to_native(sim: SimCluster) -> NativeCache:
+    """Replay a SimCluster's state into the native cache as events."""
+    nc = NativeCache()
+    for q in sim.cluster.queues.values():
+        nc.upsert_queue(q.uid, q.weight)
+    for n in sim.cluster.nodes.values():
+        nc.upsert_node(
+            n.name, n.allocatable, max_tasks=n.max_tasks,
+            unschedulable=n.unschedulable, labels=n.labels, taints=n.taints,
+        )
+    for j in sorted(sim.cluster.jobs.values(), key=lambda j: j.uid):
+        nc.upsert_job(j.uid, j.queue_uid, j.min_available, j.priority, j.creation_ts)
+        for t in sorted(j.tasks.values(), key=lambda t: t.uid):
+            nc.upsert_task(
+                t.uid, j.uid, t.resreq, int(t.status), t.priority,
+                node_name=t.node_name, node_selector=t.node_selector,
+                tolerations=t.tolerations, host_ports=t.host_ports,
+            )
+    if sim.cluster.others:
+        nc.set_others_used(res.sum_resources(t.resreq for t in sim.cluster.others))
+        # others' node usage is already reflected via... sim adds them to
+        # nodes; replay them as tasks of a synthetic job is not needed for
+        # tensor equality because node accounting is what matters — skip.
+    return nc
+
+
+def assert_tensors_equal(a, b, skip=()):
+    import dataclasses
+
+    for f in dataclasses.fields(a):
+        if f.name in skip:
+            continue
+        x, y = np.asarray(getattr(a, f.name)), np.asarray(getattr(b, f.name))
+        assert x.shape == y.shape, f"{f.name}: {x.shape} vs {y.shape}"
+        np.testing.assert_array_equal(x, y, err_msg=f.name)
+
+
+def test_native_matches_python_snapshot():
+    sim = generate_cluster(num_nodes=16, num_jobs=6, tasks_per_job=8, num_queues=2, seed=9)
+    py = build_snapshot(sim.cluster).tensors
+    nc = mirror_to_native(sim)
+    nat = nc.snapshot().tensors
+    # group ids may be numbered differently (python groups by iteration of
+    # job-sorted tasks; identical here because both iterate job-major), and
+    # float32 conversion paths are identical
+    assert_tensors_equal(py, nat)
+
+
+def test_native_matches_python_with_predicates_and_running():
+    sim = SimCluster()
+    sim.add_queue("qa", weight=2)
+    sim.add_queue("qb", weight=1)
+    sim.add_node("gpu", cpu_milli=8000, memory=16 * GB, gpu_milli=4000,
+                 labels={"accel": "tpu"}, taints=[Taint("dedicated", "ml", "NoSchedule")])
+    sim.add_node("plain", cpu_milli=4000, memory=8 * GB)
+    j1 = sim.add_job("j1", queue="qa", min_available=2, creation_ts=5)
+    sim.add_task(j1, 1000, GB, name="t-running", status=TaskStatus.RUNNING, node="plain")
+    sim.add_task(j1, 1000, GB, name="t-sel", node_selector={"accel": "tpu"},
+                 tolerations=[Toleration("dedicated", "Equal", "ml", "NoSchedule")])
+    sim.add_task(j1, 500, GB // 2, name="t-ports", host_ports=[8080])
+    j2 = sim.add_job("j2", queue="qb", creation_ts=3)
+    sim.add_task(j2, 0, 0, name="t-be")
+    py = build_snapshot(sim.cluster).tensors
+    nat = mirror_to_native(sim).snapshot().tensors
+    assert_tensors_equal(py, nat)
+
+
+def test_native_event_updates():
+    nc = NativeCache()
+    nc.upsert_queue("q", 1)
+    nc.upsert_node("n1", res.make(4000, 8 * GB), max_tasks=10)
+    nc.upsert_job("j", "q", 0, 0, 0.0)
+    nc.upsert_task("t1", "j", res.make(1000, GB), int(TaskStatus.RUNNING), node_name="n1")
+    st = nc.snapshot().tensors
+    np.testing.assert_allclose(np.asarray(st.node_idle)[0], [3000.0, 7168.0, 0.0])
+    # task terminates -> idle restored
+    nc.delete_task("t1")
+    st = nc.snapshot().tensors
+    np.testing.assert_allclose(np.asarray(st.node_idle)[0], [4000.0, 8192.0, 0.0])
+    assert int(np.asarray(st.task_valid).sum()) == 0
+
+
+def test_native_oversubscription_rejected():
+    nc = NativeCache()
+    nc.upsert_queue("q", 1)
+    nc.upsert_node("n1", res.make(1000, GB))
+    nc.upsert_job("j", "q", 0, 0, 0.0)
+    with pytest.raises(ValueError, match="insufficient idle"):
+        nc.upsert_task("t1", "j", res.make(2000, 0), int(TaskStatus.RUNNING), node_name="n1")
+
+
+def test_native_cycle_end_to_end():
+    """Native snapshot drives the same decision kernel; decode via ordinal
+    lookups."""
+    from kube_arbitrator_tpu.ops import schedule_cycle
+
+    nc = NativeCache()
+    nc.upsert_queue("q", 1)
+    nc.upsert_node("n1", res.make(2000, 4 * GB))
+    nc.upsert_job("pg", "q", 0, 0, 0.0)
+    nc.upsert_task("p1", "pg", res.make(1000, GB), int(TaskStatus.PENDING))
+    nc.upsert_task("p2", "pg", res.make(1000, GB), int(TaskStatus.PENDING))
+    snap = nc.snapshot()
+    dec = schedule_cycle(snap.tensors)
+    bind = np.asarray(dec.bind_mask)
+    node = np.asarray(dec.task_node)
+    binds = {
+        snap.index.task_uid(i): snap.index.node_name(node[i])
+        for i in np.nonzero(bind)[0]
+    }
+    assert binds == {"p1": "n1", "p2": "n1"}
