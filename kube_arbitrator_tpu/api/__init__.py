@@ -3,6 +3,7 @@ from . import resource
 from .info import (
     ClusterInfo,
     JobInfo,
+    MatchExpression,
     NodeInfo,
     QueueInfo,
     Taint,
@@ -23,6 +24,7 @@ __all__ = [
     "resource",
     "ClusterInfo",
     "JobInfo",
+    "MatchExpression",
     "NodeInfo",
     "QueueInfo",
     "Taint",

@@ -49,6 +49,44 @@ class Taint:
     effect: str = "NoSchedule"  # NoSchedule | PreferNoSchedule | NoExecute
 
 
+@dataclasses.dataclass(frozen=True)
+class MatchExpression:
+    """Node-affinity requirement (v1.NodeSelectorRequirement subset used by
+    PodMatchNodeSelector, predicates.go:130-141)."""
+
+    key: str
+    operator: str  # In | NotIn | Exists | DoesNotExist | Gt | Lt
+    values: Tuple[str, ...] = ()
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        has = self.key in labels
+        val = labels.get(self.key, "")
+        if self.operator == "In":
+            return has and val in self.values
+        if self.operator == "NotIn":
+            return not has or val not in self.values
+        if self.operator == "Exists":
+            return has
+        if self.operator == "DoesNotExist":
+            return not has
+        if self.operator == "Gt":
+            return has and _as_int(val) is not None and any(
+                _as_int(v) is not None and _as_int(val) > _as_int(v) for v in self.values
+            )
+        if self.operator == "Lt":
+            return has and _as_int(val) is not None and any(
+                _as_int(v) is not None and _as_int(val) < _as_int(v) for v in self.values
+            )
+        return False
+
+
+def _as_int(s: str):
+    try:
+        return int(s)
+    except ValueError:
+        return None
+
+
 @dataclasses.dataclass
 class TaskInfo:
     """Reference api/job_info.go:36-89 (TaskInfo)."""
@@ -63,9 +101,13 @@ class TaskInfo:
     priority: int = 1
     # Predicate inputs (tensorized via equivalence classes in the snapshot):
     node_selector: Dict[str, str] = dataclasses.field(default_factory=dict)
+    node_affinity: Tuple[MatchExpression, ...] = ()  # required terms, ANDed
     tolerations: List[Toleration] = dataclasses.field(default_factory=list)
     host_ports: Tuple[int, ...] = ()
-    affinity_terms: Tuple = ()  # reserved for pod-affinity (later stage)
+    # labels + affinity_terms are reserved for the pod-affinity stage (pod
+    # labels are what other pods' affinity terms select on)
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    affinity_terms: Tuple = ()
     # Assigned by the snapshot flattener:
     ordinal: int = -1
 

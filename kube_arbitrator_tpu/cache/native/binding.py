@@ -14,12 +14,13 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ...api import resource as res
-from ...api.info import Taint, Toleration
+from ...api.info import MatchExpression, Taint, Toleration
 from ..snapshot import (
     DEVICE_SCALE,
     Snapshot,
     SnapshotIndex,
     SnapshotTensors,
+    _node_affinity_matches,
     _selector_matches,
     _tolerates_all,
 )
@@ -168,14 +169,20 @@ class NativeCache:
         priority: int = 1,
         node_name: str = "",
         node_selector: Optional[Dict[str, str]] = None,
+        node_affinity: Sequence[MatchExpression] = (),
         tolerations: Sequence[Toleration] = (),
         host_ports: Sequence[int] = (),
+        labels: Optional[Dict[str, str]] = None,  # reserved: pod-affinity stage
     ) -> None:
         selector = dict(node_selector or {})
+        affinity = tuple(node_affinity)
         tols = list(tolerations)
-        sig = repr((tuple(sorted(selector.items())),
-                    tuple(sorted((t.key, t.operator, t.value, t.effect) for t in tols))))
-        self._task_class_rep.setdefault(sig, (selector, tols))
+        sig = repr((
+            tuple(sorted(selector.items())),
+            tuple(sorted((e.key, e.operator, e.values) for e in affinity)),
+            tuple(sorted((t.key, t.operator, t.value, t.effect) for t in tols)),
+        ))
+        self._task_class_rep.setdefault(sig, (selector, affinity, tols))
         req = (np.asarray(resreq_host_units, dtype=np.float64) * DEVICE_SCALE).astype(np.float32)
         ports = np.asarray(list(host_ports), dtype=np.int32)
         rc = self._lib.hc_upsert_task(
@@ -210,15 +217,20 @@ class NativeCache:
         class _T:  # minimal shims for the shared matcher helpers
             pass
 
-        for i, (tsig, (selector, tols)) in enumerate(self._task_class_rep.items()):
+        for i, (tsig, (selector, affinity, tols)) in enumerate(self._task_class_rep.items()):
             trep = _T()
             trep.node_selector = selector
+            trep.node_affinity = affinity
             trep.tolerations = tols
             for jn, (nsig, (labels, taints)) in enumerate(self._node_class_rep.items()):
                 nrep = _T()
                 nrep.labels = labels
                 nrep.taints = taints
-                fit[i, jn] = _selector_matches(selector, labels) and _tolerates_all(trep, nrep)
+                fit[i, jn] = (
+                    _selector_matches(selector, labels)
+                    and _node_affinity_matches(trep, labels)
+                    and _tolerates_all(trep, nrep)
+                )
         return fit
 
     def snapshot(self) -> Snapshot:

@@ -17,7 +17,16 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..api import resource as res
-from ..api.info import ClusterInfo, JobInfo, NodeInfo, QueueInfo, Taint, TaskInfo, Toleration
+from ..api.info import (
+    ClusterInfo,
+    JobInfo,
+    MatchExpression,
+    NodeInfo,
+    QueueInfo,
+    Taint,
+    TaskInfo,
+    Toleration,
+)
 from ..api.types import TaskStatus
 
 
@@ -30,6 +39,17 @@ class BindIntent:
 @dataclasses.dataclass
 class EvictIntent:
     task_uid: str
+
+
+@dataclasses.dataclass
+class Event:
+    """Kubernetes-Event equivalent (the user-facing channel,
+    cache.go:402,:637-662)."""
+
+    kind: str       # "Evict" | "Unschedulable" | "FailedScheduling"
+    object_uid: str
+    reason: str
+    message: str = ""
 
 
 @dataclasses.dataclass
@@ -57,7 +77,11 @@ class SimCluster:
         self.cluster = ClusterInfo()
         self.binder = FakeBinder()
         self.evictor = FakeEvictor()
+        self.events: List[Event] = []  # record.EventRecorder equivalent
         self._task_counter = 0
+
+    def record_event(self, kind: str, object_uid: str, reason: str, message: str = "") -> None:
+        self.events.append(Event(kind, object_uid, reason, message))
 
     # ---- builders (e2e util.go fixture equivalents) ----
 
@@ -120,8 +144,10 @@ class SimCluster:
         priority: int = 1,
         name: str = "",
         node_selector: Optional[Dict[str, str]] = None,
+        node_affinity: Sequence[MatchExpression] = (),
         tolerations: Sequence[Toleration] = (),
         host_ports: Sequence[int] = (),
+        labels: Optional[Dict[str, str]] = None,
     ) -> TaskInfo:
         self._task_counter += 1
         uid = name or f"{job.uid}-task-{self._task_counter:06d}"
@@ -135,8 +161,10 @@ class SimCluster:
             node_name=node,
             priority=priority,
             node_selector=dict(node_selector or {}),
+            node_affinity=tuple(node_affinity),
             tolerations=list(tolerations),
             host_ports=tuple(host_ports),
+            labels=dict(labels or {}),
         )
         # Node placement first: if accounting rejects the task we must not
         # leave a phantom entry in job.tasks.
@@ -194,6 +222,7 @@ class SimCluster:
             else:
                 task.status = TaskStatus.RELEASING
             self.evictor.evict(e.task_uid)
+            self.record_event("Evict", e.task_uid, "Evict")
 
 
 def generate_cluster(

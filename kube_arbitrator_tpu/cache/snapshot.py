@@ -155,6 +155,7 @@ class Snapshot:
 def _constraint_signature(t: TaskInfo) -> Tuple:
     return (
         tuple(sorted(t.node_selector.items())),
+        tuple(sorted((e.key, e.operator, e.values) for e in t.node_affinity)),
         tuple(sorted((tl.key, tl.operator, tl.value, tl.effect) for tl in t.tolerations)),
     )
 
@@ -167,10 +168,15 @@ def _property_signature(n: NodeInfo) -> Tuple:
 
 
 def _selector_matches(selector: Dict[str, str], labels: Dict[str, str]) -> bool:
-    """PodMatchNodeSelector subset: every selector k=v present in labels
-    (predicates.go:130-141; full affinity expressions arrive with the
-    pod-affinity stage)."""
+    """PodMatchNodeSelector exact-label part: every selector k=v present in
+    labels (predicates.go:130-141)."""
     return all(labels.get(k) == v for k, v in selector.items())
+
+
+def _node_affinity_matches(task: TaskInfo, labels: Dict[str, str]) -> bool:
+    """Required node-affinity match expressions, ANDed (the
+    requiredDuringScheduling half of PodMatchNodeSelector)."""
+    return all(e.matches(labels) for e in task.node_affinity)
 
 
 def _tolerates_all(task: TaskInfo, node: NodeInfo) -> bool:
@@ -243,8 +249,10 @@ def build_snapshot(cluster: ClusterInfo) -> Snapshot:
     class_fit = np.ones((CT, CN), dtype=bool)
     for ct, trep in t_rep.items():
         for cn, nrep in n_rep.items():
-            class_fit[ct, cn] = _selector_matches(trep.node_selector, nrep.labels) and _tolerates_all(
-                trep, nrep
+            class_fit[ct, cn] = (
+                _selector_matches(trep.node_selector, nrep.labels)
+                and _node_affinity_matches(trep, nrep.labels)
+                and _tolerates_all(trep, nrep)
             )
 
     # --- host-port universe ---

@@ -82,7 +82,19 @@ def load_conf(conf_str: str) -> SchedulerConfig:
             if name not in KNOWN_PLUGINS:
                 raise ValueError(f"unknown plugin {name}")
             kwargs = {attr: bool(p[yk]) for yk, attr in _FLAG_KEYS.items() if yk in p}
-            plugins.append(PluginOption(name=name, **kwargs))
+            args = p.get("arguments") or {}
+            if args:
+                kwargs["arguments"] = tuple(sorted((str(k), str(v)) for k, v in args.items()))
+            opt = PluginOption(name=name, **kwargs)
+            if name == "nodeorder":
+                from ..ops.ordering import NODE_ORDER_POLICIES
+
+                policy = opt.arg("policy", "first_fit")
+                if policy not in NODE_ORDER_POLICIES:
+                    raise ValueError(
+                        f"unknown nodeorder policy {policy!r}; one of {NODE_ORDER_POLICIES}"
+                    )
+            plugins.append(opt)
         tiers.append(Tier(plugins=tuple(plugins)))
     return SchedulerConfig(actions=action_names, tiers=tuple(tiers))
 

@@ -44,6 +44,7 @@ class Scheduler:
         self.schedule_period_s = schedule_period_s
         self.job_status: Dict[str, PodGroupStatus] = {}
         self.history: List[CycleStats] = []
+        self._last_event_msg: Dict[tuple, str] = {}
 
     def run_once(self) -> CycleResult:
         t0 = time.perf_counter()
@@ -54,10 +55,18 @@ class Scheduler:
         self.sim.apply_binds(result.binds)
         self.sim.apply_evicts(result.evicts)
         self.job_status.update(result.job_status)  # cache.UpdateJobStatus equivalent
+        # user-facing Unschedulable events (cache.go:637-662 parity),
+        # deduplicated like the kube EventRecorder aggregates repeats
+        for uid, st in result.job_status.items():
+            for cond in st.conditions:
+                key = ("Unschedulable", uid, cond.reason)
+                if self._last_event_msg.get(key) != cond.message:
+                    self._last_event_msg[key] = cond.message
+                    self.sim.record_event("Unschedulable", uid, cond.reason, cond.message)
         self.history.append(
             CycleStats(
                 cycle_ms=(t1 - t0) * 1000,
-                snapshot_ms=0.0,
+                snapshot_ms=result.snapshot_ms,
                 binds=len(result.binds),
                 evicts=len(result.evicts),
                 pending_before=pending,

@@ -22,6 +22,12 @@ from ..cache.decode import decode_decisions
 from ..cache.sim import BindIntent, EvictIntent
 from ..cache.snapshot import Snapshot, build_snapshot
 from ..ops.cycle import CycleDecisions, schedule_cycle
+from ..ops.diagnostics import HostView, explain_job
+
+# Cap on per-cycle FitError explanations: the first N unready gangs get the
+# full reason histogram; beyond that only the count message (bounds close
+# cost on pathologically saturated clusters).
+MAX_EXPLAINED_JOBS = 100
 from .conf import SchedulerConfig
 
 
@@ -56,6 +62,7 @@ class CycleResult:
     binds: List[BindIntent]
     evicts: List[EvictIntent]
     job_status: Dict[str, PodGroupStatus]
+    snapshot_ms: float = 0.0
 
 
 class Session:
@@ -67,7 +74,9 @@ class Session:
         self.uid = str(uuid.uuid4())
 
     def run(self) -> CycleResult:
+        t0 = time.perf_counter()
         snap = build_snapshot(self.cluster)
+        snapshot_ms = (time.perf_counter() - t0) * 1000
         dec = schedule_cycle(
             snap.tensors, tiers=self.config.tiers, actions=self.config.actions
         )
@@ -80,6 +89,7 @@ class Session:
             binds=binds,
             evicts=evicts,
             job_status=job_status,
+            snapshot_ms=snapshot_ms,
         )
 
     # ---- CloseSession ----
@@ -88,17 +98,29 @@ class Session:
         job_ready = np.asarray(dec.job_ready)
         statuses: Dict[str, PodGroupStatus] = {}
         now = time.time()
+        host = None
+        explained = 0
         for job in snap.index.jobs:
             unsched_cond = None
             if not job_ready[job.ordinal] and job.min_available > 0:
-                # gang.go:169-190: stamp Unschedulable for unready gangs
+                # gang.go:169-190: stamp Unschedulable for unready gangs,
+                # with the FitError-style per-node reason histogram
+                # (job_info.go:329-358) appended
                 missing = job.min_available - job.ready_task_num()
+                msg = f"{missing}/{len(job.tasks)} tasks in gang unschedulable"
+                if explained < MAX_EXPLAINED_JOBS:
+                    if host is None:
+                        host = HostView.build(snap, dec)
+                    why = explain_job(snap, dec, job.ordinal, host=host)
+                    explained += 1
+                    if why:
+                        msg = f"{msg}: {why}"
                 unsched_cond = PodGroupCondition(
                     type=COND_UNSCHEDULABLE,
                     status=True,
                     transition_id=self.uid,
                     reason="NotEnoughResources",
-                    message=f"{missing}/{len(job.tasks)} tasks in gang unschedulable",
+                    message=msg,
                     last_transition=now,
                 )
             statuses[job.uid] = self._job_status(job, unsched_cond)

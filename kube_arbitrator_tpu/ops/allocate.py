@@ -40,9 +40,15 @@ import jax.numpy as jnp
 
 from ..api.types import TaskStatus
 from ..cache.snapshot import SnapshotTensors
-from .common import BIG, EPS, ceil_div_pos, lex_argmin, safe_share
+from .common import BIG, EPS, ceil_div_pos, dominant_share, lex_argmin, safe_share
 from .fairness import drf_equilibrium_level, drf_shares, overused, queue_shares
-from .ordering import Tiers, group_order_keys, job_order_keys, queue_order_keys
+from .ordering import (
+    Tiers,
+    group_order_keys,
+    job_order_keys,
+    node_order_policy,
+    queue_order_keys,
+)
 
 ALLOCATED = jnp.int32(int(TaskStatus.ALLOCATED))
 PIPELINED = jnp.int32(int(TaskStatus.PIPELINED))
@@ -278,14 +284,31 @@ def _process_queue(
         k_rel = _node_capacity(state.node_releasing, req, ok, pods_head, has_ports)
         k_eff = jnp.where(use_rel, k_rel, k_idle)
 
-    cum = jnp.cumsum(k_eff)
+    # ---- node packing order (nodeorder plugin policy) ----
+    policy = node_order_policy(tiers)
+    N = k_eff.shape[0]
+    if policy == "first_fit":
+        nperm = None
+        k_p = k_eff
+    else:
+        used_share = dominant_share(
+            jnp.maximum(st.node_alloc - state.node_idle, 0.0), st.node_alloc
+        )
+        score = -used_share if policy == "binpack" else used_share  # asc sort
+        nperm = jnp.lexsort((jnp.arange(N), jnp.where(st.node_valid, score, BIG)))
+        k_p = k_eff[nperm]
+
+    cum = jnp.cumsum(k_p)
     placed_total = jnp.minimum(budget, cum[-1])
-    p = jnp.clip(placed_total - (cum - k_eff), 0, k_eff)  # i32[N]
+    p_p = jnp.clip(placed_total - (cum - k_p), 0, k_p)  # i32[N] (packing order)
+    p = p_p if nperm is None else jnp.zeros_like(p_p).at[nperm].set(p_p)
 
     # ---- decode: assign concrete tasks (group ranks) to node slots ----
     placed_before = state.group_placed[g]
     slots = jnp.arange(s_max)
     node_of_slot = jnp.searchsorted(cum, slots, side="right").astype(jnp.int32)
+    if nperm is not None:
+        node_of_slot = nperm[jnp.clip(node_of_slot, 0, N - 1)]
     slot_of_task = st.task_group_rank - placed_before
     assigned = (
         (st.task_group == g)
@@ -320,7 +343,10 @@ def _process_queue(
         group_placed=state.group_placed.at[g].add(placed_total),
         group_unfit=state.group_unfit.at[g].set(state.group_unfit[g] | unfit_now),
         evicted_for=state.evicted_for,
-        progress=state.progress | (placed_total > 0),
+        # marking a group unfit IS progress: it unblocks the queue's next
+        # job for the following round (otherwise a failing top job would
+        # end the action before later jobs get a turn)
+        progress=state.progress | (placed_total > 0) | unfit_now,
         rounds=state.rounds,
     )
 

@@ -70,6 +70,11 @@ class CycleDecisions:
     job_ready: jax.Array     # bool[J] gang readiness at close (jobStatus input)
     # Diagnostics for the "why unschedulable" channel (job_info.go:329-358):
     unready_alloc: jax.Array  # bool[T] allocated this cycle but uncommitted
+    # End-of-cycle node state, so explanations reflect capacity consumed by
+    # this cycle's own placements (not the pre-cycle snapshot):
+    node_idle: jax.Array      # f32[N, R]
+    node_num_tasks: jax.Array  # i32[N]
+    node_ports: jax.Array     # i32[N, W]
 
 
 def _plugin_enabled(tiers: Tiers, name: str) -> bool:
@@ -208,4 +213,7 @@ def schedule_cycle(
         evict_mask=evict_mask,
         job_ready=job_ready_status,
         unready_alloc=newly_alloc & ~job_ready_status[st.task_job],
+        node_idle=state.node_idle,
+        node_num_tasks=state.node_num_tasks,
+        node_ports=state.node_ports,
     )

@@ -1,0 +1,138 @@
+"""Per-job "why not scheduled" diagnostics.
+
+Reproduces the reference's FitError histogram channel
+(``api/job_info.go:329-358``: per-node fit deltas aggregated into
+"0/3 nodes are available: 2 Insufficient cpu, 1 Insufficient memory" pod
+conditions, surfaced via events in ``cache.go:637-662``).
+
+Computed host-side in numpy against the *end-of-cycle* node state carried
+in CycleDecisions (so a node filled by this cycle's own placements reads
+as insufficient, matching what the scheduler actually saw).  A HostView
+caches the device→host transfers so explaining many jobs costs one copy,
+and per-job work is fully vectorized over nodes.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..api.resource import RESOURCE_NAMES
+from ..api.types import TaskStatus
+from ..cache.snapshot import DEVICE_EPSILON, Snapshot
+
+
+@dataclasses.dataclass
+class HostView:
+    """One-time host copies of the arrays diagnostics consult."""
+
+    task_valid: np.ndarray
+    task_status0: np.ndarray
+    task_status1: np.ndarray
+    task_job: np.ndarray
+    task_resreq: np.ndarray
+    task_klass: np.ndarray
+    task_ports: np.ndarray
+    node_valid: np.ndarray
+    node_klass: np.ndarray
+    node_unsched: np.ndarray
+    node_idle: np.ndarray
+    node_num_tasks: np.ndarray
+    node_max_tasks: np.ndarray
+    node_ports: np.ndarray
+    class_fit: np.ndarray
+
+    @classmethod
+    def build(cls, snap: Snapshot, decisions) -> "HostView":
+        t = snap.tensors
+        return cls(
+            task_valid=np.asarray(t.task_valid),
+            task_status0=np.asarray(t.task_status),
+            task_status1=np.asarray(decisions.task_status),
+            task_job=np.asarray(t.task_job),
+            task_resreq=np.asarray(t.task_resreq),
+            task_klass=np.asarray(t.task_klass),
+            task_ports=np.asarray(t.task_ports),
+            node_valid=np.asarray(t.node_valid),
+            node_klass=np.asarray(t.node_klass),
+            node_unsched=np.asarray(t.node_unsched),
+            node_idle=np.asarray(decisions.node_idle),
+            node_num_tasks=np.asarray(decisions.node_num_tasks),
+            node_max_tasks=np.asarray(t.node_max_tasks),
+            node_ports=np.asarray(decisions.node_ports),
+            class_fit=np.asarray(t.class_fit),
+        )
+
+
+def explain_job(
+    snap: Snapshot, decisions, job_ordinal: int, host: Optional[HostView] = None
+) -> Optional[str]:
+    """FitError-style message for the job's first unplaced pending task.
+
+    Returns None when the job has nothing pending left unplaced.
+    """
+    h = host or HostView.build(snap, decisions)
+    pending_unplaced = (
+        h.task_valid
+        & (h.task_status0 == int(TaskStatus.PENDING))
+        & (h.task_status1 == int(TaskStatus.PENDING))
+        & (h.task_job == job_ordinal)
+    )
+    idx = np.nonzero(pending_unplaced)[0]
+    if len(idx) == 0:
+        return None
+    i = idx[0]
+    req = h.task_resreq[i]
+    klass = int(h.task_klass[i])
+
+    nv = h.node_valid
+    n_nodes = int(nv.sum())
+    class_fit = h.class_fit[klass, h.node_klass]
+    pods_full = h.node_num_tasks >= h.node_max_tasks
+    ports_conflict = (np.bitwise_and(h.task_ports[i][None, :], h.node_ports) != 0).any(axis=-1)
+    insufficient = req[None, :] >= h.node_idle + DEVICE_EPSILON  # (node, resource)
+
+    # first-failing-reason per node, mirroring the predicate chain order
+    reasons: Dict[str, int] = {}
+    seen = ~nv
+    for mask, label in (
+        (h.node_unsched, "node(s) were unschedulable"),
+        (~class_fit, "node(s) didn't match node selector/affinity/taints"),
+        (pods_full, "too many pods"),
+        (ports_conflict, "node(s) had conflicting host ports"),
+    ):
+        hit = mask & ~seen
+        if hit.any():
+            reasons[label] = int(hit.sum())
+        seen = seen | hit
+    res_fail = insufficient & ~seen[:, None]
+    for r in range(req.shape[0]):
+        cnt = int(res_fail[:, r].sum())
+        if cnt:
+            reasons[f"Insufficient {RESOURCE_NAMES[r]}"] = cnt
+    fits = int((~seen & ~insufficient.any(axis=-1)).sum())
+
+    parts = [f"{cnt} {reason}" for reason, cnt in sorted(reasons.items())]
+    if parts:
+        return f"{fits}/{n_nodes} nodes are available: {', '.join(parts)}."
+    return f"{fits}/{n_nodes} nodes are available."
+
+
+def unschedulable_report(snap: Snapshot, decisions, limit: int = 100) -> Dict[str, str]:
+    """Messages for jobs that ended the cycle gang-unready (bounded)."""
+    job_ready = np.asarray(decisions.job_ready)
+    out: Dict[str, str] = {}
+    jobs = getattr(snap.index, "jobs", None)
+    if jobs is None:
+        return out
+    host = HostView.build(snap, decisions)
+    for job in jobs:
+        if len(out) >= limit:
+            break
+        if job_ready[job.ordinal]:
+            continue
+        msg = explain_job(snap, decisions, job.ordinal, host=host)
+        if msg:
+            out[job.uid] = msg
+    return out

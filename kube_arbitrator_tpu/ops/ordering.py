@@ -29,7 +29,9 @@ import jax.numpy as jnp
 
 @dataclasses.dataclass(frozen=True)
 class PluginOption:
-    """Per-plugin enable flags (reference conf/scheduler_conf.go:33-50)."""
+    """Per-plugin enable flags (reference conf/scheduler_conf.go:33-50)
+    plus an ``arguments`` key/value list (the later upstream extension that
+    nodeorder-style plugins configure through)."""
 
     name: str
     job_order_disabled: bool = False
@@ -39,10 +41,17 @@ class PluginOption:
     reclaimable_disabled: bool = False
     predicate_disabled: bool = False
     job_ready_disabled: bool = False
+    arguments: Tuple[Tuple[str, str], ...] = ()
 
     @classmethod
     def of(cls, name: str, **kw) -> "PluginOption":
         return cls(name=name, **kw)
+
+    def arg(self, key: str, default: str = "") -> str:
+        for k, v in self.arguments:
+            if k == key:
+                return v
+        return default
 
 
 @dataclasses.dataclass(frozen=True)
@@ -100,6 +109,25 @@ def queue_order_keys(
                 keys.append(queue_share)
     keys.append(queue_uid_rank.astype(jnp.float32))
     return keys
+
+
+NODE_ORDER_POLICIES = ("first_fit", "binpack", "spread")
+
+
+def node_order_policy(tiers: Tiers) -> str:
+    """Node scoring policy from the nodeorder plugin: 'first_fit' (default,
+    deterministic index order), 'binpack' (most-allocated first — packs
+    tighter), or 'spread' (least-allocated first)."""
+    for tier in tiers:
+        for p in tier.plugins:
+            if p.name == "nodeorder":
+                policy = p.arg("policy", "first_fit")
+                if policy not in NODE_ORDER_POLICIES:
+                    raise ValueError(
+                        f"unknown nodeorder policy {policy!r}; one of {NODE_ORDER_POLICIES}"
+                    )
+                return policy
+    return "first_fit"
 
 
 def group_order_keys(

@@ -316,7 +316,10 @@ def _claim_turn(
             state.group_unfit[g] | (has_grp & (placed_total < budget))
         ),
         evicted_for=evicted_for,
-        progress=state.progress | (placed_total > 0),
+        # unfit-marking counts as progress so later jobs still get a turn
+        progress=state.progress
+        | (placed_total > 0)
+        | (has_grp & (placed_total < budget)),
         rounds=state.rounds,
     )
 

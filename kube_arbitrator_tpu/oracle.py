@@ -181,6 +181,8 @@ class SequentialScheduler:
             return False
         if any(n.labels.get(k) != v for k, v in t.node_selector.items()):
             return False
+        if any(not e.matches(n.labels) for e in t.node_affinity):
+            return False
         for taint in n.taints:
             if taint.effect == "PreferNoSchedule":
                 continue

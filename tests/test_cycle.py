@@ -227,6 +227,22 @@ def test_max_tasks_cap():
     assert len(binds) == 3
 
 
+def test_failing_top_job_does_not_starve_later_jobs():
+    """Regression: a queue's top job whose tasks fit nowhere must not end
+    the allocate action before later jobs in the queue get a turn (the
+    sequential loop drops the failed job and continues, allocate.go:164-175)."""
+    sim = SimCluster()
+    sim.add_queue("q")
+    sim.add_node("n1", cpu_milli=8000, memory=16 * GB)
+    # "aaa" sorts first and can never fit; "bbb" fits easily
+    impossible = sim.add_job("aaa", queue="q", min_available=1)
+    sim.add_task(impossible, 99000, GB, name="huge")
+    ok = sim.add_job("bbb", queue="q", min_available=1)
+    sim.add_task(ok, 1000, GB, name="small")
+    snap, dec, binds = run_cycle(sim)
+    assert binds == {"small": "n1"}
+
+
 @pytest.mark.parametrize("seed", [0, 1, 2])
 def test_property_random_clusters_vs_oracle(seed):
     """Random clusters: kernel satisfies invariants and matches the

@@ -1,0 +1,71 @@
+"""Why-unschedulable diagnostics (FitError histogram parity) + events."""
+import numpy as np
+
+from kube_arbitrator_tpu.api import Taint
+from kube_arbitrator_tpu.cache import SimCluster, build_snapshot
+from kube_arbitrator_tpu.framework import Scheduler, Session
+from kube_arbitrator_tpu.ops import schedule_cycle
+from kube_arbitrator_tpu.ops.diagnostics import explain_job, unschedulable_report
+
+GB = 1024**3
+
+
+def test_explain_insufficient_resources():
+    sim = SimCluster()
+    sim.add_queue("q")
+    sim.add_node("small1", cpu_milli=1000, memory=8 * GB)
+    sim.add_node("small2", cpu_milli=1000, memory=1 * GB)
+    j = sim.add_job("big", queue="q", min_available=1)
+    sim.add_task(j, 4000, 4 * GB)
+    snap = build_snapshot(sim.cluster)
+    dec = schedule_cycle(snap.tensors)
+    msg = explain_job(snap, dec, j.ordinal)
+    assert msg is not None
+    assert "0/2 nodes are available" in msg
+    assert "Insufficient cpu" in msg
+    assert "Insufficient memory" in msg  # small2 also lacks memory
+
+
+def test_explain_predicate_and_unschedulable_nodes():
+    sim = SimCluster()
+    sim.add_queue("q")
+    sim.add_node("tainted", taints=[Taint("k", "v", "NoSchedule")])
+    sim.add_node("cordoned", unschedulable=True)
+    j = sim.add_job("j", queue="q", min_available=1)
+    sim.add_task(j, 100, 0)
+    snap = build_snapshot(sim.cluster)
+    dec = schedule_cycle(snap.tensors)
+    msg = explain_job(snap, dec, j.ordinal)
+    assert "0/2 nodes are available" in msg
+    assert "selector/affinity/taints" in msg
+    assert "unschedulable" in msg
+
+
+def test_unschedulable_report_and_condition_message():
+    sim = SimCluster()
+    sim.add_queue("q")
+    sim.add_node("n1", cpu_milli=1000, memory=GB)
+    j = sim.add_job("gang", queue="q", min_available=3)
+    for _ in range(3):
+        sim.add_task(j, 1000, GB)
+    res = Session(sim.cluster).run()
+    report = unschedulable_report(res.snapshot, res.decisions)
+    assert "gang" in report
+    cond = res.job_status["gang"].conditions[0]
+    assert "tasks in gang unschedulable" in cond.message
+    assert "nodes are available" in cond.message
+
+
+def test_scheduler_records_events():
+    sim = SimCluster()
+    sim.add_queue("q")
+    sim.add_node("n1", cpu_milli=1000, memory=GB)
+    j = sim.add_job("gang", queue="q", min_available=3)
+    for _ in range(3):
+        sim.add_task(j, 1000, GB)
+    sched = Scheduler(sim)
+    sched.run_once()
+    kinds = {e.kind for e in sim.events}
+    assert "Unschedulable" in kinds
+    ev = next(e for e in sim.events if e.kind == "Unschedulable")
+    assert ev.object_uid == "gang"

@@ -28,7 +28,8 @@ def mirror_to_native(sim: SimCluster) -> NativeCache:
             nc.upsert_task(
                 t.uid, j.uid, t.resreq, int(t.status), t.priority,
                 node_name=t.node_name, node_selector=t.node_selector,
-                tolerations=t.tolerations, host_ports=t.host_ports,
+                node_affinity=t.node_affinity, tolerations=t.tolerations,
+                host_ports=t.host_ports,
             )
     if sim.cluster.others:
         nc.set_others_used(res.sum_resources(t.resreq for t in sim.cluster.others))

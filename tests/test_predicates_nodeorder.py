@@ -1,0 +1,102 @@
+"""Node-affinity match expressions + nodeorder scoring policies."""
+import numpy as np
+
+from kube_arbitrator_tpu.api import MatchExpression, TaskStatus
+from kube_arbitrator_tpu.cache import SimCluster, build_snapshot
+from kube_arbitrator_tpu.cache.decode import decode_decisions
+from kube_arbitrator_tpu.framework import load_conf
+from kube_arbitrator_tpu.ops import schedule_cycle
+
+GB = 1024**3
+
+
+def run(sim, cfg=None):
+    snap = build_snapshot(sim.cluster)
+    kw = {}
+    if cfg is not None:
+        kw = dict(tiers=cfg.tiers, actions=cfg.actions)
+    dec = schedule_cycle(snap.tensors, **kw)
+    binds, _ = decode_decisions(snap, dec)
+    return {b.task_uid: b.node_name for b in binds}
+
+
+def test_node_affinity_expressions():
+    """e2e predicates.go node-affinity scenario analog: In/NotIn/Exists/Gt."""
+    sim = SimCluster()
+    sim.add_queue("q")
+    sim.add_node("west", labels={"zone": "west", "disk": "ssd", "cpus": "64"})
+    sim.add_node("east", labels={"zone": "east", "cpus": "8"})
+    j = sim.add_job("j", queue="q")
+    sim.add_task(j, 100, 0, name="in-west",
+                 node_affinity=[MatchExpression("zone", "In", ("west",))])
+    sim.add_task(j, 100, 0, name="not-west",
+                 node_affinity=[MatchExpression("zone", "NotIn", ("west",))])
+    sim.add_task(j, 100, 0, name="has-disk",
+                 node_affinity=[MatchExpression("disk", "Exists")])
+    sim.add_task(j, 100, 0, name="big-cpu",
+                 node_affinity=[MatchExpression("cpus", "Gt", ("32",))])
+    sim.add_task(j, 100, 0, name="no-disk",
+                 node_affinity=[MatchExpression("disk", "DoesNotExist")])
+    binds = run(sim)
+    assert binds["in-west"] == "west"
+    assert binds["not-west"] == "east"
+    assert binds["has-disk"] == "west"
+    assert binds["big-cpu"] == "west"
+    assert binds["no-disk"] == "east"
+
+
+def test_node_affinity_unsatisfiable():
+    sim = SimCluster()
+    sim.add_queue("q")
+    sim.add_node("n1", labels={"zone": "west"})
+    j = sim.add_job("j", queue="q")
+    sim.add_task(j, 100, 0, name="nope",
+                 node_affinity=[MatchExpression("zone", "In", ("mars",))])
+    assert run(sim) == {}
+
+
+NODEORDER_CONF = """
+actions: "allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+    arguments:
+      policy: {policy}
+"""
+
+
+def _three_node_cluster():
+    sim = SimCluster()
+    sim.add_queue("q")
+    # n0 is half full (running task), n1 and n2 empty
+    sim.add_node("n0", cpu_milli=4000, memory=8 * GB)
+    sim.add_node("n1", cpu_milli=4000, memory=8 * GB)
+    sim.add_node("n2", cpu_milli=4000, memory=8 * GB)
+    filler = sim.add_job("filler", queue="q")
+    sim.add_task(filler, 2000, 4 * GB, status=TaskStatus.RUNNING, node="n0")
+    j = sim.add_job("j", queue="q")
+    sim.add_task(j, 1000, 2 * GB, name="t0")
+    return sim
+
+
+def test_nodeorder_binpack_prefers_fuller_node():
+    cfg = load_conf(NODEORDER_CONF.format(policy="binpack"))
+    binds = run(_three_node_cluster(), cfg)
+    assert binds["t0"] == "n0"  # most-allocated node first
+
+
+def test_nodeorder_spread_prefers_emptier_node():
+    cfg = load_conf(NODEORDER_CONF.format(policy="spread"))
+    binds = run(_three_node_cluster(), cfg)
+    assert binds["t0"] in ("n1", "n2")
+
+
+def test_nodeorder_default_first_fit():
+    binds = run(_three_node_cluster())
+    assert binds["t0"] == "n0"  # lowest index with capacity
