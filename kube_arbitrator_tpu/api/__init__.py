@@ -5,6 +5,7 @@ from .info import (
     JobInfo,
     MatchExpression,
     NodeInfo,
+    PodAffinityTerm,
     QueueInfo,
     Taint,
     TaskInfo,
@@ -26,6 +27,7 @@ __all__ = [
     "JobInfo",
     "MatchExpression",
     "NodeInfo",
+    "PodAffinityTerm",
     "QueueInfo",
     "Taint",
     "TaskInfo",

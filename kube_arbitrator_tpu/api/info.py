@@ -87,6 +87,32 @@ def _as_int(s: str):
         return None
 
 
+@dataclasses.dataclass(frozen=True)
+class PodAffinityTerm:
+    """Required pod (anti-)affinity term (the v1.PodAffinityTerm subset the
+    reference's NewPodAffinityPredicate evaluates, predicates.go:186-198):
+    a label selector over *pods*, scoped to namespaces, co-located (affinity)
+    or excluded (anti-affinity) per topology domain of ``topology_key``."""
+
+    match_labels: Tuple[Tuple[str, str], ...] = ()
+    match_expressions: Tuple[MatchExpression, ...] = ()
+    topology_key: str = "kubernetes.io/hostname"
+    anti: bool = False
+    # Empty = the owning pod's namespace (the v1 default).
+    namespaces: Tuple[str, ...] = ()
+
+    def selector_matches(self, labels: Dict[str, str]) -> bool:
+        return all(labels.get(k) == v for k, v in self.match_labels) and all(
+            e.matches(labels) for e in self.match_expressions
+        )
+
+    def matches_pod(
+        self, pod_namespace: str, pod_labels: Dict[str, str], owner_namespace: str
+    ) -> bool:
+        ns = self.namespaces or (owner_namespace,)
+        return pod_namespace in ns and self.selector_matches(pod_labels)
+
+
 @dataclasses.dataclass
 class TaskInfo:
     """Reference api/job_info.go:36-89 (TaskInfo)."""
@@ -104,10 +130,10 @@ class TaskInfo:
     node_affinity: Tuple[MatchExpression, ...] = ()  # required terms, ANDed
     tolerations: List[Toleration] = dataclasses.field(default_factory=list)
     host_ports: Tuple[int, ...] = ()
-    # labels + affinity_terms are reserved for the pod-affinity stage (pod
-    # labels are what other pods' affinity terms select on)
+    # Pod labels (what other pods' affinity terms select on) and this pod's
+    # own required (anti-)affinity terms.
     labels: Dict[str, str] = dataclasses.field(default_factory=dict)
-    affinity_terms: Tuple = ()
+    affinity_terms: Tuple["PodAffinityTerm", ...] = ()
     # Assigned by the snapshot flattener:
     ordinal: int = -1
 

@@ -308,7 +308,27 @@ class NativeCache:
         bools = [k for k, a in buf.items() if a.dtype == np.uint8]
         for k in bools:
             buf[k] = buf[k].astype(bool)
-        tensors = SnapshotTensors(class_fit=self._class_fit(CT, CN), **buf)
+        # The native plane does not encode inter-pod affinity yet: emit the
+        # zero-sized term axes so the decision plane compiles the feature
+        # out (pods carrying affinity terms go through the Python snapshot
+        # plane, cache/snapshot.py).
+        tensors = SnapshotTensors(
+            class_fit=self._class_fit(CT, CN),
+            task_pa_class=np.zeros(T, np.int32),
+            group_pa_class=np.zeros(G, np.int32),
+            group_aff_terms=np.zeros((G, 0), np.int32),
+            group_anti_terms=np.zeros((G, 0), np.int32),
+            node_dom=np.zeros((0, N), np.int32),
+            aff_key=np.zeros(0, np.int32),
+            anti_key=np.zeros(0, np.int32),
+            aff_static=np.zeros((0, 1), np.int32),
+            anti_static=np.zeros((0, 1), np.int32),
+            aff_static_total=np.zeros(0, np.int32),
+            aff_match=np.zeros((0, 1), bool),
+            anti_match=np.zeros((0, 1), bool),
+            symm_ok=np.zeros((0, N), bool),
+            **buf,
+        )
         index = NativeSnapshotIndex(self)
         return Snapshot(tensors=tensors, index=index)
 

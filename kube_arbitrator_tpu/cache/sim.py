@@ -22,6 +22,7 @@ from ..api.info import (
     JobInfo,
     MatchExpression,
     NodeInfo,
+    PodAffinityTerm,
     QueueInfo,
     Taint,
     TaskInfo,
@@ -148,6 +149,7 @@ class SimCluster:
         tolerations: Sequence[Toleration] = (),
         host_ports: Sequence[int] = (),
         labels: Optional[Dict[str, str]] = None,
+        affinity: Sequence["PodAffinityTerm"] = (),
     ) -> TaskInfo:
         self._task_counter += 1
         uid = name or f"{job.uid}-task-{self._task_counter:06d}"
@@ -165,6 +167,7 @@ class SimCluster:
             tolerations=list(tolerations),
             host_ports=tuple(host_ports),
             labels=dict(labels or {}),
+            affinity_terms=tuple(affinity),
         )
         # Node placement first: if accounting rejects the task we must not
         # leave a phantom entry in job.tasks.

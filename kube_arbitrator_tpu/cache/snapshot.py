@@ -111,6 +111,31 @@ class SnapshotTensors:
     queue_valid: jax.Array      # bool[Q]
     # ---- predicate class table [CT, CN] ----
     class_fit: jax.Array        # bool[CT, CN]
+    # ---- pod (anti-)affinity encoding ----
+    # Relational predicates factor through (a) topology domains — each
+    # distinct (topology_key, node label value) pair is one global domain
+    # ordinal — and (b) pod label classes CP = distinct (namespace, labels)
+    # among *pending* tasks.  Each distinct (selector, namespaces,
+    # topology_key) term becomes one ordinal on the TF (affinity) or TA
+    # (anti-affinity) axis with host-precomputed per-domain counts of
+    # matching *existing* pods; the kernel adds within-cycle placements
+    # dynamically (ops/podaffinity.py).  All axes are zero-sized when the
+    # snapshot has no terms, so the kernel compiles them out entirely.
+    task_pa_class: jax.Array    # i32[T] pod label class (pending tasks)
+    group_pa_class: jax.Array   # i32[G]
+    group_aff_terms: jax.Array  # i32[G, MA] term ordinals, -1 pad
+    group_anti_terms: jax.Array  # i32[G, MB]
+    node_dom: jax.Array         # i32[K, N] global domain per topology key, -1 none
+    aff_key: jax.Array          # i32[TF] topology-key index per term
+    anti_key: jax.Array         # i32[TA]
+    aff_static: jax.Array       # i32[TF, D] existing matching pods per domain
+    anti_static: jax.Array      # i32[TA, D]
+    aff_static_total: jax.Array  # i32[TF] cluster-wide existing matches
+    aff_match: jax.Array        # bool[TF, CP] class cp matches term selector
+    anti_match: jax.Array       # bool[TA, CP]
+    # Static anti-affinity symmetry (existing pods' anti terms vs incoming
+    # class): bool[CS, N]; CS == 0 when no existing pod has anti terms.
+    symm_ok: jax.Array
     # ---- cluster-level ----
     others_used: jax.Array      # f32[R] usage by other schedulers' tasks
 
@@ -198,6 +223,140 @@ def _ports_mask(ports, universe_pos: Dict[int, int]) -> np.ndarray:
     return mask
 
 
+def _build_pod_affinity(
+    tasks: List[TaskInfo],
+    nodes: List[NodeInfo],
+    T: int,
+    N: int,
+) -> Dict[str, np.ndarray]:
+    """Host-side pod-(anti-)affinity encoding; see SnapshotTensors docs."""
+    pending = [t for t in tasks if t.status == TaskStatus.PENDING]
+
+    # pod label classes over pending tasks (namespace + labels is all a
+    # selector can observe)
+    cls_of: Dict[Tuple, int] = {}
+    cls_rep: List[TaskInfo] = []
+    task_pa_class = np.zeros(T, dtype=np.int32)
+    for t in pending:
+        sig = (t.namespace, tuple(sorted(t.labels.items())))
+        c = cls_of.setdefault(sig, len(cls_of))
+        if c == len(cls_rep):
+            cls_rep.append(t)
+        task_pa_class[t.ordinal] = c
+    CP = max(1, len(cls_of))
+
+    # term universes (pending tasks' terms, namespaces resolved)
+    def term_sig(t: TaskInfo, term) -> Tuple:
+        ns = term.namespaces or (t.namespace,)
+        return (
+            term.match_labels,
+            term.match_expressions,
+            term.topology_key,
+            tuple(sorted(ns)),
+        )
+
+    aff_sigs: Dict[Tuple, int] = {}
+    anti_sigs: Dict[Tuple, int] = {}
+    aff_terms: List = []   # resolved representative terms
+    anti_terms: List = []
+    task_aff: Dict[int, List[int]] = {}
+    task_anti: Dict[int, List[int]] = {}
+    for t in pending:
+        for term in t.affinity_terms:
+            sig = term_sig(t, term)
+            table, reps, per = (
+                (anti_sigs, anti_terms, task_anti)
+                if term.anti
+                else (aff_sigs, aff_terms, task_aff)
+            )
+            tid = table.setdefault(sig, len(table))
+            if tid == len(reps):
+                reps.append((term, term.namespaces or (t.namespace,)))
+            per.setdefault(t.ordinal, []).append(tid)
+    TF, TA = len(aff_terms), len(anti_terms)
+
+    # topology keys + global domains (only keys used by pending terms)
+    keys: Dict[str, int] = {}
+    for term, _ns in aff_terms + anti_terms:
+        keys.setdefault(term.topology_key, len(keys))
+    K = len(keys)
+    dom_of: Dict[Tuple[str, str], int] = {}
+    node_dom = np.full((K, N), -1, dtype=np.int32)
+    for n in nodes:
+        for key, ki in keys.items():
+            v = n.labels.get(key)
+            if v is None:
+                continue
+            node_dom[ki, n.ordinal] = dom_of.setdefault((key, v), len(dom_of))
+    D = max(1, len(dom_of))
+
+    # existing pods = everything currently holding a node (any status)
+    existing = [
+        (nn, tt) for nn in nodes for tt in nn.tasks.values()
+    ]
+
+    aff_key = np.zeros(TF, dtype=np.int32)
+    anti_key = np.zeros(TA, dtype=np.int32)
+    aff_static = np.zeros((TF, D), dtype=np.int32)
+    anti_static = np.zeros((TA, D), dtype=np.int32)
+    aff_static_total = np.zeros(TF, dtype=np.int32)
+    aff_match = np.zeros((TF, CP), dtype=bool)
+    anti_match = np.zeros((TA, CP), dtype=bool)
+    for reps, key_arr, static, match, total in (
+        (aff_terms, aff_key, aff_static, aff_match, aff_static_total),
+        (anti_terms, anti_key, anti_static, anti_match, None),
+    ):
+        for tid, (term, ns) in enumerate(reps):
+            key_arr[tid] = keys[term.topology_key]
+            for c, rep in enumerate(cls_rep):
+                match[tid, c] = rep.namespace in ns and term.selector_matches(rep.labels)
+            for nn, tt in existing:
+                if tt.namespace in ns and term.selector_matches(tt.labels):
+                    if total is not None:
+                        total[tid] += 1
+                    v = nn.labels.get(term.topology_key)
+                    if v is not None:
+                        static[tid, dom_of[(term.topology_key, v)]] += 1
+
+    # static symmetry: existing pods' anti terms must not match an incoming
+    # class in the same domain (satisfiesExistingPodsAntiAffinity)
+    symm_ok = np.ones((CP, N), dtype=bool)
+    any_symm = False
+    for nn, tt in existing:
+        for term in tt.affinity_terms:
+            if not term.anti:
+                continue
+            v = nn.labels.get(term.topology_key)
+            if v is None:
+                continue
+            same_dom = np.array(
+                [m.labels.get(term.topology_key) == v for m in nodes], dtype=bool
+            )
+            blocked_nodes = np.zeros(N, dtype=bool)
+            blocked_nodes[: len(nodes)] = same_dom
+            for c, rep in enumerate(cls_rep):
+                if term.matches_pod(rep.namespace, rep.labels, tt.namespace):
+                    symm_ok[c] &= ~blocked_nodes
+                    any_symm = True
+    if not any_symm:
+        symm_ok = np.ones((0, N), dtype=bool)
+
+    return dict(
+        task_pa_class=task_pa_class,
+        task_aff=task_aff,
+        task_anti=task_anti,
+        node_dom=node_dom,
+        aff_key=aff_key,
+        anti_key=anti_key,
+        aff_static=aff_static,
+        anti_static=anti_static,
+        aff_static_total=aff_static_total,
+        aff_match=aff_match,
+        anti_match=anti_match,
+        symm_ok=symm_ok,
+    )
+
+
 def build_snapshot(cluster: ClusterInfo) -> Snapshot:
     """Flatten ClusterInfo into SnapshotTensors + decode index."""
     queues = sorted(cluster.queues.values(), key=lambda q: q.uid)
@@ -255,6 +414,12 @@ def build_snapshot(cluster: ClusterInfo) -> Snapshot:
                 and _tolerates_all(trep, nrep)
             )
 
+    # --- pod (anti-)affinity encoding ---
+    pa = _build_pod_affinity(tasks, nodes, T, N)
+    task_pa_class = pa["task_pa_class"]
+    task_aff_ids: Dict[int, List[int]] = pa["task_aff"]
+    task_anti_ids: Dict[int, List[int]] = pa["task_anti"]
+
     # --- host-port universe ---
     universe: List[int] = sorted(
         {p for t in tasks for p in t.host_ports}
@@ -308,6 +473,9 @@ def build_snapshot(cluster: ClusterInfo) -> Snapshot:
             t.host_ports,
             t.priority,
             t.best_effort,
+            int(task_pa_class[t.ordinal]),
+            tuple(sorted(set(task_aff_ids.get(t.ordinal, ())))),
+            tuple(sorted(set(task_anti_ids.get(t.ordinal, ())))),
         )
         g = group_key_to_ord.setdefault(key, len(group_members))
         if g == len(group_members):
@@ -341,6 +509,21 @@ def build_snapshot(cluster: ClusterInfo) -> Snapshot:
         group_uid_rank[g] = task_uid_rank[rep.ordinal]
         group_best_effort[g] = rep.best_effort
         group_valid[g] = True
+
+    # per-group pod-affinity columns (term axes sized 0 when unused so the
+    # decision plane compiles the whole feature out)
+    MA = max((len(set(v)) for v in task_aff_ids.values()), default=0)
+    MB = max((len(set(v)) for v in task_anti_ids.values()), default=0)
+    group_pa_class = np.zeros(G, dtype=np.int32)
+    group_aff_terms = np.full((G, MA), -1, dtype=np.int32)
+    group_anti_terms = np.full((G, MB), -1, dtype=np.int32)
+    for g, members in enumerate(group_members):
+        rep = members[0]
+        group_pa_class[g] = task_pa_class[rep.ordinal]
+        for m, tid in enumerate(sorted(set(task_aff_ids.get(rep.ordinal, ())))):
+            group_aff_terms[g, m] = tid
+        for m, tid in enumerate(sorted(set(task_anti_ids.get(rep.ordinal, ())))):
+            group_anti_terms[g, m] = tid
 
     # --- node tensors ---
     node_idle = np.zeros((N, R), dtype=np.float32)
@@ -429,6 +612,19 @@ def build_snapshot(cluster: ClusterInfo) -> Snapshot:
         queue_uid_rank=queue_uid_rank,
         queue_valid=queue_valid,
         class_fit=class_fit,
+        task_pa_class=task_pa_class,
+        group_pa_class=group_pa_class,
+        group_aff_terms=group_aff_terms,
+        group_anti_terms=group_anti_terms,
+        node_dom=pa["node_dom"],
+        aff_key=pa["aff_key"],
+        anti_key=pa["anti_key"],
+        aff_static=pa["aff_static"],
+        anti_static=pa["anti_static"],
+        aff_static_total=pa["aff_static_total"],
+        aff_match=pa["aff_match"],
+        anti_match=pa["anti_match"],
+        symm_ok=pa["symm_ok"],
         others_used=others_used,
     )
     index = SnapshotIndex(tasks=tasks, nodes=nodes, jobs=jobs, queues=queues, port_universe=universe)

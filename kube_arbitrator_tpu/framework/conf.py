@@ -87,13 +87,9 @@ def load_conf(conf_str: str) -> SchedulerConfig:
                 kwargs["arguments"] = tuple(sorted((str(k), str(v)) for k, v in args.items()))
             opt = PluginOption(name=name, **kwargs)
             if name == "nodeorder":
-                from ..ops.ordering import NODE_ORDER_POLICIES
+                from ..ops.ordering import node_order_policy
 
-                policy = opt.arg("policy", "first_fit")
-                if policy not in NODE_ORDER_POLICIES:
-                    raise ValueError(
-                        f"unknown nodeorder policy {policy!r}; one of {NODE_ORDER_POLICIES}"
-                    )
+                node_order_policy((Tier(plugins=(opt,)),))  # validates policy
             plugins.append(opt)
         tiers.append(Tier(plugins=tuple(plugins)))
     return SchedulerConfig(actions=action_names, tiers=tuple(tiers))

@@ -49,6 +49,7 @@ from .ordering import (
     node_order_policy,
     queue_order_keys,
 )
+from .podaffinity import apply_domain_cap, apply_seed, pa_enabled, pod_affinity_fit
 
 ALLOCATED = jnp.int32(int(TaskStatus.ALLOCATED))
 PIPELINED = jnp.int32(int(TaskStatus.PIPELINED))
@@ -269,19 +270,30 @@ def _process_queue(
         ok = st.node_valid
         has_ports = jnp.array(False)
 
+    pafit = None
+    if preds_on and pa_enabled(st):
+        pafit = pod_affinity_fit(st, g, state.task_status, state.task_node)
+        ok = ok & pafit.ok
+
     if best_effort_pass:
         # backfill: no resource constraint (backfill.go:40-71)
         k_idle = jnp.where(ok, jnp.minimum(pods_head, jnp.where(has_ports, 1, s_max)), 0).astype(
             jnp.int32
         )
+        if pafit is not None:
+            k_idle = apply_seed(st, pafit, k_idle)
         use_rel = jnp.array(False)
         k_eff = k_idle
     else:
         k_idle = _node_capacity(state.node_idle, req, ok, pods_head, has_ports)
+        if pafit is not None:
+            k_idle = apply_seed(st, pafit, k_idle)
         total_idle_cap = jnp.sum(k_idle)
         # pipeline fallback: only when nothing idle-fits anywhere
         use_rel = (total_idle_cap == 0) & (budget > 0)
         k_rel = _node_capacity(state.node_releasing, req, ok, pods_head, has_ports)
+        if pafit is not None:
+            k_rel = apply_seed(st, pafit, k_rel)
         k_eff = jnp.where(use_rel, k_rel, k_idle)
 
     # ---- node packing order (nodeorder plugin policy) ----
@@ -297,6 +309,9 @@ def _process_queue(
         score = -used_share if policy == "binpack" else used_share  # asc sort
         nperm = jnp.lexsort((jnp.arange(N), jnp.where(st.node_valid, score, BIG)))
         k_p = k_eff[nperm]
+
+    if pafit is not None:
+        k_p = apply_domain_cap(st, pafit, k_p, nperm)
 
     cum = jnp.cumsum(k_p)
     placed_total = jnp.minimum(budget, cum[-1])

@@ -1,0 +1,205 @@
+"""Pod (anti-)affinity as a per-term domain-count kernel.
+
+Reference behavior (``plugins/predicates/predicates.go:45-102,:186-198``):
+the upstream NewPodAffinityPredicate walks every existing pod per
+(task, node) call — required affinity terms must find a matching pod in the
+node's topology domain, anti-affinity terms must find none, and existing
+pods' anti-affinity terms are checked symmetrically against the incoming
+pod.  The k8s first-pod special case applies: an affinity term that matches
+the pod's *own* labels is satisfied everywhere while no pod in the cluster
+matches it.
+
+TPU-first re-design: the relational predicate factors through **topology
+domains** (snapshot.py assigns every (topology_key, node label value) a
+global domain ordinal) and **pod label classes**.  For each distinct term
+the snapshot precomputes per-domain counts of matching *existing* pods;
+the kernel adds the pods placed earlier in this cycle with one
+scatter-add over their domains, then the (group, node) verdict is an O(1)
+gather — no pairwise task×task work anywhere.
+
+Within-cycle dynamics the sequential loop gets for free and this kernel
+reproduces:
+
+* **Self-affinity seeding** — a gang whose pods select each other places
+  its first batch into one domain (chosen by capacity) and later batches
+  join it via the dynamic counts.
+* **Self-anti-affinity spreading** — at most one pod per domain, enforced
+  by a first-node-per-domain cap inside the admission order.
+* **Dynamic symmetry** — pods placed this cycle carrying anti terms block
+  later matching placements in their domains.
+
+Known deviation (conservative): a group whose affinity term is satisfied
+*only* by another job's pods placed later in the same cycle may miss this
+cycle and places next cycle; the reference's one-task-at-a-time loop has
+the same order dependence with a different arbitrary order.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..api.types import TaskStatus
+from ..cache.snapshot import SnapshotTensors
+
+PENDING = jnp.int32(int(TaskStatus.PENDING))
+ALLOCATED = jnp.int32(int(TaskStatus.ALLOCATED))
+PIPELINED = jnp.int32(int(TaskStatus.PIPELINED))
+
+
+class PodAffinityFit(NamedTuple):
+    ok: jax.Array        # bool[N] nodes admissible for the group
+    seed: jax.Array      # bool scalar: restrict this turn to ONE domain
+    seed_key: jax.Array  # i32 scalar topology-key index for seeding
+    cap: jax.Array       # bool scalar: cap one placement per domain
+    cap_key: jax.Array   # i32 scalar topology-key index for the cap
+
+
+def pa_enabled(st: SnapshotTensors) -> bool:
+    """Trace-time: does this snapshot carry any pod-affinity state?"""
+    return (
+        st.group_aff_terms.shape[1] > 0
+        or st.group_anti_terms.shape[1] > 0
+        or st.symm_ok.shape[0] > 0
+    )
+
+
+def pod_affinity_fit(
+    st: SnapshotTensors,
+    g: jax.Array,            # scalar group ordinal
+    task_status: jax.Array,  # i32[T] current (mid-cycle) status
+    task_node: jax.Array,    # i32[T] current node
+) -> PodAffinityFit:
+    N = st.num_nodes
+    ok = jnp.ones(N, dtype=bool)
+    seed = jnp.array(False)
+    seed_key = jnp.int32(0)
+    cap = jnp.array(False)
+    cap_key = jnp.int32(0)
+
+    cp = st.task_pa_class                      # i32[T]
+    cpg = st.group_pa_class[g]                 # scalar
+    # pods placed earlier this cycle (they were PENDING in the snapshot)
+    placed = (
+        (st.task_status == PENDING)
+        & ((task_status == ALLOCATED) | (task_status == PIPELINED))
+        & (task_node >= 0)
+        & st.task_valid
+    )
+    tnode = jnp.clip(task_node, 0)
+    D = st.aff_static.shape[1] if st.aff_static.shape[0] else st.anti_static.shape[1]
+
+    def dyn_count(key: jax.Array, contrib: jax.Array) -> jax.Array:
+        """i32[D]: placed-this-cycle pods in ``contrib`` per domain of key."""
+        tdom = st.node_dom[key][tnode]  # i32[T]
+        live = contrib & placed & (tdom >= 0)
+        return (
+            jnp.zeros(D + 1, jnp.int32)
+            .at[jnp.where(live, tdom, D)]
+            .add(1)[:D]
+        )
+
+    # ---- the group's own affinity terms ----
+    for m in range(st.group_aff_terms.shape[1]):
+        t = st.group_aff_terms[g, m]
+        tv = t >= 0
+        tc = jnp.clip(t, 0)
+        key = st.aff_key[tc]
+        ndom = st.node_dom[key]  # i32[N]
+        dyn = dyn_count(key, st.aff_match[tc, cp])
+        tot = st.aff_static[tc] + dyn
+        any_match = (st.aff_static_total[tc] > 0) | jnp.any(dyn > 0)
+        # first-pod special case: term matches own labels, nothing matches
+        # yet (the node must still carry the topology key)
+        self_seed = tv & ~any_match & st.aff_match[tc, cpg]
+        ok_t = (ndom >= 0) & ((tot[jnp.clip(ndom, 0)] > 0) | self_seed)
+        ok = ok & jnp.where(tv, ok_t, True)
+        seed_key = jnp.where(self_seed & ~seed, key, seed_key)
+        seed = seed | self_seed
+
+    # ---- the group's own anti-affinity terms ----
+    for m in range(st.group_anti_terms.shape[1]):
+        t = st.group_anti_terms[g, m]
+        tv = t >= 0
+        tc = jnp.clip(t, 0)
+        key = st.anti_key[tc]
+        ndom = st.node_dom[key]
+        dyn = dyn_count(key, st.anti_match[tc, cp])
+        tot = st.anti_static[tc] + dyn
+        blocked = (ndom >= 0) & (tot[jnp.clip(ndom, 0)] > 0)
+        ok = ok & jnp.where(tv, ~blocked, True)
+        # the group's own pods match its anti term -> spread one per domain
+        self_cap = tv & st.anti_match[tc, cpg]
+        cap_key = jnp.where(self_cap & ~cap, key, cap_key)
+        cap = cap | self_cap
+
+    # ---- dynamic symmetry: placed pods' anti terms vs this group ----
+    TA = st.anti_key.shape[0]
+    if TA > 0:
+        tg = jnp.clip(st.task_group, 0)
+        t_terms = st.group_anti_terms[tg]  # i32[T, MB]
+
+        def term_block(ti):
+            key = st.anti_key[ti]
+            owns = jnp.any(t_terms == ti, axis=1) & (st.task_group >= 0)
+            dyn = dyn_count(key, owns)
+            ndom = st.node_dom[key]
+            hit = (ndom >= 0) & (dyn[jnp.clip(ndom, 0)] > 0)
+            return jnp.where(st.anti_match[ti, cpg], hit, False)
+
+        blocked_any = jnp.any(jax.vmap(term_block)(jnp.arange(TA)), axis=0)
+        ok = ok & ~blocked_any
+
+    # ---- static symmetry (existing pods' anti terms) ----
+    if st.symm_ok.shape[0] > 0:
+        ok = ok & st.symm_ok[jnp.clip(cpg, 0, st.symm_ok.shape[0] - 1)]
+
+    return PodAffinityFit(ok=ok, seed=seed, seed_key=seed_key, cap=cap, cap_key=cap_key)
+
+
+def apply_seed(
+    st: SnapshotTensors, fit: PodAffinityFit, k: jax.Array
+) -> jax.Array:
+    """Self-affinity seeding: zero per-node capacity ``k`` outside the
+    single best domain (max total capacity) of the seeding topology key."""
+    if st.node_dom.shape[0] == 0:
+        return k
+    ndom = st.node_dom[fit.seed_key]  # i32[N]
+    D = st.aff_static.shape[1] if st.aff_static.shape[0] else st.anti_static.shape[1]
+    dom_cap = (
+        jnp.zeros(D + 1, k.dtype).at[jnp.where(ndom >= 0, ndom, D)].add(k)[:D]
+    )
+    best = jnp.argmax(dom_cap).astype(jnp.int32)
+    seeded = jnp.where(ndom == best, k, 0)
+    return jnp.where(fit.seed, seeded, k)
+
+
+def apply_domain_cap(
+    st: SnapshotTensors,
+    fit: PodAffinityFit,
+    k_packed: jax.Array,   # i32[N] capacities IN PACKING ORDER
+    nperm: jax.Array,      # i32[N] packing order permutation, or None
+) -> jax.Array:
+    """Self-anti-affinity spread: cap capacity at one per node and one per
+    topology domain, keeping the first node of each domain in packing
+    order.  Nodes without the topology label carry no domain and stay
+    uncapped per the upstream semantics (no domain -> no conflict)."""
+    if st.node_dom.shape[0] == 0:
+        return k_packed
+    N = k_packed.shape[0]
+    ndom = st.node_dom[fit.cap_key]
+    dom_p = ndom if nperm is None else ndom[nperm]
+    pos = jnp.arange(N)
+    # group by domain; within a domain zero-capacity nodes sort last so the
+    # kept "first" node is the first one that can actually host the pod
+    idx = jnp.lexsort((pos, k_packed == 0, dom_p))
+    sd = dom_p[idx]
+    first_sorted = jnp.concatenate([jnp.array([True]), sd[1:] != sd[:-1]])
+    first = jnp.zeros(N, bool).at[idx].set(first_sorted)
+    capped = jnp.where(
+        dom_p >= 0,
+        jnp.where(first, jnp.minimum(k_packed, 1), 0),
+        k_packed,
+    )
+    return jnp.where(fit.cap, capped, k_packed)

@@ -42,6 +42,7 @@ from .allocate import AllocState, PIPELINED, SessionCtx, _node_capacity
 from .common import BIG, EPS, lex_argmin, safe_share
 from .fairness import drf_shares, overused, queue_shares
 from .ordering import Tiers, group_order_keys, job_order_keys, queue_order_keys
+from .podaffinity import apply_domain_cap, apply_seed, pa_enabled, pod_affinity_fit
 
 RELEASING = jnp.int32(int(TaskStatus.RELEASING))
 RUNNING = jnp.int32(int(TaskStatus.RUNNING))
@@ -243,12 +244,20 @@ def _claim_turn(
         ok = st.node_valid
         has_ports = jnp.array(False)
 
+    pafit = None
+    if preds_on and pa_enabled(st):
+        pafit = pod_affinity_fit(st, g, state.task_status, state.task_node)
+        ok = ok & pafit.ok
+
     # Victims keep holding their pod slot and host ports while Releasing —
     # the reference's stmt.Evict re-adds the task to the node with
     # Releasing status (statement.go + node_info.go:101-127), so a
     # max-pods-full node stays unpreemptable there too.
     avail = state.node_releasing + totfree
     cap = _node_capacity(avail, req, ok, pods_head, has_ports)
+    if pafit is not None:
+        cap = apply_seed(st, pafit, cap)
+        cap = apply_domain_cap(st, pafit, cap, None)
 
     cum = jnp.cumsum(cap)
     placed_total = jnp.minimum(budget, cum[-1])

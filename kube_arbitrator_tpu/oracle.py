@@ -81,6 +81,12 @@ class SequentialScheduler:
         self.ports: Dict[str, set] = {
             n.name: {p for t in n.tasks.values() for p in t.host_ports} for n in self.nodes
         }
+        # pods "present" per node for inter-pod affinity: existing pods at
+        # session open + pods placed this cycle (the sequential loop sees
+        # session placements because predicates run over session state)
+        self.node_pods: Dict[str, List[TaskInfo]] = {
+            n.name: list(n.tasks.values()) for n in self.nodes
+        }
         self.job_alloc = {j.uid: j.allocated for j in self.jobs}
         self.job_ready_cnt = {j.uid: j.ready_task_num() for j in self.jobs}
         self.session_alloc: Dict[str, str] = {}
@@ -190,6 +196,55 @@ class SequentialScheduler:
                 return False
         if any(p in self.ports[n.name] for p in t.host_ports):
             return False
+        return self._pod_affinity_ok(t, n)
+
+    def _pod_affinity_ok(self, t: TaskInfo, n: NodeInfo) -> bool:
+        """Inter-pod affinity/anti-affinity incl. the k8s first-pod special
+        case and existing-pod anti-affinity symmetry (predicates.go:186-198
+        via the upstream NewPodAffinityPredicate)."""
+        nodes_by_name = {m.name: m for m in self.nodes}
+
+        def present():
+            for nn, pods in self.node_pods.items():
+                for p in pods:
+                    yield nodes_by_name[nn], p
+
+        for term in t.affinity_terms:
+            key = term.topology_key
+            v = n.labels.get(key)
+            matches_here = False
+            matches_anywhere = False
+            for nn, p in present():
+                if term.matches_pod(p.namespace, p.labels, t.namespace):
+                    matches_anywhere = True
+                    if v is not None and nn.labels.get(key) == v:
+                        matches_here = True
+            if term.anti:
+                if matches_here:
+                    return False
+            else:
+                # affinity needs the node to carry the topology key, even
+                # under the first-pod special case
+                if v is None:
+                    return False
+                if not matches_here and not (
+                    not matches_anywhere
+                    and term.matches_pod(t.namespace, t.labels, t.namespace)
+                ):
+                    return False
+        # symmetry: no present pod's anti term may match the incoming pod
+        # within that pod's domain
+        for nn, p in present():
+            for term in p.affinity_terms:
+                if not term.anti:
+                    continue
+                pv = nn.labels.get(term.topology_key)
+                if pv is None:
+                    continue
+                if n.labels.get(term.topology_key) == pv and term.matches_pod(
+                    t.namespace, t.labels, p.namespace
+                ):
+                    return False
         return True
 
     # --- the sequential loop ---
@@ -262,6 +317,7 @@ class SequentialScheduler:
             self.session_alloc[t.uid] = n.name
         self.numtasks[n.name] += 1
         self.ports[n.name] |= set(t.host_ports)
+        self.node_pods[n.name].append(t)
         juid = self._job_of(t.uid)
         self.job_alloc[juid] = self.job_alloc[juid] + t.resreq
         self.job_ready_cnt[juid] += 1

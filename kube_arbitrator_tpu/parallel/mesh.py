@@ -44,6 +44,8 @@ _NODE_SHARDED_FIELDS = frozenset(
         "node_valid",
     }
 )
+# Fields whose SECOND axis is the node dimension (per-key / per-class rows).
+_NODE_AXIS1_FIELDS = frozenset({"node_dom", "symm_ok"})
 
 
 def snapshot_shardings(mesh: Mesh) -> SnapshotTensors:
@@ -53,6 +55,8 @@ def snapshot_shardings(mesh: Mesh) -> SnapshotTensors:
     for f in dataclasses.fields(SnapshotTensors):
         if f.name in _NODE_SHARDED_FIELDS:
             specs[f.name] = NamedSharding(mesh, P(NODE_AXIS))
+        elif f.name in _NODE_AXIS1_FIELDS:
+            specs[f.name] = NamedSharding(mesh, P(None, NODE_AXIS))
         else:
             specs[f.name] = NamedSharding(mesh, P())
     return SnapshotTensors(**specs)

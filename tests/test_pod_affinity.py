@@ -1,0 +1,290 @@
+"""Pod (anti-)affinity predicate: kernel scenarios + oracle property check.
+
+Mirrors the reference e2e inter-pod scenarios (test/e2e/predicates.go pod
+affinity) plus the harder within-cycle dynamics the batched kernel must
+reproduce: gang self-affinity seeding, anti-affinity spread, and
+anti-affinity symmetry against existing pods.
+"""
+import numpy as np
+
+from kube_arbitrator_tpu.api import PodAffinityTerm, TaskStatus
+from kube_arbitrator_tpu.cache import SimCluster, build_snapshot
+from kube_arbitrator_tpu.cache.decode import decode_decisions
+from kube_arbitrator_tpu.oracle import SequentialScheduler
+from kube_arbitrator_tpu.ops import schedule_cycle
+
+GB = 1024**3
+ZONE = "topology.kubernetes.io/zone"
+HOST = "kubernetes.io/hostname"
+
+
+def run(sim):
+    snap = build_snapshot(sim.cluster)
+    dec = schedule_cycle(snap.tensors)
+    binds, _ = decode_decisions(snap, dec)
+    return {b.task_uid: b.node_name for b in binds}
+
+
+def zone_cluster(n_per_zone=2, zones=("a", "b", "c"), cpu=4000):
+    sim = SimCluster()
+    sim.add_queue("q")
+    for z in zones:
+        for i in range(n_per_zone):
+            sim.add_node(
+                f"{z}{i}", cpu_milli=cpu, labels={ZONE: z, HOST: f"{z}{i}"}
+            )
+    return sim
+
+
+def test_affinity_to_existing_pod():
+    """e2e 'pod affinity' analog: follower must land in the leader's zone."""
+    sim = zone_cluster()
+    j0 = sim.add_job("leader", queue="q")
+    sim.add_task(
+        j0, 100, 0, name="lead", status=TaskStatus.RUNNING, node="b0",
+        labels={"app": "store"},
+    )
+    j1 = sim.add_job("follower", queue="q")
+    sim.add_task(
+        j1, 100, 0, name="f1",
+        affinity=[PodAffinityTerm(match_labels=(("app", "store"),), topology_key=ZONE)],
+    )
+    binds = run(sim)
+    assert binds["f1"] in ("b0", "b1")
+
+
+def test_affinity_unsatisfiable_blocks():
+    """No matching pod anywhere and no self-match -> unschedulable."""
+    sim = zone_cluster()
+    j = sim.add_job("j", queue="q")
+    sim.add_task(
+        j, 100, 0, name="t",
+        affinity=[PodAffinityTerm(match_labels=(("app", "ghost"),), topology_key=ZONE)],
+    )
+    assert run(sim) == {}
+
+
+def test_self_affinity_gang_colocates():
+    """First-pod special case: a gang selecting its own labels seeds ONE
+    zone and the whole gang lands there."""
+    sim = zone_cluster(n_per_zone=2, cpu=4000)
+    j = sim.add_job("gang", queue="q", min_available=4)
+    for i in range(4):
+        sim.add_task(
+            j, 1500, 0, name=f"g{i}", labels={"app": "ring"},
+            affinity=[PodAffinityTerm(match_labels=(("app", "ring"),), topology_key=ZONE)],
+        )
+    binds = run(sim)
+    assert len(binds) == 4
+    zones = {sim.cluster.nodes[n].labels[ZONE] for n in binds.values()}
+    assert len(zones) == 1, f"gang split across zones: {binds}"
+
+
+def test_anti_affinity_spreads_one_per_zone():
+    """Self anti-affinity = spread: at most one replica per zone."""
+    sim = zone_cluster(n_per_zone=2)
+    j = sim.add_job("spread", queue="q")
+    for i in range(3):
+        sim.add_task(
+            j, 100, 0, name=f"s{i}", labels={"app": "web"},
+            affinity=[
+                PodAffinityTerm(match_labels=(("app", "web"),), topology_key=ZONE, anti=True)
+            ],
+        )
+    binds = run(sim)
+    assert len(binds) == 3
+    zones = [sim.cluster.nodes[n].labels[ZONE] for n in binds.values()]
+    assert len(set(zones)) == 3, f"anti-affinity violated: {binds}"
+
+
+def test_anti_affinity_overflow_stays_pending():
+    """4 replicas, 3 zones: exactly one replica stays pending."""
+    sim = zone_cluster(n_per_zone=2)
+    j = sim.add_job("spread", queue="q")
+    for i in range(4):
+        sim.add_task(
+            j, 100, 0, name=f"s{i}", labels={"app": "web"},
+            affinity=[
+                PodAffinityTerm(match_labels=(("app", "web"),), topology_key=ZONE, anti=True)
+            ],
+        )
+    binds = run(sim)
+    assert len(binds) == 3
+    zones = [sim.cluster.nodes[n].labels[ZONE] for n in binds.values()]
+    assert len(set(zones)) == 3
+
+
+def test_anti_affinity_against_existing():
+    """Existing pod occupies zone b -> anti pod avoids all of zone b."""
+    sim = zone_cluster()
+    j0 = sim.add_job("old", queue="q")
+    sim.add_task(
+        j0, 100, 0, name="old1", status=TaskStatus.RUNNING, node="b1",
+        labels={"app": "db"},
+    )
+    j1 = sim.add_job("new", queue="q")
+    sim.add_task(
+        j1, 100, 0, name="n1",
+        affinity=[PodAffinityTerm(match_labels=(("app", "db"),), topology_key=ZONE, anti=True)],
+    )
+    binds = run(sim)
+    assert sim.cluster.nodes[binds["n1"]].labels[ZONE] != "b"
+
+
+def test_anti_affinity_symmetry_existing_pod():
+    """An EXISTING pod's anti term blocks incoming matching pods in its
+    domain (satisfiesExistingPodsAntiAffinity symmetry)."""
+    sim = zone_cluster()
+    j0 = sim.add_job("guard", queue="q")
+    sim.add_task(
+        j0, 100, 0, name="guard1", status=TaskStatus.RUNNING, node="a0",
+        labels={"app": "guard"},
+        affinity=[PodAffinityTerm(match_labels=(("role", "intruder"),), topology_key=ZONE, anti=True)],
+    )
+    j1 = sim.add_job("new", queue="q")
+    sim.add_task(j1, 100, 0, name="i1", labels={"role": "intruder"})
+    binds = run(sim)
+    assert sim.cluster.nodes[binds["i1"]].labels[ZONE] != "a"
+
+
+def test_anti_affinity_dynamic_symmetry():
+    """A pod placed THIS cycle carrying an anti term blocks a later
+    matching placement in its domain."""
+    sim = zone_cluster(n_per_zone=1, zones=("a", "b"))
+    j0 = sim.add_job("first", queue="q", creation_ts=0.0)
+    sim.add_task(
+        j0, 100, 0, name="f1", labels={"app": "guard"},
+        affinity=[PodAffinityTerm(match_labels=(("role", "intruder"),), topology_key=ZONE, anti=True)],
+    )
+    j1 = sim.add_job("second", queue="q", creation_ts=1.0)
+    sim.add_task(j1, 100, 0, name="i1", labels={"role": "intruder"})
+    binds = run(sim)
+    za = sim.cluster.nodes[binds["f1"]].labels[ZONE]
+    zb = sim.cluster.nodes[binds["i1"]].labels[ZONE]
+    assert za != zb, f"dynamic symmetry violated: {binds}"
+
+
+def test_hostname_affinity_same_node():
+    """topology_key=hostname: affinity pins to the exact node."""
+    sim = zone_cluster()
+    j0 = sim.add_job("lead", queue="q")
+    sim.add_task(
+        j0, 100, 0, name="lead1", status=TaskStatus.RUNNING, node="c1",
+        labels={"app": "cache"},
+    )
+    j1 = sim.add_job("f", queue="q")
+    sim.add_task(
+        j1, 100, 0, name="f1",
+        affinity=[PodAffinityTerm(match_labels=(("app", "cache"),), topology_key=HOST)],
+    )
+    assert run(sim)["f1"] == "c1"
+
+
+def test_namespace_scoping():
+    """Terms only select pods in the owner's namespace by default."""
+    sim = zone_cluster()
+    j0 = sim.add_job("other-ns", queue="q", namespace="other")
+    sim.add_task(
+        j0, 100, 0, name="o1", status=TaskStatus.RUNNING, node="a0",
+        labels={"app": "store"},
+    )
+    j1 = sim.add_job("mine", queue="q", namespace="default")
+    sim.add_task(
+        j1, 100, 0, name="m1",
+        affinity=[PodAffinityTerm(match_labels=(("app", "store"),), topology_key=ZONE)],
+    )
+    # the only matching pod is in another namespace -> unschedulable
+    assert "m1" not in run(sim)
+    # explicitly scoping the namespace makes it schedulable
+    sim2 = zone_cluster()
+    k0 = sim2.add_job("other-ns", queue="q", namespace="other")
+    sim2.add_task(
+        k0, 100, 0, name="o1", status=TaskStatus.RUNNING, node="a0",
+        labels={"app": "store"},
+    )
+    k1 = sim2.add_job("mine", queue="q", namespace="default")
+    sim2.add_task(
+        k1, 100, 0, name="m1",
+        affinity=[
+            PodAffinityTerm(
+                match_labels=(("app", "store"),), topology_key=ZONE,
+                namespaces=("other",),
+            )
+        ],
+    )
+    assert sim2.cluster.nodes[run(sim2)["m1"]].labels[ZONE] == "a"
+
+
+def test_oracle_agreement_mixed():
+    """Property check: kernel and sequential oracle agree on WHICH tasks
+    schedule (not necessarily the same nodes) in a mixed scenario."""
+    rng = np.random.default_rng(7)
+    sim = zone_cluster(n_per_zone=2, cpu=3000)
+    apps = ["a", "b", "c"]
+    for ji in range(4):
+        j = sim.add_job(f"j{ji}", queue="q", creation_ts=float(ji))
+        for ti in range(3):
+            app = apps[int(rng.integers(0, len(apps)))]
+            terms = []
+            r = rng.random()
+            if r < 0.4:
+                terms = [PodAffinityTerm(match_labels=(("app", app),), topology_key=ZONE)]
+            elif r < 0.7:
+                terms = [
+                    PodAffinityTerm(match_labels=(("app", app),), topology_key=ZONE, anti=True)
+                ]
+            sim.add_task(
+                j, 500, 0, name=f"j{ji}t{ti}", labels={"app": app}, affinity=terms
+            )
+    kernel_binds = run(sim)
+    oracle_binds = SequentialScheduler(sim.cluster).run_cycle().binds
+    assert set(kernel_binds) == set(oracle_binds), (
+        f"kernel and oracle disagree on WHICH tasks schedule: "
+        f"kernel={sorted(kernel_binds)} oracle={sorted(oracle_binds)}"
+    )
+
+    # End-state invariant over the kernel's placements: anti terms hold with
+    # the pod itself excluded; affinity terms hold with it included (a
+    # seeded gang legitimately self-satisfies its term).
+    nodes = {n.name: n for n in sim.cluster.nodes.values()}
+    tasks = {t.uid: t for j in sim.cluster.jobs.values() for t in j.tasks.values()}
+    placed = [(nodes[nn], tasks[uid]) for uid, nn in kernel_binds.items()]
+
+    def end_state_ok(t, n):
+        for term in t.affinity_terms:
+            v = n.labels.get(term.topology_key)
+            in_dom = [
+                p
+                for nn, p in placed
+                if v is not None
+                and nn.labels.get(term.topology_key) == v
+                and term.matches_pod(p.namespace, p.labels, t.namespace)
+            ]
+            if term.anti:
+                if any(p.uid != t.uid for p in in_dom):
+                    return False
+            else:
+                if v is None or not in_dom:
+                    return False
+        for nn, p in placed:
+            if p.uid == t.uid:
+                continue
+            for term in p.affinity_terms:
+                if not term.anti:
+                    continue
+                pv = nn.labels.get(term.topology_key)
+                if pv is not None and n.labels.get(term.topology_key) == pv and term.matches_pod(
+                    t.namespace, t.labels, p.namespace
+                ):
+                    return False
+        return True
+
+    for uid, node in kernel_binds.items():
+        assert end_state_ok(tasks[uid], nodes[node]), (
+            f"kernel placed {uid} on {node} violating pod affinity; "
+            f"kernel={kernel_binds} oracle={oracle_binds}"
+        )
+    # and the same invariant holds for the oracle (sanity on the checker)
+    placed = [(nodes[nn], tasks[uid]) for uid, nn in oracle_binds.items()]
+    for uid, node in oracle_binds.items():
+        assert end_state_ok(tasks[uid], nodes[node])
