@@ -30,6 +30,12 @@ class OracleResult:
     session_alloc: Dict[str, str]     # all session placements (incl. uncommitted)
     pipelined: Dict[str, str]
     job_ready: Dict[str, bool]
+    # Set when run_cycle hit its deadline: the loop stopped early, so binds
+    # reflects only the work done so far (bench.py extrapolates the rate —
+    # a greedy loop's early rate is its best rate, so this flatters the
+    # baseline, never the kernel).
+    truncated: bool = False
+    elapsed_s: float = 0.0
 
 
 def _water_fill(
@@ -66,7 +72,16 @@ class SequentialScheduler:
         self.tiers = tiers
         self.plugins = {p.name for t in tiers for p in t.plugins}
 
-    def run_cycle(self, actions: Tuple[str, ...] = ("allocate", "backfill")) -> OracleResult:
+    def run_cycle(
+        self,
+        actions: Tuple[str, ...] = ("allocate", "backfill"),
+        deadline_s: Optional[float] = None,
+    ) -> OracleResult:
+        import time as _time
+
+        self._deadline = (_time.perf_counter() + deadline_s) if deadline_s else None
+        self._truncated = False
+        _t_start = _time.perf_counter()
         c = self.cluster
         self.nodes: List[NodeInfo] = sorted(c.nodes.values(), key=lambda n: n.name)
         self.jobs = sorted(c.jobs.values(), key=lambda j: j.uid)
@@ -87,6 +102,15 @@ class SequentialScheduler:
         self.node_pods: Dict[str, List[TaskInfo]] = {
             n.name: list(n.tasks.values()) for n in self.nodes
         }
+        self._nodes_by_name = {n.name: n for n in self.nodes}
+        # fast path: the affinity walk is O(present pods) per (task,node);
+        # skip it entirely while no present pod carries an anti term
+        self._any_anti_present = any(
+            term.anti
+            for pods in self.node_pods.values()
+            for p in pods
+            for term in p.affinity_terms
+        )
         self.job_alloc = {j.uid: j.allocated for j in self.jobs}
         self.job_ready_cnt = {j.uid: j.ready_task_num() for j in self.jobs}
         self.session_alloc: Dict[str, str] = {}
@@ -136,6 +160,8 @@ class SequentialScheduler:
             session_alloc=dict(self.session_alloc),
             pipelined=dict(self.pipelined),
             job_ready=job_ready,
+            truncated=self._truncated,
+            elapsed_s=_time.perf_counter() - _t_start,
         )
 
     # --- ordering (session_plugins.go tier semantics) ---
@@ -202,7 +228,9 @@ class SequentialScheduler:
         """Inter-pod affinity/anti-affinity incl. the k8s first-pod special
         case and existing-pod anti-affinity symmetry (predicates.go:186-198
         via the upstream NewPodAffinityPredicate)."""
-        nodes_by_name = {m.name: m for m in self.nodes}
+        if not t.affinity_terms and not self._any_anti_present:
+            return True
+        nodes_by_name = self._nodes_by_name
 
         def present():
             for nn, pods in self.node_pods.items():
@@ -271,6 +299,12 @@ class SequentialScheduler:
         active_queues = {j.queue_uid for juid, j in ((j.uid, j) for j in self.jobs) if juid in pending}
 
         while active_queues:
+            if self._deadline is not None:
+                import time as _time
+
+                if _time.perf_counter() > self._deadline:
+                    self._truncated = True
+                    return
             quid = min(
                 active_queues, key=lambda q: (self._queue_share(q) if "proportion" in self.plugins else 0, q)
             )
@@ -318,6 +352,8 @@ class SequentialScheduler:
         self.numtasks[n.name] += 1
         self.ports[n.name] |= set(t.host_ports)
         self.node_pods[n.name].append(t)
+        if any(term.anti for term in t.affinity_terms):
+            self._any_anti_present = True
         juid = self._job_of(t.uid)
         self.job_alloc[juid] = self.job_alloc[juid] + t.resreq
         self.job_ready_cnt[juid] += 1

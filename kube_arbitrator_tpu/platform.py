@@ -1,0 +1,16 @@
+"""Accelerator platform bootstrap shared by the CLI and bench entry points."""
+from __future__ import annotations
+
+
+def ensure_jax_backend() -> None:
+    """Initialize the JAX backend, falling back to autodetection when the
+    environment names a platform whose plugin isn't registered in this
+    process (e.g. a stripped PYTHONPATH dropped the sitecustomize that
+    registers the TPU plugin)."""
+    import jax
+
+    try:
+        jax.devices()
+    except RuntimeError:
+        jax.config.update("jax_platforms", "")
+        jax.devices()
