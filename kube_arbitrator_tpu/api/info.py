@@ -145,6 +145,18 @@ class TaskInfo:
         return dataclasses.replace(self, resreq=self.resreq.copy())
 
 
+@dataclasses.dataclass(frozen=True)
+class PDBInfo:
+    """PodDisruptionBudget subset the reference consumes: when a job has no
+    PodGroup, a PDB owned by the same controller supplies its gang size
+    (``api/job_info.go:188-205`` SetPDB/UnsetPDB; the PDB informer feeds it
+    at ``cache/event_handlers.go:458-492``)."""
+
+    name: str
+    namespace: str = "default"
+    min_available: int = 0
+
+
 @dataclasses.dataclass
 class JobInfo:
     """Reference api/job_info.go:117-358 (JobInfo). Gang unit == PodGroup."""
@@ -158,6 +170,21 @@ class JobInfo:
     creation_ts: float = 0.0
     tasks: Dict[str, TaskInfo] = dataclasses.field(default_factory=dict)
     ordinal: int = -1
+    pdb: Optional[PDBInfo] = None
+
+    def set_pdb(self, pdb: PDBInfo, default_queue: str = "") -> None:
+        """SetPDB (job_info.go:188-199): the PDB names the job and its
+        MinAvailable; queue = default queue if set, else the namespace."""
+        self.name = pdb.name
+        self.namespace = pdb.namespace
+        self.min_available = pdb.min_available
+        self.queue_uid = default_queue or pdb.namespace
+        self.pdb = pdb
+
+    def unset_pdb(self) -> None:
+        """UnsetPDB (job_info.go:202-205)."""
+        self.pdb = None
+        self.min_available = 0
 
     def add_task(self, t: TaskInfo) -> None:
         self.tasks[t.uid] = t

@@ -1,12 +1,23 @@
 """Cluster cache, snapshot tensorization, and simulation harness."""
-from .sim import BindIntent, EvictIntent, FakeBinder, FakeEvictor, SimCluster, generate_cluster
+from .sim import (
+    BindFailure,
+    BindIntent,
+    EvictIntent,
+    FakeBinder,
+    FakeEvictor,
+    FakeVolumeBinder,
+    SimCluster,
+    generate_cluster,
+)
 from .snapshot import Snapshot, SnapshotIndex, SnapshotTensors, build_snapshot
 
 __all__ = [
+    "BindFailure",
     "BindIntent",
     "EvictIntent",
     "FakeBinder",
     "FakeEvictor",
+    "FakeVolumeBinder",
     "SimCluster",
     "generate_cluster",
     "Snapshot",

@@ -22,6 +22,7 @@ from ..api.info import (
     JobInfo,
     MatchExpression,
     NodeInfo,
+    PDBInfo,
     PodAffinityTerm,
     QueueInfo,
     Taint,
@@ -29,6 +30,7 @@ from ..api.info import (
     Toleration,
 )
 from ..api.types import TaskStatus
+from ..options import options
 
 
 @dataclasses.dataclass
@@ -53,22 +55,56 @@ class Event:
     message: str = ""
 
 
+class BindFailure(RuntimeError):
+    """A binder/evictor backend error (the apiserver POST/DELETE failing);
+    triggers the errTasks resync path (cache.go:519-547)."""
+
+
 @dataclasses.dataclass
 class FakeBinder:
-    """Records binds, mirroring allocate_test.go's fakeBinder."""
+    """Records binds, mirroring allocate_test.go's fakeBinder.  Set
+    ``fail_uids`` to make specific binds raise (backend-error injection)."""
 
     binds: Dict[str, str] = dataclasses.field(default_factory=dict)
+    fail_uids: set = dataclasses.field(default_factory=set)
 
     def bind(self, task_uid: str, node_name: str) -> None:
+        if task_uid in self.fail_uids:
+            raise BindFailure(f"bind {task_uid} failed")
         self.binds[task_uid] = node_name
 
 
 @dataclasses.dataclass
 class FakeEvictor:
     evicts: List[str] = dataclasses.field(default_factory=list)
+    fail_uids: set = dataclasses.field(default_factory=set)
 
     def evict(self, task_uid: str) -> None:
+        if task_uid in self.fail_uids:
+            raise BindFailure(f"evict {task_uid} failed")
         self.evicts.append(task_uid)
+
+
+@dataclasses.dataclass
+class FakeVolumeBinder:
+    """VolumeBinder seam (cache/interface.go:67-76: AllocateVolumes /
+    BindVolumes before every dispatch, session.go:295-316).  The default is
+    a no-op, like the reference with no PVCs; tests inject failures."""
+
+    allocated: List[Tuple[str, str]] = dataclasses.field(default_factory=list)
+    bound: List[str] = dataclasses.field(default_factory=list)
+    fail_allocate_uids: set = dataclasses.field(default_factory=set)
+    fail_bind_uids: set = dataclasses.field(default_factory=set)
+
+    def allocate_volumes(self, task_uid: str, node_name: str) -> None:
+        if task_uid in self.fail_allocate_uids:
+            raise BindFailure(f"volume allocate {task_uid} failed")
+        self.allocated.append((task_uid, node_name))
+
+    def bind_volumes(self, task_uid: str) -> None:
+        if task_uid in self.fail_bind_uids:
+            raise BindFailure(f"volume bind {task_uid} failed")
+        self.bound.append(task_uid)
 
 
 class SimCluster:
@@ -78,8 +114,14 @@ class SimCluster:
         self.cluster = ClusterInfo()
         self.binder = FakeBinder()
         self.evictor = FakeEvictor()
+        self.volume_binder = FakeVolumeBinder()
         self.events: List[Event] = []  # record.EventRecorder equivalent
         self._task_counter = 0
+        # errTasks FIFO: binds/evicts whose backend call failed; a resync
+        # pass re-reads the source of truth and repairs (cache.go:519-547)
+        self.resync_queue: List[str] = []
+        # deferred job GC FIFO (cache.go:476-517): (job uid, deletion ts)
+        self._deleted_jobs: List[Tuple[str, float]] = []
 
     def record_event(self, kind: str, object_uid: str, reason: str, message: str = "") -> None:
         self.events.append(Event(kind, object_uid, reason, message))
@@ -90,6 +132,38 @@ class SimCluster:
         q = QueueInfo(uid=name, name=name, weight=weight)
         self.cluster.queues[name] = q
         return q
+
+    def add_namespace(self, name: str, weight: int = 1) -> Optional[QueueInfo]:
+        """Namespace event under --enable-namespace-as-queue: each namespace
+        is a queue (event_handlers.go:656-673; informer choice at
+        cache.go:290-306).  A no-op when the option is off, like the
+        reference's conditional informer registration."""
+        if not options().namespace_as_queue:
+            return None
+        return self.add_queue(name, weight=weight)
+
+    def add_pdb(self, name: str, min_available: int, namespace: str = "default") -> JobInfo:
+        """PDB event: the PDB defines/updates the gang job keyed by it
+        (event_handlers.go:458-473 setPDB; job created on demand)."""
+        uid = f"{namespace}/{name}"
+        job = self.cluster.jobs.get(uid)
+        if job is None:
+            job = JobInfo(uid=uid)
+            self.cluster.jobs[uid] = job
+        job.set_pdb(
+            PDBInfo(name=name, namespace=namespace, min_available=min_available),
+            default_queue=options().default_queue
+            if not options().namespace_as_queue
+            else "",
+        )
+        return job
+
+    def delete_pdb(self, name: str, namespace: str = "default") -> None:
+        """deletePDB (event_handlers.go:480-492): job loses its gang size."""
+        job = self.cluster.jobs.get(f"{namespace}/{name}")
+        if job is None:
+            raise KeyError(f"{namespace}/{name}")
+        job.unset_pdb()
 
     def add_node(
         self,
@@ -116,12 +190,17 @@ class SimCluster:
     def add_job(
         self,
         name: str,
-        queue: str = "default",
+        queue: Optional[str] = None,
         min_available: int = 0,
         priority: int = 0,
         creation_ts: float = 0.0,
         namespace: str = "default",
     ) -> JobInfo:
+        # Queue resolution order of JobInfo.SetPodGroup (job_info.go:166-186):
+        # explicit PodGroup queue > namespace (when namespace-as-queue) >
+        # the --default-queue option.
+        if queue is None:
+            queue = namespace if options().namespace_as_queue else options().default_queue
         j = JobInfo(
             uid=name,
             name=name,
@@ -133,6 +212,40 @@ class SimCluster:
         )
         self.cluster.jobs[name] = j
         return j
+
+    def delete_job(self, uid: str, now: Optional[float] = None) -> None:
+        """Mark a job deleted; actual removal is deferred through the GC
+        FIFO (cache.go:476-517: deleteJob → processCleanupJob after delay)."""
+        import time as _time
+
+        if uid not in self.cluster.jobs:
+            raise KeyError(uid)
+        self._deleted_jobs.append((uid, now if now is not None else _time.time()))
+
+    def collect_garbage(self, now: Optional[float] = None, delay_s: float = 5.0) -> List[str]:
+        """Process the deferred-deletion FIFO: jobs whose delay elapsed and
+        whose tasks are all terminal are removed; others are re-queued
+        (cache.go:489-517 semantics).  Returns collected job uids."""
+        import time as _time
+
+        now = now if now is not None else _time.time()
+        keep: List[Tuple[str, float]] = []
+        collected: List[str] = []
+        terminal = {TaskStatus.SUCCEEDED, TaskStatus.FAILED, TaskStatus.UNKNOWN}
+        for uid, ts in self._deleted_jobs:
+            job = self.cluster.jobs.get(uid)
+            if job is None:
+                continue
+            if now - ts < delay_s:
+                keep.append((uid, ts))
+                continue
+            if any(t.status not in terminal for t in job.tasks.values()):
+                keep.append((uid, ts))  # still has live tasks; retry later
+                continue
+            del self.cluster.jobs[uid]
+            collected.append(uid)
+        self._deleted_jobs = keep
+        return collected
 
     def add_task(
         self,
@@ -198,17 +311,39 @@ class SimCluster:
         return {uid: t for j in self.cluster.jobs.values() for uid, t in j.tasks.items()}
 
     def apply_binds(self, binds: Sequence[BindIntent]) -> None:
-        """Commit bind intents: task -> Bound on node, with accounting."""
+        """Commit bind intents: allocate volumes for the whole job first
+        (gang-atomic: a volume failure drops the job's entire batch, the
+        stronger form of session.go:243-259 failing the task before any
+        accounting), then per task BindVolumes + Bind (session.go:295-316).
+        Backend failures divert the task to the resync FIFO instead of
+        raising (cache.go:437-444)."""
         index = self._task_index()
+        by_job: Dict[str, List[BindIntent]] = {}
         for b in binds:
             task = index.get(b.task_uid)
             if task is None:
                 raise KeyError(b.task_uid)
-            node = self.cluster.nodes[b.node_name]
-            task.status = TaskStatus.BOUND
-            task.node_name = b.node_name
-            node.add_task(task)
-            self.binder.bind(b.task_uid, b.node_name)
+            by_job.setdefault(task.job_uid, []).append(b)
+        for job_uid, job_binds in by_job.items():
+            try:
+                for b in job_binds:
+                    self.volume_binder.allocate_volumes(b.task_uid, b.node_name)
+            except BindFailure as err:
+                for b in job_binds:
+                    self._defer_resync(b.task_uid, "AllocateVolumes", str(err))
+                continue
+            for b in job_binds:
+                task = index[b.task_uid]
+                node = self.cluster.nodes[b.node_name]
+                try:
+                    self.volume_binder.bind_volumes(b.task_uid)
+                    self.binder.bind(b.task_uid, b.node_name)
+                except BindFailure as err:
+                    self._defer_resync(b.task_uid, "Bind", str(err))
+                    continue
+                task.status = TaskStatus.BOUND
+                task.node_name = b.node_name
+                node.add_task(task)
 
     def apply_evicts(self, evicts: Sequence[EvictIntent]) -> None:
         """Evict: running task -> Releasing on its node (cache.go:369-405)."""
@@ -217,6 +352,11 @@ class SimCluster:
             task = index.get(e.task_uid)
             if task is None:
                 raise KeyError(e.task_uid)
+            try:
+                self.evictor.evict(e.task_uid)
+            except BindFailure as err:
+                self._defer_resync(e.task_uid, "Evict", str(err))
+                continue
             if task.node_name:
                 node = self.cluster.nodes[task.node_name]
                 node.remove_task(task)
@@ -224,8 +364,38 @@ class SimCluster:
                 node.add_task(task)
             else:
                 task.status = TaskStatus.RELEASING
-            self.evictor.evict(e.task_uid)
             self.record_event("Evict", e.task_uid, "Evict")
+
+    # ---- failure handling (errTasks resync, cache.go:519-547) ----
+
+    def _defer_resync(self, task_uid: str, op: str, message: str) -> None:
+        self.resync_queue.append(task_uid)
+        self.record_event("FailedScheduling", task_uid, op, message)
+
+    def process_resync(self) -> int:
+        """Drain the errTasks FIFO: re-read each task from the source of
+        truth (here: the cluster model, the analog of re-GETting the pod,
+        event_handlers.go:70-88) and repair its state.  A task whose bind
+        or evict never happened stays/returns Pending-off-node; its next
+        cycle retries.  Returns tasks repaired."""
+        repaired = 0
+        index = self._task_index()
+        queue, self.resync_queue = self.resync_queue, []
+        for uid in queue:
+            task = index.get(uid)
+            if task is None:
+                continue  # deleted meanwhile; nothing to repair
+            if task.status in (TaskStatus.PENDING, TaskStatus.RUNNING):
+                repaired += 1  # model already consistent (op never applied)
+                continue
+            # op half-applied (should not happen in sim: accounting follows
+            # the backend call) — restore the authoritative pending state
+            if task.node_name and uid in self.cluster.nodes.get(task.node_name, NodeInfo("")).tasks:
+                self.cluster.nodes[task.node_name].remove_task(task)
+            task.status = TaskStatus.PENDING
+            task.node_name = ""
+            repaired += 1
+        return repaired
 
 
 def generate_cluster(

@@ -25,6 +25,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--scheduler-conf", default="", help="YAML action/tier configuration file")
     p.add_argument("--schedule-period", type=float, default=1.0, help="seconds per cycle")
     p.add_argument("--default-queue", default="default", help="queue for jobs that name none")
+    p.add_argument(
+        "--enable-namespace-as-queue",
+        action="store_true",
+        help="treat namespaces as queues instead of Queue objects",
+    )
+    p.add_argument(
+        "--enable-leader-election",
+        action="store_true",
+        help="gate scheduling on holding the leader lease",
+    )
+    p.add_argument(
+        "--lock-object-namespace",
+        default="",
+        help="namespace (directory, in sim) of the leader-election lock object",
+    )
     p.add_argument("--print-version", action="store_true")
     # simulation plane
     p.add_argument("--sim-nodes", type=int, default=100)
@@ -44,6 +59,31 @@ def main(argv=None) -> int:
 
         print(f"kube-arbitrator-tpu {__version__}")
         return 0
+
+    # Validate flags before any heavy import (the ops/jax import tree
+    # initializes the accelerator backend; CheckOptionOrDie runs first in
+    # the reference too, server.go:58-66).
+    from .options import ServerOptions, set_options
+
+    opts = ServerOptions(
+        scheduler_name=args.scheduler_name,
+        schedule_period_s=args.schedule_period,
+        default_queue=args.default_queue,
+        namespace_as_queue=args.enable_namespace_as_queue,
+        scheduler_conf=args.scheduler_conf,
+        enable_leader_election=args.enable_leader_election,
+        lock_object_namespace=args.lock_object_namespace,
+    )
+    try:
+        opts.check()
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    set_options(opts)
+
+    from .platform import ensure_jax_backend
+
+    ensure_jax_backend()
 
     from .cache.sim import generate_cluster
     from .framework import Scheduler

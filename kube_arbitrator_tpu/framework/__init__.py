@@ -1,4 +1,5 @@
 """Framework: session, conf, registries, scheduler loop."""
+from ..options import ServerOptions, options, reset_options, set_options
 from .conf import DEFAULT_CONF, SchedulerConfig, load_conf, load_conf_file
 from .registry import get_action, plugin_capabilities, register_action, register_plugin
 from .scheduler import CycleStats, Scheduler
@@ -19,4 +20,8 @@ __all__ = [
     "CycleResult",
     "PodGroupCondition",
     "PodGroupStatus",
+    "ServerOptions",
+    "options",
+    "set_options",
+    "reset_options",
 ]

@@ -48,6 +48,10 @@ class Scheduler:
 
     def run_once(self) -> CycleResult:
         t0 = time.perf_counter()
+        # steady-state maintenance that runs as goroutines in the reference:
+        # errTasks resync (cache.go:519-547) and deferred job GC (:476-517)
+        self.sim.process_resync()
+        self.sim.collect_garbage()
         pending = sum(len(j.pending_tasks()) for j in self.sim.cluster.jobs.values())
         session = Session(self.sim.cluster, self.config)
         result = session.run()

@@ -1,0 +1,228 @@
+"""Cache-plane parity: PDB gangs, namespace-as-queue, bind/evict failure
+resync, deferred job GC, volume binder hooks.
+
+Reference behaviors: api/job_info.go:188-205 (SetPDB/UnsetPDB),
+cache/event_handlers.go:458-492 (PDB events), :656-673 (namespace queues),
+cache/cache.go:519-547 (errTasks resync), :476-517 (deferred job GC),
+cache/interface.go:59-76 (Binder/Evictor/VolumeBinder seams).
+"""
+import pytest
+
+from kube_arbitrator_tpu.api.types import TaskStatus
+from kube_arbitrator_tpu.cache import SimCluster, build_snapshot
+from kube_arbitrator_tpu.framework import Scheduler, load_conf
+from kube_arbitrator_tpu.options import ServerOptions, reset_options, set_options
+
+GB = 1024**3
+
+
+@pytest.fixture(autouse=True)
+def _fresh_options():
+    reset_options()
+    yield
+    reset_options()
+
+
+def _conf(actions="allocate, backfill"):
+    return load_conf(
+        f"""
+actions: "{actions}"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+"""
+    )
+
+
+# ---- PDB ----
+
+
+def test_pdb_defines_gang_min_available():
+    sim = SimCluster()
+    sim.add_queue("default")
+    sim.add_node("n1", cpu_milli=4000, memory=8 * GB)
+    job = sim.add_pdb("web", min_available=3)
+    assert job.uid == "default/web"
+    assert job.min_available == 3
+    assert job.queue_uid == "default"  # default_queue is set → wins over ns
+    # only 2 tasks fit the budget of this test: gang must block all of them
+    for _ in range(2):
+        sim.add_task(job, cpu_milli=1000, memory=1 * GB)
+    sched = Scheduler(sim, config=_conf())
+    sched.run_once()
+    assert sim.binder.binds == {}
+
+    # a third replica arrives → the gang becomes satisfiable and releases
+    sim.add_task(job, cpu_milli=1000, memory=1 * GB)
+    sched.run_once()
+    assert len(sim.binder.binds) == 3
+
+
+def test_pdb_queue_falls_back_to_namespace_without_default_queue():
+    set_options(ServerOptions(default_queue=""))
+    sim = SimCluster()
+    job = sim.add_pdb("web", min_available=1, namespace="team-a")
+    assert job.queue_uid == "team-a"
+
+
+def test_delete_pdb_clears_gang():
+    sim = SimCluster()
+    sim.add_queue("default")
+    sim.add_node("n1", cpu_milli=4000, memory=8 * GB)
+    job = sim.add_pdb("web", min_available=5)
+    for _ in range(2):
+        sim.add_task(job, cpu_milli=1000, memory=1 * GB)
+    sched = Scheduler(sim, config=_conf())
+    sched.run_once()
+    assert sim.binder.binds == {}  # gang of 5 unsatisfiable
+    sim.delete_pdb("web")
+    assert job.min_available == 0
+    sched.run_once()
+    assert len(sim.binder.binds) == 2  # no gang constraint anymore
+
+
+def test_snapshot_tolerates_empty_pdb_job():
+    sim = SimCluster()
+    sim.add_queue("default")
+    sim.add_node("n1")
+    sim.add_pdb("empty", min_available=2)  # PDB exists before any pod
+    snap = build_snapshot(sim.cluster)
+    assert snap.tensors.num_tasks >= 0  # just must not crash
+
+
+# ---- namespace-as-queue ----
+
+
+def test_namespace_as_queue_resolution():
+    set_options(ServerOptions(namespace_as_queue=True))
+    sim = SimCluster()
+    assert sim.add_namespace("team-a", weight=3) is not None
+    sim.add_namespace("team-b")
+    sim.add_node("n1", cpu_milli=4000, memory=8 * GB)
+    job = sim.add_job("j1", namespace="team-a")  # no queue named
+    assert job.queue_uid == "team-a"
+    sim.add_task(job, cpu_milli=500, memory=GB)
+    sched = Scheduler(sim, config=_conf())
+    sched.run_once()
+    assert len(sim.binder.binds) == 1
+
+
+def test_add_namespace_noop_when_option_off():
+    sim = SimCluster()
+    assert sim.add_namespace("team-a") is None
+    assert "team-a" not in sim.cluster.queues
+
+
+def test_options_check():
+    with pytest.raises(ValueError):
+        ServerOptions(enable_leader_election=True).check()
+    ServerOptions(enable_leader_election=True, lock_object_namespace="kube-system").check()
+
+
+# ---- bind failure → errTasks resync ----
+
+
+def test_bind_failure_diverts_to_resync_and_retries():
+    sim = SimCluster()
+    sim.add_queue("default")
+    sim.add_node("n1", cpu_milli=4000, memory=8 * GB)
+    job = sim.add_job("j1")
+    t1 = sim.add_task(job, cpu_milli=500, memory=GB)
+    t2 = sim.add_task(job, cpu_milli=500, memory=GB)
+    sim.binder.fail_uids.add(t1.uid)
+
+    sched = Scheduler(sim, config=_conf())
+    sched.run_once()
+    # t2 bound; t1's backend call failed: stays pending, queued for resync
+    assert t2.uid in sim.binder.binds
+    assert t1.uid not in sim.binder.binds
+    assert t1.status == TaskStatus.PENDING
+    assert sim.resync_queue == [t1.uid]
+    assert any(e.kind == "FailedScheduling" for e in sim.events)
+
+    # backend recovers → next cycle resyncs and retries the bind
+    sim.binder.fail_uids.clear()
+    sched.run_once()
+    assert t1.uid in sim.binder.binds
+    assert sim.resync_queue == []
+    # no double-accounting on the node
+    n1 = sim.cluster.nodes["n1"]
+    assert len(n1.tasks) == 2
+
+
+def test_evict_failure_keeps_task_running():
+    sim = SimCluster()
+    sim.add_queue("default")
+    sim.add_node("n1", cpu_milli=1000, memory=GB)
+    job = sim.add_job("j1")
+    t = sim.add_task(job, cpu_milli=500, memory=GB // 2, status=TaskStatus.RUNNING, node="n1")
+    sim.evictor.fail_uids.add(t.uid)
+    from kube_arbitrator_tpu.cache import EvictIntent
+
+    sim.apply_evicts([EvictIntent(task_uid=t.uid)])
+    assert t.status == TaskStatus.RUNNING  # eviction never actuated
+    assert sim.resync_queue == [t.uid]
+    sim.process_resync()
+    assert sim.resync_queue == []
+
+
+# ---- volume binder ----
+
+
+def test_volume_hooks_called_per_bind():
+    sim = SimCluster()
+    sim.add_queue("default")
+    sim.add_node("n1", cpu_milli=4000, memory=8 * GB)
+    job = sim.add_job("j1")
+    sim.add_task(job, cpu_milli=500, memory=GB)
+    sched = Scheduler(sim, config=_conf())
+    sched.run_once()
+    assert len(sim.volume_binder.allocated) == 1
+    assert len(sim.volume_binder.bound) == 1
+
+
+def test_volume_allocate_failure_is_gang_atomic():
+    """A volume failure for one gang member must not bind the others."""
+    sim = SimCluster()
+    sim.add_queue("default")
+    sim.add_node("n1", cpu_milli=4000, memory=8 * GB)
+    job = sim.add_job("gang", min_available=2)
+    t1 = sim.add_task(job, cpu_milli=500, memory=GB)
+    t2 = sim.add_task(job, cpu_milli=500, memory=GB)
+    sim.volume_binder.fail_allocate_uids.add(t1.uid)
+    sched = Scheduler(sim, config=_conf())
+    sched.run_once()
+    assert sim.binder.binds == {}  # whole gang batch dropped
+    assert t1.status == TaskStatus.PENDING and t2.status == TaskStatus.PENDING
+    assert sorted(sim.resync_queue) == sorted([t1.uid, t2.uid])
+
+    sim.volume_binder.fail_allocate_uids.clear()
+    sched.run_once()
+    assert len(sim.binder.binds) == 2
+
+
+# ---- deferred job GC ----
+
+
+def test_deferred_job_gc():
+    sim = SimCluster()
+    sim.add_queue("default")
+    job = sim.add_job("j1")
+    t = sim.add_task(job, cpu_milli=100, memory=GB)
+    sim.delete_job("j1", now=100.0)
+
+    # before the delay: kept
+    assert sim.collect_garbage(now=102.0) == []
+    # after the delay but task still live: kept
+    assert sim.collect_garbage(now=200.0) == []
+    t.status = TaskStatus.SUCCEEDED
+    # terminal → collected
+    assert sim.collect_garbage(now=200.0) == ["j1"]
+    assert "j1" not in sim.cluster.jobs
+    # FIFO drained
+    assert sim.collect_garbage(now=300.0) == []
