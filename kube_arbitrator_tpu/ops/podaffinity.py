@@ -49,11 +49,16 @@ PIPELINED = jnp.int32(int(TaskStatus.PIPELINED))
 
 
 class PodAffinityFit(NamedTuple):
-    ok: jax.Array        # bool[N] nodes admissible for the group
-    seed: jax.Array      # bool scalar: restrict this turn to ONE domain
-    seed_key: jax.Array  # i32 scalar topology-key index for seeding
-    cap: jax.Array       # bool scalar: cap one placement per domain
-    cap_key: jax.Array   # i32 scalar topology-key index for the cap
+    """Per-term seed/cap vectors: a group may carry several self-referential
+    terms over *different* topology keys (e.g. anti on hostname AND zone);
+    every one must constrain the batch, so apply_seed/apply_domain_cap fold
+    over all of them, not just the first."""
+
+    ok: jax.Array         # bool[N] nodes admissible for the group
+    seed_flags: jax.Array  # bool[MA] per aff term: restrict turn to ONE domain
+    seed_keys: jax.Array   # i32[MA] topology-key index per aff term
+    cap_flags: jax.Array   # bool[MB] per anti term: cap one per domain
+    cap_keys: jax.Array    # i32[MB] topology-key index per anti term
 
 
 def pa_enabled(st: SnapshotTensors) -> bool:
@@ -73,10 +78,10 @@ def pod_affinity_fit(
 ) -> PodAffinityFit:
     N = st.num_nodes
     ok = jnp.ones(N, dtype=bool)
-    seed = jnp.array(False)
-    seed_key = jnp.int32(0)
-    cap = jnp.array(False)
-    cap_key = jnp.int32(0)
+    seed_flags = []
+    seed_keys = []
+    cap_flags = []
+    cap_keys = []
 
     cp = st.task_pa_class                      # i32[T]
     cpg = st.group_pa_class[g]                 # scalar
@@ -115,8 +120,8 @@ def pod_affinity_fit(
         self_seed = tv & ~any_match & st.aff_match[tc, cpg]
         ok_t = (ndom >= 0) & ((tot[jnp.clip(ndom, 0)] > 0) | self_seed)
         ok = ok & jnp.where(tv, ok_t, True)
-        seed_key = jnp.where(self_seed & ~seed, key, seed_key)
-        seed = seed | self_seed
+        seed_flags.append(self_seed)
+        seed_keys.append(key)
 
     # ---- the group's own anti-affinity terms ----
     for m in range(st.group_anti_terms.shape[1]):
@@ -131,8 +136,8 @@ def pod_affinity_fit(
         ok = ok & jnp.where(tv, ~blocked, True)
         # the group's own pods match its anti term -> spread one per domain
         self_cap = tv & st.anti_match[tc, cpg]
-        cap_key = jnp.where(self_cap & ~cap, key, cap_key)
-        cap = cap | self_cap
+        cap_flags.append(self_cap)
+        cap_keys.append(key)
 
     # ---- dynamic symmetry: placed pods' anti terms vs this group ----
     TA = st.anti_key.shape[0]
@@ -155,24 +160,37 @@ def pod_affinity_fit(
     if st.symm_ok.shape[0] > 0:
         ok = ok & st.symm_ok[jnp.clip(cpg, 0, st.symm_ok.shape[0] - 1)]
 
-    return PodAffinityFit(ok=ok, seed=seed, seed_key=seed_key, cap=cap, cap_key=cap_key)
+    mk = lambda xs, dt: (jnp.stack(xs) if xs else jnp.zeros((0,), dt))  # noqa: E731
+    return PodAffinityFit(
+        ok=ok,
+        seed_flags=mk(seed_flags, bool),
+        seed_keys=mk(seed_keys, jnp.int32),
+        cap_flags=mk(cap_flags, bool),
+        cap_keys=mk(cap_keys, jnp.int32),
+    )
 
 
 def apply_seed(
     st: SnapshotTensors, fit: PodAffinityFit, k: jax.Array
 ) -> jax.Array:
-    """Self-affinity seeding: zero per-node capacity ``k`` outside the
-    single best domain (max total capacity) of the seeding topology key."""
+    """Self-affinity seeding: for EACH seeding term, zero per-node capacity
+    ``k`` outside the single best domain (max total capacity) of that term's
+    topology key.  Terms fold sequentially, so with several keys the batch
+    lands in the greedy intersection of one domain per key (possibly empty —
+    conservative: unplaced pods retry next cycle, see the module's
+    known-deviation note)."""
     if st.node_dom.shape[0] == 0:
         return k
-    ndom = st.node_dom[fit.seed_key]  # i32[N]
     D = st.aff_static.shape[1] if st.aff_static.shape[0] else st.anti_static.shape[1]
-    dom_cap = (
-        jnp.zeros(D + 1, k.dtype).at[jnp.where(ndom >= 0, ndom, D)].add(k)[:D]
-    )
-    best = jnp.argmax(dom_cap).astype(jnp.int32)
-    seeded = jnp.where(ndom == best, k, 0)
-    return jnp.where(fit.seed, seeded, k)
+    for m in range(fit.seed_flags.shape[0]):
+        ndom = st.node_dom[fit.seed_keys[m]]  # i32[N]
+        dom_cap = (
+            jnp.zeros(D + 1, k.dtype).at[jnp.where(ndom >= 0, ndom, D)].add(k)[:D]
+        )
+        best = jnp.argmax(dom_cap).astype(jnp.int32)
+        seeded = jnp.where(ndom == best, k, 0)
+        k = jnp.where(fit.seed_flags[m], seeded, k)
+    return k
 
 
 def apply_domain_cap(
@@ -181,25 +199,29 @@ def apply_domain_cap(
     k_packed: jax.Array,   # i32[N] capacities IN PACKING ORDER
     nperm: jax.Array,      # i32[N] packing order permutation, or None
 ) -> jax.Array:
-    """Self-anti-affinity spread: cap capacity at one per node and one per
-    topology domain, keeping the first node of each domain in packing
-    order.  Nodes without the topology label carry no domain and stay
-    uncapped per the upstream semantics (no domain -> no conflict)."""
+    """Self-anti-affinity spread: for EACH capping term, cap capacity at one
+    per node and one per topology domain of that term's key, keeping the
+    first node of each domain in packing order.  Sequential folding leaves
+    at most one placement per domain of *every* capping key.  Nodes without
+    the topology label carry no domain and stay uncapped per the upstream
+    semantics (no domain -> no conflict)."""
     if st.node_dom.shape[0] == 0:
         return k_packed
     N = k_packed.shape[0]
-    ndom = st.node_dom[fit.cap_key]
-    dom_p = ndom if nperm is None else ndom[nperm]
     pos = jnp.arange(N)
-    # group by domain; within a domain zero-capacity nodes sort last so the
-    # kept "first" node is the first one that can actually host the pod
-    idx = jnp.lexsort((pos, k_packed == 0, dom_p))
-    sd = dom_p[idx]
-    first_sorted = jnp.concatenate([jnp.array([True]), sd[1:] != sd[:-1]])
-    first = jnp.zeros(N, bool).at[idx].set(first_sorted)
-    capped = jnp.where(
-        dom_p >= 0,
-        jnp.where(first, jnp.minimum(k_packed, 1), 0),
-        k_packed,
-    )
-    return jnp.where(fit.cap, capped, k_packed)
+    for m in range(fit.cap_flags.shape[0]):
+        ndom = st.node_dom[fit.cap_keys[m]]
+        dom_p = ndom if nperm is None else ndom[nperm]
+        # group by domain; within a domain zero-capacity nodes sort last so
+        # the kept "first" node is the first that can actually host the pod
+        idx = jnp.lexsort((pos, k_packed == 0, dom_p))
+        sd = dom_p[idx]
+        first_sorted = jnp.concatenate([jnp.array([True]), sd[1:] != sd[:-1]])
+        first = jnp.zeros(N, bool).at[idx].set(first_sorted)
+        capped = jnp.where(
+            dom_p >= 0,
+            jnp.where(first, jnp.minimum(k_packed, 1), 0),
+            k_packed,
+        )
+        k_packed = jnp.where(fit.cap_flags[m], capped, k_packed)
+    return k_packed

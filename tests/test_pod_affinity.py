@@ -288,3 +288,55 @@ def test_oracle_agreement_mixed():
     placed = [(nodes[nn], tasks[uid]) for uid, nn in oracle_binds.items()]
     for uid, node in oracle_binds.items():
         assert end_state_ok(tasks[uid], nodes[node])
+
+
+RACK = "topology.kubernetes.io/rack"
+
+
+def test_self_anti_affinity_two_keys_spreads_both():
+    """Anti terms over hostname AND zone: the batch must respect BOTH —
+    at most one pod per host and one per zone (the first-key-only bug
+    placed two pods into one zone on distinct hosts)."""
+    sim = zone_cluster(n_per_zone=2, zones=("a", "b"), cpu=8000)
+    j = sim.add_job("spread", queue="q", min_available=2)
+    terms = [
+        PodAffinityTerm(match_labels=(("app", "x"),), topology_key=HOST, anti=True),
+        PodAffinityTerm(match_labels=(("app", "x"),), topology_key=ZONE, anti=True),
+    ]
+    for i in range(2):
+        sim.add_task(j, 500, 0, name=f"s{i}", labels={"app": "x"}, affinity=terms)
+    binds = run(sim)
+    assert len(binds) == 2
+    zones = [sim.cluster.nodes[n].labels[ZONE] for n in binds.values()]
+    assert len(set(zones)) == 2, f"two pods share a zone: {binds}"
+    # oracle agrees both terms are satisfiable
+    oracle = SequentialScheduler(sim.cluster).run_cycle()
+    ozones = [sim.cluster.nodes[n].labels[ZONE] for n in oracle.binds.values()]
+    assert len(set(ozones)) == len(ozones)
+
+
+def test_self_affinity_two_keys_colocates_both():
+    """Affinity terms over zone AND rack: the gang must land inside one
+    (zone ∩ rack) cell, not merely one zone."""
+    sim = SimCluster()
+    sim.add_queue("q")
+    for z in ("a", "b"):
+        for r in ("r1", "r2"):
+            sim.add_node(
+                f"{z}-{r}", cpu_milli=8000,
+                labels={ZONE: z, RACK: r, HOST: f"{z}-{r}"},
+            )
+    j = sim.add_job("cell", queue="q", min_available=2)
+    terms = [
+        PodAffinityTerm(match_labels=(("app", "c"),), topology_key=ZONE),
+        PodAffinityTerm(match_labels=(("app", "c"),), topology_key=RACK),
+    ]
+    for i in range(2):
+        sim.add_task(j, 500, 0, name=f"c{i}", labels={"app": "c"}, affinity=terms)
+    binds = run(sim)
+    assert len(binds) == 2
+    cells = {
+        (sim.cluster.nodes[n].labels[ZONE], sim.cluster.nodes[n].labels[RACK])
+        for n in binds.values()
+    }
+    assert len(cells) == 1, f"gang split across cells: {binds}"
