@@ -95,11 +95,20 @@ def main(argv=None) -> int:
         num_queues=args.sim_queues,
         seed=args.sim_seed,
     )
+    elector = None
+    if opts.enable_leader_election:
+        from .framework import LeaderElector
+
+        elector = LeaderElector(
+            lock_path=f"{opts.lock_object_namespace}/{opts.scheduler_name}.lock",
+            identity=opts.scheduler_name,
+        )
     try:
         sched = Scheduler(
             sim,
             conf_path=args.scheduler_conf or None,
             schedule_period_s=args.schedule_period,
+            elector=elector,
         )
     except (ValueError, OSError) as e:
         print(f"error: invalid scheduler conf: {e}", file=sys.stderr)

@@ -1,6 +1,7 @@
 """Framework: session, conf, registries, scheduler loop."""
 from ..options import ServerOptions, options, reset_options, set_options
 from .conf import DEFAULT_CONF, SchedulerConfig, load_conf, load_conf_file
+from .leader import LeaderElector, LeaderLost, LeaseRecord
 from .registry import get_action, plugin_capabilities, register_action, register_plugin
 from .scheduler import CycleStats, Scheduler
 from .session import CycleResult, PodGroupCondition, PodGroupStatus, Session
@@ -20,6 +21,9 @@ __all__ = [
     "CycleResult",
     "PodGroupCondition",
     "PodGroupStatus",
+    "LeaderElector",
+    "LeaderLost",
+    "LeaseRecord",
     "ServerOptions",
     "options",
     "set_options",

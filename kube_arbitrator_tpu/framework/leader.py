@@ -1,0 +1,151 @@
+"""Leader election: active/passive HA for the scheduler loop.
+
+Reference ``cmd/kube-batch/app/server.go:102-125``: optional leader election
+over a ConfigMap resourcelock (15 s lease, 10 s renew deadline, 5 s retry);
+only the leader runs ``sched.Run``; losing the lease is fatal.
+
+The TPU-native equivalent keeps the same lease semantics over a shared
+filesystem lock object (the deployment analog of the ConfigMap: any path on
+storage all replicas mount).  Writes are atomic (temp file + rename) and
+serialized with an ``fcntl`` lock so two contenders on one host cannot both
+win a race for a stale lease.
+"""
+from __future__ import annotations
+
+import dataclasses
+import fcntl
+import json
+import os
+import time
+import uuid
+from typing import Callable, Optional
+
+
+class LeaderLost(RuntimeError):
+    """Raised when the lease cannot be renewed; fatal like the reference's
+    OnStoppedLeading → Fatalf (server.go:119-121)."""
+
+
+@dataclasses.dataclass
+class LeaseRecord:
+    holder: str
+    acquired_ts: float
+    renew_ts: float
+    lease_duration_s: float
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, s: str) -> "LeaseRecord":
+        return cls(**json.loads(s))
+
+
+class LeaderElector:
+    """File-lease leader election with the client-go leaderelection
+    parameters (lease duration / renew deadline / retry period)."""
+
+    def __init__(
+        self,
+        lock_path: str,
+        identity: str = "",
+        lease_duration_s: float = 15.0,
+        renew_deadline_s: float = 10.0,
+        retry_period_s: float = 5.0,
+        now_fn: Callable[[], float] = time.time,
+    ):
+        self.lock_path = lock_path
+        self.identity = identity or f"{os.uname().nodename}-{uuid.uuid4().hex[:8]}"
+        self.lease_duration_s = lease_duration_s
+        self.renew_deadline_s = renew_deadline_s
+        self.retry_period_s = retry_period_s
+        self.now = now_fn
+        self._is_leader = False
+        os.makedirs(os.path.dirname(os.path.abspath(lock_path)), exist_ok=True)
+
+    # ---- lease file primitives ----
+
+    def _mutex_path(self) -> str:
+        return self.lock_path + ".mutex"
+
+    def _read(self) -> Optional[LeaseRecord]:
+        try:
+            with open(self.lock_path) as f:
+                return LeaseRecord.from_json(f.read())
+        except (FileNotFoundError, ValueError, TypeError, KeyError):
+            return None
+
+    def _write(self, rec: LeaseRecord) -> None:
+        tmp = f"{self.lock_path}.{self.identity}.tmp"
+        with open(tmp, "w") as f:
+            f.write(rec.to_json())
+        os.rename(tmp, self.lock_path)
+
+    # ---- election ----
+
+    def try_acquire(self) -> bool:
+        """One acquisition attempt: take the lease if unheld, expired, or
+        already ours.  Returns leadership."""
+        with open(self._mutex_path(), "w") as mf:
+            fcntl.flock(mf, fcntl.LOCK_EX)
+            now = self.now()
+            cur = self._read()
+            if cur is not None and cur.holder != self.identity:
+                if now - cur.renew_ts < cur.lease_duration_s:
+                    self._is_leader = False
+                    return False  # held by a live leader
+            acquired = cur.acquired_ts if cur and cur.holder == self.identity else now
+            self._write(
+                LeaseRecord(
+                    holder=self.identity,
+                    acquired_ts=acquired,
+                    renew_ts=now,
+                    lease_duration_s=self.lease_duration_s,
+                )
+            )
+            self._is_leader = True
+            return True
+
+    def renew(self) -> bool:
+        """Renew our lease; False when another holder took it (we were
+        expired and usurped) or the renew deadline passed."""
+        with open(self._mutex_path(), "w") as mf:
+            fcntl.flock(mf, fcntl.LOCK_EX)
+            now = self.now()
+            cur = self._read()
+            if cur is None or cur.holder != self.identity:
+                self._is_leader = False
+                return False
+            if now - cur.renew_ts > self.renew_deadline_s:
+                # we failed to renew in time; treat as lost even if nobody
+                # has usurped yet (client-go renew-deadline semantics)
+                self._is_leader = False
+                return False
+            self._write(dataclasses.replace(cur, renew_ts=now))
+            self._is_leader = True
+            return True
+
+    def release(self) -> None:
+        """Voluntary release (delete the lock object) so a standby can take
+        over immediately instead of waiting out the lease."""
+        with open(self._mutex_path(), "w") as mf:
+            fcntl.flock(mf, fcntl.LOCK_EX)
+            cur = self._read()
+            if cur is not None and cur.holder == self.identity:
+                os.unlink(self.lock_path)
+            self._is_leader = False
+
+    @property
+    def is_leader(self) -> bool:
+        return self._is_leader
+
+    def acquire_blocking(self, timeout_s: Optional[float] = None) -> bool:
+        """RunOrDie's acquisition loop: retry every retry_period until
+        leadership (or timeout, for tests/CLI)."""
+        start = self.now()
+        while True:
+            if self.try_acquire():
+                return True
+            if timeout_s is not None and self.now() - start >= timeout_s:
+                return False
+            time.sleep(self.retry_period_s)

@@ -15,6 +15,7 @@ from typing import Dict, List, Optional
 
 from ..cache.sim import SimCluster
 from .conf import SchedulerConfig, load_conf_file
+from .leader import LeaderElector, LeaderLost
 from .session import CycleResult, PodGroupStatus, Session
 
 
@@ -36,12 +37,14 @@ class Scheduler:
         config: Optional[SchedulerConfig] = None,
         conf_path: Optional[str] = None,
         schedule_period_s: float = 1.0,
+        elector: Optional[LeaderElector] = None,
     ):
         # conf is re-loadable per Run like the reference (scheduler.go:66-78)
         self.sim = sim
         self.conf_path = conf_path
         self.config = config or (load_conf_file(conf_path) if conf_path else SchedulerConfig.default())
         self.schedule_period_s = schedule_period_s
+        self.elector = elector
         self.job_status: Dict[str, PodGroupStatus] = {}
         self.history: List[CycleStats] = []
         self._last_event_msg: Dict[tuple, str] = {}
@@ -84,8 +87,16 @@ class Scheduler:
         progress and nothing is pending."""
         if not until_idle and not max_cycles:
             raise ValueError("until_idle=False requires max_cycles > 0")
+        # only the leader schedules; acquisition blocks like RunOrDie
+        # (server.go:102-125) and a lost lease is fatal (:119-121)
+        if self.elector is not None and not self.elector.is_leader:
+            self.elector.acquire_blocking()
         cycles = 0
         while True:
+            if self.elector is not None and not self.elector.renew():
+                raise LeaderLost(
+                    f"leader lease lost by {self.elector.identity}"
+                )
             result = self.run_once()
             cycles += 1
             if max_cycles and cycles >= max_cycles:

@@ -1,0 +1,104 @@
+"""Leader election: lease acquisition, renewal, expiry takeover, fatal loss.
+
+Reference semantics: cmd/kube-batch/app/server.go:102-125 — only the leader
+schedules; losing the lease is fatal.
+"""
+import pytest
+
+from kube_arbitrator_tpu.cache import SimCluster
+from kube_arbitrator_tpu.framework import LeaderElector, LeaderLost, Scheduler
+
+GB = 1024**3
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _elector(path, ident, clock, **kw):
+    return LeaderElector(str(path), identity=ident, now_fn=clock, **kw)
+
+
+def test_first_contender_wins_second_waits(tmp_path):
+    clock = FakeClock()
+    lock = tmp_path / "kb.lock"
+    a = _elector(lock, "a", clock)
+    b = _elector(lock, "b", clock)
+    assert a.try_acquire()
+    assert not b.try_acquire()
+    assert a.is_leader and not b.is_leader
+
+
+def test_renewal_keeps_lease_alive(tmp_path):
+    clock = FakeClock()
+    lock = tmp_path / "kb.lock"
+    a = _elector(lock, "a", clock, lease_duration_s=15, renew_deadline_s=10)
+    b = _elector(lock, "b", clock)
+    assert a.try_acquire()
+    for _ in range(10):
+        clock.t += 5.0
+        assert a.renew()
+        assert not b.try_acquire()
+
+
+def test_stale_lease_taken_over(tmp_path):
+    clock = FakeClock()
+    lock = tmp_path / "kb.lock"
+    a = _elector(lock, "a", clock, lease_duration_s=15)
+    b = _elector(lock, "b", clock)
+    assert a.try_acquire()
+    clock.t += 16.0  # lease expired, never renewed
+    assert b.try_acquire()
+    # usurped: a's renewal must now fail
+    assert not a.renew()
+    assert not a.is_leader
+
+
+def test_renew_deadline_is_fatal_even_without_usurper(tmp_path):
+    clock = FakeClock()
+    lock = tmp_path / "kb.lock"
+    a = _elector(lock, "a", clock, lease_duration_s=30, renew_deadline_s=10)
+    assert a.try_acquire()
+    clock.t += 11.0  # missed the renew deadline
+    assert not a.renew()
+
+
+def test_release_hands_over_immediately(tmp_path):
+    clock = FakeClock()
+    lock = tmp_path / "kb.lock"
+    a = _elector(lock, "a", clock)
+    b = _elector(lock, "b", clock)
+    assert a.try_acquire()
+    a.release()
+    assert b.try_acquire()
+
+
+def test_scheduler_gated_on_leadership_and_loss_is_fatal(tmp_path):
+    clock = FakeClock()
+    lock = tmp_path / "kb.lock"
+    leader = _elector(lock, "leader", clock)
+    standby = _elector(lock, "standby", clock)
+    assert leader.try_acquire()
+
+    sim = SimCluster()
+    sim.add_queue("default")
+    sim.add_node("n1", cpu_milli=4000, memory=8 * GB)
+    job = sim.add_job("j1")
+    sim.add_task(job, cpu_milli=500, memory=GB)
+
+    # standby loses acquisition within its timeout → never schedules
+    assert not standby.acquire_blocking(timeout_s=0.0)
+
+    sched = Scheduler(sim, elector=leader)
+    sched.run(max_cycles=1)
+    assert len(sim.binder.binds) == 1
+
+    # lease usurped between cycles → next run dies
+    clock.t += 100.0
+    assert standby.try_acquire()
+    with pytest.raises(LeaderLost):
+        sched.run(max_cycles=1)
