@@ -49,6 +49,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sim-seed", type=int, default=0)
     p.add_argument("--cycles", type=int, default=0, help="max cycles (0 = until idle)")
     p.add_argument("--json", action="store_true", help="emit per-cycle stats as JSON lines")
+    # observability (SURVEY §5: timing histograms + profiler hooks)
+    p.add_argument(
+        "--metrics-file",
+        default="",
+        help="write Prometheus-text metrics here after the run",
+    )
+    p.add_argument(
+        "--profile-dir",
+        default="",
+        help="run cycles under jax.profiler.trace, emitting to this dir",
+    )
     return p
 
 
@@ -109,6 +120,7 @@ def main(argv=None) -> int:
             conf_path=args.scheduler_conf or None,
             schedule_period_s=args.schedule_period,
             elector=elector,
+            profile_dir=args.profile_dir or None,
         )
     except (ValueError, OSError) as e:
         print(f"error: invalid scheduler conf: {e}", file=sys.stderr)
@@ -135,6 +147,11 @@ def main(argv=None) -> int:
             {"cycles": cycles, "binds": total_binds, "evicts": total_evicts}
         )
     )
+    if args.metrics_file:
+        from .utils.metrics import metrics
+
+        with open(args.metrics_file, "w") as f:
+            f.write(metrics().render())
     return 0
 
 

@@ -14,6 +14,7 @@ import time
 from typing import Dict, List, Optional
 
 from ..cache.sim import SimCluster
+from ..utils.metrics import metrics
 from .conf import SchedulerConfig, load_conf_file
 from .leader import LeaderElector, LeaderLost
 from .session import CycleResult, PodGroupStatus, Session
@@ -26,6 +27,10 @@ class CycleStats:
     binds: int
     evicts: int
     pending_before: int
+    kernel_ms: float = 0.0
+    decode_ms: float = 0.0
+    close_ms: float = 0.0
+    actuate_ms: float = 0.0
 
 
 class Scheduler:
@@ -38,6 +43,7 @@ class Scheduler:
         conf_path: Optional[str] = None,
         schedule_period_s: float = 1.0,
         elector: Optional[LeaderElector] = None,
+        profile_dir: Optional[str] = None,
     ):
         # conf is re-loadable per Run like the reference (scheduler.go:66-78)
         self.sim = sim
@@ -45,11 +51,25 @@ class Scheduler:
         self.config = config or (load_conf_file(conf_path) if conf_path else SchedulerConfig.default())
         self.schedule_period_s = schedule_period_s
         self.elector = elector
+        # SURVEY §5: JAX profiler hook — when set, cycles run under
+        # jax.profiler.trace and emit a TensorBoard-readable trace
+        self.profile_dir = profile_dir
         self.job_status: Dict[str, PodGroupStatus] = {}
         self.history: List[CycleStats] = []
         self._last_event_msg: Dict[tuple, str] = {}
 
     def run_once(self) -> CycleResult:
+        import contextlib
+
+        ctx = contextlib.nullcontext()
+        if self.profile_dir:
+            import jax
+
+            ctx = jax.profiler.trace(self.profile_dir)
+        with ctx:
+            return self._run_once_inner()
+
+    def _run_once_inner(self) -> CycleResult:
         t0 = time.perf_counter()
         # steady-state maintenance that runs as goroutines in the reference:
         # errTasks resync (cache.go:519-547) and deferred job GC (:476-517)
@@ -70,16 +90,42 @@ class Scheduler:
                 if self._last_event_msg.get(key) != cond.message:
                     self._last_event_msg[key] = cond.message
                     self.sim.record_event("Unschedulable", uid, cond.reason, cond.message)
-        self.history.append(
-            CycleStats(
-                cycle_ms=(t1 - t0) * 1000,
-                snapshot_ms=result.snapshot_ms,
-                binds=len(result.binds),
-                evicts=len(result.evicts),
-                pending_before=pending,
-            )
+        t2 = time.perf_counter()
+        stats = CycleStats(
+            cycle_ms=(t2 - t0) * 1000,
+            snapshot_ms=result.snapshot_ms,
+            binds=len(result.binds),
+            evicts=len(result.evicts),
+            pending_before=pending,
+            kernel_ms=result.kernel_ms,
+            decode_ms=result.decode_ms,
+            close_ms=result.close_ms,
+            actuate_ms=(t2 - t1) * 1000,
         )
+        self.history.append(stats)
+        self._record_metrics(stats)
         return result
+
+    def _record_metrics(self, s: CycleStats) -> None:
+        m = metrics()
+        m.describe(
+            "e2e_scheduling_duration_seconds",
+            "Full cycle latency: snapshot through actuation.",
+        )
+        m.observe("e2e_scheduling_duration_seconds", s.cycle_ms / 1000)
+        for phase, ms in (
+            ("snapshot", s.snapshot_ms),
+            ("kernel", s.kernel_ms),
+            ("decode", s.decode_ms),
+            ("close", s.close_ms),
+            ("actuate", s.actuate_ms),
+        ):
+            m.observe(
+                "cycle_phase_duration_seconds", ms / 1000, labels={"phase": phase}
+            )
+        m.counter_add("binds_total", s.binds)
+        m.counter_add("evicts_total", s.evicts)
+        m.gauge_set("pending_tasks", s.pending_before)
 
     def run(self, max_cycles: int = 0, until_idle: bool = True) -> int:
         """Run cycles at the configured cadence (in sim: back-to-back).

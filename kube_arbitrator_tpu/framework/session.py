@@ -63,6 +63,9 @@ class CycleResult:
     evicts: List[EvictIntent]
     job_status: Dict[str, PodGroupStatus]
     snapshot_ms: float = 0.0
+    kernel_ms: float = 0.0
+    decode_ms: float = 0.0
+    close_ms: float = 0.0
 
 
 class Session:
@@ -76,12 +79,16 @@ class Session:
     def run(self) -> CycleResult:
         t0 = time.perf_counter()
         snap = build_snapshot(self.cluster)
-        snapshot_ms = (time.perf_counter() - t0) * 1000
+        t1 = time.perf_counter()
         dec = schedule_cycle(
             snap.tensors, tiers=self.config.tiers, actions=self.config.actions
         )
+        dec.task_node.block_until_ready()  # time the device program honestly
+        t2 = time.perf_counter()
         binds, evicts = decode_decisions(snap, dec)
+        t3 = time.perf_counter()
         job_status = self._close(snap, dec)
+        t4 = time.perf_counter()
         return CycleResult(
             session_uid=self.uid,
             snapshot=snap,
@@ -89,7 +96,10 @@ class Session:
             binds=binds,
             evicts=evicts,
             job_status=job_status,
-            snapshot_ms=snapshot_ms,
+            snapshot_ms=(t1 - t0) * 1000,
+            kernel_ms=(t2 - t1) * 1000,
+            decode_ms=(t3 - t2) * 1000,
+            close_ms=(t4 - t3) * 1000,
         )
 
     # ---- CloseSession ----

@@ -1,0 +1,4 @@
+"""Utilities: metrics, timing, profiling hooks."""
+from .metrics import Histogram, MetricsRegistry, metrics
+
+__all__ = ["Histogram", "MetricsRegistry", "metrics"]

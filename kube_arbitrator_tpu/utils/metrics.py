@@ -1,0 +1,154 @@
+"""Per-cycle timing histograms + Prometheus-text metrics export.
+
+The reference has no metrics endpoint at all — only leveled glog traces
+(SURVEY §5: "No pprof endpoint, no Prometheus"); the rebuild adds per-cycle
+phase timing histograms because proving the <1 s/100k-pod target requires
+them.  Names follow the kube-scheduler metric conventions
+(``*_duration_seconds`` histograms, ``*_total`` counters) so standard
+dashboards apply.
+"""
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import math
+from typing import Dict, List, Optional, Tuple
+
+
+def _default_buckets() -> List[float]:
+    # 1 ms .. ~65 s exponential (seconds)
+    return [0.001 * (2**i) for i in range(17)]
+
+
+@dataclasses.dataclass
+class Histogram:
+    """Cumulative-bucket histogram (Prometheus semantics) with exact
+    count/sum and quantile estimates from bucket interpolation."""
+
+    buckets: List[float] = dataclasses.field(default_factory=_default_buckets)
+    counts: List[int] = dataclasses.field(default=None)  # type: ignore[assignment]
+    total: float = 0.0
+    n: int = 0
+
+    def __post_init__(self) -> None:
+        if self.counts is None:
+            self.counts = [0] * (len(self.buckets) + 1)  # +inf bucket
+
+    def observe(self, v: float) -> None:
+        self.counts[bisect.bisect_left(self.buckets, v)] += 1
+        self.total += v
+        self.n += 1
+
+    def quantile(self, q: float) -> float:
+        """Linear interpolation inside the target bucket (Prometheus
+        histogram_quantile)."""
+        if self.n == 0:
+            return math.nan
+        rank = q * self.n
+        cum = 0
+        for i, c in enumerate(self.counts):
+            if cum + c >= rank and c > 0:
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = self.buckets[i] if i < len(self.buckets) else self.buckets[-1]
+                frac = (rank - cum) / c
+                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+            cum += c
+        return self.buckets[-1]
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.n if self.n else math.nan
+
+
+class MetricsRegistry:
+    """Counters, gauges, histograms with label support; renders the
+    Prometheus text exposition format."""
+
+    def __init__(self, namespace: str = "kube_arbitrator_tpu"):
+        self.namespace = namespace
+        self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+        self._gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+        self._hists: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], Histogram] = {}
+        self._help: Dict[str, str] = {}
+
+    @staticmethod
+    def _key(name: str, labels: Optional[Dict[str, str]]):
+        return (name, tuple(sorted((labels or {}).items())))
+
+    def describe(self, name: str, help_text: str) -> None:
+        self._help[name] = help_text
+
+    def counter_add(self, name: str, v: float = 1.0, labels: Optional[Dict[str, str]] = None) -> None:
+        k = self._key(name, labels)
+        self._counters[k] = self._counters.get(k, 0.0) + v
+
+    def gauge_set(self, name: str, v: float, labels: Optional[Dict[str, str]] = None) -> None:
+        self._gauges[self._key(name, labels)] = v
+
+    def observe(self, name: str, v: float, labels: Optional[Dict[str, str]] = None) -> None:
+        k = self._key(name, labels)
+        h = self._hists.get(k)
+        if h is None:
+            h = self._hists[k] = Histogram()
+        h.observe(v)
+
+    def histogram(self, name: str, labels: Optional[Dict[str, str]] = None) -> Optional[Histogram]:
+        return self._hists.get(self._key(name, labels))
+
+    # ---- rendering ----
+
+    @staticmethod
+    def _fmt_labels(labels: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
+        parts = [f'{k}="{v}"' for k, v in labels]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    def render(self) -> str:
+        ns = self.namespace
+        out: List[str] = []
+        for (name, labels), v in sorted(self._counters.items()):
+            full = f"{ns}_{name}"
+            if name in self._help:
+                out.append(f"# HELP {full} {self._help[name]}")
+            out.append(f"# TYPE {full} counter")
+            out.append(f"{full}{self._fmt_labels(labels)} {v:g}")
+        for (name, labels), v in sorted(self._gauges.items()):
+            full = f"{ns}_{name}"
+            if name in self._help:
+                out.append(f"# HELP {full} {self._help[name]}")
+            out.append(f"# TYPE {full} gauge")
+            out.append(f"{full}{self._fmt_labels(labels)} {v:g}")
+        for (name, labels), h in sorted(self._hists.items()):
+            full = f"{ns}_{name}"
+            if name in self._help:
+                out.append(f"# HELP {full} {self._help[name]}")
+            out.append(f"# TYPE {full} histogram")
+            cum = 0
+            for i, b in enumerate(h.buckets):
+                cum += h.counts[i]
+                out.append(
+                    f"{full}_bucket{self._fmt_labels(labels, f'le=\"{b:g}\"')} {cum}"
+                )
+            out.append(
+                f"{full}_bucket{self._fmt_labels(labels, 'le=\"+Inf\"')} {h.n}"
+            )
+            out.append(f"{full}_sum{self._fmt_labels(labels)} {h.total:g}")
+            out.append(f"{full}_count{self._fmt_labels(labels)} {h.n}")
+        return "\n".join(out) + ("\n" if out else "")
+
+    def reset(self) -> None:
+        self._counters.clear()
+        self._gauges.clear()
+        self._hists.clear()
+
+
+_registry: Optional[MetricsRegistry] = None
+
+
+def metrics() -> MetricsRegistry:
+    """Process-wide registry (the default the scheduler records into)."""
+    global _registry
+    if _registry is None:
+        _registry = MetricsRegistry()
+    return _registry

@@ -1,0 +1,60 @@
+"""Observability: histograms, Prometheus rendering, scheduler phase timings."""
+import math
+
+from kube_arbitrator_tpu.cache import SimCluster
+from kube_arbitrator_tpu.framework import Scheduler
+from kube_arbitrator_tpu.utils.metrics import Histogram, MetricsRegistry, metrics
+
+GB = 1024**3
+
+
+def test_histogram_quantiles_and_mean():
+    h = Histogram()
+    for v in [0.001, 0.002, 0.004, 0.008, 0.1, 1.0]:
+        h.observe(v)
+    assert h.n == 6
+    assert abs(h.total - 1.115) < 1e-9
+    assert 0.001 <= h.quantile(0.5) <= 0.01
+    assert h.quantile(0.99) >= 0.1
+    assert not math.isnan(h.mean)
+
+
+def test_registry_render_prometheus_text():
+    r = MetricsRegistry(namespace="kat")
+    r.describe("binds_total", "Committed binds.")
+    r.counter_add("binds_total", 3)
+    r.gauge_set("pending_tasks", 7)
+    r.observe("cycle_phase_duration_seconds", 0.05, labels={"phase": "kernel"})
+    text = r.render()
+    assert "# TYPE kat_binds_total counter" in text
+    assert "kat_binds_total 3" in text
+    assert "kat_pending_tasks 7" in text
+    assert 'kat_cycle_phase_duration_seconds_bucket{phase="kernel",le="+Inf"} 1' in text
+    assert 'kat_cycle_phase_duration_seconds_count{phase="kernel"} 1' in text
+    # cumulative bucket counts are monotone
+    counts = [
+        int(line.rsplit(" ", 1)[1])
+        for line in text.splitlines()
+        if line.startswith("kat_cycle_phase_duration_seconds_bucket")
+    ]
+    assert counts == sorted(counts)
+
+
+def test_scheduler_records_phase_timings():
+    metrics().reset()
+    sim = SimCluster()
+    sim.add_queue("default")
+    sim.add_node("n1", cpu_milli=4000, memory=8 * GB)
+    job = sim.add_job("j1")
+    sim.add_task(job, cpu_milli=500, memory=GB)
+    sched = Scheduler(sim)
+    sched.run_once()
+    s = sched.history[-1]
+    assert s.kernel_ms > 0 and s.snapshot_ms > 0
+    # phases are sub-measurements of the cycle
+    assert s.cycle_ms >= s.kernel_ms
+    m = metrics()
+    assert m.histogram("e2e_scheduling_duration_seconds").n == 1
+    assert m.histogram("cycle_phase_duration_seconds", {"phase": "kernel"}).n == 1
+    text = m.render()
+    assert "kube_arbitrator_tpu_binds_total 1" in text
