@@ -60,6 +60,18 @@ def build_parser() -> argparse.ArgumentParser:
         default="",
         help="run cycles under jax.profiler.trace, emitting to this dir",
     )
+    # decision-plane RPC (SURVEY §5: the gRPC hop to the JAX sidecar)
+    p.add_argument(
+        "--decision-endpoint",
+        default="",
+        help="host:port of a decision sidecar; cycles run there instead of in-process",
+    )
+    p.add_argument(
+        "--sidecar",
+        metavar="BIND",
+        default="",
+        help="run as a decision sidecar bound to BIND (e.g. 0.0.0.0:8686) and serve forever",
+    )
     return p
 
 
@@ -96,6 +108,12 @@ def main(argv=None) -> int:
 
     ensure_jax_backend()
 
+    if args.sidecar:
+        from .rpc.sidecar import main as sidecar_main
+
+        sidecar_main(args.sidecar)
+        return 0
+
     from .cache.sim import generate_cluster
     from .framework import Scheduler
 
@@ -106,6 +124,27 @@ def main(argv=None) -> int:
         num_queues=args.sim_queues,
         seed=args.sim_seed,
     )
+    decider = None
+    if args.decision_endpoint:
+        # fail fast on a bad endpoint instead of a mid-run traceback
+        try:
+            from .rpc.client import RemoteDecider
+
+            decider = RemoteDecider(args.decision_endpoint)
+            health = decider.health()
+        except ImportError as e:
+            print(f"error: decision endpoint needs grpcio: {e}", file=sys.stderr)
+            return 1
+        except Exception as e:
+            print(
+                f"error: decision sidecar {args.decision_endpoint} unreachable: {e}",
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            f"decision sidecar: {health.platform} x{health.device_count}",
+            file=sys.stderr,
+        )
     elector = None
     if opts.enable_leader_election:
         from .framework import LeaderElector
@@ -121,6 +160,7 @@ def main(argv=None) -> int:
             schedule_period_s=args.schedule_period,
             elector=elector,
             profile_dir=args.profile_dir or None,
+            decider=decider,
         )
     except (ValueError, OSError) as e:
         print(f"error: invalid scheduler conf: {e}", file=sys.stderr)

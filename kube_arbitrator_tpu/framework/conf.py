@@ -98,3 +98,26 @@ def load_conf(conf_str: str) -> SchedulerConfig:
 def load_conf_file(path: str) -> SchedulerConfig:
     with open(path) as f:
         return load_conf(f.read())
+
+
+def dump_conf(config: SchedulerConfig) -> str:
+    """SchedulerConfig -> YAML string accepted by load_conf.  Used by the
+    decision-plane RPC client to ship the compile-time structure to the
+    sidecar (rpc/client.py)."""
+    import yaml
+
+    tiers = []
+    for tier in config.tiers:
+        plugins = []
+        for p in tier.plugins:
+            entry = {"name": p.name}
+            for yk, attr in _FLAG_KEYS.items():
+                if getattr(p, attr):
+                    entry[yk] = True
+            if p.arguments:
+                entry["arguments"] = {k: v for k, v in p.arguments}
+            plugins.append(entry)
+        tiers.append({"plugins": plugins})
+    return yaml.safe_dump(
+        {"actions": ", ".join(config.actions), "tiers": tiers}, sort_keys=False
+    )

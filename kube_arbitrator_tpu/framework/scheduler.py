@@ -31,6 +31,7 @@ class CycleStats:
     decode_ms: float = 0.0
     close_ms: float = 0.0
     actuate_ms: float = 0.0
+    transport_ms: float = 0.0
 
 
 class Scheduler:
@@ -44,6 +45,7 @@ class Scheduler:
         schedule_period_s: float = 1.0,
         elector: Optional[LeaderElector] = None,
         profile_dir: Optional[str] = None,
+        decider=None,
     ):
         # conf is re-loadable per Run like the reference (scheduler.go:66-78)
         self.sim = sim
@@ -54,6 +56,8 @@ class Scheduler:
         # SURVEY §5: JAX profiler hook — when set, cycles run under
         # jax.profiler.trace and emit a TensorBoard-readable trace
         self.profile_dir = profile_dir
+        # None = in-process; a rpc.RemoteDecider runs cycles on a sidecar
+        self.decider = decider
         self.job_status: Dict[str, PodGroupStatus] = {}
         self.history: List[CycleStats] = []
         self._last_event_msg: Dict[tuple, str] = {}
@@ -76,7 +80,7 @@ class Scheduler:
         self.sim.process_resync()
         self.sim.collect_garbage()
         pending = sum(len(j.pending_tasks()) for j in self.sim.cluster.jobs.values())
-        session = Session(self.sim.cluster, self.config)
+        session = Session(self.sim.cluster, self.config, decider=self.decider)
         result = session.run()
         t1 = time.perf_counter()
         self.sim.apply_binds(result.binds)
@@ -101,6 +105,7 @@ class Scheduler:
             decode_ms=result.decode_ms,
             close_ms=result.close_ms,
             actuate_ms=(t2 - t1) * 1000,
+            transport_ms=result.transport_ms,
         )
         self.history.append(stats)
         self._record_metrics(stats)
@@ -119,6 +124,7 @@ class Scheduler:
             ("decode", s.decode_ms),
             ("close", s.close_ms),
             ("actuate", s.actuate_ms),
+            ("transport", s.transport_ms),
         ):
             m.observe(
                 "cycle_phase_duration_seconds", ms / 1000, labels={"phase": phase}

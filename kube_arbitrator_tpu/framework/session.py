@@ -21,7 +21,7 @@ from ..api.types import COND_UNSCHEDULABLE, PodGroupPhase, TaskStatus, is_alloca
 from ..cache.decode import decode_decisions
 from ..cache.sim import BindIntent, EvictIntent
 from ..cache.snapshot import Snapshot, build_snapshot
-from ..ops.cycle import CycleDecisions, schedule_cycle
+from ..ops.cycle import CycleDecisions
 from ..ops.diagnostics import HostView, explain_job
 
 # Cap on per-cycle FitError explanations: the first N unready gangs get the
@@ -66,24 +66,39 @@ class CycleResult:
     kernel_ms: float = 0.0
     decode_ms: float = 0.0
     close_ms: float = 0.0
+    # decide-wall minus device time: ~0 in-process, RPC overhead remote
+    transport_ms: float = 0.0
 
 
 class Session:
-    """One scheduling cycle over a ClusterInfo."""
+    """One scheduling cycle over a ClusterInfo.
 
-    def __init__(self, cluster: ClusterInfo, config: Optional[SchedulerConfig] = None):
+    ``decider`` selects where the decision program runs: in-process
+    (default) or on a gRPC decision sidecar (rpc/client.RemoteDecider)."""
+
+    def __init__(
+        self,
+        cluster: ClusterInfo,
+        config: Optional[SchedulerConfig] = None,
+        decider=None,
+    ):
         self.cluster = cluster
         self.config = config or SchedulerConfig.default()
+        self.decider = decider
         self.uid = str(uuid.uuid4())
 
     def run(self) -> CycleResult:
+        decider = self.decider
+        if decider is None:
+            from ..rpc.client import LocalDecider
+
+            decider = LocalDecider()
         t0 = time.perf_counter()
         snap = build_snapshot(self.cluster)
         t1 = time.perf_counter()
-        dec = schedule_cycle(
-            snap.tensors, tiers=self.config.tiers, actions=self.config.actions
-        )
-        dec.task_node.block_until_ready()  # time the device program honestly
+        # kernel_ms is device time in both modes (the sidecar measures its
+        # own); remote transport overhead is the decide-wall minus it
+        dec, kernel_ms = decider.decide(snap.tensors, self.config)
         t2 = time.perf_counter()
         binds, evicts = decode_decisions(snap, dec)
         t3 = time.perf_counter()
@@ -97,9 +112,10 @@ class Session:
             evicts=evicts,
             job_status=job_status,
             snapshot_ms=(t1 - t0) * 1000,
-            kernel_ms=(t2 - t1) * 1000,
+            kernel_ms=kernel_ms,
             decode_ms=(t3 - t2) * 1000,
             close_ms=(t4 - t3) * 1000,
+            transport_ms=max((t2 - t1) * 1000 - kernel_ms, 0.0),
         )
 
     # ---- CloseSession ----

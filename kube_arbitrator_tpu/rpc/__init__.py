@@ -1,0 +1,14 @@
+"""Decision-plane RPC: snapshot tensors over gRPC to a JAX sidecar.
+
+The TPU-native analog of the reference's distributed backend (client-go
+<-> apiserver protobuf-over-HTTPS); see SURVEY.md §5 and decision.proto.
+"""
+from .client import LocalDecider, RemoteDecider
+from .sidecar import DecisionService, serve
+
+__all__ = [
+    "LocalDecider",
+    "RemoteDecider",
+    "DecisionService",
+    "serve",
+]

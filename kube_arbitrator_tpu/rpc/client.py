@@ -1,0 +1,109 @@
+"""Decider abstraction: where does ``schedule_cycle`` run?
+
+``LocalDecider`` — in-process on whatever jax backend is live (default).
+``RemoteDecider`` — ship the snapshot tensors to a decision sidecar over
+gRPC (rpc/sidecar.py) and decode the reply.  The scheduler process then
+needs no accelerator at all: it owns cluster state + actuation, the
+sidecar owns the TPU — mirroring how the reference's scheduler owns no
+cluster state and talks to the apiserver for everything.
+"""
+from __future__ import annotations
+
+import time
+from typing import Tuple
+
+from ..cache.snapshot import SnapshotTensors
+from .codec import snapshot_request, unpack_tensors
+from .sidecar import CHANNEL_OPTIONS, SERVICE
+
+from . import decision_pb2 as pb
+
+
+class LocalDecider:
+    """Run the cycle in-process (the default path Session uses).
+
+    decide() returns (CycleDecisions, device-time ms)."""
+
+    def decide(self, st: SnapshotTensors, config) -> Tuple[object, float]:
+        from ..ops.cycle import schedule_cycle
+
+        t0 = time.perf_counter()
+        dec = schedule_cycle(st, tiers=config.tiers, actions=config.actions)
+        dec.task_node.block_until_ready()  # time the device program honestly
+        return dec, (time.perf_counter() - t0) * 1000
+
+
+class RemoteDecider:
+    """Run the cycle on a decision sidecar over gRPC.
+
+    Transient transport failures (sidecar restart, network blip) are
+    retried with backoff — the analog of the reference's errTasks resync
+    tolerating apiserver hiccups (cache.go:519-547) — so one blip doesn't
+    kill the scheduler loop (and its leader lease) when the sidecar comes
+    back seconds later."""
+
+    RETRYABLE = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "UNKNOWN")
+
+    def __init__(
+        self,
+        target: str,
+        timeout_s: float = 300.0,
+        retries: int = 3,
+        retry_backoff_s: float = 1.0,
+    ):
+        import grpc
+
+        self.target = target
+        self.timeout_s = timeout_s
+        self.retries = retries
+        self.retry_backoff_s = retry_backoff_s
+        self._channel = grpc.insecure_channel(target, options=CHANNEL_OPTIONS)
+        self._decide = self._channel.unary_unary(
+            f"/{SERVICE}/Decide",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.DecideReply.FromString,
+        )
+        self._health = self._channel.unary_unary(
+            f"/{SERVICE}/Health",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.HealthReply.FromString,
+        )
+        self._cycle = 0
+        self.last_kernel_ms = 0.0
+        self.last_roundtrip_ms = 0.0
+
+    def health(self, timeout_s: float = 10.0) -> "pb.HealthReply":
+        return self._health(pb.HealthRequest(), timeout=timeout_s)
+
+    def decide(self, st: SnapshotTensors, config) -> Tuple[object, float]:
+        """Returns (CycleDecisions of host numpy arrays, sidecar device-time
+        ms).  The decisions feed decode_decisions / close-side status
+        exactly like the local path — those consume arrays via np.asarray.
+        Round-trip time (serialize + network + device) is kept in
+        ``last_roundtrip_ms`` for the transport-overhead metric."""
+        import grpc
+
+        from ..framework.conf import dump_conf
+        from ..ops.cycle import CycleDecisions
+
+        self._cycle += 1
+        req = snapshot_request(st, dump_conf(config), self._cycle)
+        t0 = time.perf_counter()
+        attempt = 0
+        while True:
+            try:
+                rep = self._decide(req, timeout=self.timeout_s)
+                break
+            except grpc.RpcError as e:
+                code = e.code().name if e.code() is not None else "UNKNOWN"
+                attempt += 1
+                if code not in self.RETRYABLE or attempt > self.retries:
+                    raise
+                time.sleep(self.retry_backoff_s * attempt)
+        self.last_roundtrip_ms = (time.perf_counter() - t0) * 1000
+        self.last_kernel_ms = rep.kernel_ms
+        dec = unpack_tensors(CycleDecisions, rep.tensors)
+        return dec, rep.kernel_ms
+
+    def close(self) -> None:
+        self._channel.close()

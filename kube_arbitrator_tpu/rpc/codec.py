@@ -1,0 +1,59 @@
+"""Tensor-pytree <-> protobuf codec for the decision-plane RPC.
+
+Both payload dataclasses (``SnapshotTensors``, ``CycleDecisions``) are flat
+dataclasses whose fields are all dense arrays, so the wire format is simply
+every field serialized by name as raw C-order bytes + dtype + shape.  The
+decode side reconstructs by field name, which keeps the protocol stable
+under field reordering and lets either side be upgraded first as long as
+the field sets agree.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Type, TypeVar
+
+import numpy as np
+
+from . import decision_pb2 as pb
+
+X = TypeVar("X")
+
+
+def pack_tensors(obj, into) -> None:
+    """Serialize every dataclass field of ``obj`` into ``into`` (a repeated
+    Tensor proto field)."""
+    for f in dataclasses.fields(obj):
+        arr = np.ascontiguousarray(np.asarray(getattr(obj, f.name)))
+        t = into.add()
+        t.name = f.name
+        t.dtype = arr.dtype.str
+        t.shape.extend(arr.shape)
+        t.data = arr.tobytes()
+
+
+def unpack_tensors(cls: Type[X], tensors, to_jax: bool = False) -> X:
+    """Rebuild dataclass ``cls`` from a repeated Tensor field by name."""
+    by_name: Dict[str, np.ndarray] = {}
+    for t in tensors:
+        arr = np.frombuffer(t.data, dtype=np.dtype(t.dtype)).reshape(tuple(t.shape))
+        by_name[t.name] = arr
+    missing = [f.name for f in dataclasses.fields(cls) if f.name not in by_name]
+    if missing:
+        raise ValueError(f"{cls.__name__} wire payload missing fields: {missing}")
+    if to_jax:
+        import jax.numpy as jnp
+
+        by_name = {k: jnp.asarray(v) for k, v in by_name.items()}
+    return cls(**by_name)
+
+
+def snapshot_request(tensors, conf_yaml: str, cycle: int) -> "pb.SnapshotRequest":
+    req = pb.SnapshotRequest(cycle=cycle, conf_yaml=conf_yaml)
+    pack_tensors(tensors, req.tensors)
+    return req
+
+
+def decide_reply(decisions, cycle: int, kernel_ms: float) -> "pb.DecideReply":
+    rep = pb.DecideReply(cycle=cycle, kernel_ms=kernel_ms)
+    pack_tensors(decisions, rep.tensors)
+    return rep

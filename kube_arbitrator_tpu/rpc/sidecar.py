@@ -1,0 +1,126 @@
+"""The JAX decision sidecar: a gRPC server hosting the jitted cycle.
+
+Deployment shape (SURVEY.md §5 "distributed communication backend"): the
+snapshot/cache process owns cluster state and actuation; this process owns
+the accelerator.  Per cycle the client ships the dense snapshot tensors,
+the sidecar runs ``schedule_cycle`` (compiled once per conf + shape
+bucket), and the decisions travel back as tensors.  The analog of the
+reference's client-go <-> apiserver hop (cache.go:88-123, :240-306) —
+protobuf over HTTP/2 both here and there.
+
+The service is defined in ``decision.proto``.  Handlers are registered via
+``grpc.method_handlers_generic_handler`` with the protoc-generated message
+classes, so no grpc_tools stub generation is needed at build time.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from concurrent import futures
+from typing import Dict, Optional, Tuple
+
+from . import decision_pb2 as pb
+from .codec import decide_reply, unpack_tensors
+
+log = logging.getLogger(__name__)
+
+SERVICE = "katpu.rpc.DecisionPlane"
+
+# Snapshots at 100k tasks x 10k nodes are tens of MB of dense tensors;
+# lift gRPC's 4 MB default on both directions.
+MAX_MESSAGE_BYTES = 1 << 30
+CHANNEL_OPTIONS = [
+    ("grpc.max_send_message_length", MAX_MESSAGE_BYTES),
+    ("grpc.max_receive_message_length", MAX_MESSAGE_BYTES),
+]
+
+
+class DecisionService:
+    """Implements DecisionPlane against the local jax backend."""
+
+    def __init__(self):
+        self.cycles_served = 0
+        # conf YAML -> parsed (actions, tiers); jax caches the compiled
+        # program per (conf, shape-bucket) under its own jit cache
+        self._conf_cache: Dict[str, Tuple] = {}
+
+    def _config(self, conf_yaml: str):
+        cached = self._conf_cache.get(conf_yaml)
+        if cached is None:
+            from ..framework.conf import SchedulerConfig, load_conf
+
+            cfg = load_conf(conf_yaml) if conf_yaml.strip() else SchedulerConfig.default()
+            cached = (cfg.actions, cfg.tiers)
+            self._conf_cache[conf_yaml] = cached
+        return cached
+
+    def Decide(self, request: "pb.SnapshotRequest", context) -> "pb.DecideReply":
+        from ..cache.snapshot import SnapshotTensors
+        from ..ops.cycle import schedule_cycle
+
+        actions, tiers = self._config(request.conf_yaml)
+        st = unpack_tensors(SnapshotTensors, request.tensors, to_jax=True)
+        t0 = time.perf_counter()
+        dec = schedule_cycle(st, tiers=tiers, actions=actions)
+        dec.task_node.block_until_ready()
+        kernel_ms = (time.perf_counter() - t0) * 1000
+        self.cycles_served += 1
+        return decide_reply(dec, cycle=request.cycle, kernel_ms=kernel_ms)
+
+    def Health(self, request: "pb.HealthRequest", context) -> "pb.HealthReply":
+        import jax
+
+        devices = jax.devices()
+        return pb.HealthReply(
+            platform=devices[0].platform if devices else "none",
+            device_count=len(devices),
+            cycles_served=self.cycles_served,
+        )
+
+
+def _handlers(service: DecisionService):
+    import grpc
+
+    def unary(fn, req_cls):
+        return grpc.unary_unary_rpc_method_handler(
+            fn,
+            request_deserializer=req_cls.FromString,
+            response_serializer=lambda m: m.SerializeToString(),
+        )
+
+    return grpc.method_handlers_generic_handler(
+        SERVICE,
+        {
+            "Decide": unary(service.Decide, pb.SnapshotRequest),
+            "Health": unary(service.Health, pb.HealthRequest),
+        },
+    )
+
+
+def serve(
+    bind: str = "127.0.0.1:0",
+    max_workers: int = 4,
+    service: Optional[DecisionService] = None,
+):
+    """Start the sidecar.  Returns (grpc server, bound port).  The caller
+    owns shutdown (``server.stop``)."""
+    import grpc
+
+    service = service or DecisionService()
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers), options=CHANNEL_OPTIONS
+    )
+    server.add_generic_rpc_handlers((_handlers(service),))
+    port = server.add_insecure_port(bind)
+    if port == 0:
+        raise RuntimeError(f"failed to bind {bind}")
+    server.start()
+    log.info("decision sidecar serving on port %d", port)
+    return server, port
+
+
+def main(bind: str = "0.0.0.0:8686") -> None:
+    """Blocking entry point for ``python -m kube_arbitrator_tpu sidecar``."""
+    server, port = serve(bind)
+    print(f"decision sidecar listening on {port}", flush=True)
+    server.wait_for_termination()
