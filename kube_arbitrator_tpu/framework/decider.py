@@ -1,0 +1,23 @@
+"""The in-process decider: runs the compiled cycle on the local backend.
+
+Kept free of any RPC imports so the default scheduler path needs neither
+grpcio nor protobuf — the remote path lives in rpc/client.py.
+"""
+from __future__ import annotations
+
+import time
+from typing import Tuple
+
+
+class LocalDecider:
+    """Run the cycle in-process (the default path Session uses).
+
+    decide() returns (CycleDecisions, device-time ms)."""
+
+    def decide(self, st, config) -> Tuple[object, float]:
+        from ..ops.cycle import schedule_cycle
+
+        t0 = time.perf_counter()
+        dec = schedule_cycle(st, tiers=config.tiers, actions=config.actions)
+        dec.task_node.block_until_ready()  # time the device program honestly
+        return dec, (time.perf_counter() - t0) * 1000

@@ -90,7 +90,7 @@ class Session:
     def run(self) -> CycleResult:
         decider = self.decider
         if decider is None:
-            from ..rpc.client import LocalDecider
+            from .decider import LocalDecider
 
             decider = LocalDecider()
         t0 = time.perf_counter()

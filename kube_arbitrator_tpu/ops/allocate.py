@@ -136,6 +136,19 @@ def _node_capacity(
     return jnp.maximum(k, 0.0).astype(jnp.int32)
 
 
+# Deferred-decode gate: accumulate per-(group, node) placement counts in
+# the round loop and decode tasks once afterwards, instead of touching the
+# [T]-sized task arrays every turn.  Worth it exactly when the [G, N]
+# count matrices fit comfortably in HBM; 2 matrices x 4 B/cell at this cap
+# is ~256 MB.  Pod affinity reads per-task placements *during* the loop
+# (ops/podaffinity.py), so it forces the immediate path.
+DEFER_MAX_CELLS = 1 << 25
+
+
+def _use_deferred_decode(st: SnapshotTensors) -> bool:
+    return (not pa_enabled(st)) and st.num_groups * st.num_nodes <= DEFER_MAX_CELLS
+
+
 def _process_queue(
     q: jax.Array,
     st: SnapshotTensors,
@@ -144,9 +157,14 @@ def _process_queue(
     tiers: Tiers,
     s_max: int,
     best_effort_pass: bool,
-) -> AllocState:
+    gn: "Tuple[jax.Array, jax.Array] | None" = None,
+) -> "Tuple[AllocState, Tuple[jax.Array, jax.Array] | None]":
     """One queue's turn within a round. All control flow is mask-based so a
-    skipped queue is a no-op state pass-through."""
+    skipped queue is a no-op state pass-through.
+
+    When ``gn`` is given (deferred decode), task arrays are left untouched
+    and placements accumulate into the (alloc, pipelined) [G, N] count
+    matrices instead."""
     J = st.num_jobs
     G = st.num_groups
 
@@ -318,21 +336,34 @@ def _process_queue(
     p_p = jnp.clip(placed_total - (cum - k_p), 0, k_p)  # i32[N] (packing order)
     p = p_p if nperm is None else jnp.zeros_like(p_p).at[nperm].set(p_p)
 
-    # ---- decode: assign concrete tasks (group ranks) to node slots ----
-    placed_before = state.group_placed[g]
-    slots = jnp.arange(s_max)
-    node_of_slot = jnp.searchsorted(cum, slots, side="right").astype(jnp.int32)
-    if nperm is not None:
-        node_of_slot = nperm[jnp.clip(node_of_slot, 0, N - 1)]
-    slot_of_task = st.task_group_rank - placed_before
-    assigned = (
-        (st.task_group == g)
-        & (slot_of_task >= 0)
-        & (slot_of_task < placed_total)
-        & st.task_valid
-    )
-    tnode = node_of_slot[jnp.clip(slot_of_task, 0, s_max - 1)]
-    new_status = jnp.where(use_rel, PIPELINED, ALLOCATED)
+    if gn is None:
+        # ---- decode: assign concrete tasks (group ranks) to node slots ----
+        placed_before = state.group_placed[g]
+        slots = jnp.arange(s_max)
+        node_of_slot = jnp.searchsorted(cum, slots, side="right").astype(jnp.int32)
+        if nperm is not None:
+            node_of_slot = nperm[jnp.clip(node_of_slot, 0, N - 1)]
+        slot_of_task = st.task_group_rank - placed_before
+        assigned = (
+            (st.task_group == g)
+            & (slot_of_task >= 0)
+            & (slot_of_task < placed_total)
+            & st.task_valid
+        )
+        tnode = node_of_slot[jnp.clip(slot_of_task, 0, s_max - 1)]
+        new_status = jnp.where(use_rel, PIPELINED, ALLOCATED)
+        task_status = jnp.where(assigned, new_status, state.task_status)
+        task_node = jnp.where(assigned, tnode, state.task_node)
+        gn_out = None
+    else:
+        # deferred decode: only the [G, N] counters change per turn
+        task_status = state.task_status
+        task_node = state.task_node
+        gn_a, gn_p = gn
+        gn_out = (
+            gn_a.at[g].add(jnp.where(use_rel, 0, p)),
+            gn_p.at[g].add(jnp.where(use_rel, p, 0)),
+        )
 
     # ---- state updates (no-ops when placed_total == 0) ----
     pf = p.astype(jnp.float32)[:, None] * req[None, :]
@@ -345,9 +376,9 @@ def _process_queue(
         unfit_now = has_grp & (placed_total < budget)
     else:
         unfit_now = has_grp & use_rel & (placed_total < budget)
-    return AllocState(
-        task_status=jnp.where(assigned, new_status, state.task_status),
-        task_node=jnp.where(assigned, tnode, state.task_node),
+    new_state = AllocState(
+        task_status=task_status,
+        task_node=task_node,
         node_idle=jnp.where(use_rel, state.node_idle, state.node_idle - pf),
         node_releasing=jnp.where(use_rel, state.node_releasing - pf, state.node_releasing),
         node_ports=port_upd,
@@ -364,6 +395,7 @@ def _process_queue(
         progress=state.progress | (placed_total > 0) | unfit_now,
         rounds=state.rounds,
     )
+    return new_state, gn_out
 
 
 def _round(
@@ -373,7 +405,8 @@ def _round(
     tiers: Tiers,
     s_max: int,
     best_effort_pass: bool,
-) -> AllocState:
+    gn=None,
+):
     Q = st.num_queues
     # queue processing order from the tiered key stack (the tensor analog
     # of allocate.go:45's queue priority-queue over ssn.QueueOrderFn)
@@ -383,11 +416,64 @@ def _round(
     # jnp.lexsort treats the LAST key as primary
     perm = jnp.lexsort(tuple(reversed(keys)))
 
-    def body(qi, s):
-        return _process_queue(perm[qi], st, sess, s, tiers, s_max, best_effort_pass)
+    if gn is None:
 
-    state = jax.lax.fori_loop(0, Q, body, state)
-    return dataclasses.replace(state, rounds=state.rounds + 1)
+        def body(qi, s):
+            ns, _ = _process_queue(perm[qi], st, sess, s, tiers, s_max, best_effort_pass)
+            return ns
+
+        state = jax.lax.fori_loop(0, Q, body, state)
+    else:
+
+        def body(qi, carry):
+            s, g = carry
+            return _process_queue(
+                perm[qi], st, sess, s, tiers, s_max, best_effort_pass, gn=g
+            )
+
+        state, gn = jax.lax.fori_loop(0, Q, body, (state, gn))
+    return dataclasses.replace(state, rounds=state.rounds + 1), gn
+
+
+def _decode_deferred(
+    st: SnapshotTensors,
+    state: AllocState,
+    entry_placed: jax.Array,  # i32[G] group_placed at action entry
+    gn_a: jax.Array,  # i32[G, N] allocated counts
+    gn_p: jax.Array,  # i32[G, N] pipelined counts
+) -> AllocState:
+    """Turn the per-(group, node) counts into concrete task placements in
+    one vectorized pass.
+
+    A group's pending tasks are interchangeable, so rank r (uid order,
+    offset by what previous actions placed) maps onto nodes in node-ordinal
+    order: allocated slots first, then pipelined — a single searchsorted
+    into the row-flattened cumulative counts.  Flattening stays globally
+    monotone because each row is offset by the running total of previous
+    rows, so one searchsorted serves every group at once."""
+    N = st.num_nodes
+
+    def flat_lookup(counts, rank, in_range_base):
+        cum = jnp.cumsum(counts, axis=1)          # [G, N]
+        total = cum[:, -1]                        # [G]
+        base = jnp.cumsum(total) - total          # [G] exclusive
+        flat = (cum + base[:, None]).reshape(-1)  # [G*N] non-decreasing
+        g = jnp.clip(st.task_group, 0, None)
+        hit = in_range_base & (rank >= 0) & (rank < total[g])
+        idx = jnp.searchsorted(flat, base[g] + rank, side="right")
+        return hit, (jnp.clip(idx, 0, flat.shape[0] - 1) % N).astype(jnp.int32), total
+
+    gq = jnp.clip(st.task_group, 0, None)
+    in_group = (st.task_group >= 0) & st.task_valid
+    r0 = st.task_group_rank - entry_placed[gq]
+    in_a, node_a, total_a = flat_lookup(gn_a, r0, in_group)
+    in_p, node_p, _ = flat_lookup(gn_p, r0 - total_a[gq], in_group & ~in_a)
+
+    task_status = jnp.where(
+        in_a, ALLOCATED, jnp.where(in_p, PIPELINED, state.task_status)
+    )
+    task_node = jnp.where(in_a, node_a, jnp.where(in_p, node_p, state.task_node))
+    return dataclasses.replace(state, task_status=task_status, task_node=task_node)
 
 
 @partial(jax.jit, static_argnames=("tiers", "s_max", "max_rounds", "best_effort_pass"))
@@ -401,21 +487,36 @@ def allocate_action(
     best_effort_pass: bool = False,
 ) -> AllocState:
     """Run rounds until a full round places nothing (queues drained)."""
+    defer = _use_deferred_decode(st)
 
-    def cond(s: AllocState):
+    def cond(carry):
+        s = carry[0] if defer else carry
         return s.progress & (s.rounds < max_rounds)
 
-    def body(s: AllocState):
+    def body(carry):
+        if defer:
+            s, gn = carry
+        else:
+            s, gn = carry, None
         s = dataclasses.replace(s, progress=jnp.array(False))
-        return _round(st, sess, s, tiers, s_max, best_effort_pass)
+        s, gn = _round(st, sess, s, tiers, s_max, best_effort_pass, gn=gn)
+        return (s, gn) if defer else s
 
+    entry_placed = state.group_placed
     state = dataclasses.replace(
         state,
         progress=jnp.array(True),
         rounds=jnp.int32(0),
         group_unfit=jnp.zeros_like(state.group_unfit),
     )
-    return jax.lax.while_loop(cond, body, state)
+    if not defer:
+        return jax.lax.while_loop(cond, body, state)
+    gn0 = (
+        jnp.zeros((st.num_groups, st.num_nodes), jnp.int32),
+        jnp.zeros((st.num_groups, st.num_nodes), jnp.int32),
+    )
+    state, (gn_a, gn_p) = jax.lax.while_loop(cond, body, (state, gn0))
+    return _decode_deferred(st, state, entry_placed, gn_a, gn_p)
 
 
 def backfill_action(

@@ -13,24 +13,11 @@ import time
 from typing import Tuple
 
 from ..cache.snapshot import SnapshotTensors
+from ..framework.decider import LocalDecider  # noqa: F401  (re-export; pb-free home)
 from .codec import snapshot_request, unpack_tensors
 from .sidecar import CHANNEL_OPTIONS, SERVICE
 
 from . import decision_pb2 as pb
-
-
-class LocalDecider:
-    """Run the cycle in-process (the default path Session uses).
-
-    decide() returns (CycleDecisions, device-time ms)."""
-
-    def decide(self, st: SnapshotTensors, config) -> Tuple[object, float]:
-        from ..ops.cycle import schedule_cycle
-
-        t0 = time.perf_counter()
-        dec = schedule_cycle(st, tiers=config.tiers, actions=config.actions)
-        dec.task_node.block_until_ready()  # time the device program honestly
-        return dec, (time.perf_counter() - t0) * 1000
 
 
 class RemoteDecider:
@@ -42,7 +29,10 @@ class RemoteDecider:
     kill the scheduler loop (and its leader lease) when the sidecar comes
     back seconds later."""
 
-    RETRYABLE = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "UNKNOWN")
+    # UNKNOWN is deliberately absent: gRPC maps unhandled server-side
+    # exceptions (bad conf, codec field mismatch) to UNKNOWN, and those are
+    # deterministic — retrying only re-ships the snapshot to the same error.
+    RETRYABLE = ("UNAVAILABLE", "DEADLINE_EXCEEDED")
 
     def __init__(
         self,
