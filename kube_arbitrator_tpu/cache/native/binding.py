@@ -327,6 +327,7 @@ class NativeCache:
             aff_match=np.zeros((0, 1), bool),
             anti_match=np.zeros((0, 1), bool),
             symm_ok=np.zeros((0, N), bool),
+            n_valid_queues=np.int32(buf["queue_valid"].sum()),
             **buf,
         )
         index = NativeSnapshotIndex(self)

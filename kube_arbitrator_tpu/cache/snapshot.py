@@ -138,6 +138,14 @@ class SnapshotTensors:
     symm_ok: jax.Array
     # ---- cluster-level ----
     others_used: jax.Array      # f32[R] usage by other schedulers' tasks
+    # Count of real queues as a traced i32 scalar: the queue axis pads to
+    # >=8, and the per-queue round loops bound their trip count by this
+    # instead of paying full [N]-sized turn cost for padding queues.
+    # Traced (not compile-time static) so a queue appearing or draining
+    # never recompiles the cycle.  0 = unknown -> padded axis length.
+    n_valid_queues: jax.Array = dataclasses.field(
+        default_factory=lambda: np.int32(0)
+    )
 
     @property
     def num_tasks(self) -> int:
@@ -626,6 +634,7 @@ def build_snapshot(cluster: ClusterInfo) -> Snapshot:
         anti_match=pa["anti_match"],
         symm_ok=pa["symm_ok"],
         others_used=others_used,
+        n_valid_queues=np.int32(len(queues)),
     )
     index = SnapshotIndex(tasks=tasks, nodes=nodes, jobs=jobs, queues=queues, port_universe=universe)
     return Snapshot(tensors=tensors, index=index)

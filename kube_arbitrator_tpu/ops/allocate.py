@@ -166,7 +166,6 @@ def _process_queue(
     and placements accumulate into the (alloc, pipelined) [G, N] count
     matrices instead."""
     J = st.num_jobs
-    G = st.num_groups
 
     if best_effort_pass:
         # backfill has no queue-fairness gating (backfill.go:40-71)
@@ -175,8 +174,10 @@ def _process_queue(
         q_over = overused(state.queue_alloc, sess.deserved)[q]
         q_ok = st.queue_valid[q] & ~q_over
 
-    # ---- job selection (ssn.JobOrderFn over the queue's jobs) ----
-    job_ready = state.job_ready_cnt >= sess.min_avail
+    # ---- eligibility masks (hoisted; a lax.cond gate over the heavy body
+    # was measured SLOWER — the passthrough branch copies the state pytree
+    # per skipped turn — so every turn runs the full body and padding
+    # queues are instead skipped via the n_valid_queues trip bound) ----
     grp_remaining = st.group_size - state.group_placed
     grp_elig = (
         st.group_valid
@@ -187,6 +188,20 @@ def _process_queue(
     )
     job_has_pending = jnp.zeros(J, dtype=bool).at[st.group_job].max(grp_elig)
     jmask = (st.job_queue == q) & job_has_pending & st.job_valid & q_ok
+
+    return _process_queue_heavy(
+        q, st, sess, state, tiers, s_max, best_effort_pass, gn,
+        jmask, grp_elig, grp_remaining,
+    )
+
+
+def _process_queue_heavy(
+    q, st, sess, state, tiers, s_max, best_effort_pass, gn,
+    jmask, grp_elig, grp_remaining,
+):
+    J = st.num_jobs
+    # ---- job selection (ssn.JobOrderFn over the queue's jobs) ----
+    job_ready = state.job_ready_cnt >= sess.min_avail
     job_share = drf_shares(state.job_alloc, sess.drf_total)
     jkeys = job_order_keys(
         tiers, st.job_priority, job_ready, st.job_creation_rank, job_share
@@ -407,7 +422,13 @@ def _round(
     best_effort_pass: bool,
     gn=None,
 ):
+    # real queues only: invalid (padding) queues sort last under the BIG
+    # key, so bounding the trip count by the valid-queue scalar skips
+    # their full-cost no-op turns (traced bound -> no recompile when the
+    # queue count changes; fori_loop lowers it to a while_loop)
     Q = st.num_queues
+    nq = jnp.asarray(st.n_valid_queues, jnp.int32)
+    Q = jnp.where((nq > 0) & (nq < Q), nq, Q)
     # queue processing order from the tiered key stack (the tensor analog
     # of allocate.go:45's queue priority-queue over ssn.QueueOrderFn)
     q_share = queue_shares(state.queue_alloc, sess.deserved)

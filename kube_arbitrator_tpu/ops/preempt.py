@@ -175,8 +175,9 @@ def _claim_turn(
     else:
         q_ok = st.queue_valid[q]  # preempt has no overused gate
 
-    # ---- claimant selection (same order machinery as allocate) ----
-    job_ready = state.job_ready_cnt >= sess.min_avail
+    # eligibility masks, hoisted as in allocate._process_queue (padding
+    # queues are skipped via the n_valid_queues trip bound, not lax.cond —
+    # a cond's passthrough branch copies the state pytree per turn)
     grp_remaining = st.group_size - state.group_placed
     grp_elig = (
         st.group_valid
@@ -187,6 +188,20 @@ def _claim_turn(
     )
     job_has_pending = jnp.zeros(J, dtype=bool).at[st.group_job].max(grp_elig)
     jmask = (st.job_queue == q) & job_has_pending & st.job_valid & q_ok
+
+    return _claim_turn_heavy(
+        q, st, sess, state, tiers, s_max, mode, jmask, grp_elig, grp_remaining
+    )
+
+
+def _claim_turn_heavy(
+    q, st, sess, state, tiers, s_max, mode, jmask, grp_elig, grp_remaining
+) -> AllocState:
+    J = st.num_jobs
+    reclaim = mode == "reclaim"
+
+    # ---- claimant selection (same order machinery as allocate) ----
+    job_ready = state.job_ready_cnt >= sess.min_avail
     job_share = drf_shares(state.job_alloc, sess.drf_total)
     jkeys = job_order_keys(tiers, st.job_priority, job_ready, st.job_creation_rank, job_share)
     j, has_job = lex_argmin(jkeys, jmask)
@@ -334,7 +349,10 @@ def _claim_turn(
 
 
 def _rounds(st, sess, state, tiers, s_max, max_rounds, mode):
+    # as in allocate._round: only real queues get turns (traced bound)
     Q = st.num_queues
+    nq = jnp.asarray(st.n_valid_queues, jnp.int32)
+    Q = jnp.where((nq > 0) & (nq < Q), nq, Q)
 
     def round_body(s):
         s = dataclasses.replace(s, progress=jnp.array(False))

@@ -48,27 +48,29 @@ _NODE_SHARDED_FIELDS = frozenset(
 _NODE_AXIS1_FIELDS = frozenset({"node_dom", "symm_ok"})
 
 
-def snapshot_shardings(mesh: Mesh) -> SnapshotTensors:
-    """A SnapshotTensors-shaped pytree of NamedShardings: node-axis arrays
-    sharded over the mesh, everything else replicated."""
-    specs = {}
-    for f in dataclasses.fields(SnapshotTensors):
-        if f.name in _NODE_SHARDED_FIELDS:
-            specs[f.name] = NamedSharding(mesh, P(NODE_AXIS))
-        elif f.name in _NODE_AXIS1_FIELDS:
-            specs[f.name] = NamedSharding(mesh, P(None, NODE_AXIS))
-        else:
-            specs[f.name] = NamedSharding(mesh, P())
-    return SnapshotTensors(**specs)
+def _field_sharding(name: str, mesh: Mesh) -> NamedSharding:
+    if name in _NODE_SHARDED_FIELDS:
+        return NamedSharding(mesh, P(NODE_AXIS))
+    if name in _NODE_AXIS1_FIELDS:
+        return NamedSharding(mesh, P(None, NODE_AXIS))
+    return NamedSharding(mesh, P())
+
+
+def snapshot_shardings(mesh: Mesh):
+    """Field name -> NamedSharding: node-axis arrays sharded over the
+    mesh, everything else replicated (static fields excluded)."""
+    return {
+        f.name: _field_sharding(f.name, mesh)
+        for f in dataclasses.fields(SnapshotTensors)
+        if not f.metadata.get("static")
+    }
 
 
 def shard_snapshot(st: SnapshotTensors, mesh: Mesh) -> SnapshotTensors:
     """Device-put a snapshot with node-axis sharding.  Node bucketing pads
     to multiples of 128, so any mesh of <=128 devices divides evenly."""
-    shardings = snapshot_shardings(mesh)
-    return jax.tree.map(
-        lambda x, s: jax.device_put(x, s),
-        st,
-        shardings,
-        is_leaf=lambda x: not isinstance(x, SnapshotTensors),
-    )
+    placed = {
+        name: jax.device_put(getattr(st, name), s)
+        for name, s in snapshot_shardings(mesh).items()
+    }
+    return dataclasses.replace(st, **placed)

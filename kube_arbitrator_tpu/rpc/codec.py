@@ -23,7 +23,9 @@ def pack_tensors(obj, into) -> None:
     """Serialize every dataclass field of ``obj`` into ``into`` (a repeated
     Tensor proto field)."""
     for f in dataclasses.fields(obj):
-        arr = np.ascontiguousarray(np.asarray(getattr(obj, f.name)))
+        arr = np.asarray(getattr(obj, f.name))
+        # ascontiguousarray promotes 0-d to (1,); restore the true shape
+        arr = np.ascontiguousarray(arr).reshape(arr.shape)
         t = into.add()
         t.name = f.name
         t.dtype = arr.dtype.str
@@ -37,7 +39,15 @@ def unpack_tensors(cls: Type[X], tensors, to_jax: bool = False) -> X:
     for t in tensors:
         arr = np.frombuffer(t.data, dtype=np.dtype(t.dtype)).reshape(tuple(t.shape))
         by_name[t.name] = arr
-    missing = [f.name for f in dataclasses.fields(cls) if f.name not in by_name]
+    # fields with defaults may be absent (a peer one release behind can
+    # omit a newly added field; its default is the documented fallback)
+    missing = [
+        f.name
+        for f in dataclasses.fields(cls)
+        if f.name not in by_name
+        and f.default is dataclasses.MISSING
+        and f.default_factory is dataclasses.MISSING
+    ]
     if missing:
         raise ValueError(f"{cls.__name__} wire payload missing fields: {missing}")
     if to_jax:
