@@ -1,0 +1,121 @@
+"""Snapshot persistence: save/load cycle inputs for replay and benchmarks.
+
+The reference needs no checkpointing — the apiserver is the source of
+truth and restart means re-list + re-watch (SURVEY §5 "Checkpoint /
+resume").  This framework keeps that property (the decision plane is
+stateless per cycle); what IS worth persisting is the dense snapshot
+itself, so a production cycle can be replayed offline — for debugging a
+placement decision, regression-testing kernel changes against recorded
+clusters, or benchmarking on real shapes.
+
+Format: the decision-plane wire message (rpc/decision.proto
+SnapshotRequest) written length-delimited to a file — one record per
+cycle, so a file is a replayable trace.  Reuses the RPC codec; needs
+protobuf but not grpc.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Iterator, List, Optional
+
+from .snapshot import SnapshotTensors
+
+_MAGIC = b"KATS"  # kube-arbitrator-tpu snapshot trace
+_VERSION = 1
+
+
+def save_trace(path: str, snapshots: List[SnapshotTensors], conf_yaml: str = "") -> None:
+    """Write snapshots as one replayable trace file."""
+    from ..rpc.codec import snapshot_request
+
+    with open(path, "wb") as f:
+        f.write(_MAGIC + struct.pack("<I", _VERSION))
+        for i, st in enumerate(snapshots):
+            blob = snapshot_request(st, conf_yaml, cycle=i).SerializeToString()
+            f.write(struct.pack("<Q", len(blob)))
+            f.write(blob)
+
+
+def load_trace(path: str) -> Iterator[tuple]:
+    """Yield (cycle, conf_yaml, SnapshotTensors) records from a trace."""
+    from ..rpc import decision_pb2 as pb
+    from ..rpc.codec import unpack_tensors
+
+    with open(path, "rb") as f:
+        header = f.read(8)
+        if header[:4] != _MAGIC:
+            raise ValueError(f"{path}: not a snapshot trace (bad magic)")
+        version = struct.unpack("<I", header[4:])[0]
+        if version != _VERSION:
+            raise ValueError(f"{path}: unsupported trace version {version}")
+        while True:
+            lenb = f.read(8)
+            if not lenb:
+                return
+            (n,) = struct.unpack("<Q", lenb)
+            req = pb.SnapshotRequest.FromString(f.read(n))
+            yield req.cycle, req.conf_yaml, unpack_tensors(
+                SnapshotTensors, req.tensors
+            )
+
+
+def replay_trace(path: str, conf=None) -> List[dict]:
+    """Re-run the decision kernel over every recorded cycle; returns
+    per-cycle stats.  The recorded conf is used unless one is passed."""
+    import time
+
+    import numpy as np
+
+    from ..framework.conf import SchedulerConfig, load_conf
+    from ..ops.cycle import schedule_cycle
+
+    out = []
+    for cycle, conf_yaml, st in load_trace(path):
+        cfg = conf or (load_conf(conf_yaml) if conf_yaml.strip() else SchedulerConfig.default())
+        t0 = time.perf_counter()
+        dec = schedule_cycle(st, tiers=cfg.tiers, actions=cfg.actions)
+        dec.task_node.block_until_ready()
+        out.append(
+            {
+                "cycle": int(cycle),
+                "kernel_ms": (time.perf_counter() - t0) * 1000,
+                "binds": int(np.asarray(dec.bind_mask).sum()),
+                "evicts": int(np.asarray(dec.evict_mask).sum()),
+            }
+        )
+    return out
+
+
+class TraceRecorder:
+    """Attachable cycle hook: streams every snapshot the scheduler sees to
+    a trace file, one record per cycle.
+
+    Records are written (and flushed) as they arrive, so a crashed run —
+    the main thing worth debugging with a trace — keeps everything up to
+    its last completed cycle, and nothing accumulates in memory."""
+
+    def __init__(self, path: str, conf_yaml: str = ""):
+        self.path = path
+        self.conf_yaml = conf_yaml
+        self._count = 0
+        self._f = None
+
+    def record(self, tensors: SnapshotTensors) -> None:
+        from ..rpc.codec import snapshot_request
+
+        if self._f is None:
+            self._f = open(self.path, "wb")
+            self._f.write(_MAGIC + struct.pack("<I", _VERSION))
+        blob = snapshot_request(tensors, self.conf_yaml, cycle=self._count).SerializeToString()
+        self._f.write(struct.pack("<Q", len(blob)))
+        self._f.write(blob)
+        self._f.flush()
+        self._count += 1
+
+    def __len__(self) -> int:
+        return self._count
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None

@@ -72,6 +72,17 @@ def build_parser() -> argparse.ArgumentParser:
         default="",
         help="run as a decision sidecar bound to BIND (e.g. 0.0.0.0:8686) and serve forever",
     )
+    # snapshot trace record/replay (SURVEY §5: snapshot persistence)
+    p.add_argument(
+        "--record-trace",
+        default="",
+        help="record every cycle's snapshot tensors to this trace file",
+    )
+    p.add_argument(
+        "--replay-trace",
+        default="",
+        help="replay a recorded trace through the decision kernel and exit",
+    )
     return p
 
 
@@ -112,6 +123,13 @@ def main(argv=None) -> int:
         from .rpc.sidecar import main as sidecar_main
 
         sidecar_main(args.sidecar)
+        return 0
+
+    if args.replay_trace:
+        from .cache.persist import replay_trace
+
+        for line in replay_trace(args.replay_trace):
+            print(json.dumps(line))
         return 0
 
     from .cache.sim import generate_cluster
@@ -170,7 +188,24 @@ def main(argv=None) -> int:
             print(f"error: invalid scheduler conf: {e}", file=sys.stderr)
             return 1
         raise
-    cycles = sched.run(max_cycles=args.cycles)
+    recorder = None
+    if args.record_trace:
+        # the recorder carries the *resolved* conf so replay re-runs the
+        # same tiers/actions the live cycles used
+        from .cache.persist import TraceRecorder
+        from .framework.conf import dump_conf
+
+        recorder = TraceRecorder(args.record_trace, conf_yaml=dump_conf(sched.config))
+        sched.trace_recorder = recorder
+    try:
+        cycles = sched.run(max_cycles=args.cycles)
+    finally:
+        if recorder is not None:
+            recorder.close()
+            print(
+                f"recorded {len(recorder)} cycle snapshots to {args.record_trace}",
+                file=sys.stderr,
+            )
     total_binds = sum(s.binds for s in sched.history)
     total_evicts = sum(s.evicts for s in sched.history)
     for i, s in enumerate(sched.history):

@@ -46,6 +46,7 @@ class Scheduler:
         elector: Optional[LeaderElector] = None,
         profile_dir: Optional[str] = None,
         decider=None,
+        trace_recorder=None,
     ):
         # conf is re-loadable per Run like the reference (scheduler.go:66-78)
         self.sim = sim
@@ -58,6 +59,8 @@ class Scheduler:
         self.profile_dir = profile_dir
         # None = in-process; a rpc.RemoteDecider runs cycles on a sidecar
         self.decider = decider
+        # cache.persist.TraceRecorder: records every cycle's snapshot
+        self.trace_recorder = trace_recorder
         self.job_status: Dict[str, PodGroupStatus] = {}
         self.history: List[CycleStats] = []
         self._last_event_msg: Dict[tuple, str] = {}
@@ -82,6 +85,8 @@ class Scheduler:
         pending = sum(len(j.pending_tasks()) for j in self.sim.cluster.jobs.values())
         session = Session(self.sim.cluster, self.config, decider=self.decider)
         result = session.run()
+        if self.trace_recorder is not None:
+            self.trace_recorder.record(result.snapshot.tensors)
         t1 = time.perf_counter()
         self.sim.apply_binds(result.binds)
         self.sim.apply_evicts(result.evicts)

@@ -174,10 +174,11 @@ def _process_queue(
         q_over = overused(state.queue_alloc, sess.deserved)[q]
         q_ok = st.queue_valid[q] & ~q_over
 
-    # ---- eligibility masks (hoisted; a lax.cond gate over the heavy body
-    # was measured SLOWER — the passthrough branch copies the state pytree
-    # per skipped turn — so every turn runs the full body and padding
-    # queues are instead skipped via the n_valid_queues trip bound) ----
+    # ---- eligibility masks (NOTE: a lax.cond gate skipping the rest of
+    # the body for empty queues was measured SLOWER — the passthrough
+    # branch copies the state pytree per skipped turn — so every turn runs
+    # the full body and padding queues are instead skipped via the
+    # n_valid_queues trip bound in _round) ----
     grp_remaining = st.group_size - state.group_placed
     grp_elig = (
         st.group_valid
@@ -189,17 +190,6 @@ def _process_queue(
     job_has_pending = jnp.zeros(J, dtype=bool).at[st.group_job].max(grp_elig)
     jmask = (st.job_queue == q) & job_has_pending & st.job_valid & q_ok
 
-    return _process_queue_heavy(
-        q, st, sess, state, tiers, s_max, best_effort_pass, gn,
-        jmask, grp_elig, grp_remaining,
-    )
-
-
-def _process_queue_heavy(
-    q, st, sess, state, tiers, s_max, best_effort_pass, gn,
-    jmask, grp_elig, grp_remaining,
-):
-    J = st.num_jobs
     # ---- job selection (ssn.JobOrderFn over the queue's jobs) ----
     job_ready = state.job_ready_cnt >= sess.min_avail
     job_share = drf_shares(state.job_alloc, sess.drf_total)

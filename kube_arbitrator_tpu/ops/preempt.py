@@ -175,9 +175,9 @@ def _claim_turn(
     else:
         q_ok = st.queue_valid[q]  # preempt has no overused gate
 
-    # eligibility masks, hoisted as in allocate._process_queue (padding
-    # queues are skipped via the n_valid_queues trip bound, not lax.cond —
-    # a cond's passthrough branch copies the state pytree per turn)
+    # (padding queues are skipped via the n_valid_queues trip bound in
+    # _rounds, not a lax.cond — a cond's passthrough branch would copy the
+    # state pytree per turn)
     grp_remaining = st.group_size - state.group_placed
     grp_elig = (
         st.group_valid
@@ -188,17 +188,6 @@ def _claim_turn(
     )
     job_has_pending = jnp.zeros(J, dtype=bool).at[st.group_job].max(grp_elig)
     jmask = (st.job_queue == q) & job_has_pending & st.job_valid & q_ok
-
-    return _claim_turn_heavy(
-        q, st, sess, state, tiers, s_max, mode, jmask, grp_elig, grp_remaining
-    )
-
-
-def _claim_turn_heavy(
-    q, st, sess, state, tiers, s_max, mode, jmask, grp_elig, grp_remaining
-) -> AllocState:
-    J = st.num_jobs
-    reclaim = mode == "reclaim"
 
     # ---- claimant selection (same order machinery as allocate) ----
     job_ready = state.job_ready_cnt >= sess.min_avail

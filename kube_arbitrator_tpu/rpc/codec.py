@@ -35,8 +35,11 @@ def pack_tensors(obj, into) -> None:
 
 def unpack_tensors(cls: Type[X], tensors, to_jax: bool = False) -> X:
     """Rebuild dataclass ``cls`` from a repeated Tensor field by name."""
+    known = {f.name for f in dataclasses.fields(cls)}
     by_name: Dict[str, np.ndarray] = {}
     for t in tensors:
+        if t.name not in known:
+            continue  # newer peer sent a field this side predates
         arr = np.frombuffer(t.data, dtype=np.dtype(t.dtype)).reshape(tuple(t.shape))
         by_name[t.name] = arr
     # fields with defaults may be absent (a peer one release behind can
