@@ -26,18 +26,20 @@ _VERSION = 1
 
 def save_trace(path: str, snapshots: List[SnapshotTensors], conf_yaml: str = "") -> None:
     """Write snapshots as one replayable trace file."""
-    from ..rpc.codec import snapshot_request
-
-    with open(path, "wb") as f:
-        f.write(_MAGIC + struct.pack("<I", _VERSION))
-        for i, st in enumerate(snapshots):
-            blob = snapshot_request(st, conf_yaml, cycle=i).SerializeToString()
-            f.write(struct.pack("<Q", len(blob)))
-            f.write(blob)
+    rec = TraceRecorder(path, conf_yaml)
+    try:
+        for st in snapshots:
+            rec.record(st)
+    finally:
+        rec.close()
 
 
 def load_trace(path: str) -> Iterator[tuple]:
-    """Yield (cycle, conf_yaml, SnapshotTensors) records from a trace."""
+    """Yield (cycle, conf_yaml, SnapshotTensors) records from a trace.
+
+    A truncated tail record (the run died mid-write) ends iteration
+    gracefully — every completed cycle before it is still yielded, which
+    is the whole point of a crashed-run trace."""
     from ..rpc import decision_pb2 as pb
     from ..rpc.codec import unpack_tensors
 
@@ -50,10 +52,13 @@ def load_trace(path: str) -> Iterator[tuple]:
             raise ValueError(f"{path}: unsupported trace version {version}")
         while True:
             lenb = f.read(8)
-            if not lenb:
+            if len(lenb) < 8:
                 return
             (n,) = struct.unpack("<Q", lenb)
-            req = pb.SnapshotRequest.FromString(f.read(n))
+            blob = f.read(n)
+            if len(blob) < n:
+                return  # truncated tail record: crashed mid-write
+            req = pb.SnapshotRequest.FromString(blob)
             yield req.cycle, req.conf_yaml, unpack_tensors(
                 SnapshotTensors, req.tensors
             )
@@ -70,8 +75,15 @@ def replay_trace(path: str, conf=None) -> List[dict]:
     from ..ops.cycle import schedule_cycle
 
     out = []
+    conf_cache: dict = {}  # every record carries the same yaml; parse once
     for cycle, conf_yaml, st in load_trace(path):
-        cfg = conf or (load_conf(conf_yaml) if conf_yaml.strip() else SchedulerConfig.default())
+        if conf is not None:
+            cfg = conf
+        elif conf_yaml in conf_cache:
+            cfg = conf_cache[conf_yaml]
+        else:
+            cfg = load_conf(conf_yaml) if conf_yaml.strip() else SchedulerConfig.default()
+            conf_cache[conf_yaml] = cfg
         t0 = time.perf_counter()
         dec = schedule_cycle(st, tiers=cfg.tiers, actions=cfg.actions)
         dec.task_node.block_until_ready()

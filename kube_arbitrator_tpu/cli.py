@@ -128,7 +128,12 @@ def main(argv=None) -> int:
     if args.replay_trace:
         from .cache.persist import replay_trace
 
-        for line in replay_trace(args.replay_trace):
+        conf = None
+        if args.scheduler_conf:  # override the recorded conf, e.g. to A/B a change
+            from .framework.conf import load_conf_file
+
+            conf = load_conf_file(args.scheduler_conf)
+        for line in replay_trace(args.replay_trace, conf=conf):
             print(json.dumps(line))
         return 0
 

@@ -1,0 +1,82 @@
+"""Pallas admission kernel vs its jnp reference (interpret mode on CPU).
+
+The kernel itself runs on TPU in production (opt-in); here interpret=True
+executes the same kernel body under the Pallas interpreter so the logic —
+including the exact-int32 MXU prefix-sum construction — stays verified on
+every platform.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from kube_arbitrator_tpu.ops.pallas_admit import (
+    admit_reference,
+    pallas_admit,
+    pallas_admit_eligible,
+)
+
+
+def make_case(seed, best_effort=False, ports=False, n=384):
+    r = np.random.default_rng(seed)
+    req = (
+        np.zeros(3, np.float32)
+        if best_effort
+        else np.array([1000.0, 2048.0, 0.0], np.float32)
+    )
+    return (
+        jnp.asarray(req),
+        jnp.int32(int(r.integers(1, 500))),
+        jnp.asarray(np.array([1, 0], np.int32) if ports else np.zeros(2, np.int32)),
+        jnp.asarray(bool(ports)),
+        jnp.asarray((r.random((3, n)) * 32000).astype(np.float32)),
+        jnp.asarray((r.random((3, n)) * 8000).astype(np.float32)),
+        jnp.asarray(r.integers(0, 4, (2, n)).astype(np.int32)),
+        jnp.asarray(r.integers(0, 100, (1, n)).astype(np.int32)),
+        jnp.asarray(np.full((1, n), 110, np.int32)),
+        jnp.asarray((r.random((1, n)) > 0.2).astype(np.int32)),
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("best_effort", [False, True])
+@pytest.mark.parametrize("ports", [False, True])
+def test_kernel_matches_reference(seed, best_effort, ports):
+    args = make_case(seed, best_effort, ports)
+    got = pallas_admit(*args, best_effort=best_effort, interpret=True)
+    want = admit_reference(*args, best_effort=best_effort)
+    for i, (g, w) in enumerate(zip(got, want)):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w), err_msg=f"output {i}")
+
+
+def test_releasing_fallback():
+    """Zero idle capacity everywhere -> the kernel pivots to releasing
+    space and reports use_rel."""
+    args = list(make_case(5))
+    args[4] = jnp.zeros_like(args[4])  # idle = 0
+    p, total, use_rel, idle2, rel2, _, _ = pallas_admit(*args, interpret=True)
+    assert bool(use_rel) and int(total) > 0
+    np.testing.assert_array_equal(np.asarray(idle2), 0.0)
+    assert float(np.asarray(rel2).sum()) < float(np.asarray(args[5]).sum())
+
+
+def test_exact_cumsum_large_values():
+    """Counts > 256 exercise the hi/lo byte split (a single bf16 MXU pass
+    would drift); totals must be bit-exact."""
+    n = 256
+    args = list(make_case(7, n=n))
+    args[1] = jnp.int32(4096)  # budget
+    args[4] = jnp.asarray(np.full((3, n), 3.0e7, np.float32))  # idle >> req
+    args[8] = jnp.asarray(np.full((1, n), 4096, np.int32))  # max_tasks
+    args[7] = jnp.zeros((1, n), jnp.int32)
+    got = pallas_admit(*args, interpret=True)
+    want = admit_reference(*args)
+    np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(want[0]))
+    assert int(got[1]) == int(want[1]) == 4096
+
+
+def test_eligibility():
+    assert pallas_admit_eligible(10112)
+    assert pallas_admit_eligible(16384)
+    assert not pallas_admit_eligible(16512)
+    assert not pallas_admit_eligible(100)
