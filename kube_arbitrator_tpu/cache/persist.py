@@ -47,6 +47,8 @@ def load_trace(path: str) -> Iterator[tuple]:
         header = f.read(8)
         if header[:4] != _MAGIC:
             raise ValueError(f"{path}: not a snapshot trace (bad magic)")
+        if len(header) < 8:
+            return  # killed mid-header: nothing was recorded
         version = struct.unpack("<I", header[4:])[0]
         if version != _VERSION:
             raise ValueError(f"{path}: unsupported trace version {version}")
@@ -110,14 +112,16 @@ class TraceRecorder:
         self.path = path
         self.conf_yaml = conf_yaml
         self._count = 0
-        self._f = None
+        # eager open: an empty run still leaves a valid header-only trace
+        self._f = open(self.path, "wb")
+        self._f.write(_MAGIC + struct.pack("<I", _VERSION))
+        self._f.flush()
 
     def record(self, tensors: SnapshotTensors) -> None:
         from ..rpc.codec import snapshot_request
 
         if self._f is None:
-            self._f = open(self.path, "wb")
-            self._f.write(_MAGIC + struct.pack("<I", _VERSION))
+            raise ValueError(f"recorder for {self.path} already closed")
         blob = snapshot_request(tensors, self.conf_yaml, cycle=self._count).SerializeToString()
         self._f.write(struct.pack("<Q", len(blob)))
         self._f.write(blob)

@@ -28,10 +28,11 @@ Design notes:
 * node state (idle, releasing, ports, task counts) is updated in-kernel
   and aliased input→output, so the turn loop carries no extra copies.
 
-Eligibility (checked by ops/allocate.py): TPU backend, first-fit node
-order, pod-affinity off, N ≤ 16384 (row-offset matmul needs ≤128 rows
-of 128 lanes).  Everything else falls back to the jnp path, which stays
-the reference semantics; ``admit_reference`` here mirrors the kernel 1:1
+Eligibility — whoever wires this in MUST gate on: TPU backend, first-fit
+node order, pod-affinity off, and ``pallas_admit_eligible(N)`` (N a
+multiple of 128, ≤ 16384: the row-offset matmul needs ≤128 rows of 128
+lanes).  No such gating exists yet anywhere — the kernel currently has
+no production caller.  ``admit_reference`` here mirrors the kernel 1:1
 for property tests.
 """
 from __future__ import annotations
@@ -153,7 +154,9 @@ def _admit_body(
         k_rel = cap(rel)
         k = jnp.where(use_rel, k_rel, k_idle)
 
-    k = jnp.minimum(k, budget)  # keeps every cumsum half < 2^16
+    # the exact-cumsum byte split needs every count < 2^16; budget is a
+    # runtime value, so clamp explicitly rather than trusting it
+    k = jnp.minimum(k, jnp.minimum(budget, 65535))
     cum = _exact_cumsum_i32(k, nr)
     total = jnp.minimum(budget, cum[0, nr * 128 - 1])  # -1 would be a dynamic_slice
     p = jnp.clip(total - (cum - k), 0, k)
@@ -270,7 +273,7 @@ def admit_reference(
         use_rel = (jnp.sum(k_idle) == 0) & (budget > 0)
         k = jnp.where(use_rel, cap(rel_t), k_idle)
 
-    k = jnp.minimum(k, budget)
+    k = jnp.minimum(k, jnp.minimum(budget, 65535))
     cum = jnp.cumsum(k, axis=-1)
     total = jnp.minimum(budget, cum[0, -1])
     p = jnp.clip(total - (cum - k), 0, k)

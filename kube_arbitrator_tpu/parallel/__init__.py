@@ -1,4 +1,19 @@
 """Mesh/sharding layer: scale the cycle over TPU chips along the node axis."""
 from .mesh import NODE_AXIS, make_mesh, shard_snapshot, snapshot_shardings
+from .multihost import (
+    global_mesh,
+    initialize_multihost,
+    process_info,
+    shard_snapshot_global,
+)
 
-__all__ = ["NODE_AXIS", "make_mesh", "shard_snapshot", "snapshot_shardings"]
+__all__ = [
+    "NODE_AXIS",
+    "make_mesh",
+    "shard_snapshot",
+    "snapshot_shardings",
+    "initialize_multihost",
+    "global_mesh",
+    "shard_snapshot_global",
+    "process_info",
+]
