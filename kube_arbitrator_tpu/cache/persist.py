@@ -45,10 +45,12 @@ def load_trace(path: str) -> Iterator[tuple]:
 
     with open(path, "rb") as f:
         header = f.read(8)
+        if len(header) < 8:
+            # killed mid-header (incl. a 0-byte file from a crash between
+            # open and the first flush): nothing was recorded
+            return
         if header[:4] != _MAGIC:
             raise ValueError(f"{path}: not a snapshot trace (bad magic)")
-        if len(header) < 8:
-            return  # killed mid-header: nothing was recorded
         version = struct.unpack("<I", header[4:])[0]
         if version != _VERSION:
             raise ValueError(f"{path}: unsupported trace version {version}")

@@ -120,6 +120,92 @@ def _status_in(status: jax.Array, members) -> jax.Array:
     return m
 
 
+def turn_budget(
+    st: SnapshotTensors,
+    sess: SessionCtx,
+    tiers: Tiers,
+    j: jax.Array,       # selected job ordinal
+    q: jax.Array,       # queue ordinal
+    req: jax.Array,     # f32[R] per-task resreq of the selected group
+    job_share: jax.Array,  # f32[J] current DRF shares
+    job_ready: jax.Array,  # bool[J]
+    jmask: jax.Array,   # bool[J] contender mask (this queue's eligible jobs)
+    state: AllocState,
+    s_max: int,
+    queue_clamp: bool = True,
+) -> jax.Array:
+    """How many tasks the sequential loop would grant job ``j`` before the
+    ordering switches away from it — shared by allocate (idle placement)
+    and preempt/reclaim (victim claims), whose reference loops pop one
+    task at a time through the same JobOrderFn/Overused machinery.
+
+    ``queue_clamp`` applies proportion's check-before-pop overused stop;
+    preempt has no overused gate (preempt.go) so it passes False."""
+    J = st.num_jobs
+    b_gang = jnp.where(
+        job_ready[j],
+        s_max,
+        jnp.maximum(sess.min_avail[j] - state.job_ready_cnt[j], 1),
+    )
+    # DRF: tasks until this job's share reaches the next contender's.
+    others = (
+        jmask
+        & (jnp.arange(J) != j)
+        & (st.job_priority == st.job_priority[j])
+        & (job_ready == job_ready[j])
+    )
+    s2 = jnp.min(jnp.where(others, job_share, BIG))
+    delta = jnp.max(safe_share(req, sess.drf_total))
+    b_drf = jnp.where(
+        (s2 >= BIG / 2) | (delta <= 0),
+        s_max,
+        ceil_div_pos(jnp.maximum(s2 - job_share[j], 0.0), delta) + 1,
+    )
+    # proportion: the t-th task is granted iff the queue is not yet
+    # overused before it, i.e. some resource still has
+    # deserved >= alloc + (t-1)*req + eps (check-before-pop,
+    # allocate.go:71-74 + proportion.go:188-193).  Max t is
+    # 1 + max_r floor((deserved - alloc - eps)/req_r); resources the
+    # group doesn't request keep the queue un-overused forever.
+    if queue_clamp:
+        d_minus_a = sess.deserved[q] - state.queue_alloc[q]
+        f_r = jnp.where(
+            req > 0,
+            jnp.floor((d_minus_a - EPS) / jnp.maximum(req, 1e-30)),
+            jnp.where(d_minus_a >= EPS, BIG, -1.0),
+        )
+        t_max = jnp.max(f_r) + 1.0
+        b_queue = jnp.where(t_max >= BIG / 2, s_max, jnp.maximum(t_max, 1.0)).astype(
+            jnp.int32
+        )
+    else:
+        b_queue = jnp.int32(s_max)
+    # equilibrium floor: grant up to the fair level λ* in one turn (see
+    # fairness.drf_equilibrium_level) instead of one task per turn when
+    # shares are tied; proportion's b_queue still clamps.  The floor
+    # only applies to jobs that are already gang-ready — a not-ready
+    # job must stop at readiness so the gang order flip (ready jobs
+    # yield to not-ready ones, gang.go:129-165) happens at the same
+    # points as in the sequential loop.
+    b_quota = jnp.floor(
+        (sess.drf_level - job_share[j]) / jnp.maximum(delta, 1e-9)
+    ).astype(jnp.int32)
+    # Under the default tiers, gang's creation-rank column strictly
+    # precedes drf for not-ready pairs (gang.go:129-165), so a
+    # not-ready job is served to readiness before any contender and
+    # b_gang alone bounds the turn.  Only when a tier config puts drf's
+    # job order ahead of gang does the share-crossing clamp apply to
+    # not-ready jobs too.
+    if _drf_before_gang(tiers):
+        b_not_ready = jnp.minimum(b_gang, b_drf)
+    else:
+        b_not_ready = b_gang
+    return jnp.minimum(
+        jnp.where(job_ready[j], jnp.maximum(b_drf, b_quota), b_not_ready),
+        b_queue,
+    )
+
+
 def _node_capacity(
     avail: jax.Array,  # f32[N, R] idle or releasing
     req: jax.Array,  # f32[R]
@@ -209,62 +295,8 @@ def _process_queue(
     if best_effort_pass:
         budget = jnp.int32(s_max)
     else:
-        b_gang = jnp.where(
-            job_ready[j],
-            s_max,
-            jnp.maximum(sess.min_avail[j] - state.job_ready_cnt[j], 1),
-        )
-        # DRF: tasks until this job's share reaches the next contender's.
-        others = (
-            jmask
-            & (jnp.arange(J) != j)
-            & (st.job_priority == st.job_priority[j])
-            & (job_ready == job_ready[j])
-        )
-        s2 = jnp.min(jnp.where(others, job_share, BIG))
-        delta = jnp.max(safe_share(req, sess.drf_total))
-        b_drf = jnp.where(
-            (s2 >= BIG / 2) | (delta <= 0),
-            s_max,
-            ceil_div_pos(jnp.maximum(s2 - job_share[j], 0.0), delta) + 1,
-        )
-        # proportion: the t-th task is granted iff the queue is not yet
-        # overused before it, i.e. some resource still has
-        # deserved >= alloc + (t-1)*req + eps (check-before-pop,
-        # allocate.go:71-74 + proportion.go:188-193).  Max t is
-        # 1 + max_r floor((deserved - alloc - eps)/req_r); resources the
-        # group doesn't request keep the queue un-overused forever.
-        d_minus_a = sess.deserved[q] - state.queue_alloc[q]
-        f_r = jnp.where(
-            req > 0,
-            jnp.floor((d_minus_a - EPS) / jnp.maximum(req, 1e-30)),
-            jnp.where(d_minus_a >= EPS, BIG, -1.0),
-        )
-        t_max = jnp.max(f_r) + 1.0
-        b_queue = jnp.where(t_max >= BIG / 2, s_max, jnp.maximum(t_max, 1.0)).astype(jnp.int32)
-        # equilibrium floor: grant up to the fair level λ* in one turn (see
-        # fairness.drf_equilibrium_level) instead of one task per turn when
-        # shares are tied; proportion's b_queue still clamps.  The floor
-        # only applies to jobs that are already gang-ready — a not-ready
-        # job must stop at readiness so the gang order flip (ready jobs
-        # yield to not-ready ones, gang.go:129-165) happens at the same
-        # points as in the sequential loop.
-        b_quota = jnp.floor(
-            (sess.drf_level - job_share[j]) / jnp.maximum(delta, 1e-9)
-        ).astype(jnp.int32)
-        # Under the default tiers, gang's creation-rank column strictly
-        # precedes drf for not-ready pairs (gang.go:129-165), so a
-        # not-ready job is served to readiness before any contender and
-        # b_gang alone bounds the turn.  Only when a tier config puts drf's
-        # job order ahead of gang does the share-crossing clamp apply to
-        # not-ready jobs too.
-        if _drf_before_gang(tiers):
-            b_not_ready = jnp.minimum(b_gang, b_drf)
-        else:
-            b_not_ready = b_gang
-        budget = jnp.minimum(
-            jnp.where(job_ready[j], jnp.maximum(b_drf, b_quota), b_not_ready),
-            b_queue,
+        budget = turn_budget(
+            st, sess, tiers, j, q, req, job_share, job_ready, jmask, state, s_max
         )
     budget = jnp.clip(budget, 0, s_max)
     budget = jnp.where(has_grp, jnp.minimum(budget, grp_remaining[g]), 0)

@@ -38,7 +38,7 @@ import jax.numpy as jnp
 
 from ..api.types import TaskStatus
 from ..cache.snapshot import SnapshotTensors
-from .allocate import AllocState, PIPELINED, SessionCtx, _node_capacity
+from .allocate import AllocState, PIPELINED, SessionCtx, _node_capacity, turn_budget
 from .common import BIG, EPS, lex_argmin, safe_share
 from .fairness import drf_shares, overused, queue_shares
 from .ordering import Tiers, group_order_keys, job_order_keys, queue_order_keys
@@ -56,6 +56,69 @@ def _plugin_on(tiers: Tiers, name: str, attr: str) -> bool:
     )
 
 
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SortLayout:
+    """One fixed sort order (victim priority asc, uid asc within a segment
+    key) with its segment bases, computed ONCE per action.
+
+    Sorting [T] tensors costs milliseconds on TPU, and the victim orders
+    never change within an action — priorities and uids are static, and a
+    RUNNING task's node only changes by leaving the candidate set — so
+    per-turn work reduces to gathers and cumsums over these layouts."""
+
+    order: jax.Array     # i32[T] sorted position -> task index
+    inv: jax.Array       # i32[T] task index -> sorted position
+    base_idx: jax.Array  # i32[T] sorted position -> its segment's start position
+
+    @classmethod
+    def build(cls, segment: jax.Array, priority: jax.Array, uid_rank: jax.Array):
+        T = segment.shape[0]
+        order = jnp.lexsort((uid_rank, priority, segment))
+        s_seg = segment[order]
+        pos = jnp.arange(T)
+        seg_start = jnp.concatenate([jnp.array([True]), s_seg[1:] != s_seg[:-1]])
+        base_idx = jax.lax.associative_scan(jnp.maximum, jnp.where(seg_start, pos, 0))
+        inv = jnp.zeros(T, jnp.int32).at[order].set(pos.astype(jnp.int32))
+        return cls(order=order, inv=inv, base_idx=base_idx)
+
+    def rank_and_cum(self, mask: jax.Array, resreq: jax.Array):
+        """Per-task exclusive in-segment candidate rank and INCLUSIVE
+        cumulative resreq among candidates, in task-index space.
+        Non-candidates get the rank/cum of the candidates before them."""
+        m_s = mask[self.order].astype(jnp.int32)
+        v_s = jnp.where(mask[:, None], resreq, 0.0)[self.order]
+        cnt = jnp.cumsum(m_s)
+        res = jnp.cumsum(v_s, axis=0)
+        cnt_base = cnt[self.base_idx] - m_s[self.base_idx]
+        res_base = res[self.base_idx] - v_s[self.base_idx]
+        rank_s = cnt - m_s - cnt_base            # exclusive candidate rank
+        cum_s = res - res_base                    # inclusive candidate resreq
+        return rank_s[self.inv], cum_s[self.inv]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class VictimLayouts:
+    """The four fixed victim orders one action needs."""
+
+    by_job: SortLayout     # segment = victim's job
+    by_queue: SortLayout   # segment = victim's queue
+    global_: SortLayout    # one segment (cluster-wide cumulative)
+    by_node: SortLayout    # segment = victim's node
+
+    @classmethod
+    def build(cls, st: SnapshotTensors, task_node: jax.Array):
+        vj = st.task_job
+        zeros = jnp.zeros(st.num_tasks, jnp.int32)
+        return cls(
+            by_job=SortLayout.build(vj, st.task_priority, st.task_uid_rank),
+            by_queue=SortLayout.build(st.job_queue[vj], st.task_priority, st.task_uid_rank),
+            global_=SortLayout.build(zeros, st.task_priority, st.task_uid_rank),
+            by_node=SortLayout.build(task_node, st.task_priority, st.task_uid_rank),
+        )
+
+
 def _victim_verdict(
     st: SnapshotTensors,
     state: AllocState,
@@ -65,33 +128,20 @@ def _victim_verdict(
     claimant_job: jax.Array,  # scalar job ordinal
     req: jax.Array,  # f32[R] claimant per-task resreq
     reclaim: bool,
+    layouts: VictimLayouts,
 ) -> jax.Array:
     """Tiered victim filter: within a tier verdicts intersect; the first
-    tier producing any victim wins (session_plugins.go:59-140)."""
+    tier producing any victim wins (session_plugins.go:59-140).
+
+    Per-victim in-segment ranks and cumulative resreqs mirror the
+    reference's per-job/per-queue ``allocations`` maps that subtract
+    victims cumulatively as they are considered (drf.go:86-99,
+    proportion.go:161-186); the deterministic (priority, uid) orders come
+    from the action-level ``layouts``."""
     attr = "reclaimable_disabled" if reclaim else "preemptable_disabled"
     vj = st.task_job
-    T = st.num_tasks
 
-    def _seg_rank_and_cum(segment: jax.Array):
-        """Victims grouped by ``segment`` in deterministic (priority asc,
-        uid asc) order: per-victim in-segment rank and *inclusive*
-        cumulative resreq.  Mirrors the reference's per-job/per-queue
-        ``allocations`` maps that subtract victims cumulatively as they
-        are considered (drf.go:86-99, proportion.go:161-186)."""
-        seg = jnp.where(candidates, segment, jnp.int32(2**30))
-        order = jnp.lexsort((st.task_uid_rank, st.task_priority, seg))
-        s_seg = seg[order]
-        s_res = jnp.where(candidates[:, None], st.task_resreq, 0.0)[order]
-        pos = jnp.arange(T)
-        seg_start = jnp.concatenate([jnp.array([True]), s_seg[1:] != s_seg[:-1]])
-        base_idx = jax.lax.associative_scan(jnp.maximum, jnp.where(seg_start, pos, 0))
-        c_incl = jnp.cumsum(s_res, axis=0)
-        c_incl = c_incl - (c_incl[base_idx] - s_res[base_idx])
-        rank_sorted = pos - base_idx
-        inv = jnp.zeros(T, jnp.int32).at[order].set(pos.astype(jnp.int32))
-        return rank_sorted.astype(jnp.int32)[inv], c_incl[inv]
-
-    job_rank, job_cum = _seg_rank_and_cum(vj)
+    job_rank, job_cum = layouts.by_job.rank_and_cum(candidates, st.task_resreq)
 
     def gang_ok():
         # victim's job must stay gang-viable as victims accumulate:
@@ -107,7 +157,7 @@ def _victim_verdict(
         # so a multi-task turn progresses ls exactly like the sequential
         # evict-one/place-one interleave.
         total = sess.drf_total
-        _, global_cum = _seg_rank_and_cum(jnp.zeros(T, jnp.int32))
+        _, global_cum = layouts.global_.rank_and_cum(candidates, st.task_resreq)
         supported = jnp.min(
             jnp.where(req[None, :] > 0, global_cum / jnp.maximum(req[None, :], 1e-30), BIG),
             axis=-1,
@@ -128,7 +178,7 @@ def _victim_verdict(
         # cumulative per victim queue: the queue must stay at/above its
         # deserved after this and all earlier same-queue victims leave
         vq = st.job_queue[vj]
-        _, queue_cum = _seg_rank_and_cum(vq)
+        _, queue_cum = layouts.by_queue.rank_and_cum(candidates, st.task_resreq)
         after = state.queue_alloc[vq] - queue_cum
         return candidates & jnp.all(sess.deserved[vq] < after + EPS, axis=-1)
 
@@ -163,6 +213,7 @@ def _claim_turn(
     tiers: Tiers,
     s_max: int,
     mode: str,  # "preempt" | "preempt_intra" | "reclaim"
+    layouts: VictimLayouts,
 ) -> AllocState:
     """One queue turn of an eviction-based action: select claimant job and
     group, select victims, evict the minimal prefix, pipeline claimant
@@ -200,11 +251,20 @@ def _claim_turn(
     g, has_grp = lex_argmin(gkeys, gmask)
     req = st.group_resreq[g]
 
-    # budget: not-ready jobs preempt until ready; ready jobs one per turn
-    b_gang = jnp.where(
-        job_ready[j], 1, jnp.maximum(sess.min_avail[j] - state.job_ready_cnt[j], 1)
+    # Fairness-batched budget, shared with allocate: the reference's
+    # push-back loop (preempt.go:116-131) keeps re-popping the same job
+    # one task at a time until JobOrderFn prefers a contender — exactly
+    # the share-crossing/equilibrium budget.  The cumulative victim
+    # verdicts below were built for multi-task turns (per-victim rank and
+    # prefix caps), so a batched turn replays the same evict-one/place-one
+    # chain.  Reclaim keeps proportion's overused stop (reclaim.go:88-91);
+    # preempt has no overused gate so the queue clamp is off.
+    budget = turn_budget(
+        st, sess, tiers, j, q, req, job_share, job_ready, jmask, state, s_max,
+        queue_clamp=reclaim,
     )
-    budget = jnp.where(has_grp, jnp.minimum(jnp.minimum(b_gang, grp_remaining[g]), s_max), 0)
+    budget = jnp.clip(budget, 0, s_max)
+    budget = jnp.where(has_grp, jnp.minimum(budget, grp_remaining[g]), 0)
 
     # ---- victim candidates by scope ----
     running = (state.task_status == RUNNING) & st.task_valid & (state.task_node >= 0)
@@ -215,19 +275,15 @@ def _claim_turn(
         scope = running & (vj == j) & (st.task_priority < st.group_priority[g])
     else:  # reclaim: other queues' jobs
         scope = running & (st.job_queue[vj] != q)
-    victims = _victim_verdict(st, state, sess, tiers, scope, j, req, reclaim) & has_grp
+    victims = (
+        _victim_verdict(st, state, sess, tiers, scope, j, req, reclaim, layouts)
+        & has_grp
+    )
 
     # ---- per-node victim prefix sums (deterministic order) ----
-    vnode = jnp.where(victims, state.task_node, jnp.int32(2**30))
-    order = jnp.lexsort((st.task_uid_rank, st.task_priority, vnode))
-    s_node = vnode[order]
-    s_res = jnp.where(victims[:, None], st.task_resreq, 0.0)[order]
-    c_incl = jnp.cumsum(s_res, axis=0)
-    seg_start = jnp.concatenate([jnp.array([True]), s_node[1:] != s_node[:-1]])
-    pos = jnp.arange(st.num_tasks)
-    base_idx = jax.lax.associative_scan(jnp.maximum, jnp.where(seg_start, pos, 0))
-    c_base = c_incl[base_idx] - s_res[base_idx]  # cumsum before segment start
-    c_excl = c_incl - s_res - c_base  # per-victim exclusive in-node prefix
+    _, node_cum = layouts.by_node.rank_and_cum(victims, st.task_resreq)
+    vres = jnp.where(victims[:, None], st.task_resreq, 0.0)
+    c_excl = node_cum - vres  # per-victim exclusive in-node prefix
 
     totfree = jnp.zeros_like(state.node_releasing).at[
         jnp.where(victims, state.task_node, 0)
@@ -269,10 +325,10 @@ def _claim_turn(
 
     # ---- minimal victim prefix per node to cover p_n placements ----
     needed = p.astype(jnp.float32)[:, None] * req[None, :] - state.node_releasing - EPS
-    needed_of_victim = needed[jnp.where(victims, state.task_node, 0)]
-    evict_sorted_scope = jnp.any(c_excl < needed_of_victim[order], axis=-1)
-    evict = jnp.zeros(st.num_tasks, bool).at[order].set(evict_sorted_scope)
-    evict = evict & victims & (p[jnp.where(victims, state.task_node, 0)] > 0)
+    vnode_safe = jnp.where(victims, state.task_node, 0)
+    needed_of_victim = needed[vnode_safe]
+    evict = victims & jnp.any(c_excl < needed_of_victim, axis=-1)
+    evict = evict & (p[vnode_safe] > 0)
 
     freed = jnp.zeros_like(state.node_releasing).at[
         jnp.where(evict, state.task_node, 0)
@@ -337,7 +393,7 @@ def _claim_turn(
     )
 
 
-def _rounds(st, sess, state, tiers, s_max, max_rounds, mode):
+def _rounds(st, sess, state, tiers, s_max, max_rounds, mode, layouts):
     # as in allocate._round: only real queues get turns (traced bound)
     Q = st.num_queues
     nq = jnp.asarray(st.n_valid_queues, jnp.int32)
@@ -351,7 +407,7 @@ def _rounds(st, sess, state, tiers, s_max, max_rounds, mode):
         perm = jnp.lexsort(tuple(reversed(keys)))
 
         def body(qi, ss):
-            return _claim_turn(perm[qi], st, sess, ss, tiers, s_max, mode)
+            return _claim_turn(perm[qi], st, sess, ss, tiers, s_max, mode, layouts)
 
         s = jax.lax.fori_loop(0, Q, body, s)
         return dataclasses.replace(s, rounds=s.rounds + 1)
@@ -376,9 +432,12 @@ def preempt_action(
     s_max: int = 4096,
     max_rounds: int = 100_000,
 ) -> AllocState:
-    """Phase 1 (inter-job within queue) then phase 2 (intra-job priority)."""
-    state = _rounds(st, sess, state, tiers, s_max, max_rounds, "preempt")
-    state = _rounds(st, sess, state, tiers, s_max, max_rounds, "preempt_intra")
+    """Phase 1 (inter-job within queue) then phase 2 (intra-job priority).
+    Victim sort layouts are built once and shared by both phases: RUNNING
+    tasks (the only victims) never change node mid-action."""
+    layouts = VictimLayouts.build(st, state.task_node)
+    state = _rounds(st, sess, state, tiers, s_max, max_rounds, "preempt", layouts)
+    state = _rounds(st, sess, state, tiers, s_max, max_rounds, "preempt_intra", layouts)
     return state
 
 
@@ -390,4 +449,7 @@ def reclaim_action(
     s_max: int = 4096,
     max_rounds: int = 100_000,
 ) -> AllocState:
-    return _rounds(st, sess, state, tiers, s_max, max_rounds, "reclaim")
+    return _rounds(
+        st, sess, state, tiers, s_max, max_rounds, "reclaim",
+        VictimLayouts.build(st, state.task_node),
+    )

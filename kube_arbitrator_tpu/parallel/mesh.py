@@ -27,6 +27,14 @@ NODE_AXIS = "nodes"
 
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     devs = list(devices) if devices is not None else jax.devices()
+    if 128 % len(devs) != 0:
+        # node bucketing pads to multiples of 128, so even sharding needs a
+        # device count that divides 128 (every TPU slice size does; odd CPU
+        # fleets should round down to a power of two)
+        raise ValueError(
+            f"device count {len(devs)} does not divide the node bucket (128); "
+            f"use a power-of-two subset, e.g. devices[:{2 ** (len(devs).bit_length() - 1)}]"
+        )
     return Mesh(np.array(devs), (NODE_AXIS,))
 
 

@@ -52,8 +52,9 @@ def initialize_multihost(
 
 
 def global_mesh(devices: Optional[Sequence[jax.Device]] = None):
-    """One node-axis mesh over every device of every host.  The node
-    bucketing (multiples of 128) divides any ≤128-device mesh evenly."""
+    """One node-axis mesh over every device of every host.  The global
+    device count must divide the 128-node bucket (every TPU slice size
+    does); make_mesh raises a clear error otherwise."""
     return make_mesh(list(devices) if devices is not None else jax.devices())
 
 

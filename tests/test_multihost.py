@@ -53,7 +53,9 @@ def test_two_process_global_mesh(tmp_path):
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     env["PYTHONPATH"] = repo
     env["JAX_PLATFORMS"] = "cpu"
-    port = "29531"
+    # ephemeral-ish port derived from the test process so concurrent or
+    # back-to-back runs don't collide on a fixed coordinator port
+    port = str(20000 + os.getpid() % 20000)
     procs = [
         subprocess.Popen(
             [sys.executable, str(worker), str(pid), "2", port],
@@ -64,7 +66,12 @@ def test_two_process_global_mesh(tmp_path):
         )
         for pid in range(2)
     ]
-    outs = [p.communicate(timeout=280)[0] for p in procs]
+    try:
+        outs = [p.communicate(timeout=280)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     results = []
     for pid, out in enumerate(outs):
         assert procs[pid].returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
