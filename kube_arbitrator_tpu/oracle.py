@@ -30,6 +30,9 @@ class OracleResult:
     session_alloc: Dict[str, str]     # all session placements (incl. uncommitted)
     pipelined: Dict[str, str]
     job_ready: Dict[str, bool]
+    # committed evictions: victim task uid -> claimant job uid ("" when
+    # unconditional: reclaim / intra-job preemption)
+    evicts: Dict[str, str] = dataclasses.field(default_factory=dict)
     # Set when run_cycle hit its deadline: the loop stopped early, so binds
     # reflects only the work done so far (bench.py extrapolates the rate —
     # a greedy loop's early rate is its best rate, so this flatters the
@@ -142,11 +145,19 @@ class SequentialScheduler:
             self.deserved = {q.uid: np.full(res.NUM_RESOURCES, 3e38) for q in self.queues}
             self.queue_alloc = {q.uid: res.zeros() for q in self.queues}
 
+        self.evicted: Dict[str, str] = {}  # task uid -> claimant job uid ("" = unconditional)
+        self._discard_pool: set = set()
+        self._stmt: list = []
+
         for action in actions:
             if action == "allocate":
                 self._allocate(best_effort=False)
             elif action == "backfill":
                 self._allocate(best_effort=True)
+            elif action == "preempt":
+                self._preempt()
+            elif action == "reclaim":
+                self._reclaim()
 
         # --- close: gang-masked commit ---
         job_ready = {j.uid: self.job_ready_cnt[j.uid] >= self.min_avail[j.uid] for j in self.jobs}
@@ -160,6 +171,7 @@ class SequentialScheduler:
             session_alloc=dict(self.session_alloc),
             pipelined=dict(self.pipelined),
             job_ready=job_ready,
+            evicts=dict(self.evicted),
             truncated=self._truncated,
             elapsed_s=_time.perf_counter() - _t_start,
         )
@@ -363,3 +375,269 @@ class SequentialScheduler:
 
     def _task_queue(self, juid: str) -> str:
         return self.cluster.jobs[juid].queue_uid
+
+    # --- eviction-based actions (preempt.go:43-253, reclaim.go:41-188) ---
+
+    def _running_on(self, n: NodeInfo) -> List[TaskInfo]:
+        """RUNNING tasks still present on node (not yet evicted this
+        session), deterministic (priority asc, uid asc)."""
+        out = [
+            t
+            for t in self.node_pods[n.name]
+            if t.status == TaskStatus.RUNNING and t.uid not in self.evicted
+            and t.uid not in self._discard_pool
+        ]
+        out.sort(key=lambda t: (t.priority, t.uid))
+        return out
+
+    def _preemptable(self, claimant: TaskInfo, preemptees: List[TaskInfo], reclaim: bool) -> List[TaskInfo]:
+        """Tiered victim verdict (session_plugins.go:59-140): the first
+        tier with any enabled verdict plugin decides; a nil first-tier
+        verdict poisons the rest."""
+        names = {"gang", "proportion"} if reclaim else {"gang", "drf"}
+        attr = "reclaimable_disabled" if reclaim else "preemptable_disabled"
+        for tier in self.tiers:
+            plugins = [
+                p.name
+                for p in tier.plugins
+                if p.name in names and not getattr(p, attr) and p.name in self.plugins
+            ]
+            if not plugins:
+                continue
+            victims = None
+            for name in plugins:
+                cand = getattr(self, f"_victims_{name}")(claimant, preemptees)
+                victims = cand if victims is None else [v for v in victims if v in cand]
+            return victims or []
+        return []
+
+    def _victims_gang(self, claimant, preemptees):
+        out = []
+        evicted_per_job: Dict[str, int] = {}
+        for t in preemptees:
+            juid = self._job_of(t.uid)
+            already = evicted_per_job.get(juid, 0)
+            if self.min_avail[juid] <= self.job_ready_cnt[juid] - already - 1:
+                out.append(t)
+                evicted_per_job[juid] = already + 1
+        return out
+
+    def _victims_drf(self, claimant, preemptees):
+        out = []
+        freed = res.zeros()
+        removed: Dict[str, np.ndarray] = {}
+        for t in preemptees:
+            juid = self._job_of(t.uid)
+            rem = removed.get(juid, res.zeros())
+            rs = res.dominant_share(self.job_alloc[juid] - rem - t.resreq, self.total)
+            cj = self._job_of(claimant.uid)
+            supported = 0
+            req = claimant.resreq
+            with np.errstate(divide="ignore", invalid="ignore"):
+                per = np.where(req > 0, (freed + t.resreq) / np.maximum(req, 1e-30), np.inf)
+            supported = max(int(np.floor(per.min())) - 1, 0) if np.isfinite(per.min()) else 0
+            ls = res.dominant_share(
+                self.job_alloc[cj] + (supported + 1) * req, self.total
+            )
+            if ls < rs or abs(ls - rs) <= 1e-6:
+                out.append(t)
+                removed[juid] = rem + t.resreq
+                freed = freed + t.resreq
+        return out
+
+    def _victims_proportion(self, claimant, preemptees):
+        out = []
+        removed: Dict[str, np.ndarray] = {}
+        for t in preemptees:
+            quid = self._task_queue(self._job_of(t.uid))
+            if quid not in self.queue_alloc:
+                continue
+            rem = removed.get(quid, res.zeros())
+            after = self.queue_alloc[quid] - rem - t.resreq
+            if np.all(self.deserved[quid] < after + res.EPSILON):
+                out.append(t)
+                removed[quid] = rem + t.resreq
+        return out
+
+    def _evict(self, t: TaskInfo, claimant_job: str) -> None:
+        """Session-side eviction: resources become Releasing; the victim
+        keeps its pod slot and ports (node_info.go:101-127)."""
+        n = t.node_name
+        self.releasing[n] = self.releasing[n] + t.resreq
+        juid = self._job_of(t.uid)
+        self.job_alloc[juid] = self.job_alloc[juid] - t.resreq
+        self.job_ready_cnt[juid] -= 1
+        quid = self._task_queue(juid)
+        if quid in self.queue_alloc:
+            self.queue_alloc[quid] = self.queue_alloc[quid] - t.resreq
+        self.evicted[t.uid] = claimant_job
+
+    def _unevict(self, t: TaskInfo) -> None:
+        n = t.node_name
+        self.releasing[n] = self.releasing[n] - t.resreq
+        juid = self._job_of(t.uid)
+        self.job_alloc[juid] = self.job_alloc[juid] + t.resreq
+        self.job_ready_cnt[juid] += 1
+        quid = self._task_queue(juid)
+        if quid in self.queue_alloc:
+            self.queue_alloc[quid] = self.queue_alloc[quid] + t.resreq
+        del self.evicted[t.uid]
+
+    def _unpipeline(self, t: TaskInfo) -> None:
+        n = self.pipelined[t.uid]
+        self.releasing[n] = self.releasing[n] + t.resreq
+        self.numtasks[n] -= 1
+        self.node_pods[n].remove(t)
+        juid = self._job_of(t.uid)
+        self.job_alloc[juid] = self.job_alloc[juid] - t.resreq
+        self.job_ready_cnt[juid] -= 1
+        quid = self._task_queue(juid)
+        if quid in self.queue_alloc:
+            self.queue_alloc[quid] = self.queue_alloc[quid] - t.resreq
+        del self.pipelined[t.uid]
+
+    def _claim(self, claimant: TaskInfo, node_filter, reclaim: bool) -> bool:
+        """preempt() helper (preempt.go:169-236): first node passing
+        predicates whose victims cover resreq; evict minimally, pipeline
+        the claimant there."""
+        for n in self.nodes:
+            if not self._predicate(claimant, n):
+                continue
+            preemptees = [t for t in self._running_on(n) if node_filter(t)]
+            victims = self._preemptable(claimant, preemptees, reclaim)
+            avail = self.releasing[n.name].copy()
+            if not victims and not res.less_equal(claimant.resreq, avail):
+                continue
+            if not res.less_equal(
+                claimant.resreq, avail + res.sum_resources(v.resreq for v in victims)
+            ):
+                continue  # validateVictims: not enough resources
+            claimant_job = "" if reclaim else self._job_of(claimant.uid)
+            for v in victims:
+                if res.less_equal(claimant.resreq, avail):
+                    break
+                self._evict(v, claimant_job)
+                self._stmt.append(("evict", v))
+                avail = avail + v.resreq
+            self._commit(claimant, n, pipelined=True)
+            self._stmt.append(("pipeline", claimant))
+            return True
+        return False
+
+    def _preempt(self) -> None:
+        """Inter-job (statement, commit on JobReady) then intra-job."""
+        self._discard_pool: set = set()
+        preemptor_tasks: Dict[str, List[TaskInfo]] = {}
+        under_request: List[JobInfo] = []
+        for j in self.jobs:
+            if not self.sched_valid[j.uid]:
+                continue
+            ts = [
+                t for t in j.pending_tasks()
+                if t.uid not in self.session_alloc and t.uid not in self.pipelined
+                and not t.best_effort
+            ]
+            if ts:
+                ts.sort(key=self._task_key)
+                preemptor_tasks[j.uid] = ts
+                under_request.append(j)
+
+        for q in self.queues:
+            while True:
+                cand = [
+                    j for j in under_request
+                    if j.queue_uid == q.uid and preemptor_tasks.get(j.uid)
+                ]
+                if not cand:
+                    break
+                job = min(cand, key=self._job_key)
+                self._stmt = []
+                assigned = False
+                committed = False
+                while preemptor_tasks[job.uid]:
+                    t = preemptor_tasks[job.uid].pop(0)
+                    if self._claim(
+                        t,
+                        lambda v, _q=q.uid, _j=job.uid: self._task_queue(self._job_of(v.uid)) == _q
+                        and self._job_of(v.uid) != _j,
+                        reclaim=False,
+                    ):
+                        assigned = True
+                    if self.job_ready_cnt[job.uid] >= self.min_avail[job.uid]:
+                        committed = True  # stmt.Commit
+                        break
+                if not committed:
+                    # stmt.Discard: roll back in reverse
+                    for op, t in reversed(self._stmt):
+                        if op == "evict":
+                            self._unevict(t)
+                        else:
+                            self._unpipeline(t)
+                    # tasks already popped stay consumed (PQ drained)
+                    if not assigned:
+                        preemptor_tasks[job.uid] = []
+                if not preemptor_tasks.get(job.uid):
+                    preemptor_tasks.pop(job.uid, None)
+
+            # Phase 2: intra-job priority preemption (commit unconditional)
+            for job in under_request:
+                if job.queue_uid != q.uid:
+                    continue
+                while preemptor_tasks.get(job.uid):
+                    t = preemptor_tasks[job.uid].pop(0)
+                    self._stmt = []
+                    ok = self._claim(
+                        t,
+                        lambda v, _j=job.uid, _p=t.priority: self._job_of(v.uid) == _j
+                        and v.priority < _p,
+                        reclaim=False,
+                    )
+                    if ok:
+                        for op, v in self._stmt:
+                            if op == "evict":
+                                self.evicted[v.uid] = ""  # unconditional
+                    else:
+                        break
+
+    def _reclaim(self) -> None:
+        """Cross-queue reclaim; evictions are direct (no statement)."""
+        self._discard_pool = set()
+        claimant_tasks: Dict[str, List[TaskInfo]] = {}
+        for j in self.jobs:
+            if not self.sched_valid[j.uid]:
+                continue
+            ts = [
+                t for t in j.pending_tasks()
+                if t.uid not in self.session_alloc and t.uid not in self.pipelined
+                and not t.best_effort
+            ]
+            if ts:
+                ts.sort(key=self._task_key)
+                claimant_tasks[j.uid] = ts
+
+        for q in self.queues:
+            while True:
+                if self._overused(q.uid):
+                    break
+                cand = [
+                    j for j in self.jobs
+                    if j.queue_uid == q.uid and claimant_tasks.get(j.uid)
+                ]
+                if not cand:
+                    break
+                job = min(cand, key=self._job_key)
+                t = claimant_tasks[job.uid].pop(0)
+                self._stmt = []
+                ok = self._claim(
+                    t,
+                    lambda v, _q=q.uid: self._task_queue(self._job_of(v.uid)) != _q,
+                    reclaim=True,
+                )
+                if ok:
+                    for op, v in self._stmt:
+                        if op == "evict":
+                            self.evicted[v.uid] = ""  # reclaim commits directly
+                else:
+                    claimant_tasks[job.uid] = []
+                if not claimant_tasks.get(job.uid):
+                    claimant_tasks.pop(job.uid, None)

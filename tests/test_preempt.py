@@ -1,6 +1,7 @@
 """Preempt/reclaim action tests (e2e job.go preemption + queue.go reclaim
 scenario analogs)."""
 import numpy as np
+import pytest
 
 from kube_arbitrator_tpu.api import TaskStatus
 from kube_arbitrator_tpu.cache import SimCluster, build_snapshot
@@ -168,3 +169,42 @@ def test_two_cycle_preemption_settles():
     b_bound = [b.task_uid for b in binds2 if b.task_uid.startswith("b-")]
     assert len(b_bound) == 4
     assert evicts2 == []
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_property_full_actions_vs_oracle(seed):
+    """Random loaded clusters, full action list: the batched kernel and
+    the sequential oracle (which now implements preempt/reclaim with
+    statement semantics) must agree on per-job gang readiness and on
+    aggregate binds/evictions within batching slack."""
+    from kube_arbitrator_tpu.cache import generate_cluster
+    from kube_arbitrator_tpu.oracle import SequentialScheduler
+
+    sim = generate_cluster(
+        num_nodes=12,
+        num_jobs=8,
+        tasks_per_job=8,
+        num_queues=3,
+        seed=seed,
+        node_cpu_milli=8000,
+        node_memory=16 * GB,
+        running_fraction=0.45,
+    )
+    snap, dec, binds, evicts = run(sim)
+    oracle = SequentialScheduler(sim.cluster).run_cycle(actions=FULL_ACTIONS)
+
+    job_ready_k = {
+        j.uid: bool(np.asarray(dec.job_ready)[j.ordinal]) for j in snap.index.jobs
+    }
+    assert job_ready_k == oracle.job_ready, (job_ready_k, oracle.job_ready)
+
+    n_bind_o = len(oracle.binds)
+    n_evict_o = len(oracle.evicts)
+    bind_slack = max(3, n_bind_o // 3)
+    evict_slack = max(3, n_evict_o // 3)
+    assert abs(len(binds) - n_bind_o) <= bind_slack, (
+        f"kernel {len(binds)} binds vs oracle {n_bind_o}"
+    )
+    assert abs(len(evicts) - n_evict_o) <= evict_slack, (
+        f"kernel {len(evicts)} evicts vs oracle {n_evict_o}"
+    )
