@@ -206,6 +206,13 @@ def turn_budget(
     )
 
 
+def _copies_fit(avail: jax.Array, req: jax.Array) -> jax.Array:
+    """f32[N]: floor(min over requested dims of avail/req) with the
+    epsilon fit slack — the raw per-node copy count before clamps."""
+    per_r = jnp.where(req[None, :] > 0, (avail + EPS) / jnp.maximum(req[None, :], 1e-30), BIG)
+    return jnp.maximum(jnp.floor(jnp.min(per_r, axis=-1)), 0.0)
+
+
 def _node_capacity(
     avail: jax.Array,  # f32[N, R] idle or releasing
     req: jax.Array,  # f32[R]
@@ -214,9 +221,7 @@ def _node_capacity(
     single_per_node: jax.Array,  # bool scalar (host-port groups)
 ) -> jax.Array:
     """i32[N]: copies of ``req`` placeable per node."""
-    per_r = jnp.where(req[None, :] > 0, (avail + EPS) / jnp.maximum(req[None, :], 1e-30), BIG)
-    k = jnp.floor(jnp.min(per_r, axis=-1))
-    k = jnp.minimum(k, pods_head.astype(jnp.float32))
+    k = jnp.minimum(_copies_fit(avail, req), pods_head.astype(jnp.float32))
     k = jnp.where(single_per_node, jnp.minimum(k, 1.0), k)
     k = jnp.where(ok, k, 0.0)
     return jnp.maximum(k, 0.0).astype(jnp.int32)

@@ -38,7 +38,7 @@ import jax.numpy as jnp
 
 from ..api.types import TaskStatus
 from ..cache.snapshot import SnapshotTensors
-from .allocate import AllocState, PIPELINED, SessionCtx, _node_capacity, turn_budget
+from .allocate import AllocState, PIPELINED, SessionCtx, _copies_fit, turn_budget
 from .common import BIG, EPS, lex_argmin, safe_share
 from .fairness import drf_shares, overused, queue_shares
 from .ordering import Tiers, group_order_keys, job_order_keys, queue_order_keys
@@ -265,6 +265,21 @@ def _claim_turn(
     )
     budget = jnp.clip(budget, 0, s_max)
     budget = jnp.where(has_grp, jnp.minimum(budget, grp_remaining[g]), 0)
+    was_ready = job_ready[j]
+    need = jnp.maximum(sess.min_avail[j] - state.job_ready_cnt[j], 0)
+    if reclaim:
+        # reclaim.go never re-pushes the job PQ: each job gets exactly ONE
+        # task claim per cycle, so a turn is one task and consumes the job
+        # (the group_unfit update below retires all of job j's groups)
+        budget = jnp.minimum(budget, 1)
+    elif mode == "preempt":
+        # a not-ready preemptor's statement pops tasks until JobReady with
+        # no mid-statement re-ordering (preempt.go:89-120), so its turn
+        # budget is exactly the tasks-to-ready gap, not the drf clamp
+        budget = jnp.where(
+            was_ready, budget,
+            jnp.where(has_grp, jnp.minimum(jnp.maximum(need, 1), grp_remaining[g]), 0),
+        )
 
     # ---- victim candidates by scope ----
     running = (state.task_status == RUNNING) & st.task_valid & (state.task_node >= 0)
@@ -281,13 +296,16 @@ def _claim_turn(
     )
 
     # ---- per-node victim prefix sums (deterministic order) ----
-    _, node_cum = layouts.by_node.rank_and_cum(victims, st.task_resreq)
+    node_rank, node_cum = layouts.by_node.rank_and_cum(victims, st.task_resreq)
     vres = jnp.where(victims[:, None], st.task_resreq, 0.0)
     c_excl = node_cum - vres  # per-victim exclusive in-node prefix
 
     totfree = jnp.zeros_like(state.node_releasing).at[
         jnp.where(victims, state.task_node, 0)
     ].add(jnp.where(victims[:, None], st.task_resreq, 0.0))
+    node_victims = jnp.zeros(st.num_nodes, jnp.int32).at[
+        jnp.where(victims, state.task_node, 0)
+    ].add(victims.astype(jnp.int32))
 
     # ---- claimant placement capacity on freed+releasing space ----
     preds_on = _plugin_on(tiers, "predicates", "predicate_disabled")
@@ -313,8 +331,42 @@ def _claim_turn(
     # the reference's stmt.Evict re-adds the task to the node with
     # Releasing status (statement.go + node_info.go:101-127), so a
     # max-pods-full node stays unpreemptable there too.
-    avail = state.node_releasing + totfree
-    cap = _node_capacity(avail, req, ok, pods_head, has_ports)
+    #
+    # A claim is backed by victims ONLY: a node without victims is skipped
+    # even if its pre-existing Releasing capacity covers the claimant
+    # (validateVictims, preempt.go:239-241 / reclaim.go:137-140), and the
+    # evict loop gives no releasing credit (preempt.go:205-219) — placing
+    # pending tasks onto releasing space is allocate's job
+    # (allocate.go:148-158).
+    #
+    # WEAK validation (preempt.go:248 ``allRes.Less(resreq)``): the victim
+    # sum only fails a node when it is STRICTLY below resreq in EVERY dim —
+    # including unrequested ones (gpu 0 < 0 is false) — so for typical
+    # workloads any non-empty victim set passes, the evict loop then takes
+    # every victim on the node, and the claimant pipelines even when the
+    # freed space does not cover it ("corrected in next scheduling loop").
+    # Per node that yields floor(totfree/req) fully-covered claims plus one
+    # trailing under-covered claim whenever leftover victims remain.
+    ok = ok & (node_victims > 0)
+    weak_ok = ~jnp.all(totfree < req[None, :], axis=-1)
+    reqpos = req[None, :] > 0
+    full = jnp.minimum(_copies_fit(totfree, req), jnp.float32(s_max))
+    # the trailing under-covered claim: granted when requested resources
+    # are left beyond the full chunks, or when the victims cover nothing
+    # requested at all (full == 0) — validateVictims passing guarantees
+    # the reference at least one claim either way
+    partial = (
+        jnp.any(reqpos & (totfree > full[:, None] * req[None, :] + EPS), axis=-1)
+        | (full < 1.0)
+    )
+    # one claim consumes a whole victim CHUNK (minimal covering prefix):
+    # the chunk's leftover is wasted, so claims never exceed the victim
+    # count (exact when victims >= req; mixed sizes may still round up)
+    cap = jnp.minimum(full + partial.astype(jnp.float32), node_victims.astype(jnp.float32))
+    cap = jnp.minimum(cap, pods_head.astype(jnp.float32))
+    cap = jnp.where(has_ports, jnp.minimum(cap, 1.0), cap)
+    cap = jnp.where(ok & weak_ok, cap, 0.0)
+    cap = jnp.maximum(cap, 0.0).astype(jnp.int32)
     if pafit is not None:
         cap = apply_seed(st, pafit, cap)
         cap = apply_domain_cap(st, pafit, cap, None)
@@ -323,11 +375,38 @@ def _claim_turn(
     placed_total = jnp.minimum(budget, cum[-1])
     p = jnp.clip(placed_total - (cum - cap), 0, cap)  # i32[N]
 
-    # ---- minimal victim prefix per node to cover p_n placements ----
-    needed = p.astype(jnp.float32)[:, None] * req[None, :] - state.node_releasing - EPS
+    # Statement discard at turn granularity (preempt.go:122-126): a
+    # not-ready preemptor whose turn fell short of its budget can never
+    # commit — victims only shrink and placed < budget retires the group
+    # below — so the whole turn is discarded NOW, leaving its would-be
+    # victims RUNNING for later claimants (the oracle's
+    # j2-after-failed-j1 case).  A turn that FILLED its budget keeps its
+    # placements even while still short of JobReady (a multi-group job's
+    # statement spans turns); the close-side evicted_for/gang mask drops
+    # everything if the job never reaches ready.  Gating p/evict before
+    # the scatters keeps the rollback free of pytree copies.
+    placed_pre = placed_total
+    if mode == "preempt":
+        keep = ~(has_grp & ~was_ready & (placed_pre < budget) & (placed_pre < need))
+        placed_total = jnp.where(keep, placed_total, 0)
+        p = p * keep.astype(p.dtype)
+
+    # ---- victim prefix per node for p_n placements: minimal covering
+    # prefix for full claims; EVERYTHING on the node once the trailing
+    # under-covered claim is used (the reference evict loop runs out of
+    # victims before rem is covered and keeps them all evicted) ----
+    use_partial = p > full.astype(jnp.int32)
+    needed = jnp.where(
+        use_partial[:, None], BIG, p.astype(jnp.float32)[:, None] * req[None, :] - EPS
+    )
     vnode_safe = jnp.where(victims, state.task_node, 0)
     needed_of_victim = needed[vnode_safe]
-    evict = victims & jnp.any(c_excl < needed_of_victim, axis=-1)
+    # a victim is consumed when it sits in the covering prefix of p*req OR
+    # within the first p single-victim chunks (each claim wastes its
+    # chunk's leftover, so p big victims back exactly p claims)
+    evict = victims & (
+        jnp.any(c_excl < needed_of_victim, axis=-1) | (node_rank < p[vnode_safe])
+    )
     evict = evict & (p[vnode_safe] > 0)
 
     freed = jnp.zeros_like(state.node_releasing).at[
@@ -381,14 +460,21 @@ def _claim_turn(
         queue_alloc=queue_alloc,
         job_ready_cnt=job_ready_cnt,
         group_placed=state.group_placed.at[g].add(placed_total),
-        group_unfit=state.group_unfit.at[g].set(
-            state.group_unfit[g] | (has_grp & (placed_total < budget))
+        group_unfit=(
+            # reclaim consumes the whole job in one turn (one task attempt
+            # per job per cycle, reclaim.go:94-105): retire every group of j
+            state.group_unfit | (has_grp & (st.group_job == j))
+            if reclaim
+            else state.group_unfit.at[g].set(
+                state.group_unfit[g] | (has_grp & (placed_pre < budget))
+            )
         ),
         evicted_for=evicted_for,
         # unfit-marking counts as progress so later jobs still get a turn
         progress=state.progress
         | (placed_total > 0)
-        | (has_grp & (placed_total < budget)),
+        | (has_grp & (placed_pre < budget))
+        | (has_grp if reclaim else False),
         rounds=state.rounds,
     )
 

@@ -149,6 +149,14 @@ class SequentialScheduler:
         self._discard_pool: set = set()
         self._stmt: list = []
 
+        # action-order-independent lookups (reclaim/preempt may run before
+        # allocate in the configured action list, e.g. the reference's full
+        # conf "reclaim, allocate, backfill, preempt")
+        self._creation_rank = {}
+        for rank, j in enumerate(sorted(self.jobs, key=lambda j: (j.creation_ts, j.uid))):
+            self._creation_rank[j.uid] = rank
+        self._task_job = {t.uid: j.uid for j in self.jobs for t in j.tasks.values()}
+
         for action in actions:
             if action == "allocate":
                 self._allocate(best_effort=False)
@@ -290,11 +298,6 @@ class SequentialScheduler:
     # --- the sequential loop ---
 
     def _allocate(self, best_effort: bool) -> None:
-        self._creation_rank = {}
-        for rank, j in enumerate(sorted(self.jobs, key=lambda j: (j.creation_ts, j.uid))):
-            self._creation_rank[j.uid] = rank
-        self._task_job = {t.uid: j.uid for j in self.jobs for t in j.tasks.values()}
-
         # pending task lists per job (PQ equivalent; failed tasks discarded)
         pending: Dict[str, List[TaskInfo]] = {}
         for j in self.jobs:
@@ -303,7 +306,11 @@ class SequentialScheduler:
             ts = [
                 t
                 for t in j.pending_tasks()
-                if t.best_effort == best_effort and t.uid not in self.session_alloc
+                if t.best_effort == best_effort
+                # a task placed earlier this session (Allocated or Pipelined)
+                # is no longer Pending — allocate must not re-place it
+                and t.uid not in self.session_alloc
+                and t.uid not in self.pipelined
             ]
             ts.sort(key=self._task_key)
             if ts:
@@ -497,35 +504,56 @@ class SequentialScheduler:
         del self.pipelined[t.uid]
 
     def _claim(self, claimant: TaskInfo, node_filter, reclaim: bool) -> bool:
-        """preempt() helper (preempt.go:169-236): first node passing
-        predicates whose victims cover resreq; evict minimally, pipeline
-        the claimant there."""
+        """preempt() helper (preempt.go:169-236, reclaim.go:112-181): first
+        node passing predicates with a non-empty victim set covering resreq;
+        evict the minimal victim prefix, pipeline the claimant there.
+
+        Reference fidelity notes: a node with NO victims is skipped even if
+        its Releasing capacity would cover the claimant (validateVictims
+        preempt.go:239-241, reclaim.go:137-140) — pre-existing releasing
+        space is allocate's job (allocate.go:148-158), not a claim's; the
+        victim-sufficiency check is the reference's weak all-dims-strict
+        ``allRes.Less(resreq)`` (preempt.go:248); the evict loop ignores
+        releasing credit and stops after the victim whose resreq covers the
+        remainder (preempt.go:205-219)."""
         for n in self.nodes:
             if not self._predicate(claimant, n):
                 continue
             preemptees = [t for t in self._running_on(n) if node_filter(t)]
             victims = self._preemptable(claimant, preemptees, reclaim)
-            avail = self.releasing[n.name].copy()
-            if not victims and not res.less_equal(claimant.resreq, avail):
-                continue
-            if not res.less_equal(
-                claimant.resreq, avail + res.sum_resources(v.resreq for v in victims)
+            if not victims:
+                continue  # validateVictims: no victims
+            if res.less(
+                res.sum_resources(v.resreq for v in victims), claimant.resreq
             ):
                 continue  # validateVictims: not enough resources
             claimant_job = "" if reclaim else self._job_of(claimant.uid)
+            rem = claimant.resreq.copy()
             for v in victims:
-                if res.less_equal(claimant.resreq, avail):
-                    break
                 self._evict(v, claimant_job)
                 self._stmt.append(("evict", v))
-                avail = avail + v.resreq
+                if res.less_equal(rem, v.resreq):
+                    break
+                rem = np.maximum(rem - v.resreq, 0.0)
             self._commit(claimant, n, pipelined=True)
             self._stmt.append(("pipeline", claimant))
             return True
         return False
 
     def _preempt(self) -> None:
-        """Inter-job (statement, commit on JobReady) then intra-job."""
+        """Inter-job (statement, commit on JobReady) then intra-job
+        (preempt.go:74-163).
+
+        Phase-1 job-PQ semantics are faithful: a popped job takes one turn
+        (a statement scope); a not-yet-ready job keeps popping tasks until
+        ready (commit) or its tasks are exhausted (discard); it is
+        re-pushed only when the turn both committed and assigned
+        (preempt.go:116-130), so an already-ready job preempts one task per
+        turn while claims keep succeeding and drops out at the first dry
+        turn.  Determinism deviation: the reference runs phase 2 over ALL
+        under-request jobs inside each queue iteration of a Go-map-ordered
+        queue list (preempt.go:75,133-163); we run phase 1 for every queue
+        (uid order) then phase 2 once for every job."""
         self._discard_pool: set = set()
         preemptor_tasks: Dict[str, List[TaskInfo]] = {}
         under_request: List[JobInfo] = []
@@ -543,14 +571,14 @@ class SequentialScheduler:
                 under_request.append(j)
 
         for q in self.queues:
-            while True:
-                cand = [
-                    j for j in under_request
-                    if j.queue_uid == q.uid and preemptor_tasks.get(j.uid)
-                ]
-                if not cand:
-                    break
-                job = min(cand, key=self._job_key)
+            # job PQ for this queue: popped jobs return only on
+            # committed-and-assigned turns
+            jobpq = [j for j in under_request if j.queue_uid == q.uid]
+            while jobpq:
+                job = min(jobpq, key=self._job_key)
+                jobpq.remove(job)
+                if not preemptor_tasks.get(job.uid):
+                    continue
                 self._stmt = []
                 assigned = False
                 committed = False
@@ -567,40 +595,43 @@ class SequentialScheduler:
                         committed = True  # stmt.Commit
                         break
                 if not committed:
-                    # stmt.Discard: roll back in reverse
+                    # stmt.Discard: roll back in reverse; popped tasks stay
+                    # consumed (the reference PQ is drained)
                     for op, t in reversed(self._stmt):
                         if op == "evict":
                             self._unevict(t)
                         else:
                             self._unpipeline(t)
-                    # tasks already popped stay consumed (PQ drained)
-                    if not assigned:
-                        preemptor_tasks[job.uid] = []
-                if not preemptor_tasks.get(job.uid):
-                    preemptor_tasks.pop(job.uid, None)
+                elif assigned:
+                    jobpq.append(job)
 
-            # Phase 2: intra-job priority preemption (commit unconditional)
-            for job in under_request:
-                if job.queue_uid != q.uid:
-                    continue
-                while preemptor_tasks.get(job.uid):
-                    t = preemptor_tasks[job.uid].pop(0)
-                    self._stmt = []
-                    ok = self._claim(
-                        t,
-                        lambda v, _j=job.uid, _p=t.priority: self._job_of(v.uid) == _j
-                        and v.priority < _p,
-                        reclaim=False,
-                    )
-                    if ok:
-                        for op, v in self._stmt:
-                            if op == "evict":
-                                self.evicted[v.uid] = ""  # unconditional
-                    else:
-                        break
+        # Phase 2: intra-job priority preemption (commit unconditional)
+        for job in under_request:
+            while preemptor_tasks.get(job.uid):
+                t = preemptor_tasks[job.uid].pop(0)
+                self._stmt = []
+                ok = self._claim(
+                    t,
+                    lambda v, _j=job.uid, _p=t.priority: self._job_of(v.uid) == _j
+                    and v.priority < _p,
+                    reclaim=False,
+                )
+                if ok:
+                    for op, v in self._stmt:
+                        if op == "evict":
+                            self.evicted[v.uid] = ""  # unconditional
+                else:
+                    break
 
     def _reclaim(self) -> None:
-        """Cross-queue reclaim; evictions are direct (no statement)."""
+        """Cross-queue reclaim; evictions are direct (no statement).
+
+        Reference fidelity (reclaim.go:41-186): the job PQ is never
+        re-pushed, so each job with pending tasks gets exactly ONE task
+        claim attempt per cycle — success or failure consumes the job.
+        The queue PQ holds one entry per job of the queue
+        (reclaim.go:54-76) and is re-pushed only on a successful claim;
+        Overused is re-checked at every queue pop."""
         self._discard_pool = set()
         claimant_tasks: Dict[str, List[TaskInfo]] = {}
         for j in self.jobs:
@@ -615,17 +646,26 @@ class SequentialScheduler:
                 ts.sort(key=self._task_key)
                 claimant_tasks[j.uid] = ts
 
-        for q in self.queues:
-            while True:
+        # Round structure: the reference pops queues from a PQ whose
+        # LessFn reads shares that MUTATE as reclaims land — container/heap
+        # order under mutated keys is undefined, so any determinization is
+        # as faithful as another.  We pick the kernel's: per round, order
+        # queues by (share, uid) once, give each queue one job turn; a job
+        # is consumed by its turn whether or not the claim succeeds.
+        jobpq: Dict[str, List[JobInfo]] = {
+            q.uid: [j for j in self.jobs if j.queue_uid == q.uid and claimant_tasks.get(j.uid)]
+            for q in self.queues
+        }
+        while True:
+            progress = False
+            for q in sorted(self.queues, key=lambda q: (self._queue_share(q.uid), q.uid)):
                 if self._overused(q.uid):
-                    break
-                cand = [
-                    j for j in self.jobs
-                    if j.queue_uid == q.uid and claimant_tasks.get(j.uid)
-                ]
-                if not cand:
-                    break
-                job = min(cand, key=self._job_key)
+                    continue
+                if not jobpq[q.uid]:
+                    continue
+                job = min(jobpq[q.uid], key=self._job_key)
+                jobpq[q.uid].remove(job)
+                progress = True
                 t = claimant_tasks[job.uid].pop(0)
                 self._stmt = []
                 ok = self._claim(
@@ -637,7 +677,5 @@ class SequentialScheduler:
                     for op, v in self._stmt:
                         if op == "evict":
                             self.evicted[v.uid] = ""  # reclaim commits directly
-                else:
-                    claimant_tasks[job.uid] = []
-                if not claimant_tasks.get(job.uid):
-                    claimant_tasks.pop(job.uid, None)
+            if not progress:
+                break
