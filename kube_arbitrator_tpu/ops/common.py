@@ -7,6 +7,7 @@ from __future__ import annotations
 
 from typing import Sequence
 
+import jax
 import jax.numpy as jnp
 
 from ..cache.snapshot import DEVICE_EPSILON
@@ -58,3 +59,35 @@ def lex_argmin(keys: Sequence[jnp.ndarray], mask: jnp.ndarray) -> tuple[jnp.ndar
 def ceil_div_pos(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """ceil(a/b) for positive b, as int32, clipped at >= 0."""
     return jnp.maximum(jnp.ceil(a / jnp.maximum(b, 1e-30)), 0.0).astype(jnp.int32)
+
+
+def mm_cumsum(x: jnp.ndarray, block: int = 512) -> jnp.ndarray:
+    """Inclusive prefix sum along axis 0 via triangular matmuls.
+
+    XLA lowers ``jnp.cumsum`` on TPU to a log-depth chain of ~17 full-size
+    steps for a 50k-row array (~110 us measured); inside the per-turn claim
+    loops that serial chain dominates.  Reformulating as a two-level scan —
+    block-local prefix sums as one [block, block] triangular matmul on the
+    MXU plus a tiny cross-block cumsum — runs ~3x faster and collapses the
+    op count per loop iteration.
+
+    x: [T] or [T, C] float; returns same shape/dtype (f32 accumulation).
+    """
+    squeeze = x.ndim == 1
+    if squeeze:
+        x = x[:, None]
+    T = x.shape[0]
+    pad = (-T) % block
+    xp = jnp.pad(x.astype(jnp.float32), ((0, pad), (0, 0)))
+    B = xp.shape[0] // block
+    xb = xp.reshape(B, block, -1)
+    tri = jnp.tril(jnp.ones((block, block), jnp.float32))
+    # HIGHEST: the TPU MXU multiplies in bf16 by default; resource sums feed
+    # epsilon comparisons (EPS = 10 device units) so bf16 input rounding of
+    # O(1e3) values would swamp the slack.  3-pass f32 is still trivial here.
+    inner = jnp.einsum("ij,bjc->bic", tri, xb, precision=jax.lax.Precision.HIGHEST)
+    tot = inner[:, -1, :]
+    outer = jnp.cumsum(tot, axis=0) - tot  # exclusive cross-block offsets
+    out = (inner + outer[:, None, :]).reshape(-1, x.shape[-1])[:T]
+    out = out.astype(x.dtype) if jnp.issubdtype(x.dtype, jnp.floating) else out
+    return out[:, 0] if squeeze else out

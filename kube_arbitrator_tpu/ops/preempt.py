@@ -39,8 +39,8 @@ import jax.numpy as jnp
 from ..api.types import TaskStatus
 from ..cache.snapshot import SnapshotTensors
 from .allocate import AllocState, PIPELINED, SessionCtx, _copies_fit, turn_budget
-from .common import BIG, EPS, lex_argmin, safe_share
-from .fairness import drf_shares, overused, queue_shares
+from .common import BIG, EPS, lex_argmin, mm_cumsum, safe_share
+from .fairness import drf_shares, queue_shares
 from .ordering import Tiers, group_order_keys, job_order_keys, queue_order_keys
 from .podaffinity import apply_domain_cap, apply_seed, pa_enabled, pod_affinity_fit
 
@@ -70,29 +70,43 @@ class SortLayout:
     order: jax.Array     # i32[T] sorted position -> task index
     inv: jax.Array       # i32[T] task index -> sorted position
     base_idx: jax.Array  # i32[T] sorted position -> its segment's start position
+    res_sorted: jax.Array  # f32[T, R] task resreq pre-gathered into sort order
 
     @classmethod
-    def build(cls, segment: jax.Array, priority: jax.Array, uid_rank: jax.Array):
-        T = segment.shape[0]
-        order = jnp.lexsort((uid_rank, priority, segment))
-        s_seg = segment[order]
+    def build(cls, segment, priority: jax.Array, uid_rank: jax.Array,
+              resreq: jax.Array):
+        """``segment`` is one i32[T] key or a tuple of them (composite
+        segments, e.g. (node, job) — grouped by all keys jointly)."""
+        segs = segment if isinstance(segment, tuple) else (segment,)
+        T = segs[0].shape[0]
+        # jnp.lexsort: LAST key is primary; any segment nesting order works
+        # as long as equal composite keys end up contiguous.
+        order = jnp.lexsort((uid_rank, priority) + tuple(segs))
         pos = jnp.arange(T)
-        seg_start = jnp.concatenate([jnp.array([True]), s_seg[1:] != s_seg[:-1]])
+        seg_start = jnp.zeros(T, bool).at[0].set(True)
+        for s in segs:
+            s_s = s[order]
+            seg_start = seg_start.at[1:].max(s_s[1:] != s_s[:-1])
         base_idx = jax.lax.associative_scan(jnp.maximum, jnp.where(seg_start, pos, 0))
         inv = jnp.zeros(T, jnp.int32).at[order].set(pos.astype(jnp.int32))
-        return cls(order=order, inv=inv, base_idx=base_idx)
+        return cls(order=order, inv=inv, base_idx=base_idx, res_sorted=resreq[order])
 
-    def rank_and_cum(self, mask: jax.Array, resreq: jax.Array):
+    def rank_and_cum(self, mask: jax.Array):
         """Per-task exclusive in-segment candidate rank and INCLUSIVE
         cumulative resreq among candidates, in task-index space.
-        Non-candidates get the rank/cum of the candidates before them."""
-        m_s = mask[self.order].astype(jnp.int32)
-        v_s = jnp.where(mask[:, None], resreq, 0.0)[self.order]
-        cnt = jnp.cumsum(m_s)
-        res = jnp.cumsum(v_s, axis=0)
-        cnt_base = cnt[self.base_idx] - m_s[self.base_idx]
+        Non-candidates get the rank/cum of the candidates before them.
+
+        The count column rides the same fused mm_cumsum as the resource
+        columns (one matmul instead of two log-depth scans per call); the
+        resreq gather is pre-staged in ``res_sorted`` at build time."""
+        m_s = mask[self.order]
+        m_f = m_s.astype(jnp.float32)
+        v_s = jnp.where(m_s[:, None], self.res_sorted, 0.0)
+        both = mm_cumsum(jnp.concatenate([m_f[:, None], v_s], axis=1))
+        cnt, res = both[:, 0], both[:, 1:]
+        cnt_base = cnt[self.base_idx] - m_f[self.base_idx]
         res_base = res[self.base_idx] - v_s[self.base_idx]
-        rank_s = cnt - m_s - cnt_base            # exclusive candidate rank
+        rank_s = (cnt - m_f - cnt_base).astype(jnp.int32)  # exclusive candidate rank
         cum_s = res - res_base                    # inclusive candidate resreq
         return rank_s[self.inv], cum_s[self.inv]
 
@@ -100,10 +114,9 @@ class SortLayout:
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class VictimLayouts:
-    """The four fixed victim orders one action needs."""
+    """The three fixed victim orders a preempt phase needs."""
 
     by_job: SortLayout     # segment = victim's job
-    by_queue: SortLayout   # segment = victim's queue
     global_: SortLayout    # one segment (cluster-wide cumulative)
     by_node: SortLayout    # segment = victim's node
 
@@ -111,11 +124,11 @@ class VictimLayouts:
     def build(cls, st: SnapshotTensors, task_node: jax.Array):
         vj = st.task_job
         zeros = jnp.zeros(st.num_tasks, jnp.int32)
+        rr = st.task_resreq
         return cls(
-            by_job=SortLayout.build(vj, st.task_priority, st.task_uid_rank),
-            by_queue=SortLayout.build(st.job_queue[vj], st.task_priority, st.task_uid_rank),
-            global_=SortLayout.build(zeros, st.task_priority, st.task_uid_rank),
-            by_node=SortLayout.build(task_node, st.task_priority, st.task_uid_rank),
+            by_job=SortLayout.build(vj, st.task_priority, st.task_uid_rank, rr),
+            global_=SortLayout.build(zeros, st.task_priority, st.task_uid_rank, rr),
+            by_node=SortLayout.build(task_node, st.task_priority, st.task_uid_rank, rr),
         )
 
 
@@ -127,21 +140,22 @@ def _victim_verdict(
     candidates: jax.Array,  # bool[T]
     claimant_job: jax.Array,  # scalar job ordinal
     req: jax.Array,  # f32[R] claimant per-task resreq
-    reclaim: bool,
     layouts: VictimLayouts,
 ) -> jax.Array:
-    """Tiered victim filter: within a tier verdicts intersect; the first
-    tier producing any victim wins (session_plugins.go:59-140).
+    """Tiered Preemptable victim filter for the preempt phases; reclaim
+    verdicts live in ``_reclaim_fast`` (session_plugins.go:59-140: within
+    a tier verdicts intersect; the first tier producing any victim wins).
 
     Per-victim in-segment ranks and cumulative resreqs mirror the
-    reference's per-job/per-queue ``allocations`` maps that subtract
-    victims cumulatively as they are considered (drf.go:86-99,
-    proportion.go:161-186); the deterministic (priority, uid) orders come
-    from the action-level ``layouts``."""
-    attr = "reclaimable_disabled" if reclaim else "preemptable_disabled"
+    reference's per-job ``allocations`` map, which subtracts every
+    CONSIDERED victim — the mutating ``Sub`` at drf.go:94 persists even
+    for rejected victims — so an inclusive cumulative over candidates is
+    the faithful form; the deterministic (priority, uid) orders come from
+    the action-level ``layouts``."""
+    attr = "preemptable_disabled"
     vj = st.task_job
 
-    job_rank, job_cum = layouts.by_job.rank_and_cum(candidates, st.task_resreq)
+    job_rank, job_cum = layouts.by_job.rank_and_cum(candidates)
 
     def gang_ok():
         # victim's job must stay gang-viable as victims accumulate:
@@ -157,7 +171,7 @@ def _victim_verdict(
         # so a multi-task turn progresses ls exactly like the sequential
         # evict-one/place-one interleave.
         total = sess.drf_total
-        _, global_cum = layouts.global_.rank_and_cum(candidates, st.task_resreq)
+        _, global_cum = layouts.global_.rank_and_cum(candidates)
         supported = jnp.min(
             jnp.where(req[None, :] > 0, global_cum / jnp.maximum(req[None, :], 1e-30), BIG),
             axis=-1,
@@ -174,17 +188,7 @@ def _victim_verdict(
         rs = jnp.max(safe_share(state.job_alloc[vj] - job_cum, total[None, :]), axis=-1)
         return candidates & ((ls < rs) | (jnp.abs(ls - rs) <= SHARE_DELTA))
 
-    def proportion_ok():
-        # cumulative per victim queue: the queue must stay at/above its
-        # deserved after this and all earlier same-queue victims leave
-        vq = st.job_queue[vj]
-        _, queue_cum = layouts.by_queue.rank_and_cum(candidates, st.task_resreq)
-        after = state.queue_alloc[vq] - queue_cum
-        return candidates & jnp.all(sess.deserved[vq] < after + EPS, axis=-1)
-
     verdict_fns = {"gang": gang_ok, "drf": drf_ok}
-    if reclaim:
-        verdict_fns = {"gang": gang_ok, "proportion": proportion_ok}
 
     # Reference semantics (session_plugins.go:59-140): the verdict is the
     # intersection of the FIRST tier containing any enabled verdict plugin.
@@ -212,19 +216,15 @@ def _claim_turn(
     state: AllocState,
     tiers: Tiers,
     s_max: int,
-    mode: str,  # "preempt" | "preempt_intra" | "reclaim"
+    mode: str,  # "preempt" | "preempt_intra"
     layouts: VictimLayouts,
 ) -> AllocState:
-    """One queue turn of an eviction-based action: select claimant job and
-    group, select victims, evict the minimal prefix, pipeline claimant
-    tasks onto the freed (releasing) capacity."""
+    """One queue turn of a preempt phase: select claimant job and group,
+    select victims, evict the minimal prefix, pipeline claimant tasks onto
+    the freed (releasing) capacity.  (Reclaim runs in ``_reclaim_fast``.)"""
     J = st.num_jobs
-    reclaim = mode == "reclaim"
 
-    if reclaim:
-        q_ok = st.queue_valid[q] & ~overused(state.queue_alloc, sess.deserved)[q]
-    else:
-        q_ok = st.queue_valid[q]  # preempt has no overused gate
+    q_ok = st.queue_valid[q]  # preempt has no overused gate
 
     # (padding queues are skipped via the n_valid_queues trip bound in
     # _rounds, not a lax.cond — a cond's passthrough branch would copy the
@@ -257,22 +257,16 @@ def _claim_turn(
     # the share-crossing/equilibrium budget.  The cumulative victim
     # verdicts below were built for multi-task turns (per-victim rank and
     # prefix caps), so a batched turn replays the same evict-one/place-one
-    # chain.  Reclaim keeps proportion's overused stop (reclaim.go:88-91);
-    # preempt has no overused gate so the queue clamp is off.
+    # chain.  Preempt has no overused gate so the queue clamp is off.
     budget = turn_budget(
         st, sess, tiers, j, q, req, job_share, job_ready, jmask, state, s_max,
-        queue_clamp=reclaim,
+        queue_clamp=False,
     )
     budget = jnp.clip(budget, 0, s_max)
     budget = jnp.where(has_grp, jnp.minimum(budget, grp_remaining[g]), 0)
     was_ready = job_ready[j]
     need = jnp.maximum(sess.min_avail[j] - state.job_ready_cnt[j], 0)
-    if reclaim:
-        # reclaim.go never re-pushes the job PQ: each job gets exactly ONE
-        # task claim per cycle, so a turn is one task and consumes the job
-        # (the group_unfit update below retires all of job j's groups)
-        budget = jnp.minimum(budget, 1)
-    elif mode == "preempt":
+    if mode == "preempt":
         # a not-ready preemptor's statement pops tasks until JobReady with
         # no mid-statement re-ordering (preempt.go:89-120), so its turn
         # budget is exactly the tasks-to-ready gap, not the drf clamp
@@ -280,23 +274,25 @@ def _claim_turn(
             was_ready, budget,
             jnp.where(has_grp, jnp.minimum(jnp.maximum(need, 1), grp_remaining[g]), 0),
         )
+    # the mode overrides can exceed s_max (a tasks-to-ready gap is
+    # unbounded) but the slot decode below only covers s_max slots —
+    # re-clamp so placed_total can never outrun the decodable range
+    budget = jnp.minimum(budget, s_max)
 
     # ---- victim candidates by scope ----
     running = (state.task_status == RUNNING) & st.task_valid & (state.task_node >= 0)
     vj = st.task_job
     if mode == "preempt":
         scope = running & (vj != j) & (st.job_queue[vj] == q)
-    elif mode == "preempt_intra":
+    else:  # preempt_intra: lower-priority tasks of the same job
         scope = running & (vj == j) & (st.task_priority < st.group_priority[g])
-    else:  # reclaim: other queues' jobs
-        scope = running & (st.job_queue[vj] != q)
     victims = (
-        _victim_verdict(st, state, sess, tiers, scope, j, req, reclaim, layouts)
+        _victim_verdict(st, state, sess, tiers, scope, j, req, layouts)
         & has_grp
     )
 
     # ---- per-node victim prefix sums (deterministic order) ----
-    node_rank, node_cum = layouts.by_node.rank_and_cum(victims, st.task_resreq)
+    node_rank, node_cum = layouts.by_node.rank_and_cum(victims)
     vres = jnp.where(victims[:, None], st.task_resreq, 0.0)
     c_excl = node_cum - vres  # per-victim exclusive in-node prefix
 
@@ -350,18 +346,57 @@ def _claim_turn(
     ok = ok & (node_victims > 0)
     weak_ok = ~jnp.all(totfree < req[None, :], axis=-1)
     reqpos = req[None, :] > 0
-    full = jnp.minimum(_copies_fit(totfree, req), jnp.float32(s_max))
-    # the trailing under-covered claim: granted when requested resources
-    # are left beyond the full chunks, or when the victims cover nothing
-    # requested at all (full == 0) — validateVictims passing guarantees
-    # the reference at least one claim either way
-    partial = (
-        jnp.any(reqpos & (totfree > full[:, None] * req[None, :] + EPS), axis=-1)
-        | (full < 1.0)
+
+    # Per-node victim-size spread, for the chunked claim count below.
+    vnode_for_minmax = jnp.where(victims, state.task_node, st.num_nodes)
+    vmax = jnp.full_like(totfree, -BIG).at[vnode_for_minmax].max(
+        jnp.where(victims[:, None], st.task_resreq, -BIG), mode="drop"
     )
-    # one claim consumes a whole victim CHUNK (minimal covering prefix):
-    # the chunk's leftover is wasted, so claims never exceed the victim
-    # count (exact when victims >= req; mixed sizes may still round up)
+    vmin = jnp.full_like(totfree, BIG).at[vnode_for_minmax].min(
+        jnp.where(victims[:, None], st.task_resreq, BIG), mode="drop"
+    )
+    node_uniform = jnp.all(vmax - vmin <= EPS, axis=-1) & (node_victims > 0)
+
+    # Claim count per node.  The sequential evict loop consumes a whole
+    # covering CHUNK per claim and wastes the chunk's leftover
+    # (preempt.go:205-219 restarts ``resreq`` per claim), so for victims
+    # individually smaller than req the count is a renewal process, NOT
+    # floor(totfree/req).  With uniform victim sizes the renewal is closed
+    # form: each full claim eats m = max_r ceil(req_r/v_r) victims.  Mixed
+    # sizes fall back to floor(totfree/req) — an upper bound whose
+    # rounding the fuzz slack absorbs (advisor round-2 finding).
+    full_mixed = _copies_fit(totfree, req)
+    m_per_dim = jnp.where(
+        reqpos,
+        jnp.ceil((req[None, :] - EPS) / jnp.maximum(vmax, 1e-30)),
+        1.0,
+    )
+    m_per_dim = jnp.where(reqpos & (vmax <= EPS), BIG, m_per_dim)
+    chunk_m = jnp.maximum(jnp.max(m_per_dim, axis=-1), 1.0)  # f32[N]
+    full_uniform = jnp.floor(node_victims.astype(jnp.float32) / chunk_m)
+    full = jnp.where(node_uniform, full_uniform, full_mixed)
+    full = jnp.minimum(full, jnp.float32(s_max))
+    # the trailing under-covered claim: granted when victims are left
+    # beyond the full chunks (uniform) / requested resources are left
+    # (mixed) AND the remainder passes the re-run weak validateVictims —
+    # the reference re-checks ``allRes.Less(resreq)`` against only the
+    # REMAINING victims per claim (preempt.go:238-253), so a remainder
+    # strictly below req in EVERY dim fails the trailing claim.  full == 0
+    # rides the node-level weak_ok gate below.
+    rem_uniform = (
+        jnp.maximum(node_victims.astype(jnp.float32) - full * chunk_m, 0.0)[:, None]
+        * vmax
+    )
+    rem_mixed = jnp.maximum(totfree - full[:, None] * req[None, :], 0.0)
+    remaining = jnp.where(node_uniform[:, None], rem_uniform, rem_mixed)
+    weak_rem = ~jnp.all(remaining < req[None, :], axis=-1)
+    partial_mixed = jnp.any(reqpos & (rem_mixed > EPS), axis=-1)
+    partial_uniform = node_victims.astype(jnp.float32) > full * chunk_m
+    partial = (
+        jnp.where(node_uniform, partial_uniform, partial_mixed) & weak_rem
+    ) | (full < 1.0)
+    # one claim consumes a whole victim chunk, so claims never exceed the
+    # victim count
     cap = jnp.minimum(full + partial.astype(jnp.float32), node_victims.astype(jnp.float32))
     cap = jnp.minimum(cap, pods_head.astype(jnp.float32))
     cap = jnp.where(has_ports, jnp.minimum(cap, 1.0), cap)
@@ -399,14 +434,20 @@ def _claim_turn(
     needed = jnp.where(
         use_partial[:, None], BIG, p.astype(jnp.float32)[:, None] * req[None, :] - EPS
     )
+    # uniform-victim nodes consume exactly p chunks of chunk_m victims
+    # (everything once the trailing partial claim is used)
+    rank_needed = jnp.where(
+        use_partial, jnp.float32(st.num_tasks), p.astype(jnp.float32) * chunk_m
+    )
     vnode_safe = jnp.where(victims, state.task_node, 0)
     needed_of_victim = needed[vnode_safe]
     # a victim is consumed when it sits in the covering prefix of p*req OR
     # within the first p single-victim chunks (each claim wastes its
-    # chunk's leftover, so p big victims back exactly p claims)
-    evict = victims & (
-        jnp.any(c_excl < needed_of_victim, axis=-1) | (node_rank < p[vnode_safe])
-    )
+    # chunk's leftover, so p big victims back exactly p claims); uniform
+    # nodes use the exact chunk-rank rule instead
+    cum_rule = jnp.any(c_excl < needed_of_victim, axis=-1) | (node_rank < p[vnode_safe])
+    rank_rule = node_rank.astype(jnp.float32) < rank_needed[vnode_safe]
+    evict = victims & jnp.where(node_uniform[vnode_safe], rank_rule, cum_rule)
     evict = evict & (p[vnode_safe] > 0)
 
     freed = jnp.zeros_like(state.node_releasing).at[
@@ -427,7 +468,7 @@ def _claim_turn(
     evict_res = jnp.where(evict[:, None], st.task_resreq, 0.0)
     evict_cnt = evict.astype(jnp.int32)
     ptf = placed_total.astype(jnp.float32) * req
-    uncond = mode in ("preempt_intra", "reclaim")
+    uncond = mode == "preempt_intra"
 
     new_status = jnp.where(evict, RELEASING, state.task_status)
     new_status = jnp.where(assigned, PIPELINED, new_status)
@@ -460,21 +501,14 @@ def _claim_turn(
         queue_alloc=queue_alloc,
         job_ready_cnt=job_ready_cnt,
         group_placed=state.group_placed.at[g].add(placed_total),
-        group_unfit=(
-            # reclaim consumes the whole job in one turn (one task attempt
-            # per job per cycle, reclaim.go:94-105): retire every group of j
-            state.group_unfit | (has_grp & (st.group_job == j))
-            if reclaim
-            else state.group_unfit.at[g].set(
-                state.group_unfit[g] | (has_grp & (placed_pre < budget))
-            )
+        group_unfit=state.group_unfit.at[g].set(
+            state.group_unfit[g] | (has_grp & (placed_pre < budget))
         ),
         evicted_for=evicted_for,
         # unfit-marking counts as progress so later jobs still get a turn
         progress=state.progress
         | (placed_total > 0)
-        | (has_grp & (placed_pre < budget))
-        | (has_grp if reclaim else False),
+        | (has_grp & (placed_pre < budget)),
         rounds=state.rounds,
     )
 
@@ -527,6 +561,259 @@ def preempt_action(
     return state
 
 
+def _reclaim_verdict_names(tiers: Tiers):
+    """Statically resolve which verdict plugins the first verdict-bearing
+    tier contributes for reclaim (session_plugins.go:59-140: first tier
+    with any enabled Reclaimable plugin wins; later tiers are poisoned)."""
+    for tier in tiers:
+        names = [
+            p.name
+            for p in tier.plugins
+            if p.name in ("gang", "proportion") and not p.reclaimable_disabled
+        ]
+        if names:
+            return tuple(names)
+    return ()
+
+
+
+def _reclaim_fast(
+    st: SnapshotTensors,
+    sess: SessionCtx,
+    state: AllocState,
+    tiers: Tiers,
+    max_rounds: int,
+) -> AllocState:
+    """Cross-queue reclaim: sequential single-task claims with per-turn
+    cost collapsed to two matmul prefix sums — the TPU-native shape of
+    ``reclaim.go:41-188``.
+
+    Semantics (each verified against the Go source):
+
+    * the queue PQ is seeded with one entry per session job of the queue
+      (reclaim.go:54-63) and re-pushed only on a successful claim
+      (:183-185), so each queue carries a retry budget of its job count;
+      an overused pop (:90-93), an empty job PQ pop (:96-99), or a failed
+      claim burns one entry (``q_entries``).
+    * the job PQ is never re-pushed: one task claim attempt per job per
+      cycle, consumed at the pop whether or not the claim lands
+      (``job_consumed``).
+    * victim verdicts use the reference's per-node-call scoping: gang rank
+      within the node's per-job victim list against live ready counts
+      (gang.go:104-127), proportion cumulative within the node's
+      per-queue list (proportion.go:161-186's per-call ``allocations``
+      map) — realized as fixed per-(node,job)/(node,queue) sort layouts
+      whose candidate masks are recomputed from live ``task_status`` each
+      turn, so a turn is stateless and exact.
+    * node choice is the first-fit scan (first node passing predicates
+      with a non-empty victim set whose sum survives the weak
+      ``allRes.Less(resreq)`` check, reclaim.go:112-140); the evict loop
+      takes the minimal covering victim prefix (:158-168) and the
+      claimant pipelines there even when under-covered (:172-175).
+
+    Round structure: queues ordered by (share, uid) once per round, one
+    pop per queue per round — the same determinization as the oracle; the
+    reference's heap order under share keys that mutate mid-heap is
+    undefined, so any consistent ordering is equally faithful.
+    """
+    J, Q, N = st.num_jobs, st.num_queues, st.num_nodes
+    rr = st.task_resreq
+    vj = st.task_job
+    vq = st.job_queue[vj]
+    verdict_names = _reclaim_verdict_names(tiers)
+    preds_on = _plugin_on(tiers, "predicates", "predicate_disabled")
+    use_gang = "gang" in verdict_names
+    use_prop = "proportion" in verdict_names
+
+    node_key = jnp.maximum(state.task_node, 0)
+    L_node = SortLayout.build(node_key, st.task_priority, st.task_uid_rank, rr)
+    L_nj = (
+        SortLayout.build((vj, node_key), st.task_priority, st.task_uid_rank, rr)
+        if use_gang else None
+    )
+    L_nq = (
+        SortLayout.build((vq, node_key), st.task_priority, st.task_uid_rank, rr)
+        if use_prop else None
+    )
+
+    q_entries0 = jnp.zeros(Q, jnp.int32).at[st.job_queue].add(
+        st.job_valid.astype(jnp.int32)
+    )
+    pa_on = preds_on and pa_enabled(st)
+
+    def queue_turn(qi, carry):
+        state, q_entries, job_consumed, perm = carry
+        q = perm[qi]
+
+        # single-queue OverusedFn row (proportion.go:188-193; fairness.overused)
+        q_over = jnp.all(sess.deserved[q] < state.queue_alloc[q] + EPS)
+        active = st.queue_valid[q] & (q_entries[q] > 0)
+
+        # ---- job pop (JobOrderFn over the queue's unconsumed jobs) ----
+        grp_remaining = st.group_size - state.group_placed
+        grp_elig = (
+            st.group_valid
+            & ~st.group_best_effort
+            & (grp_remaining > 0)
+            & sess.job_sched_valid[st.group_job]
+            & ~job_consumed[st.group_job]
+        )
+        job_has_pending = jnp.zeros(J, dtype=bool).at[st.group_job].max(grp_elig)
+        jmask = (
+            (st.job_queue == q) & job_has_pending & st.job_valid & active & ~q_over
+        )
+        job_ready = state.job_ready_cnt >= sess.min_avail
+        job_share = drf_shares(state.job_alloc, sess.drf_total)
+        jkeys = job_order_keys(
+            tiers, st.job_priority, job_ready, st.job_creation_rank, job_share
+        )
+        j, has_job = lex_argmin(jkeys, jmask)
+        pop = active & ~q_over & has_job
+        burn_now = active & (q_over | ~has_job)
+
+        gmask = (st.group_job == j) & grp_elig & pop
+        gkeys = group_order_keys(tiers, st.group_priority, st.group_uid_rank)
+        g, has_grp = lex_argmin(gkeys, gmask)
+        req = st.group_resreq[g]
+
+        # ---- victim eligibility (live task_status; fixed sort layouts) ----
+        cand = (state.task_status == RUNNING) & st.task_valid & (state.task_node >= 0)
+        elig = cand
+        if use_gang:
+            nj_rank, _ = L_nj.rank_and_cum(cand)
+            cap = jnp.maximum(state.job_ready_cnt - sess.min_avail, 0)
+            elig = elig & (nj_rank < cap[vj])
+        if use_prop:
+            _, nq_cum = L_nq.rank_and_cum(cand)
+            after = state.queue_alloc[vq] - nq_cum
+            elig = elig & jnp.all(sess.deserved[vq] < after + EPS, axis=-1)
+        if not verdict_names:
+            elig = jnp.zeros_like(cand)
+        mask_v = elig & (vq != q)
+
+        # per-node victim prefix (own-queue exclusion is free: mask_v)
+        _, cum_v = L_node.rank_and_cum(mask_v)
+        vres = jnp.where(mask_v[:, None], rr, 0.0)
+        vstat = jnp.concatenate([mask_v.astype(jnp.float32)[:, None], vres], axis=1)
+        agg = jnp.zeros((N, vstat.shape[1])).at[node_key].add(
+            jnp.where(mask_v[:, None], vstat, 0.0)
+        )
+        vic_cnt, vic_res = agg[:, 0], agg[:, 1:]
+
+        # ---- first-fit node choice ----
+        if preds_on:
+            node_ok = (
+                st.class_fit[st.group_klass[g], st.node_klass]
+                & st.node_valid
+                & ~st.node_unsched
+            )
+            g_ports = st.group_ports[g]
+            node_ok = node_ok & jnp.all((g_ports[None, :] & state.node_ports) == 0, axis=-1)
+            node_ok = node_ok & (st.node_max_tasks - state.node_num_tasks > 0)
+        else:
+            node_ok = st.node_valid
+        if pa_on:
+            pafit = pod_affinity_fit(st, g, state.task_status, state.task_node)
+            node_ok = node_ok & pafit.ok
+        weak_ok = ~jnp.all(vic_res < req[None, :], axis=-1)
+        feas = node_ok & (vic_cnt > 0) & weak_ok & pop & has_grp
+        has_node = jnp.any(feas)
+        n_star = jnp.argmin(jnp.where(feas, jnp.arange(N), N)).astype(jnp.int32)
+        claimed = pop & has_grp & has_node
+        fail = pop & ~claimed
+        q_entries = q_entries.at[q].add(-(burn_now | fail).astype(jnp.int32))
+        job_consumed = job_consumed.at[j].set(job_consumed[j] | pop)
+
+        # ---- evict the minimal covering prefix on n_star ----
+        c_excl = cum_v - vres
+        evict = (
+            mask_v
+            & claimed
+            & (state.task_node == n_star)
+            & jnp.any(c_excl < req[None, :] - EPS, axis=-1)
+        )
+        evict_res = jnp.where(evict[:, None], rr, 0.0)
+        freed = jnp.sum(evict_res, axis=0)
+
+        # ---- claimant task decode (top pending task of group g) ----
+        assigned = (
+            (st.task_group == g)
+            & st.task_valid
+            & (st.task_group_rank == state.group_placed[g])
+            & claimed
+        )
+        new_status = jnp.where(evict, RELEASING, state.task_status)
+        new_status = jnp.where(assigned, PIPELINED, new_status)
+        task_node = jnp.where(assigned, n_star, state.task_node)
+
+        # ---- accounting (evict side: one fused [T,R+1] scatter per axis) ----
+        ev_cnt_res = jnp.concatenate(
+            [evict.astype(jnp.float32)[:, None], evict_res], axis=1
+        )
+        jstat = jnp.zeros((J, ev_cnt_res.shape[1])).at[
+            jnp.where(evict, vj, J)
+        ].add(ev_cnt_res, mode="drop")
+        qstat = jnp.zeros((Q, ev_cnt_res.shape[1])).at[
+            jnp.where(evict, vq, Q)
+        ].add(ev_cnt_res, mode="drop")
+        creq = req * claimed
+        job_alloc = state.job_alloc - jstat[:, 1:]
+        job_alloc = job_alloc.at[j].add(creq)
+        queue_alloc = state.queue_alloc - qstat[:, 1:]
+        queue_alloc = queue_alloc.at[q].add(creq)
+        job_ready_cnt = state.job_ready_cnt - jstat[:, 0].astype(jnp.int32)
+        job_ready_cnt = job_ready_cnt.at[j].add(claimed.astype(jnp.int32))
+
+        rel = state.node_releasing.at[n_star].add(freed - creq)
+        ports = jnp.where(
+            claimed,
+            state.node_ports.at[n_star].set(state.node_ports[n_star] | st.group_ports[g]),
+            state.node_ports,
+        )
+        state = AllocState(
+            task_status=new_status,
+            task_node=task_node,
+            node_idle=state.node_idle,
+            node_releasing=rel,
+            node_ports=ports,
+            node_num_tasks=state.node_num_tasks.at[n_star].add(claimed.astype(jnp.int32)),
+            job_alloc=job_alloc,
+            queue_alloc=queue_alloc,
+            job_ready_cnt=job_ready_cnt,
+            group_placed=state.group_placed.at[g].add(claimed.astype(jnp.int32)),
+            group_unfit=state.group_unfit,
+            evicted_for=jnp.where(evict, jnp.int32(-2), state.evicted_for),
+            progress=state.progress | pop,
+            rounds=state.rounds,
+        )
+        return state, q_entries, job_consumed, perm
+
+    nq_valid = jnp.asarray(st.n_valid_queues, jnp.int32)
+    Q_trip = jnp.where((nq_valid > 0) & (nq_valid < Q), nq_valid, Q)
+
+    def round_body(carry):
+        state, q_entries, job_consumed = carry
+        state = dataclasses.replace(state, progress=jnp.array(False))
+        q_share = queue_shares(state.queue_alloc, sess.deserved)
+        qkeys = queue_order_keys(tiers, q_share, st.queue_uid_rank)
+        qkeys = [jnp.where(st.queue_valid, k, BIG) for k in qkeys]
+        perm = jnp.lexsort(tuple(reversed(qkeys)))
+        state, q_entries, job_consumed, _ = jax.lax.fori_loop(
+            0, Q_trip, queue_turn, (state, q_entries, job_consumed, perm)
+        )
+        return dataclasses.replace(state, rounds=state.rounds + 1), q_entries, job_consumed
+
+    def cond(carry):
+        state = carry[0]
+        return state.progress & (state.rounds < max_rounds)
+
+    state = dataclasses.replace(state, progress=jnp.array(True), rounds=jnp.int32(0))
+    state, _, _ = jax.lax.while_loop(
+        cond, round_body, (state, q_entries0, jnp.zeros(J, bool))
+    )
+    return state
+
+
 def reclaim_action(
     st: SnapshotTensors,
     sess: SessionCtx,
@@ -535,7 +822,8 @@ def reclaim_action(
     s_max: int = 4096,
     max_rounds: int = 100_000,
 ) -> AllocState:
-    return _rounds(
-        st, sess, state, tiers, s_max, max_rounds, "reclaim",
-        VictimLayouts.build(st, state.task_node),
-    )
+    """``s_max`` is accepted for ACTION_KERNELS signature uniformity but
+    inert here: reclaim claims are single-task by construction
+    (reclaim.go:94-105 pops one task per job per cycle)."""
+    del s_max
+    return _reclaim_fast(st, sess, state, tiers, max_rounds)

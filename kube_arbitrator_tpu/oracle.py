@@ -146,7 +146,6 @@ class SequentialScheduler:
             self.queue_alloc = {q.uid: res.zeros() for q in self.queues}
 
         self.evicted: Dict[str, str] = {}  # task uid -> claimant job uid ("" = unconditional)
-        self._discard_pool: set = set()
         self._stmt: list = []
 
         # action-order-independent lookups (reclaim/preempt may run before
@@ -392,7 +391,6 @@ class SequentialScheduler:
             t
             for t in self.node_pods[n.name]
             if t.status == TaskStatus.RUNNING and t.uid not in self.evicted
-            and t.uid not in self._discard_pool
         ]
         out.sort(key=lambda t: (t.priority, t.uid))
         return out
@@ -430,13 +428,17 @@ class SequentialScheduler:
         return out
 
     def _victims_drf(self, claimant, preemptees):
+        """drf.go:80-107.  The per-call ``allocations`` map subtracts every
+        CONSIDERED victim (the mutating ``Sub`` at drf.go:94 persists even
+        when the victim is rejected), not just accepted ones."""
         out = []
         freed = res.zeros()
         removed: Dict[str, np.ndarray] = {}
         for t in preemptees:
             juid = self._job_of(t.uid)
-            rem = removed.get(juid, res.zeros())
-            rs = res.dominant_share(self.job_alloc[juid] - rem - t.resreq, self.total)
+            rem = removed.get(juid, res.zeros()) + t.resreq
+            removed[juid] = rem
+            rs = res.dominant_share(self.job_alloc[juid] - rem, self.total)
             cj = self._job_of(claimant.uid)
             supported = 0
             req = claimant.resreq
@@ -448,11 +450,14 @@ class SequentialScheduler:
             )
             if ls < rs or abs(ls - rs) <= 1e-6:
                 out.append(t)
-                removed[juid] = rem + t.resreq
                 freed = freed + t.resreq
         return out
 
     def _victims_proportion(self, claimant, preemptees):
+        """proportion.go:161-186.  As with drf, the ``allocations`` map
+        subtracts every considered victim; the only skip is the underflow
+        guard ``allocated.Less(reclaimee.Resreq)`` (all dims strictly
+        below), which rejects WITHOUT subtracting."""
         out = []
         removed: Dict[str, np.ndarray] = {}
         for t in preemptees:
@@ -460,10 +465,13 @@ class SequentialScheduler:
             if quid not in self.queue_alloc:
                 continue
             rem = removed.get(quid, res.zeros())
-            after = self.queue_alloc[quid] - rem - t.resreq
-            if np.all(self.deserved[quid] < after + res.EPSILON):
+            avail = self.queue_alloc[quid] - rem
+            if np.all(avail < t.resreq):  # Resource.Less underflow guard
+                continue
+            rem = rem + t.resreq
+            removed[quid] = rem
+            if np.all(self.deserved[quid] < self.queue_alloc[quid] - rem + res.EPSILON):
                 out.append(t)
-                removed[quid] = rem + t.resreq
         return out
 
     def _evict(self, t: TaskInfo, claimant_job: str) -> None:
@@ -554,7 +562,6 @@ class SequentialScheduler:
         under-request jobs inside each queue iteration of a Go-map-ordered
         queue list (preempt.go:75,133-163); we run phase 1 for every queue
         (uid order) then phase 2 once for every job."""
-        self._discard_pool: set = set()
         preemptor_tasks: Dict[str, List[TaskInfo]] = {}
         under_request: List[JobInfo] = []
         for j in self.jobs:
@@ -629,10 +636,11 @@ class SequentialScheduler:
         Reference fidelity (reclaim.go:41-186): the job PQ is never
         re-pushed, so each job with pending tasks gets exactly ONE task
         claim attempt per cycle — success or failure consumes the job.
-        The queue PQ holds one entry per job of the queue
-        (reclaim.go:54-76) and is re-pushed only on a successful claim;
-        Overused is re-checked at every queue pop."""
-        self._discard_pool = set()
+        The queue PQ is seeded with one entry per session job of the queue
+        (reclaim.go:54-63 pushes job.Queue for every job) and re-pushed
+        only on a successful claim — so each queue carries a retry budget
+        of its job count; an overused pop, an empty-job-PQ pop, or a
+        failed claim burns one entry."""
         claimant_tasks: Dict[str, List[TaskInfo]] = {}
         for j in self.jobs:
             if not self.sched_valid[j.uid]:
@@ -650,18 +658,27 @@ class SequentialScheduler:
         # LessFn reads shares that MUTATE as reclaims land — container/heap
         # order under mutated keys is undefined, so any determinization is
         # as faithful as another.  We pick the kernel's: per round, order
-        # queues by (share, uid) once, give each queue one job turn; a job
-        # is consumed by its turn whether or not the claim succeeds.
+        # queues by (share, uid) once, give each queue (with entries left)
+        # one job turn; a job is consumed by its turn whether or not the
+        # claim succeeds; failed pops burn one queue entry.
         jobpq: Dict[str, List[JobInfo]] = {
             q.uid: [j for j in self.jobs if j.queue_uid == q.uid and claimant_tasks.get(j.uid)]
+            for q in self.queues
+        }
+        entries: Dict[str, int] = {
+            q.uid: sum(1 for j in self.jobs if j.queue_uid == q.uid)
             for q in self.queues
         }
         while True:
             progress = False
             for q in sorted(self.queues, key=lambda q: (self._queue_share(q.uid), q.uid)):
+                if entries[q.uid] <= 0:
+                    continue
                 if self._overused(q.uid):
+                    entries[q.uid] -= 1
                     continue
                 if not jobpq[q.uid]:
+                    entries[q.uid] -= 1
                     continue
                 job = min(jobpq[q.uid], key=self._job_key)
                 jobpq[q.uid].remove(job)
@@ -677,5 +694,7 @@ class SequentialScheduler:
                     for op, v in self._stmt:
                         if op == "evict":
                             self.evicted[v.uid] = ""  # reclaim commits directly
+                else:
+                    entries[q.uid] -= 1
             if not progress:
                 break

@@ -255,3 +255,105 @@ def test_property_full_actions_vs_oracle(seed):
     assert abs(len(evicts) - n_evict_o) <= evict_slack, (
         f"kernel {len(evicts)} evicts vs oracle {n_evict_o}"
     )
+
+
+def test_preempt_uniform_small_victims_chunked_claims():
+    """Advisor round-2 finding: when victims are individually smaller than
+    the claimant's req, each sequential claim consumes a covering chunk
+    and wastes the chunk's leftover (preempt.go:205-219 restarts resreq
+    per claim), so four 2000m victims back exactly TWO 3000m claims —
+    not floor(8000/3000) full + 1 trailing = 3.  Kernel and oracle must
+    agree exactly on both the claim count and the victim set."""
+    from kube_arbitrator_tpu.oracle import SequentialScheduler
+
+    sim = SimCluster()
+    sim.add_queue("q")
+    sim.add_node("n1", cpu_milli=8000, memory=16 * GB)
+    ja = sim.add_job("a", queue="q", creation_ts=1)  # no gang floor
+    _fill_running(sim, ja, "n1", 4, cpu=2000)
+    jb = sim.add_job("b", queue="q", min_available=2, creation_ts=2)
+    for i in range(3):
+        sim.add_task(jb, 3000, 0, name=f"b-p{i}")
+
+    snap, dec, binds, evicts = run(sim)
+    oracle = SequentialScheduler(sim.cluster).run_cycle(actions=FULL_ACTIONS)
+
+    assert {e.task_uid for e in evicts} == set(oracle.evicts)
+    assert len(evicts) == 4
+    # two pipelined claimant tasks (ready at minAvailable=2), not three
+    ts = np.asarray(dec.task_status)
+    pre = np.asarray(snap.tensors.task_status)
+    n_pipe = int(
+        ((ts == int(TaskStatus.PIPELINED)) & (pre == int(TaskStatus.PENDING))).sum()
+    )
+    assert n_pipe == len(oracle.pipelined) == 2
+
+
+def _prop_reclaim_tiers():
+    """Tiers whose first Reclaimable-bearing tier is proportion: gang's
+    verdict disabled in tier 1, so tier 2's proportion decides
+    (session_plugins.go:59-140 first-tier-wins)."""
+    from kube_arbitrator_tpu.ops import PluginOption, Tier
+
+    return (
+        Tier(plugins=(PluginOption.of("priority"),
+                      PluginOption.of("gang", reclaimable_disabled=True))),
+        Tier(plugins=(PluginOption.of("drf"), PluginOption.of("predicates"),
+                      PluginOption.of("proportion"))),
+    )
+
+
+def _prop_reclaim_cluster(big_first: bool):
+    """Queue A (weight 1) runs 7000m against a 4000m deserved; queue B
+    (weight 3) has 12 pending 1000m tasks.  A's victims on n1 in priority
+    order are a 6000m and a 1000m task."""
+    sim = SimCluster()
+    sim.add_queue("qa", weight=1)
+    sim.add_queue("qb", weight=3)
+    sim.add_node("n1", cpu_milli=8000, memory=16 * GB)
+    sim.add_node("n2", cpu_milli=8000, memory=16 * GB)
+    ja = sim.add_job("a", queue="qa", creation_ts=1)
+    big_prio, small_prio = (0, 1) if big_first else (1, 0)
+    sim.add_task(ja, 6000, 0, status=TaskStatus.RUNNING, node="n1",
+                 name="a-big", priority=big_prio)
+    sim.add_task(ja, 1000, 0, status=TaskStatus.RUNNING, node="n1",
+                 name="a-small", priority=small_prio)
+    jb = sim.add_job("b", queue="qb", min_available=1, creation_ts=2)
+    for i in range(12):
+        sim.add_task(jb, 1000, 0, name=f"b-p{i}")
+    return sim
+
+
+def test_reclaim_proportion_considered_all_cumulative():
+    """proportion.go:161-186's per-call ``allocations`` map subtracts every
+    CONSIDERED victim (the mutating Sub persists for rejected victims), so
+    with the 6000m victim first, the rejected big victim still consumes
+    queue A's margin and the small victim is rejected too — no reclaim.
+    An accept-only cumulative (the old oracle) would wrongly evict the
+    small victim.  Kernel and oracle must agree on zero evictions."""
+    from kube_arbitrator_tpu.oracle import SequentialScheduler
+
+    tiers = _prop_reclaim_tiers()
+    sim = _prop_reclaim_cluster(big_first=True)
+    snap = build_snapshot(sim.cluster)
+    dec = schedule_cycle(snap.tensors, tiers=tiers, actions=("reclaim",))
+    from kube_arbitrator_tpu.cache.decode import decode_decisions
+    binds, evicts = decode_decisions(snap, dec)
+    oracle = SequentialScheduler(sim.cluster, tiers=tiers).run_cycle(actions=("reclaim",))
+    assert [e.task_uid for e in evicts] == [] == sorted(oracle.evicts)
+
+
+def test_reclaim_proportion_small_victim_first_reclaims():
+    """Positive control for the test above: with the 1000m victim first in
+    (priority, uid) order it survives the cumulative check (7000-1000 >=
+    4000 deserved) and exactly one reclaim lands; kernel == oracle."""
+    from kube_arbitrator_tpu.oracle import SequentialScheduler
+
+    tiers = _prop_reclaim_tiers()
+    sim = _prop_reclaim_cluster(big_first=False)
+    snap = build_snapshot(sim.cluster)
+    dec = schedule_cycle(snap.tensors, tiers=tiers, actions=("reclaim",))
+    from kube_arbitrator_tpu.cache.decode import decode_decisions
+    binds, evicts = decode_decisions(snap, dec)
+    oracle = SequentialScheduler(sim.cluster, tiers=tiers).run_cycle(actions=("reclaim",))
+    assert sorted(e.task_uid for e in evicts) == sorted(oracle.evicts) == ["a-small"]
