@@ -25,6 +25,11 @@ from .types import (
 )
 
 
+# PV zone topology key (the VolumeZone predicate's label; see
+# cache/sim.FakeVolumeBinder and cache/snapshot's class table).
+ZONE_LABEL = "topology.kubernetes.io/zone"
+
+
 @dataclasses.dataclass
 class Toleration:
     """Subset of v1.Toleration the reference's taint predicate consults."""
@@ -134,6 +139,10 @@ class TaskInfo:
     # own required (anti-)affinity terms.
     labels: Dict[str, str] = dataclasses.field(default_factory=dict)
     affinity_terms: Tuple["PodAffinityTerm", ...] = ()
+    # Zone a bound PV pins this task's volumes to ("" = unconstrained) —
+    # the predicate face of the k8s volumebinder the reference wires
+    # (cache.go:230-238); attach COUNTS ride resreq's 4th axis.
+    volume_zone: str = ""
     # Assigned by the snapshot flattener:
     ordinal: int = -1
 

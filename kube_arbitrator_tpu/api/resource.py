@@ -20,24 +20,38 @@ from typing import Iterable
 import numpy as np
 
 # Resource axis order. Everything in the framework — host accounting, snapshot
-# tensors, kernels — uses this order.
+# tensors, kernels — uses this order.  The 4th axis is attachable-volume
+# capacity: the reference routes volume limits through the k8s volumebinder
+# (cache.go:230-238); here capacity dimensions are just resource axes, so
+# every fit/claim kernel enforces attach limits with no extra code.  It is
+# NOT a fairness axis — DRF/proportion read only the first
+# ``NUM_FAIR_RESOURCES`` (the reference's Resource has exactly
+# cpu/memory/gpu, resource_info.go:26-40).
 CPU = 0
 MEMORY = 1
 GPU = 2
-NUM_RESOURCES = 3
-RESOURCE_NAMES = ("cpu", "memory", "gpu")
+ATTACH = 3
+NUM_RESOURCES = 4
+NUM_FAIR_RESOURCES = 3
+RESOURCE_NAMES = ("cpu", "memory", "gpu", "attachments")
 
 # Epsilon slack per resource: 10 milli-cpu, 10 MiB, 10 milli-gpu
-# (reference resource_info.go:54-56).
-EPSILON = np.array([10.0, 10.0 * 1024 * 1024, 10.0], dtype=np.float64)
+# (reference resource_info.go:54-56); attachments are integral so the
+# slack is a tenth of a volume.
+EPSILON = np.array([10.0, 10.0 * 1024 * 1024, 10.0, 0.1], dtype=np.float64)
 
 
 def zeros() -> np.ndarray:
     return np.zeros(NUM_RESOURCES, dtype=np.float64)
 
 
-def make(cpu_milli: float = 0.0, memory: float = 0.0, gpu_milli: float = 0.0) -> np.ndarray:
-    return np.array([cpu_milli, memory, gpu_milli], dtype=np.float64)
+def make(
+    cpu_milli: float = 0.0,
+    memory: float = 0.0,
+    gpu_milli: float = 0.0,
+    attach: float = 0.0,
+) -> np.ndarray:
+    return np.array([cpu_milli, memory, gpu_milli, attach], dtype=np.float64)
 
 
 def is_empty(r: np.ndarray) -> bool:
@@ -90,7 +104,8 @@ def share(alloc: float, total: float) -> float:
 
 def dominant_share(alloc: np.ndarray, total: np.ndarray) -> float:
     """DRF dominant share: max_r share(alloc_r, total_r) (drf.go:150-160)."""
-    return max(share(float(alloc[i]), float(total[i])) for i in range(NUM_RESOURCES))
+    # DRF dominance is over the reference's resource set only
+    return max(share(float(alloc[i]), float(total[i])) for i in range(NUM_FAIR_RESOURCES))
 
 
 def res_min(a: np.ndarray, b: np.ndarray) -> np.ndarray:

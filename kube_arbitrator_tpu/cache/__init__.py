@@ -10,6 +10,8 @@ from .sim import (
     generate_cluster,
 )
 from .snapshot import Snapshot, SnapshotIndex, SnapshotTensors, build_snapshot
+from .fakeapi import FakeApiServer, ApiError
+from .live import LiveCache
 
 __all__ = [
     "BindFailure",
@@ -20,6 +22,9 @@ __all__ = [
     "FakeVolumeBinder",
     "SimCluster",
     "generate_cluster",
+    "FakeApiServer",
+    "ApiError",
+    "LiveCache",
     "Snapshot",
     "SnapshotIndex",
     "SnapshotTensors",

@@ -1,0 +1,200 @@
+"""In-memory fake Kubernetes apiserver: the list/watch + actuation test
+double for the live-cluster plane.
+
+Plays the role the apiserver plays for the reference's generated clientset
+and informers (``pkg/client/``, ``pkg/scheduler/cache/cache.go:225-306``):
+an object store per resource kind with monotonically increasing resource
+versions, pull-based watch streams, and the three actuation verbs the
+scheduler issues — POST pod binding (``cache.go:88-104`` DefaultBinder),
+DELETE pod (``:106-123`` DefaultEvictor), PUT PodGroup status (``:665-675``
+StatusUpdater).  Objects are plain JSON-shaped dicts, so a recorded event
+log round-trips through JSONL for watch-stream replay fixtures.
+"""
+from __future__ import annotations
+
+import copy
+import json
+from typing import Dict, Iterable, List, Optional, Tuple
+
+RESOURCES = (
+    "pods",
+    "nodes",
+    "podgroups",
+    "queues",
+    "namespaces",
+    "pdbs",
+)
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+class ApiError(RuntimeError):
+    """A failed REST call (non-2xx) — triggers the caller's errTasks
+    resync path, like a failed POST bind (cache.go:519-547)."""
+
+
+def _key(obj: dict) -> Tuple[str, str]:
+    md = obj.get("metadata", {})
+    return md.get("namespace", ""), md["name"]
+
+
+class FakeApiServer:
+    """Object store + event log.  Watches are pull-based: a client asks for
+    events after a resourceVersion; the informer pump drains them."""
+
+    def __init__(self) -> None:
+        self._store: Dict[str, Dict[Tuple[str, str], dict]] = {r: {} for r in RESOURCES}
+        self._rv = 0
+        # (rv, resource, type, object-copy)
+        self.event_log: List[Tuple[int, str, str, dict]] = []
+        # failure injection: uids whose bind/delete/status calls raise
+        self.fail_bind_uids: set = set()
+        self.fail_delete_uids: set = set()
+        # kubelet emulation: POST bind also moves the pod to Running,
+        # producing the MODIFIED watch event a real cluster would
+        self.auto_run_bound_pods = True
+
+    # ---- REST verbs ----
+
+    def _bump(self, resource: str, etype: str, obj: dict) -> None:
+        self._rv += 1
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+        self.event_log.append((self._rv, resource, etype, copy.deepcopy(obj)))
+
+    def create(self, resource: str, obj: dict) -> dict:
+        k = _key(obj)
+        if k in self._store[resource]:
+            raise ApiError(f"{resource} {k} already exists")
+        obj = copy.deepcopy(obj)
+        self._store[resource][k] = obj
+        self._bump(resource, ADDED, obj)
+        return copy.deepcopy(obj)
+
+    def update(self, resource: str, obj: dict) -> dict:
+        k = _key(obj)
+        if k not in self._store[resource]:
+            raise ApiError(f"{resource} {k} not found")
+        obj = copy.deepcopy(obj)
+        self._store[resource][k] = obj
+        self._bump(resource, MODIFIED, obj)
+        return copy.deepcopy(obj)
+
+    def delete(self, resource: str, namespace: str, name: str) -> None:
+        k = (namespace, name)
+        obj = self._store[resource].pop(k, None)
+        if obj is None:
+            raise ApiError(f"{resource} {k} not found")
+        self._bump(resource, DELETED, obj)
+
+    def get(self, resource: str, namespace: str, name: str) -> Optional[dict]:
+        obj = self._store[resource].get((namespace, name))
+        return copy.deepcopy(obj) if obj is not None else None
+
+    def list(self, resource: str) -> Tuple[List[dict], int]:
+        """LIST: (items, resourceVersion to watch from)."""
+        return [copy.deepcopy(o) for o in self._store[resource].values()], self._rv
+
+    def watch(self, resource: str, since_rv: int) -> List[Tuple[int, str, dict]]:
+        """Pull the (rv, type, object) events for ``resource`` after
+        ``since_rv`` — one informer pump's worth."""
+        return [
+            (rv, etype, copy.deepcopy(obj))
+            for rv, r, etype, obj in self.event_log
+            if r == resource and rv > since_rv
+        ]
+
+    def watch_all(self, since_rv: int) -> List[Tuple[int, str, str, dict]]:
+        """All resources' events after ``since_rv`` in global rv order — a
+        single-threaded stand-in for concurrent per-resource informers that
+        preserves causal order (a pod's bind never precedes its node)."""
+        return [
+            (rv, r, etype, copy.deepcopy(obj))
+            for rv, r, etype, obj in self.event_log
+            if rv > since_rv
+        ]
+
+    # ---- actuation subresources ----
+
+    def bind_pod(self, namespace: str, name: str, node_name: str) -> None:
+        """POST /api/v1/namespaces/{ns}/pods/{name}/binding
+        (DefaultBinder, cache.go:88-104)."""
+        pod = self._store["pods"].get((namespace, name))
+        if pod is None:
+            raise ApiError(f"pod {namespace}/{name} not found")
+        if pod.get("metadata", {}).get("uid") in self.fail_bind_uids:
+            raise ApiError(f"bind {namespace}/{name} injected failure")
+        if pod.get("spec", {}).get("nodeName"):
+            raise ApiError(f"pod {namespace}/{name} already bound")
+        pod.setdefault("spec", {})["nodeName"] = node_name
+        self._bump("pods", MODIFIED, pod)
+        if self.auto_run_bound_pods:
+            pod.setdefault("status", {})["phase"] = "Running"
+            self._bump("pods", MODIFIED, pod)
+
+    def evict_pod(self, namespace: str, name: str) -> None:
+        """DELETE pod (DefaultEvictor, cache.go:106-123)."""
+        pod = self._store["pods"].get((namespace, name))
+        if pod is None:
+            raise ApiError(f"pod {namespace}/{name} not found")
+        if pod.get("metadata", {}).get("uid") in self.fail_delete_uids:
+            raise ApiError(f"evict {namespace}/{name} injected failure")
+        self.delete("pods", namespace, name)
+
+    def update_pod_condition(self, namespace: str, name: str, condition: dict) -> None:
+        """PATCH a pod status condition (StatusUpdater.UpdatePodCondition,
+        cache.go:125-142): replaces the condition of the same type."""
+        pod = self._store["pods"].get((namespace, name))
+        if pod is None:
+            raise ApiError(f"pod {namespace}/{name} not found")
+        conds = pod.setdefault("status", {}).setdefault("conditions", [])
+        conds[:] = [c for c in conds if c.get("type") != condition.get("type")]
+        conds.append(copy.deepcopy(condition))
+        self._bump("pods", MODIFIED, pod)
+
+    def update_podgroup_status(self, namespace: str, name: str, status: dict) -> dict:
+        """PUT /status on a PodGroup (StatusUpdater, cache.go:665-675)."""
+        pg = self._store["podgroups"].get((namespace, name))
+        if pg is None:
+            raise ApiError(f"podgroup {namespace}/{name} not found")
+        pg["status"] = copy.deepcopy(status)
+        self._bump("podgroups", MODIFIED, pg)
+        return copy.deepcopy(pg)
+
+    # ---- recorded watch streams ----
+
+    def dump_stream(self, path: str) -> None:
+        """Serialize the full event log as JSONL for replay fixtures."""
+        with open(path, "w") as f:
+            for rv, resource, etype, obj in self.event_log:
+                f.write(json.dumps(
+                    {"rv": rv, "resource": resource, "type": etype, "object": obj}
+                ) + "\n")
+
+    @staticmethod
+    def load_stream(path: str) -> List[Tuple[int, str, str, dict]]:
+        out = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                out.append((rec["rv"], rec["resource"], rec["type"], rec["object"]))
+        return out
+
+    @classmethod
+    def from_stream(cls, events: Iterable[Tuple[int, str, str, dict]]) -> "FakeApiServer":
+        """Rebuild a server whose store/log replays a recorded stream —
+        truncation-tolerant: the store reflects a prefix-consistent state."""
+        srv = cls()
+        for rv, resource, etype, obj in events:
+            k = _key(obj)
+            if etype == DELETED:
+                srv._store[resource].pop(k, None)
+            else:
+                srv._store[resource][k] = copy.deepcopy(obj)
+            srv._rv = max(srv._rv, rv)
+            srv.event_log.append((rv, resource, etype, copy.deepcopy(obj)))
+        return srv

@@ -1,0 +1,568 @@
+"""Live-cluster cache: list/watch ingestion + apiserver actuation.
+
+The analog of the reference's informer-driven ``SchedulerCache``
+(``pkg/scheduler/cache/cache.go:225-306`` wires 9 informers with filtered
+handlers; ``event_handlers.go`` mutates the in-memory model;
+``cache.go:88-165`` actuates through DefaultBinder/DefaultEvictor/
+StatusUpdater).  The TPU-native decision plane is unchanged — this module
+keeps the same ``ClusterInfo`` model the snapshot flattener consumes, and
+presents the same backend surface the :class:`framework.Scheduler` drives
+(``process_resync`` / ``collect_garbage`` / ``apply_binds`` /
+``apply_evicts`` / ``record_event``), so sim and live backends are
+interchangeable.
+
+Differences from a real client-go stack, by design:
+
+* watches are pull-based (the scheduler pumps ``sync()`` at cycle start,
+  the single-threaded equivalent of informer goroutines draining their
+  queues between cycles);
+* the apiserver is any object speaking the verbs of
+  :class:`fakeapi.FakeApiServer` — the in-memory store for tests, a
+  recorded JSONL stream for replay, or a real REST shim later;
+* pod inter-(anti)affinity JSON is not yet translated (node selector,
+  node affinity, tolerations, host ports, and resources are) — the
+  decision plane supports it; the translator gains it with the live REST
+  shim.
+
+Actuation is circular like the real thing: ``apply_binds`` POSTs the
+binding subresource and the model only learns the outcome from the watch
+events the next ``sync()`` drains (with the fake server's kubelet
+emulation moving bound pods to Running).  A failed POST/DELETE diverts the
+task uid to the errTasks resync FIFO; ``process_resync`` re-GETs the pod
+and repairs the model (``cache.go:519-547``, ``event_handlers.go:70-88``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time as _time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..api import resource as res
+from ..api.info import (
+    ClusterInfo,
+    JobInfo,
+    MatchExpression,
+    NodeInfo,
+    QueueInfo,
+    Taint,
+    TaskInfo,
+    Toleration,
+)
+from ..api.types import TaskStatus
+from ..options import options
+from .fakeapi import ADDED, DELETED, MODIFIED, RESOURCES, ApiError, FakeApiServer
+from .sim import BindIntent, Event, EvictIntent
+
+GROUP_ANNOTATION = "scheduling.k8s.io/group-name"  # reference labels.go:20
+
+_MEM_SUFFIX = {
+    "Ki": 1024.0,
+    "Mi": 1024.0**2,
+    "Gi": 1024.0**3,
+    "Ti": 1024.0**4,
+    "K": 1e3,
+    "M": 1e6,
+    "G": 1e9,
+    "T": 1e12,
+}
+
+
+def parse_cpu_milli(q) -> float:
+    """k8s cpu quantity -> millicores ("500m" -> 500, "2" -> 2000)."""
+    if isinstance(q, (int, float)):
+        return float(q) * 1000.0
+    s = str(q)
+    if s.endswith("m"):
+        return float(s[:-1])
+    return float(s) * 1000.0
+
+
+def parse_memory_bytes(q) -> float:
+    if isinstance(q, (int, float)):
+        return float(q)
+    s = str(q)
+    for suf, mult in _MEM_SUFFIX.items():
+        if s.endswith(suf):
+            return float(s[: -len(suf)]) * mult
+    return float(s)
+
+
+def pod_resreq(pod: dict):
+    """Sum of container requests (job_info.go:36-60 GetPodResourceRequest)."""
+    cpu = mem = gpu = 0.0
+    for c in pod.get("spec", {}).get("containers", []):
+        reqs = c.get("resources", {}).get("requests", {})
+        if "cpu" in reqs:
+            cpu += parse_cpu_milli(reqs["cpu"])
+        if "memory" in reqs:
+            mem += parse_memory_bytes(reqs["memory"])
+        if "nvidia.com/gpu" in reqs:
+            gpu += float(reqs["nvidia.com/gpu"]) * 1000.0
+    return res.make(cpu, mem, gpu)
+
+
+def pod_status(pod: dict) -> TaskStatus:
+    """Pod -> TaskStatus (helpers.go:35-61)."""
+    phase = pod.get("status", {}).get("phase", "Pending")
+    node = pod.get("spec", {}).get("nodeName", "")
+    if pod.get("metadata", {}).get("deletionTimestamp") and node:
+        return TaskStatus.RELEASING
+    if phase == "Running":
+        return TaskStatus.RUNNING
+    if phase == "Pending":
+        return TaskStatus.BOUND if node else TaskStatus.PENDING
+    if phase == "Succeeded":
+        return TaskStatus.SUCCEEDED
+    if phase == "Failed":
+        return TaskStatus.FAILED
+    return TaskStatus.UNKNOWN
+
+
+def _match_expressions(terms) -> Tuple[MatchExpression, ...]:
+    out = []
+    for t in terms or []:
+        out.append(
+            MatchExpression(
+                key=t.get("key", ""),
+                operator=t.get("operator", "In"),
+                values=tuple(t.get("values", ())),
+            )
+        )
+    return tuple(out)
+
+
+def pod_to_task(pod: dict, job_uid: str) -> TaskInfo:
+    md = pod.get("metadata", {})
+    spec = pod.get("spec", {})
+    ports = tuple(
+        p["hostPort"]
+        for c in spec.get("containers", [])
+        for p in c.get("ports", [])
+        if "hostPort" in p
+    )
+    node_aff = ()
+    aff = spec.get("affinity", {}).get("nodeAffinity", {})
+    required = aff.get("requiredDuringSchedulingIgnoredDuringExecution", {})
+    terms = required.get("nodeSelectorTerms", [])
+    if terms:
+        # first term's matchExpressions, ANDed (predicates.go:130-141 adapts
+        # the same upstream helper)
+        node_aff = _match_expressions(terms[0].get("matchExpressions"))
+    tolerations = [
+        Toleration(
+            key=t.get("key", ""),
+            operator=t.get("operator", "Equal"),
+            value=t.get("value", ""),
+            effect=t.get("effect", ""),
+        )
+        for t in spec.get("tolerations", [])
+    ]
+    return TaskInfo(
+        uid=md.get("uid") or f"{md.get('namespace', 'default')}/{md['name']}",
+        job_uid=job_uid,
+        name=md["name"],
+        namespace=md.get("namespace", "default"),
+        resreq=pod_resreq(pod),
+        node_name=spec.get("nodeName", ""),
+        status=pod_status(pod),
+        # k8s semantics: unset pod priority means 0 (job_info.go:66-70
+        # reads *pod.Spec.Priority only when present)
+        priority=int(spec.get("priority") or 0),
+        node_selector=dict(spec.get("nodeSelector", {})),
+        node_affinity=node_aff,
+        tolerations=tolerations,
+        host_ports=ports,
+        labels=dict(md.get("labels", {})),
+    )
+
+
+def node_to_info(node: dict) -> NodeInfo:
+    md = node.get("metadata", {})
+    st = node.get("status", {})
+    alloc = st.get("allocatable", st.get("capacity", {}))
+    cpu = parse_cpu_milli(alloc.get("cpu", 0))
+    mem = parse_memory_bytes(alloc.get("memory", 0))
+    gpu = float(alloc.get("nvidia.com/gpu", 0)) * 1000.0
+    taints = [
+        Taint(key=t.get("key", ""), value=t.get("value", ""), effect=t.get("effect", ""))
+        for t in node.get("spec", {}).get("taints", [])
+    ]
+    return NodeInfo(
+        name=md["name"],
+        allocatable=res.make(cpu, mem, gpu),
+        capability=res.make(cpu, mem, gpu),
+        max_tasks=int(alloc.get("pods", 110)),
+        labels=dict(md.get("labels", {})),
+        taints=taints,
+        unschedulable=bool(node.get("spec", {}).get("unschedulable", False)),
+    )
+
+
+def _job_uid_for_pod(pod: dict) -> str:
+    """Job identity resolution: PodGroup annotation, then ownerReference,
+    then the pod itself (apis/utils/utils.go:18-34 GetController fallback)."""
+    md = pod.get("metadata", {})
+    ns = md.get("namespace", "default")
+    group = md.get("annotations", {}).get(GROUP_ANNOTATION)
+    if group:
+        return f"{ns}/{group}"
+    owners = md.get("ownerReferences", [])
+    if owners:
+        return f"{ns}/owner-{owners[0].get('uid') or owners[0].get('name')}"
+    return f"{ns}/pod-{md.get('uid') or md['name']}"
+
+
+class LiveCache:
+    """Cluster model fed by list/watch; actuation through the apiserver.
+
+    Drop-in backend for :class:`framework.Scheduler` (same duck-typed
+    surface as :class:`SimCluster`)."""
+
+    def __init__(self, api: FakeApiServer):
+        self.api = api
+        self.cluster = ClusterInfo()
+        self.events: List[Event] = []
+        self.resync_queue: List[str] = []
+        self._watch_rv = 0
+        self._listed = False
+        # task uid -> (namespace, pod name) for actuation verbs
+        self._pod_ref: Dict[str, Tuple[str, str]] = {}
+        # job uid -> (namespace, podgroup name) for status write-back
+        self._pg_ref: Dict[str, Tuple[str, str]] = {}
+        self._deleted_jobs: List[Tuple[str, float]] = []
+        self._task_by_uid: Dict[str, TaskInfo] = {}
+        self._other_by_uid: Dict[str, TaskInfo] = {}
+
+    # ---- informer pump ----
+
+    # LIST order puts pods last so their nodes/queues/groups exist first;
+    # the WATCH phase preserves the apiserver's global event order instead
+    # (a real informer set gives no cross-resource ordering; nodes-first
+    # list + placeholder nodes cover the gap like event_handlers.go's
+    # auto-created empty NodeInfo).
+    _LIST_ORDER = ("nodes", "queues", "namespaces", "podgroups", "pdbs", "pods")
+
+    def sync(self) -> int:
+        """One pump: initial LIST then incremental WATCH; returns events
+        applied (WaitForCacheSync + handler goroutines, cache.go:311-351,
+        single-threaded)."""
+        n = 0
+        if not self._listed:
+            for resource in self._LIST_ORDER:
+                items, rv = self.api.list(resource)
+                for obj in items:
+                    self._dispatch(resource, ADDED, obj)
+                    n += 1
+                self._watch_rv = max(self._watch_rv, rv)
+            self._listed = True
+            return n
+        for rv, resource, etype, obj in self.api.watch_all(self._watch_rv):
+            self._dispatch(resource, etype, obj)
+            self._watch_rv = rv
+            n += 1
+        return n
+
+    def _dispatch(self, resource: str, etype: str, obj: dict) -> None:
+        handler = {
+            "pods": self._on_pod,
+            "nodes": self._on_node,
+            "podgroups": self._on_podgroup,
+            "queues": self._on_queue,
+            "namespaces": self._on_namespace,
+            "pdbs": self._on_pdb,
+        }[resource]
+        handler(etype, obj)
+
+    # ---- handlers (event_handlers.go) ----
+
+    def _remove_task(self, uid: str) -> None:
+        t = self._task_by_uid.pop(uid, None)
+        if t is not None:
+            if t.node_name and t.node_name in self.cluster.nodes:
+                node = self.cluster.nodes[t.node_name]
+                if uid in node.tasks:
+                    node.remove_task(t)
+            job = self.cluster.jobs.get(t.job_uid)
+            if job is not None:
+                job.tasks.pop(uid, None)
+        o = self._other_by_uid.pop(uid, None)
+        if o is not None:
+            if o.node_name and o.node_name in self.cluster.nodes:
+                node = self.cluster.nodes[o.node_name]
+                if uid in node.tasks:
+                    node.remove_task(o)
+            self.cluster.others = [x for x in self.cluster.others if x.uid != uid]
+
+    def _host_task(self, t: TaskInfo) -> None:
+        """Account the task on its node; a node the informer has not
+        delivered yet gets an empty placeholder (event_handlers.go's
+        auto-created NodeInfo) whose accounting is skipped until the real
+        node object re-hosts its tasks."""
+        node = self.cluster.nodes.get(t.node_name)
+        if node is None:
+            node = NodeInfo(name=t.node_name)
+            self.cluster.nodes[t.node_name] = node
+        try:
+            node.add_task(t)
+        except ValueError as err:
+            # overcommitted or placeholder node: keep the task in the model
+            # without node accounting; the node update re-hosts it
+            self.record_event("Unschedulable", t.uid, "NodeOvercommit", str(err))
+
+    def _on_pod(self, etype: str, pod: dict) -> None:
+        md = pod.get("metadata", {})
+        uid = md.get("uid") or f"{md.get('namespace', 'default')}/{md['name']}"
+        # updatePod == deletePod + addPod (event_handlers.go:190-210)
+        self._remove_task(uid)
+        if etype == DELETED:
+            self._pod_ref.pop(uid, None)
+            return
+        spec = pod.get("spec", {})
+        responsible = spec.get("schedulerName", "") == options().scheduler_name
+        assigned = bool(spec.get("nodeName"))
+        status = pod_status(pod)
+        terminal = status in (TaskStatus.SUCCEEDED, TaskStatus.FAILED)
+        # informer filter (cache.go:254-272): our pods always; other
+        # schedulers' pods only while assigned and non-terminated
+        if not responsible and not (assigned and not terminal):
+            return
+        if responsible:
+            job_uid = _job_uid_for_pod(pod)
+            job = self.cluster.jobs.get(job_uid)
+            if job is None:
+                # shadow job until its PodGroup arrives (SetPodGroup's
+                # queue resolution, job_info.go:166-186)
+                ns = md.get("namespace", "default")
+                queue = ns if options().namespace_as_queue else options().default_queue
+                job = JobInfo(uid=job_uid, name=job_uid, namespace=ns, queue_uid=queue)
+                self.cluster.jobs[job_uid] = job
+            t = pod_to_task(pod, job_uid)
+            job.add_task(t)
+            job.priority = max(job.priority, t.priority)
+            if t.node_name:
+                self._host_task(t)
+            self._task_by_uid[uid] = t
+            self._pod_ref[uid] = (t.namespace, md["name"])
+        else:
+            t = pod_to_task(pod, "")
+            self.cluster.others.append(t)
+            self._host_task(t)
+            self._other_by_uid[uid] = t
+
+    def _on_node(self, etype: str, node_obj: dict) -> None:
+        name = node_obj["metadata"]["name"]
+        old = self.cluster.nodes.get(name)
+        if etype == DELETED:
+            if old is not None:
+                del self.cluster.nodes[name]
+            return
+        fresh = node_to_info(node_obj)
+        # re-host existing tasks, then adopt tasks that referenced this
+        # node before it was listed; an overcommit (node shrank below its
+        # hosted usage, or placeholder adoption raced) must not kill the
+        # watch loop — the task stays in the model without node accounting
+        # and the next update re-hosts it (same tolerance as _host_task)
+        hostees = list(old.tasks.values()) if old is not None else []
+        for t in list(self._task_by_uid.values()) + list(self._other_by_uid.values()):
+            if t.node_name == name and t.uid not in {x.uid for x in hostees}:
+                hostees.append(t)
+        for t in hostees:
+            try:
+                fresh.add_task(t)
+            except ValueError as err:
+                self.record_event("Unschedulable", t.uid, "NodeOvercommit", str(err))
+        self.cluster.nodes[name] = fresh
+
+    def _on_podgroup(self, etype: str, pg: dict) -> None:
+        md = pg.get("metadata", {})
+        ns = md.get("namespace", "default")
+        job_uid = f"{ns}/{md['name']}"
+        if etype == DELETED:
+            self._pg_ref.pop(job_uid, None)
+            self._deleted_jobs.append((job_uid, _time.time()))
+            return
+        job = self.cluster.jobs.get(job_uid)
+        if job is None:
+            job = JobInfo(uid=job_uid, name=md["name"], namespace=ns)
+            self.cluster.jobs[job_uid] = job
+        spec = pg.get("spec", {})
+        job.name = md["name"]
+        job.min_available = int(spec.get("minMember", 0))
+        # queue resolution (job_info.go:166-186): PodGroup queue >
+        # namespace-as-queue > --default-queue
+        if spec.get("queue"):
+            job.queue_uid = spec["queue"]
+        elif options().namespace_as_queue:
+            job.queue_uid = ns
+        else:
+            job.queue_uid = options().default_queue
+        ts = md.get("creationTimestamp")
+        if isinstance(ts, (int, float)):
+            job.creation_ts = float(ts)
+        self._pg_ref[job_uid] = (ns, md["name"])
+
+    def _on_queue(self, etype: str, q: dict) -> None:
+        if options().namespace_as_queue:
+            return  # namespaces back the queues instead (cache.go:290-306)
+        name = q["metadata"]["name"]
+        if etype == DELETED:
+            self.cluster.queues.pop(name, None)
+            return
+        self.cluster.queues[name] = QueueInfo(
+            uid=name, name=name, weight=int(q.get("spec", {}).get("weight", 1))
+        )
+
+    def _on_namespace(self, etype: str, ns_obj: dict) -> None:
+        if not options().namespace_as_queue:
+            return
+        name = ns_obj["metadata"]["name"]
+        if etype == DELETED:
+            self.cluster.queues.pop(name, None)
+            return
+        # namespace-as-queue: weight fixed at 1 (cache.go:290-306)
+        self.cluster.queues[name] = QueueInfo(uid=name, name=name, weight=1)
+
+    def _on_pdb(self, etype: str, pdb: dict) -> None:
+        md = pdb.get("metadata", {})
+        ns = md.get("namespace", "default")
+        job_uid = f"{ns}/{md['name']}"
+        if etype == DELETED:
+            job = self.cluster.jobs.get(job_uid)
+            if job is not None:
+                job.unset_pdb()
+            return
+        from ..api.info import PDBInfo
+
+        job = self.cluster.jobs.get(job_uid)
+        if job is None:
+            job = JobInfo(uid=job_uid, namespace=ns)
+            self.cluster.jobs[job_uid] = job
+        job.set_pdb(
+            PDBInfo(
+                name=md["name"],
+                namespace=ns,
+                min_available=int(pdb.get("spec", {}).get("minAvailable", 0)),
+            ),
+            default_queue=options().default_queue,
+        )
+
+    # ---- Scheduler backend surface ----
+
+    def record_event(self, kind: str, object_uid: str, reason: str, message: str = "") -> None:
+        self.events.append(Event(kind=kind, object_uid=object_uid, reason=reason, message=message))
+
+    def apply_binds(self, binds: Sequence[BindIntent]) -> None:
+        """POST the binding subresource per intent (async goroutine in the
+        reference, cache.go:437-444); failures divert to the resync FIFO."""
+        for b in binds:
+            ref = self._pod_ref.get(b.task_uid)
+            if ref is None:
+                continue  # pod vanished between snapshot and actuation
+            try:
+                self.api.bind_pod(ref[0], ref[1], b.node_name)
+            except ApiError as err:
+                self._defer_resync(b.task_uid, "Bind", str(err))
+
+    def apply_evicts(self, evicts: Sequence[EvictIntent]) -> None:
+        for e in evicts:
+            ref = self._pod_ref.get(e.task_uid)
+            if ref is None:
+                continue
+            try:
+                self.api.evict_pod(ref[0], ref[1])
+            except ApiError as err:
+                self._defer_resync(e.task_uid, "Evict", str(err))
+                continue
+            self.record_event("Evict", e.task_uid, "Evict")
+
+    def update_job_status(self, job_uid: str, status) -> None:
+        """PUT PodGroup status (closeSession write-back,
+        session.go:130-144 -> cache.go:665-675)."""
+        ref = self._pg_ref.get(job_uid)
+        if ref is None:
+            return
+        # wire phase strings per v1alpha1/types.go:28-39
+        phase_name = getattr(status.phase, "name", str(status.phase)).capitalize()
+        payload = {
+            "phase": phase_name,
+            "running": status.running,
+            "succeeded": status.succeeded,
+            "failed": status.failed,
+            "conditions": [
+                {
+                    "type": c.type,
+                    "status": c.status,
+                    "reason": c.reason,
+                    "message": c.message,
+                }
+                for c in status.conditions
+            ],
+        }
+        try:
+            self.api.update_podgroup_status(ref[0], ref[1], payload)
+        except ApiError:
+            pass  # status write-back is best-effort (reference logs only)
+
+    def update_pod_condition(self, task_uid: str, message: str) -> None:
+        """PATCH PodScheduled=False + reason onto the pod
+        (taskUnschedulable, cache.go:456-474)."""
+        ref = self._pod_ref.get(task_uid)
+        if ref is None:
+            return
+        try:
+            self.api.update_pod_condition(
+                ref[0],
+                ref[1],
+                {
+                    "type": "PodScheduled",
+                    "status": "False",
+                    "reason": "Unschedulable",
+                    "message": message,
+                },
+            )
+        except ApiError:
+            pass  # condition write-back is best-effort (reference logs only)
+
+    def _defer_resync(self, task_uid: str, op: str, message: str) -> None:
+        self.resync_queue.append(task_uid)
+        self.record_event("FailedScheduling", task_uid, op, message)
+
+    def process_resync(self) -> int:
+        """Pump the watch plane, then drain errTasks by re-GETting each pod
+        and re-syncing it into the model (cache.go:519-547)."""
+        self.sync()
+        repaired = 0
+        queue, self.resync_queue = self.resync_queue, []
+        for uid in queue:
+            ref = self._pod_ref.get(uid)
+            if ref is None:
+                continue
+            pod = self.api.get("pods", ref[0], ref[1])
+            if pod is None:
+                self._remove_task(uid)
+                self._pod_ref.pop(uid, None)
+            else:
+                self._on_pod(MODIFIED, pod)
+            repaired += 1
+        return repaired
+
+    def collect_garbage(self, now: Optional[float] = None, delay_s: float = 5.0) -> List[str]:
+        """Deferred job GC (cache.go:476-517): a deleted PodGroup's job is
+        removed once its delay elapsed and no live tasks remain."""
+        now = now if now is not None else _time.time()
+        keep: List[Tuple[str, float]] = []
+        collected: List[str] = []
+        terminal = {TaskStatus.SUCCEEDED, TaskStatus.FAILED, TaskStatus.UNKNOWN}
+        for uid, ts in self._deleted_jobs:
+            job = self.cluster.jobs.get(uid)
+            if job is None:
+                continue
+            if now - ts < delay_s or any(
+                t.status not in terminal for t in job.tasks.values()
+            ):
+                keep.append((uid, ts))
+                continue
+            del self.cluster.jobs[uid]
+            collected.append(uid)
+        self._deleted_jobs = keep
+        return collected

@@ -9,7 +9,8 @@
 //
 // Exposed as a C ABI for ctypes (no pybind11 in this environment).
 // Units follow the device convention: resources are [cpu_milli, mem_MiB,
-// gpu_milli] float32; the epsilon is uniformly 10.0 (resource_info.go:54-56).
+// gpu_milli, attach_x100] float32; the epsilon is uniformly 10.0
+// (resource_info.go:54-56; attachments scale x100 so 10.0 = 0.1 volume).
 //
 // Status lattice values match api/types.py (TaskStatus).
 
@@ -23,7 +24,7 @@
 
 namespace {
 
-constexpr int R = 3;
+constexpr int R = 4;
 constexpr float EPS = 10.0f;
 constexpr int PORT_WORDS = 2;
 constexpr int MAX_PORTS = PORT_WORDS * 31;

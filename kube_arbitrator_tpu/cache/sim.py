@@ -18,6 +18,7 @@ import numpy as np
 
 from ..api import resource as res
 from ..api.info import (
+    ZONE_LABEL,
     ClusterInfo,
     JobInfo,
     MatchExpression,
@@ -87,18 +88,38 @@ class FakeEvictor:
 
 @dataclasses.dataclass
 class FakeVolumeBinder:
-    """VolumeBinder seam (cache/interface.go:67-76: AllocateVolumes /
-    BindVolumes before every dispatch, session.go:295-316).  The default is
-    a no-op, like the reference with no PVCs; tests inject failures."""
+    """VolumeBinder (cache/interface.go:67-76: AllocateVolumes before node
+    accounting, session.go:243-259; BindVolumes at dispatch, :295-316).
+
+    The scheduler already rejects volume-infeasible placements up front —
+    attach counts ride the resreq/allocatable 4th resource axis and PV
+    zone pinning rides the predicate class table — so like the reference's
+    volumebinder this is the actuation-time re-check: zone mismatch or
+    attach-limit overflow (state raced since the snapshot) raises
+    BindFailure, and the caller's gang-atomic batch rollback plus errTasks
+    resync take over.  Tests inject failures via ``fail_*_uids``."""
 
     allocated: List[Tuple[str, str]] = dataclasses.field(default_factory=list)
     bound: List[str] = dataclasses.field(default_factory=list)
     fail_allocate_uids: set = dataclasses.field(default_factory=set)
     fail_bind_uids: set = dataclasses.field(default_factory=set)
+    # wired by SimCluster so the re-checks can read live cluster state
+    sim: Optional["SimCluster"] = None
 
     def allocate_volumes(self, task_uid: str, node_name: str) -> None:
         if task_uid in self.fail_allocate_uids:
             raise BindFailure(f"volume allocate {task_uid} failed")
+        if self.sim is not None:
+            task = self.sim.cluster.task_by_uid(task_uid)
+            node = self.sim.cluster.nodes.get(node_name)
+            if task is not None and node is not None:
+                zone = node.labels.get(ZONE_LABEL, "")
+                if task.volume_zone and task.volume_zone != zone:
+                    raise BindFailure(
+                        f"volume zone {task.volume_zone} != node zone {zone or '<none>'}"
+                    )
+                if task.resreq[res.ATTACH] > node.idle[res.ATTACH] + res.EPSILON[res.ATTACH]:
+                    raise BindFailure(f"attach limit exceeded on {node_name}")
         self.allocated.append((task_uid, node_name))
 
     def bind_volumes(self, task_uid: str) -> None:
@@ -114,14 +135,21 @@ class SimCluster:
         self.cluster = ClusterInfo()
         self.binder = FakeBinder()
         self.evictor = FakeEvictor()
-        self.volume_binder = FakeVolumeBinder()
+        self.volume_binder = FakeVolumeBinder(sim=self)
         self.events: List[Event] = []  # record.EventRecorder equivalent
+        # task uid -> PodScheduled=False message (taskUnschedulable channel)
+        self.pod_conditions: Dict[str, str] = {}
         self._task_counter = 0
         # errTasks FIFO: binds/evicts whose backend call failed; a resync
         # pass re-reads the source of truth and repairs (cache.go:519-547)
         self.resync_queue: List[str] = []
         # deferred job GC FIFO (cache.go:476-517): (job uid, deletion ts)
         self._deleted_jobs: List[Tuple[str, float]] = []
+
+    def update_pod_condition(self, task_uid: str, message: str) -> None:
+        """Record the PodScheduled=False condition (the fakeStatusUpdater
+        analog of cache.go:456-474's taskUnschedulable)."""
+        self.pod_conditions[task_uid] = message
 
     def record_event(self, kind: str, object_uid: str, reason: str, message: str = "") -> None:
         self.events.append(Event(kind, object_uid, reason, message))
@@ -175,10 +203,11 @@ class SimCluster:
         labels: Optional[Dict[str, str]] = None,
         taints: Sequence[Taint] = (),
         unschedulable: bool = False,
+        attach_limit: int = 40,
     ) -> NodeInfo:
         n = NodeInfo(
             name=name,
-            allocatable=res.make(cpu_milli, memory, gpu_milli),
+            allocatable=res.make(cpu_milli, memory, gpu_milli, attach_limit),
             max_tasks=max_tasks,
             labels=dict(labels or {}),
             taints=list(taints),
@@ -263,6 +292,8 @@ class SimCluster:
         host_ports: Sequence[int] = (),
         labels: Optional[Dict[str, str]] = None,
         affinity: Sequence["PodAffinityTerm"] = (),
+        volumes: int = 0,
+        volume_zone: str = "",
     ) -> TaskInfo:
         self._task_counter += 1
         uid = name or f"{job.uid}-task-{self._task_counter:06d}"
@@ -271,7 +302,8 @@ class SimCluster:
             job_uid=job.uid,
             name=uid,
             namespace=job.namespace,
-            resreq=res.make(cpu_milli, memory, gpu_milli),
+            resreq=res.make(cpu_milli, memory, gpu_milli, volumes),
+            volume_zone=volume_zone,
             status=status,
             node_name=node,
             priority=priority,

@@ -34,13 +34,16 @@ import jax
 import numpy as np
 
 from ..api import resource as res
-from ..api.info import ClusterInfo, JobInfo, NodeInfo, QueueInfo, TaskInfo
+from ..api.info import ZONE_LABEL, ClusterInfo, JobInfo, NodeInfo, QueueInfo, TaskInfo
 from ..api.types import TaskStatus
 
 # Device-side units per resource axis: cpu milli (x1), memory bytes -> MiB,
-# gpu milli (x1).
-DEVICE_SCALE = np.array([1.0, 1.0 / (1024.0 * 1024.0), 1.0], dtype=np.float64)
-# In device units the epsilon is uniform (10m cpu / 10MiB / 10m gpu).
+# gpu milli (x1), volume attachments (x100 so the uniform epsilon is a
+# tenth of a volume).
+DEVICE_SCALE = np.array(
+    [1.0, 1.0 / (1024.0 * 1024.0), 1.0, 100.0], dtype=np.float64
+)
+# In device units the epsilon is uniform (10m cpu / 10MiB / 10m gpu / 0.1 vol).
 DEVICE_EPSILON = 10.0
 
 MAX_PORT_WORDS = 2  # 31 usable bits per int32 word -> 62 distinct host ports/snapshot
@@ -190,6 +193,7 @@ def _constraint_signature(t: TaskInfo) -> Tuple:
         tuple(sorted(t.node_selector.items())),
         tuple(sorted((e.key, e.operator, e.values) for e in t.node_affinity)),
         tuple(sorted((tl.key, tl.operator, tl.value, tl.effect) for tl in t.tolerations)),
+        t.volume_zone,
     )
 
 
@@ -210,6 +214,16 @@ def _node_affinity_matches(task: TaskInfo, labels: Dict[str, str]) -> bool:
     """Required node-affinity match expressions, ANDed (the
     requiredDuringScheduling half of PodMatchNodeSelector)."""
     return all(e.matches(labels) for e in task.node_affinity)
+
+
+def _volume_zone_matches(task: TaskInfo, node: NodeInfo) -> bool:
+    """PV zone pinning as a predicate class: a task whose bound volumes
+    live in a zone only fits nodes of that zone (the VolumeZone predicate
+    the k8s volumebinder enforces; reference wires it at cache.go:230-238
+    and checks at session.go:243-259 AllocateVolumes)."""
+    if not task.volume_zone:
+        return True
+    return node.labels.get(ZONE_LABEL, "") == task.volume_zone
 
 
 def _tolerates_all(task: TaskInfo, node: NodeInfo) -> bool:
@@ -420,6 +434,7 @@ def build_snapshot(cluster: ClusterInfo) -> Snapshot:
                 _selector_matches(trep.node_selector, nrep.labels)
                 and _node_affinity_matches(trep, nrep.labels)
                 and _tolerates_all(trep, nrep)
+                and _volume_zone_matches(trep, nrep)
             )
 
     # --- pod (anti-)affinity encoding ---

@@ -91,6 +91,22 @@ class Scheduler:
         self.sim.apply_binds(result.binds)
         self.sim.apply_evicts(result.evicts)
         self.job_status.update(result.job_status)  # cache.UpdateJobStatus equivalent
+        # live backends PUT the PodGroup status back to the apiserver
+        # (closeSession -> cache.UpdateJobStatus, session.go:130-144)
+        if hasattr(self.sim, "update_job_status"):
+            for uid, st in result.job_status.items():
+                self.sim.update_job_status(uid, st)
+        # per-pod PodScheduled=False conditions (cache.go:456-474) —
+        # computed only when the backend consumes them, so the close path
+        # of condition-less runs (bench, raw kernels) stays bounded
+        if hasattr(self.sim, "update_pod_condition"):
+            from ..ops.diagnostics import explain_pending_tasks
+
+            result.task_conditions = explain_pending_tasks(
+                result.snapshot, result.decisions
+            )
+            for uid, msg in result.task_conditions.items():
+                self.sim.update_pod_condition(uid, msg)
         # user-facing Unschedulable events (cache.go:637-662 parity),
         # deduplicated like the kube EventRecorder aggregates repeats
         for uid, st in result.job_status.items():

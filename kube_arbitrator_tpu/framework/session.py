@@ -62,6 +62,12 @@ class CycleResult:
     binds: List[BindIntent]
     evicts: List[EvictIntent]
     job_status: Dict[str, PodGroupStatus]
+    # uid -> "why unschedulable" for EVERY unplaced pending pod of every
+    # gang-unready job: the PodScheduled=False condition channel
+    # (cache.go:456-474 taskUnschedulable + :637-662 event messages).
+    # Computed lazily — the close path stays bounded; backends that
+    # consume pod conditions trigger it (Scheduler fills it in)
+    task_conditions: Dict[str, str] = dataclasses.field(default_factory=dict)
     snapshot_ms: float = 0.0
     kernel_ms: float = 0.0
     decode_ms: float = 0.0
@@ -122,6 +128,7 @@ class Session:
 
     def _close(self, snap: Snapshot, dec: CycleDecisions) -> Dict[str, PodGroupStatus]:
         job_ready = np.asarray(dec.job_ready)
+        task_status = np.asarray(dec.task_status)
         statuses: Dict[str, PodGroupStatus] = {}
         now = time.time()
         host = None
@@ -149,30 +156,37 @@ class Session:
                     message=msg,
                     last_transition=now,
                 )
-            statuses[job.uid] = self._job_status(job, unsched_cond)
+            statuses[job.uid] = self._job_status(job, unsched_cond, task_status)
         return statuses
 
     def _job_status(
-        self, job: JobInfo, unsched: Optional[PodGroupCondition]
+        self,
+        job: JobInfo,
+        unsched: Optional[PodGroupCondition],
+        task_status: np.ndarray,
     ) -> PodGroupStatus:
         """session.go:159-197 jobStatus semantics (incl. the strict '>'
-        on minMember)."""
+        on minMember).  Counts come from the SESSION-side statuses
+        (``dec.task_status``): the reference's jobStatus reads the
+        session's TaskStatusIndex, which includes this cycle's Allocated/
+        Pipelined transitions (ssn.Allocate's UpdateTaskStatus) — not the
+        pre-actuation cache state."""
         st = PodGroupStatus()
-        n_running = len(job.tasks_with_status(TaskStatus.RUNNING))
+        ords = [t.ordinal for t in job.tasks.values() if t.ordinal >= 0]
+        sts = [TaskStatus(int(task_status[o])) for o in ords]
+        n_running = sum(1 for x in sts if x == TaskStatus.RUNNING)
         if unsched is not None:
             st.conditions.append(unsched)
         if n_running != 0 and unsched is not None:
             st.phase = PodGroupPhase.UNKNOWN
         else:
-            allocated = sum(
-                1 for t in job.tasks.values() if is_allocated_status(t.status)
-            )
+            allocated = sum(1 for x in sts if is_allocated_status(x))
             st.phase = (
                 PodGroupPhase.RUNNING
                 if allocated > job.min_available
                 else PodGroupPhase.PENDING
             )
         st.running = n_running
-        st.succeeded = len(job.tasks_with_status(TaskStatus.SUCCEEDED))
-        st.failed = len(job.tasks_with_status(TaskStatus.FAILED))
+        st.succeeded = sum(1 for x in sts if x == TaskStatus.SUCCEEDED)
+        st.failed = sum(1 for x in sts if x == TaskStatus.FAILED)
         return st

@@ -40,7 +40,7 @@ import jax.numpy as jnp
 
 from ..api.types import TaskStatus
 from ..cache.snapshot import SnapshotTensors
-from .common import BIG, EPS, ceil_div_pos, dominant_share, lex_argmin, safe_share
+from .common import BIG, EPS, ceil_div_pos, dominant_share, fair, lex_argmin, safe_share
 from .fairness import drf_equilibrium_level, drf_shares, overused, queue_shares
 from .ordering import (
     Tiers,
@@ -168,10 +168,13 @@ def turn_budget(
     # 1 + max_r floor((deserved - alloc - eps)/req_r); resources the
     # group doesn't request keep the queue un-overused forever.
     if queue_clamp:
-        d_minus_a = sess.deserved[q] - state.queue_alloc[q]
+        # proportion's Resource is the fair set only; the attach axis
+        # carries +inf deserved and must not defeat the clamp
+        d_minus_a = fair(sess.deserved[q]) - fair(state.queue_alloc[q])
+        req_f = fair(req)
         f_r = jnp.where(
-            req > 0,
-            jnp.floor((d_minus_a - EPS) / jnp.maximum(req, 1e-30)),
+            req_f > 0,
+            jnp.floor((d_minus_a - EPS) / jnp.maximum(req_f, 1e-30)),
             jnp.where(d_minus_a >= EPS, BIG, -1.0),
         )
         t_max = jnp.max(f_r) + 1.0
@@ -236,8 +239,18 @@ def _node_capacity(
 DEFER_MAX_CELLS = 1 << 25
 
 
-def _use_deferred_decode(st: SnapshotTensors) -> bool:
-    return (not pa_enabled(st)) and st.num_groups * st.num_nodes <= DEFER_MAX_CELLS
+def _use_deferred_decode(st: SnapshotTensors, tiers: Tiers) -> bool:
+    """Deferred decode maps group ranks to nodes in node-ordinal order,
+    which matches the immediate path's slot decode ONLY under first-fit
+    node order; binpack/spread route slots through the per-turn score
+    permutation, so deferring would silently change task->node PAIRING
+    with snapshot size (advisor round-2 finding).  Pod affinity reads
+    per-task placements mid-loop, so it too forces the immediate path."""
+    return (
+        node_order_policy(tiers) == "first_fit"
+        and not pa_enabled(st)
+        and st.num_groups * st.num_nodes <= DEFER_MAX_CELLS
+    )
 
 
 def _process_queue(
@@ -535,7 +548,7 @@ def allocate_action(
     best_effort_pass: bool = False,
 ) -> AllocState:
     """Run rounds until a full round places nothing (queues drained)."""
-    defer = _use_deferred_decode(st)
+    defer = _use_deferred_decode(st, tiers)
 
     def cond(carry):
         s = carry[0] if defer else carry

@@ -10,10 +10,19 @@ from typing import Sequence
 import jax
 import jax.numpy as jnp
 
+from ..api.resource import NUM_FAIR_RESOURCES
 from ..cache.snapshot import DEVICE_EPSILON
 
 EPS = DEVICE_EPSILON
 BIG = jnp.float32(3.0e38)  # effectively +inf for f32 mins
+NUM_FAIR = NUM_FAIR_RESOURCES
+
+
+def fair(x: jnp.ndarray) -> jnp.ndarray:
+    """The fairness view of a resource vector: DRF/proportion read only the
+    reference's resource set (cpu/memory/gpu, resource_info.go:26-40); the
+    trailing capacity axes (volume attachments) are fit-only."""
+    return x[..., :NUM_FAIR]
 
 
 def fits(req: jnp.ndarray, avail: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
@@ -32,8 +41,9 @@ def safe_share(alloc: jnp.ndarray, total: jnp.ndarray) -> jnp.ndarray:
 
 
 def dominant_share(alloc: jnp.ndarray, total: jnp.ndarray) -> jnp.ndarray:
-    """max_r share(alloc_r, total_r); alloc [..., R], total broadcastable."""
-    return jnp.max(safe_share(alloc, total), axis=-1)
+    """max over FAIR resources of share(alloc_r, total_r); alloc [..., R],
+    total broadcastable (DRF dominance excludes capacity-only axes)."""
+    return jnp.max(safe_share(fair(alloc), fair(total)), axis=-1)
 
 
 def lex_argmin(keys: Sequence[jnp.ndarray], mask: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:

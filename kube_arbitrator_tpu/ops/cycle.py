@@ -28,7 +28,7 @@ from .allocate import (
     allocate_action,
     backfill_action,
 )
-from .common import safe_share
+from .common import fair, safe_share
 from .fairness import drf_equilibrium_level, drf_shares, proportion_deserved
 from .ordering import DEFAULT_ACTIONS, DEFAULT_TIERS, Tiers
 from .preempt import preempt_action, reclaim_action
@@ -131,7 +131,7 @@ def open_session(st: SnapshotTensors, tiers: Tiers) -> Tuple[SessionCtx, AllocSt
     job_pending_req = jnp.zeros((J, R)).at[st.task_job].add(res_or_0(pending_now))
     mean_req = job_pending_req / jnp.maximum(job_pending_cnt, 1)[:, None]
     job_share0 = drf_shares(job_alloc, drf_total)
-    job_delta = jnp.max(safe_share(mean_req, drf_total[None, :]), axis=-1)
+    job_delta = jnp.max(safe_share(fair(mean_req), fair(drf_total)[None, :]), axis=-1)
     # actual free capacity (accounts for other schedulers' and running
     # tasks' usage) — λ* must not overestimate the reachable level
     headroom = jnp.sum(jnp.where(nv, st.node_idle, 0.0), axis=0)

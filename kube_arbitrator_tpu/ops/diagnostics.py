@@ -1,20 +1,25 @@
-"""Per-job "why not scheduled" diagnostics.
+"""Per-job and per-pod "why not scheduled" diagnostics.
 
 Reproduces the reference's FitError histogram channel
 (``api/job_info.go:329-358``: per-node fit deltas aggregated into
 "0/3 nodes are available: 2 Insufficient cpu, 1 Insufficient memory" pod
-conditions, surfaced via events in ``cache.go:637-662``).
+conditions, surfaced via events in ``cache.go:637-662``) and the per-pod
+``PodScheduled=False`` condition channel (``cache.go:456-474``
+taskUnschedulable, stamped on every Pending/Allocated task of an
+unschedulable job).
 
 Computed host-side in numpy against the *end-of-cycle* node state carried
 in CycleDecisions (so a node filled by this cycle's own placements reads
 as insufficient, matching what the scheduler actually saw).  A HostView
-caches the device→host transfers so explaining many jobs costs one copy,
-and per-job work is fully vectorized over nodes.
+caches the device→host transfers so explaining many jobs costs one copy;
+the histogram itself is one vectorized pass per batch of (resreq, class,
+ports) rows — pods of the same scheduling group share a message, so the
+per-pod channel costs O(G·N), not O(T·N).
 """
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -65,6 +70,50 @@ class HostView:
         )
 
 
+def _fit_messages(
+    req: np.ndarray,    # f32[k, R] per-row resreq
+    klass: np.ndarray,  # i32[k]
+    ports: np.ndarray,  # i32[k, W]
+    h: HostView,
+) -> List[str]:
+    """FitError histogram messages for ``k`` (resreq, class, ports) rows at
+    once — the single implementation behind both the per-job and the
+    per-pod channels: per node the FIRST failing reason in predicate-chain
+    order is attributed (job_info.go:329-358's reason counts)."""
+    n_nodes = int(h.node_valid.sum())
+    pods_full = h.node_num_tasks >= h.node_max_tasks
+    cf = h.class_fit[klass][:, h.node_klass]                          # [k, N]
+    ports_conflict = (
+        np.bitwise_and(ports[:, None, :], h.node_ports[None, :, :]) != 0
+    ).any(axis=-1)                                                    # [k, N]
+    insufficient = req[:, None, :] >= h.node_idle[None, :, :] + DEVICE_EPSILON
+
+    seen = np.broadcast_to(~h.node_valid, cf.shape).copy()
+    counts = {}
+    for mask, label in (
+        (np.broadcast_to(h.node_unsched, cf.shape), "node(s) were unschedulable"),
+        (~cf, "node(s) didn't match node selector/affinity/taints"),
+        (np.broadcast_to(pods_full, cf.shape), "too many pods"),
+        (ports_conflict, "node(s) had conflicting host ports"),
+    ):
+        hit = mask & ~seen
+        counts[label] = hit.sum(axis=1)
+        seen = seen | hit
+    res_fail = (insufficient & ~seen[:, :, None]).sum(axis=1)         # [k, R]
+    fits = (~seen & ~insufficient.any(axis=-1)).sum(axis=1)
+
+    out = []
+    for i in range(req.shape[0]):
+        reasons = {label: int(c[i]) for label, c in counts.items() if int(c[i])}
+        for r in range(req.shape[1]):
+            if int(res_fail[i, r]):
+                reasons[f"Insufficient {RESOURCE_NAMES[r]}"] = int(res_fail[i, r])
+        parts = [f"{cnt} {reason}" for reason, cnt in sorted(reasons.items())]
+        tail = f": {', '.join(parts)}." if parts else "."
+        out.append(f"{int(fits[i])}/{n_nodes} nodes are available{tail}")
+    return out
+
+
 def explain_job(
     snap: Snapshot, decisions, job_ordinal: int, host: Optional[HostView] = None
 ) -> Optional[str]:
@@ -83,40 +132,12 @@ def explain_job(
     if len(idx) == 0:
         return None
     i = idx[0]
-    req = h.task_resreq[i]
-    klass = int(h.task_klass[i])
-
-    nv = h.node_valid
-    n_nodes = int(nv.sum())
-    class_fit = h.class_fit[klass, h.node_klass]
-    pods_full = h.node_num_tasks >= h.node_max_tasks
-    ports_conflict = (np.bitwise_and(h.task_ports[i][None, :], h.node_ports) != 0).any(axis=-1)
-    insufficient = req[None, :] >= h.node_idle + DEVICE_EPSILON  # (node, resource)
-
-    # first-failing-reason per node, mirroring the predicate chain order
-    reasons: Dict[str, int] = {}
-    seen = ~nv
-    for mask, label in (
-        (h.node_unsched, "node(s) were unschedulable"),
-        (~class_fit, "node(s) didn't match node selector/affinity/taints"),
-        (pods_full, "too many pods"),
-        (ports_conflict, "node(s) had conflicting host ports"),
-    ):
-        hit = mask & ~seen
-        if hit.any():
-            reasons[label] = int(hit.sum())
-        seen = seen | hit
-    res_fail = insufficient & ~seen[:, None]
-    for r in range(req.shape[0]):
-        cnt = int(res_fail[:, r].sum())
-        if cnt:
-            reasons[f"Insufficient {RESOURCE_NAMES[r]}"] = cnt
-    fits = int((~seen & ~insufficient.any(axis=-1)).sum())
-
-    parts = [f"{cnt} {reason}" for reason, cnt in sorted(reasons.items())]
-    if parts:
-        return f"{fits}/{n_nodes} nodes are available: {', '.join(parts)}."
-    return f"{fits}/{n_nodes} nodes are available."
+    return _fit_messages(
+        h.task_resreq[i][None, :],
+        np.asarray([h.task_klass[i]]),
+        h.task_ports[i][None, :],
+        h,
+    )[0]
 
 
 def unschedulable_report(snap: Snapshot, decisions, limit: int = 100) -> Dict[str, str]:
@@ -135,4 +156,56 @@ def unschedulable_report(snap: Snapshot, decisions, limit: int = 100) -> Dict[st
         msg = explain_job(snap, decisions, job.ordinal, host=host)
         if msg:
             out[job.uid] = msg
+    return out
+
+
+def explain_pending_tasks(
+    snap: Snapshot, decisions, group_chunk: int = 256
+) -> Dict[str, str]:
+    """Per-POD "why unschedulable" messages for EVERY unplaced pending or
+    session-Allocated task of every gang-unready job — the parity channel
+    for ``taskUnschedulable`` (cache.go:456-474) and the per-pod event
+    messages (:637-662); the reference's status loop covers both Allocated
+    and Pending tasks (cache.go:654-661).
+
+    Pods of the same scheduling group (job, resreq, class, ports) see the
+    same cluster, so the histogram is computed once per GROUP (chunked
+    [group_chunk, N] passes) and broadcast to member pods.
+    """
+    t = snap.tensors
+    job_ready = np.asarray(decisions.job_ready)
+    task_status1 = np.asarray(decisions.task_status)
+    task_status0 = np.asarray(t.task_status)
+    task_valid = np.asarray(t.task_valid)
+    task_job = np.asarray(t.task_job)
+    task_group = np.asarray(t.task_group)
+
+    unplaced = (
+        task_valid
+        & (task_status0 == int(TaskStatus.PENDING))
+        & (
+            (task_status1 == int(TaskStatus.PENDING))
+            | (task_status1 == int(TaskStatus.ALLOCATED))
+        )
+        & ~job_ready[task_job]
+    )
+    if not unplaced.any():
+        return {}
+
+    group_ids = np.unique(task_group[unplaced & (task_group >= 0)])
+    g_res = np.asarray(t.group_resreq)
+    g_klass = np.asarray(t.group_klass)
+    g_ports = np.asarray(t.group_ports)
+    h = HostView.build(snap, decisions)
+    group_msg: Dict[int, str] = {}
+    for lo in range(0, len(group_ids), group_chunk):
+        gs = group_ids[lo : lo + group_chunk]
+        for g, m in zip(gs, _fit_messages(g_res[gs], g_klass[gs], g_ports[gs], h)):
+            group_msg[int(g)] = m
+
+    out: Dict[str, str] = {}
+    for i in np.nonzero(unplaced)[0]:
+        g = int(task_group[i])
+        if g in group_msg:
+            out[snap.index.tasks[i].uid] = group_msg[g]
     return out

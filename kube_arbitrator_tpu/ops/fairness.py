@@ -22,7 +22,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .common import EPS, dominant_share, is_empty_res
+from .common import BIG, EPS, dominant_share, fair, is_empty_res
 
 
 def drf_shares(job_alloc: jnp.ndarray, total: jnp.ndarray) -> jnp.ndarray:
@@ -41,7 +41,15 @@ def proportion_deserved(
     Runs Q+1 fixed iterations (each iteration either caps >=1 queue at its
     request or consumes the whole remainder, so Q+1 always reaches the
     fixed point); masking replaces the reference's ``meet`` set.
+
+    Only the fair resource axes are water-filled; trailing capacity axes
+    (volume attachments) get +inf deserved — they are never a fairness
+    commodity, so they can neither mark a queue overused nor clamp its
+    turn budgets.
     """
+    R_full = queue_request.shape[1]
+    queue_request = fair(queue_request)
+    total = fair(total)
     Q = queue_weight.shape[0]
     deserved0 = jnp.zeros_like(queue_request)
     remaining0 = total
@@ -67,7 +75,8 @@ def proportion_deserved(
         )
 
     deserved, _, _ = jax.lax.fori_loop(0, Q + 1, body, (deserved0, remaining0, met0))
-    return deserved
+    pad = jnp.full((Q, R_full - deserved.shape[1]), BIG)
+    return jnp.concatenate([deserved, pad], axis=1)
 
 
 def drf_equilibrium_level(
@@ -118,6 +127,6 @@ def queue_shares(queue_alloc: jnp.ndarray, deserved: jnp.ndarray) -> jnp.ndarray
 
 
 def overused(queue_alloc: jnp.ndarray, deserved: jnp.ndarray) -> jnp.ndarray:
-    """[Q] OverusedFn: deserved epsilon-LessEqual allocated
-    (proportion.go:188-193)."""
-    return jnp.all(deserved < queue_alloc + EPS, axis=-1)
+    """[Q] OverusedFn: deserved epsilon-LessEqual allocated over the fair
+    resource set (proportion.go:188-193)."""
+    return jnp.all(fair(deserved) < fair(queue_alloc) + EPS, axis=-1)

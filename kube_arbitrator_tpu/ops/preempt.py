@@ -39,7 +39,7 @@ import jax.numpy as jnp
 from ..api.types import TaskStatus
 from ..cache.snapshot import SnapshotTensors
 from .allocate import AllocState, PIPELINED, SessionCtx, _copies_fit, turn_budget
-from .common import BIG, EPS, lex_argmin, mm_cumsum, safe_share
+from .common import BIG, EPS, fair, lex_argmin, mm_cumsum, safe_share
 from .fairness import drf_shares, queue_shares
 from .ordering import Tiers, group_order_keys, job_order_keys, queue_order_keys
 from .podaffinity import apply_domain_cap, apply_seed, pa_enabled, pod_affinity_fit
@@ -646,7 +646,7 @@ def _reclaim_fast(
         q = perm[qi]
 
         # single-queue OverusedFn row (proportion.go:188-193; fairness.overused)
-        q_over = jnp.all(sess.deserved[q] < state.queue_alloc[q] + EPS)
+        q_over = jnp.all(fair(sess.deserved[q]) < fair(state.queue_alloc[q]) + EPS)
         active = st.queue_valid[q] & (q_entries[q] > 0)
 
         # ---- job pop (JobOrderFn over the queue's unconsumed jobs) ----
@@ -686,7 +686,7 @@ def _reclaim_fast(
         if use_prop:
             _, nq_cum = L_nq.rank_and_cum(cand)
             after = state.queue_alloc[vq] - nq_cum
-            elig = elig & jnp.all(sess.deserved[vq] < after + EPS, axis=-1)
+            elig = elig & jnp.all(fair(sess.deserved[vq]) < fair(after) + EPS, axis=-1)
         if not verdict_names:
             elig = jnp.zeros_like(cand)
         mask_v = elig & (vq != q)

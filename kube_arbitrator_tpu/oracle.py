@@ -19,7 +19,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from .api import resource as res
-from .api.info import ClusterInfo, JobInfo, NodeInfo, TaskInfo
+from .api.info import ZONE_LABEL, ClusterInfo, JobInfo, NodeInfo, TaskInfo
 from .api.types import TaskStatus, is_allocated_status
 from .ops.ordering import DEFAULT_TIERS, Tiers
 
@@ -45,26 +45,31 @@ def _water_fill(
     weights: Dict[str, int], request: Dict[str, np.ndarray], total: np.ndarray
 ) -> Dict[str, np.ndarray]:
     """Proportion deserved fixed point (see ops/fairness.py for the
-    deviation note vs proportion.go:102-144)."""
-    deserved = {q: res.zeros() for q in weights}
+    deviation note vs proportion.go:102-144).  Fair resource axes only;
+    trailing capacity axes (volume attachments) get +inf deserved."""
+    F = res.NUM_FAIR_RESOURCES
+    tail = res.NUM_RESOURCES - F
+    request = {q: r[:F] for q, r in request.items()}
+    total = total[:F]
+    deserved = {q: np.zeros(F) for q in weights}
     remaining = total.copy()
     met: set = set()
     for _ in range(len(weights) + 1):
         active = [q for q in weights if q not in met]
         total_w = sum(weights[q] for q in active)
-        if total_w == 0 or res.is_empty(remaining):
+        if total_w == 0 or bool(np.all(remaining < res.EPSILON[:F])):
             break
-        granted = res.zeros()
+        granted = np.zeros(F)
         for q in active:
             inc = remaining * (weights[q] / total_w)
             new = deserved[q] + inc
-            if not res.less_equal(new, request[q]):
-                new = res.res_min(new, request[q])
+            if not np.all(new < request[q] + res.EPSILON[:F]):
+                new = np.minimum(new, request[q])
                 met.add(q)
             granted += new - deserved[q]
             deserved[q] = new
         remaining = np.maximum(remaining - granted, 0.0)
-    return deserved
+    return {q: np.concatenate([d, np.full(tail, np.inf)]) for q, d in deserved.items()}
 
 
 class SequentialScheduler:
@@ -209,7 +214,10 @@ class SequentialScheduler:
         return res.dominant_share(self.queue_alloc[quid], self.deserved[quid])
 
     def _overused(self, quid: str) -> bool:
-        return res.less_equal(self.deserved[quid], self.queue_alloc[quid])
+        F = res.NUM_FAIR_RESOURCES
+        return bool(np.all(
+            self.deserved[quid][:F] < self.queue_alloc[quid][:F] + res.EPSILON[:F]
+        ))
 
     def _task_key(self, t: TaskInfo):
         key = []
@@ -241,6 +249,8 @@ class SequentialScheduler:
                 return False
         if any(p in self.ports[n.name] for p in t.host_ports):
             return False
+        if t.volume_zone and n.labels.get(ZONE_LABEL, "") != t.volume_zone:
+            return False  # VolumeZone predicate (volumebinder, cache.go:230-238)
         return self._pod_affinity_ok(t, n)
 
     def _pod_affinity_ok(self, t: TaskInfo, n: NodeInfo) -> bool:
@@ -470,7 +480,9 @@ class SequentialScheduler:
                 continue
             rem = rem + t.resreq
             removed[quid] = rem
-            if np.all(self.deserved[quid] < self.queue_alloc[quid] - rem + res.EPSILON):
+            F = res.NUM_FAIR_RESOURCES
+            after = self.queue_alloc[quid] - rem
+            if np.all(self.deserved[quid][:F] < after[:F] + res.EPSILON[:F]):
                 out.append(t)
         return out
 

@@ -26,15 +26,11 @@ NODE_AXIS = "nodes"
 
 
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Any device count is accepted: ``shard_snapshot`` re-pads the node
+    axis to a multiple of the mesh size when the snapshot's 128-bucketed
+    padding does not already divide (e.g. a 256-chip slice over a
+    128-node-padded snapshot)."""
     devs = list(devices) if devices is not None else jax.devices()
-    if 128 % len(devs) != 0:
-        # node bucketing pads to multiples of 128, so even sharding needs a
-        # device count that divides 128 (every TPU slice size does; odd CPU
-        # fleets should round down to a power of two)
-        raise ValueError(
-            f"device count {len(devs)} does not divide the node bucket (128); "
-            f"use a power-of-two subset, e.g. devices[:{2 ** (len(devs).bit_length() - 1)}]"
-        )
     return Mesh(np.array(devs), (NODE_AXIS,))
 
 
@@ -74,9 +70,36 @@ def snapshot_shardings(mesh: Mesh):
     }
 
 
+def pad_nodes(st: SnapshotTensors, multiple: int) -> SnapshotTensors:
+    """Pad the node axis to a multiple of ``multiple`` with invalid
+    (``node_valid=False``) filler nodes — semantics-neutral: every kernel
+    gates on node validity."""
+    n = st.node_idle.shape[0]
+    pad = (-n) % multiple
+    if pad == 0:
+        return st
+    upd = {}
+    for name in _NODE_SHARDED_FIELDS:
+        a = getattr(st, name)
+        widths = ((0, pad),) + ((0, 0),) * (a.ndim - 1)
+        upd[name] = np.pad(np.asarray(a), widths)
+    for name in _NODE_AXIS1_FIELDS:
+        a = np.asarray(getattr(st, name))
+        widths = ((0, 0), (0, pad)) + ((0, 0),) * (a.ndim - 2)
+        # node_dom uses -1 = "no domain"; boolean/int masks pad with 0
+        fill = -1 if name == "node_dom" else 0
+        upd[name] = np.pad(a, widths, constant_values=fill)
+    return dataclasses.replace(st, **upd)
+
+
 def shard_snapshot(st: SnapshotTensors, mesh: Mesh) -> SnapshotTensors:
-    """Device-put a snapshot with node-axis sharding.  Node bucketing pads
-    to multiples of 128, so any mesh of <=128 devices divides evenly."""
+    """Device-put a snapshot with node-axis sharding.  The snapshot's node
+    bucketing pads to multiples of 128; for mesh sizes that do not divide
+    that padding (any count is allowed by :func:`make_mesh`) the node axis
+    is re-padded with invalid nodes to the mesh size first."""
+    n = st.node_idle.shape[0]
+    if n % len(mesh.devices.flat) != 0:
+        st = pad_nodes(st, len(mesh.devices.flat))
     placed = {
         name: jax.device_put(getattr(st, name), s)
         for name, s in snapshot_shardings(mesh).items()

@@ -52,9 +52,10 @@ def initialize_multihost(
 
 
 def global_mesh(devices: Optional[Sequence[jax.Device]] = None):
-    """One node-axis mesh over every device of every host.  The global
-    device count must divide the 128-node bucket (every TPU slice size
-    does); make_mesh raises a clear error otherwise."""
+    """One node-axis mesh over every device of every host.  Any device
+    count works: shard_snapshot re-pads the node axis to the mesh size
+    with invalid filler nodes when the snapshot's 128-bucketed padding
+    does not already divide."""
     return make_mesh(list(devices) if devices is not None else jax.devices())
 
 

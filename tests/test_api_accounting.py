@@ -39,10 +39,10 @@ def test_node_add_remove_task_accounting():
     q = sim.add_queue("default")
     j = sim.add_job("j1")
     t = sim.add_task(j, 2000, 4 * 1024**3, status=TaskStatus.RUNNING, node="n1")
-    np.testing.assert_allclose(n.idle, res.make(6000, 12 * 1024**3, 0))
+    np.testing.assert_allclose(n.idle, res.make(6000, 12 * 1024**3, 0, 40))
     np.testing.assert_allclose(n.used, res.make(2000, 4 * 1024**3, 0))
     n.remove_task(t)
-    np.testing.assert_allclose(n.idle, res.make(8000, 16 * 1024**3, 0))
+    np.testing.assert_allclose(n.idle, res.make(8000, 16 * 1024**3, 0, 40))
     np.testing.assert_allclose(n.used, res.zeros())
 
 
@@ -54,7 +54,7 @@ def test_node_releasing_accounting():
     j = sim.add_job("j1")
     t = sim.add_task(j, 2000, 4 * 1024**3, status=TaskStatus.RELEASING, node="n1")
     np.testing.assert_allclose(n.releasing, res.make(2000, 4 * 1024**3, 0))
-    np.testing.assert_allclose(n.idle, res.make(6000, 12 * 1024**3, 0))
+    np.testing.assert_allclose(n.idle, res.make(6000, 12 * 1024**3, 0, 40))
     # a pipelined task consumes the releasing budget
     t2 = sim.add_task(j, 2000, 4 * 1024**3, status=TaskStatus.PIPELINED, node="n1")
     np.testing.assert_allclose(n.releasing, res.zeros())

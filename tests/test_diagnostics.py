@@ -69,3 +69,51 @@ def test_scheduler_records_events():
     assert "Unschedulable" in kinds
     ev = next(e for e in sim.events if e.kind == "Unschedulable")
     assert ev.object_uid == "gang"
+
+
+def test_every_pod_of_blocked_gang_gets_condition():
+    """VERDICT round-2 #6: the per-pod condition channel must cover EVERY
+    unplaced pending pod of a blocked gang (cache.go:456-474 stamps
+    PodScheduled=False per task), not just the first task of the first 100
+    jobs."""
+    from kube_arbitrator_tpu.cache import SimCluster
+    from kube_arbitrator_tpu.framework import Scheduler
+
+    sim = SimCluster()
+    sim.add_queue("q")
+    sim.add_node("n1", cpu_milli=2000, memory=4 * GB)
+    # gang of 8 x 1cpu on a 2cpu node: can never reach minMember=8
+    j = sim.add_job("gang", queue="q", min_available=8)
+    for i in range(8):
+        sim.add_task(j, 1000, GB // 4, name=f"g-{i}")
+    sched = Scheduler(sim)
+    result = sched.run_once()
+
+    assert set(result.task_conditions) == {f"g-{i}" for i in range(8)}
+    for msg in result.task_conditions.values():
+        assert "nodes are available" in msg and "Insufficient cpu" in msg
+    # the backend recorded them (fake StatusUpdater surface)
+    assert set(sim.pod_conditions) == {f"g-{i}" for i in range(8)}
+
+
+def test_pod_conditions_reach_fake_apiserver():
+    """Live plane: the conditions are PATCHed onto the pod objects."""
+    from kube_arbitrator_tpu.cache import FakeApiServer, LiveCache
+    from kube_arbitrator_tpu.framework import Scheduler
+    from tests.test_live_cache import make_node, make_pod, make_podgroup
+
+    api = FakeApiServer()
+    api.create("nodes", make_node("n0", cpu="1"))
+    api.create("queues", {"metadata": {"name": "default"}, "spec": {"weight": 1}})
+    api.create("podgroups", make_podgroup("pg", min_member=4))
+    for i in range(4):
+        api.create("pods", make_pod(f"p{i}", group="pg", cpu="1"))
+    live = LiveCache(api)
+    Scheduler(live).run_once()
+    for i in range(4):
+        pod = api.get("pods", "default", f"p{i}")
+        conds = pod["status"].get("conditions", [])
+        assert any(
+            c["type"] == "PodScheduled" and c["status"] == "False" and c["message"]
+            for c in conds
+        ), f"p{i} missing PodScheduled condition"

@@ -199,10 +199,16 @@ def test_preemption():
     assert ready_tasks(j1) == rep
     j2 = make_job(sim, "preemptor-qj", "default", rep=rep, minm=1, mem=0)
     history = settle_with_controller(sim, FULL_CONF, max_cycles=8)
-    # rep//2 - 1: the sim's lockstep cycles quantize the exchange one task
-    # coarser than the live cluster's pod-lifecycle slack
+    # the preemptor attains its full fair half; the preemptee's observable
+    # maximum is one task coarser because the sim's lockstep cycles
+    # quantize the exchange (the live cluster's pod lifecycle interleaves)
+    assert max(history[j2.uid]) >= rep // 2, history
     assert max(history[j1.uid]) >= rep // 2 - 1, history
-    assert max(history[j2.uid]) >= rep // 2 - 1, history
+    # invariants at every cycle: the victim job never drops below its gang
+    # floor (gang.go:104-127), and total ready never exceeds capacity
+    assert min(history[j1.uid]) >= j1.min_available, history
+    for a, b in zip(history[j1.uid], history[j2.uid]):
+        assert a + b <= rep
 
 
 def test_multiple_preemption():
@@ -216,9 +222,13 @@ def test_multiple_preemption():
     j2 = make_job(sim, "preemptor-qj1", "default", rep=rep, minm=1, mem=0)
     j3 = make_job(sim, "preemptor-qj2", "default", rep=rep, minm=1, mem=0)
     history = settle_with_controller(sim, FULL_CONF, max_cycles=12)
-    for j in (j1, j2, j3):
-        # same one-task lockstep quantization as test_preemption
-        assert max(history[j.uid]) >= rep // 3 - 1, history
+    # preemptors attain the full fair third; the original job's observable
+    # max is one task coarser (lockstep quantization, as in
+    # test_preemption); the victim never drops below its gang floor
+    for j in (j2, j3):
+        assert max(history[j.uid]) >= rep // 3, history
+    assert max(history[j1.uid]) >= rep // 3 - 1, history
+    assert min(history[j1.uid]) >= j1.min_available, history
 
 
 def test_schedule_best_effort_job():
@@ -375,6 +385,62 @@ def test_reclaim_between_queues():
     assert gang_ready(j1) and ready_tasks(j1) >= rep // 2
     j2 = make_job(sim, "q2-qj-2", "q2", rep=rep, minm=1, mem=0)
     history = settle_with_controller(sim, FULL_CONF, max_cycles=20)
-    expected = rep // 2 - 1  # the e2e's decimal-fraction tolerance
+    expected = rep // 2 - 1  # one task of boundary churn (see below)
     assert history[j2.uid][-1] >= expected, history
     assert history[j1.uid][-1] >= expected, history
+    # Invariant (every cycle once both queues are active): neither queue
+    # drops below deserved minus the one marginal task the reclaim/allocate
+    # exchange churns at the boundary — the reference's own
+    # evict-then-"corrected in next scheduling loop" steady state.  And the
+    # two queues never oversubscribe the cluster.
+    for a, b in zip(history[j1.uid][1:], history[j2.uid][1:]):
+        assert a >= expected and b >= expected, history
+        assert a + b <= rep
+
+
+def test_taint_untaint_node_mid_run():
+    """util.go:746-800 (taintAllNodes / removeTaintsFromAllNodes): taints
+    applied BETWEEN cycles redirect subsequent scheduling away from the
+    tainted node; removing the taint restores it.  Running pods stay (the
+    taint effect is NoSchedule)."""
+    sim = SimCluster()
+    sim.add_queue("default")
+    three_node_cluster(sim)
+    j1 = make_job(sim, "warm", "default", rep=3, minm=3)
+    settle(sim, config=FULL_CONF)
+    assert gang_ready(j1)
+
+    # taint node-2 mid-run (strategic-merge patch analog)
+    taint = Taint(key="test-taint-key", value="taint-val", effect="NoSchedule")
+    sim.cluster.nodes["node-2"].taints.append(taint)
+    j2 = make_job(sim, "after-taint", "default", rep=6, minm=1)
+    settle(sim, config=FULL_CONF)
+    placed_nodes = {t.node_name for t in j2.tasks.values() if t.status in PLACED}
+    assert placed_nodes and "node-2" not in placed_nodes
+
+    # untaint: the remaining pending tasks reach node-2 on the next cycles
+    sim.cluster.nodes["node-2"].taints.clear()
+    j3 = make_job(sim, "after-untaint", "default", rep=3, minm=1)
+    settle(sim, config=FULL_CONF)
+    placed3 = {t.node_name for t in j3.tasks.values() if t.status in PLACED}
+    assert "node-2" in placed3
+
+
+def test_eviction_detected_via_events():
+    """util.go:419-438 waitTasksEvicted detects preemption through Evict
+    EVENTS, not pod polling: the victim pods' eviction must surface on the
+    event channel with their uids."""
+    sim = SimCluster()
+    sim.add_queue("default")
+    rep = three_node_cluster(sim)
+    j1 = make_job(sim, "victim-job", "default", rep=rep, minm=1, mem=0)
+    settle(sim)
+    assert ready_tasks(j1) == rep
+    make_job(sim, "preemptor-job", "default", rep=rep, minm=1, mem=0)
+    settle_with_controller(sim, FULL_CONF, max_cycles=6)
+
+    evict_events = [e for e in sim.events if e.kind == "Evict"]
+    assert evict_events, "no Evict events recorded"
+    # the preempt/recreate exchange may also evict recreated preemptor
+    # pods in later cycles; the victim job's evictions must be observable
+    assert any(e.object_uid.startswith("victim-job") for e in evict_events)

@@ -1,0 +1,237 @@
+"""Live-cluster plane integration: fake apiserver -> list/watch ingestion
+-> scheduling -> bind/evict/status actuation -> watch round-trip.
+
+The live analog of the reference's informer + default-backend stack
+(cache.go:225-306, :88-165); scenarios mirror what its cache unit tests
+(cache_test.go TestAddPod/TestAddNode) and the e2e bind flow exercise.
+"""
+import numpy as np
+import pytest
+
+from kube_arbitrator_tpu.api import TaskStatus
+from kube_arbitrator_tpu.api import resource as res
+from kube_arbitrator_tpu.cache import FakeApiServer, LiveCache
+from kube_arbitrator_tpu.framework import Scheduler
+from kube_arbitrator_tpu.options import options, reset_options
+
+GB = 1024**3
+
+
+@pytest.fixture(autouse=True)
+def _fresh_options():
+    reset_options()
+    yield
+    reset_options()
+
+
+def make_pod(name, ns="default", group=None, cpu="1", memory="1Gi",
+             scheduler="kube-batch", node="", phase="Pending", uid=None,
+             priority=1):
+    pod = {
+        "metadata": {
+            "name": name,
+            "namespace": ns,
+            "uid": uid or f"uid-{ns}-{name}",
+            "annotations": {},
+            "labels": {},
+        },
+        "spec": {
+            "schedulerName": scheduler,
+            "nodeName": node,
+            "priority": priority,
+            "containers": [
+                {"resources": {"requests": {"cpu": cpu, "memory": memory}}}
+            ],
+        },
+        "status": {"phase": phase},
+    }
+    if group:
+        pod["metadata"]["annotations"]["scheduling.k8s.io/group-name"] = group
+    return pod
+
+
+def make_node(name, cpu="4", memory="8Gi", pods=110):
+    return {
+        "metadata": {"name": name, "labels": {}},
+        "status": {"allocatable": {"cpu": cpu, "memory": memory, "pods": pods}},
+        "spec": {},
+    }
+
+
+def make_podgroup(name, ns="default", min_member=1, queue=""):
+    pg = {
+        "metadata": {"name": name, "namespace": ns, "creationTimestamp": 1.0},
+        "spec": {"minMember": min_member},
+        "status": {},
+    }
+    if queue:
+        pg["spec"]["queue"] = queue
+    return pg
+
+
+def seed_gang_cluster(api, n_nodes=2, n_pods=3, min_member=3):
+    for i in range(n_nodes):
+        api.create("nodes", make_node(f"n{i}"))
+    api.create("queues", {"metadata": {"name": "default"}, "spec": {"weight": 1}})
+    api.create("podgroups", make_podgroup("pg1", min_member=min_member))
+    for i in range(n_pods):
+        api.create("pods", make_pod(f"p{i}", group="pg1"))
+
+
+def test_list_watch_sync_builds_model():
+    api = FakeApiServer()
+    seed_gang_cluster(api)
+    # an assigned pod of another scheduler -> Others (cache.go:254-272)
+    api.create("pods", make_pod("alien", scheduler="default-scheduler",
+                                node="n0", phase="Running", cpu="2"))
+    live = LiveCache(api)
+    live.sync()
+
+    assert set(live.cluster.nodes) == {"n0", "n1"}
+    assert np.allclose(live.cluster.nodes["n0"].allocatable, res.make(4000, 8 * GB))
+    assert "default" in live.cluster.queues
+    job = live.cluster.jobs["default/pg1"]
+    assert job.min_available == 3 and len(job.tasks) == 3
+    t = next(iter(job.tasks.values()))
+    assert np.allclose(t.resreq, res.make(1000, GB))
+    assert len(live.cluster.others) == 1
+    # the alien pod consumes node capacity
+    assert np.allclose(live.cluster.nodes["n0"].idle, res.make(2000, 7 * GB))
+
+
+def test_scheduler_binds_through_adapter_and_watch_roundtrip():
+    # 4 pods over minMember=3: jobStatus's strict '>' (session.go:159-197)
+    # needs allocated > minMember for phase Running
+    api = FakeApiServer()
+    seed_gang_cluster(api, n_pods=4)
+    live = LiveCache(api)
+    sched = Scheduler(live)
+
+    result = sched.run_once()
+    assert len(result.binds) == 4
+    # binds were POSTed: apiserver pods carry nodeName + kubelet emulation
+    for i in range(4):
+        pod = api.get("pods", "default", f"p{i}")
+        assert pod["spec"]["nodeName"] in ("n0", "n1")
+        assert pod["status"]["phase"] == "Running"
+    # status write-back round-trips (PUT /status)
+    pg = api.get("podgroups", "default", "pg1")
+    assert pg["status"]["phase"] == "Running"
+
+    # next pump: the MODIFIED watch events update the model
+    live.sync()
+    job = live.cluster.jobs["default/pg1"]
+    assert all(t.status == TaskStatus.RUNNING for t in job.tasks.values())
+    # node accounting reflects the running pods
+    used = sum(np.asarray(n.used) for n in live.cluster.nodes.values())
+    assert np.allclose(used, res.make(4000, 4 * GB))
+    # second cycle: nothing pending, no new binds
+    result2 = sched.run_once()
+    assert result2.binds == []
+
+
+def test_bind_failure_diverts_to_resync():
+    api = FakeApiServer()
+    seed_gang_cluster(api, min_member=1, n_pods=2)
+    api.fail_bind_uids = {"uid-default-p0"}
+    live = LiveCache(api)
+    sched = Scheduler(live)
+
+    sched.run_once()
+    # p1 bound; p0's POST failed -> resync FIFO + FailedScheduling event
+    assert api.get("pods", "default", "p1")["spec"]["nodeName"]
+    assert not api.get("pods", "default", "p0")["spec"]["nodeName"]
+    assert any(e.kind == "FailedScheduling" for e in live.events)
+
+    # failure clears; resync re-GETs, the next cycle binds p0
+    api.fail_bind_uids = set()
+    sched.run_once()
+    assert api.get("pods", "default", "p0")["spec"]["nodeName"]
+
+
+def test_evict_deletes_pod_via_apiserver():
+    api = FakeApiServer()
+    api.create("nodes", make_node("n0", cpu="4"))
+    api.create("queues", {"metadata": {"name": "qa"}, "spec": {"weight": 1}})
+    api.create("queues", {"metadata": {"name": "qb"}, "spec": {"weight": 1}})
+    api.create("podgroups", make_podgroup("victims", min_member=0, queue="qa"))
+    api.create("podgroups", make_podgroup("claimer", min_member=1, queue="qb"))
+    # queue A fills the node; queue B reclaims
+    for i in range(4):
+        api.create("pods", make_pod(f"v{i}", group="victims", cpu="1",
+                                    memory="256Mi", node="n0", phase="Running"))
+    api.create("pods", make_pod("c0", group="claimer", cpu="1", memory="256Mi"))
+    live = LiveCache(api)
+    from kube_arbitrator_tpu.framework.conf import load_conf
+
+    # full-action conf WITH tiers: a tierless conf faithfully means no
+    # plugins, hence no Reclaimable verdicts at all (util.go:30-64)
+    cfg = load_conf(
+        'actions: "reclaim, allocate, backfill, preempt"\n'
+        "tiers:\n"
+        "- plugins:\n"
+        "  - name: priority\n"
+        "  - name: gang\n"
+        "- plugins:\n"
+        "  - name: drf\n"
+        "  - name: predicates\n"
+        "  - name: proportion\n"
+    )
+    sched = Scheduler(live, config=cfg)
+    result = sched.run_once()
+    assert len(result.evicts) >= 1
+    # DELETE hit the apiserver
+    gone = [f"v{i}" for i in range(4) if api.get("pods", "default", f"v{i}") is None]
+    assert len(gone) == len(result.evicts)
+    # the deletion flows back through the watch into the model
+    live.sync()
+    vic_job = live.cluster.jobs["default/victims"]
+    assert len(vic_job.tasks) == 4 - len(gone)
+
+
+def test_recorded_watch_stream_replay(tmp_path):
+    """VERDICT round-2 #3 'done' criterion: replay a recorded
+    pod/node/PodGroup watch stream, schedule through the adapter, and
+    round-trip the status write-back."""
+    api = FakeApiServer()
+    seed_gang_cluster(api, n_pods=4)
+    path = str(tmp_path / "stream.jsonl")
+    api.dump_stream(path)
+
+    replayed = FakeApiServer.from_stream(FakeApiServer.load_stream(path))
+    live = LiveCache(replayed)
+    sched = Scheduler(live)
+    result = sched.run_once()
+    assert len(result.binds) == 4
+    assert replayed.get("podgroups", "default", "pg1")["status"]["phase"] == "Running"
+
+
+def test_pod_deletion_and_node_update_flow():
+    api = FakeApiServer()
+    seed_gang_cluster(api, min_member=1, n_pods=2)
+    live = LiveCache(api)
+    live.sync()
+    assert len(live.cluster.jobs["default/pg1"].tasks) == 2
+
+    api.delete("pods", "default", "p1")
+    node = api.get("nodes", "", "n0")
+    node["spec"]["unschedulable"] = True
+    api.update("nodes", node)
+    live.sync()
+    assert len(live.cluster.jobs["default/pg1"].tasks) == 1
+    assert live.cluster.nodes["n0"].unschedulable
+
+
+def test_namespace_as_queue_backend():
+    from kube_arbitrator_tpu.options import ServerOptions, set_options
+
+    set_options(ServerOptions(namespace_as_queue=True))
+    api = FakeApiServer()
+    api.create("namespaces", {"metadata": {"name": "team-a"}})
+    api.create("nodes", make_node("n0"))
+    api.create("pods", make_pod("p0", ns="team-a", group="g", cpu="1"))
+    api.create("podgroups", make_podgroup("g", ns="team-a", min_member=1))
+    live = LiveCache(api)
+    live.sync()
+    assert "team-a" in live.cluster.queues
+    assert live.cluster.jobs["team-a/g"].queue_uid == "team-a"

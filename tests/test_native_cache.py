@@ -83,15 +83,15 @@ def test_native_matches_python_with_predicates_and_running():
 def test_native_event_updates():
     nc = NativeCache()
     nc.upsert_queue("q", 1)
-    nc.upsert_node("n1", res.make(4000, 8 * GB), max_tasks=10)
+    nc.upsert_node("n1", res.make(4000, 8 * GB, 0, 40), max_tasks=10)
     nc.upsert_job("j", "q", 0, 0, 0.0)
     nc.upsert_task("t1", "j", res.make(1000, GB), int(TaskStatus.RUNNING), node_name="n1")
     st = nc.snapshot().tensors
-    np.testing.assert_allclose(np.asarray(st.node_idle)[0], [3000.0, 7168.0, 0.0])
+    np.testing.assert_allclose(np.asarray(st.node_idle)[0], [3000.0, 7168.0, 0.0, 4000.0])
     # task terminates -> idle restored
     nc.delete_task("t1")
     st = nc.snapshot().tensors
-    np.testing.assert_allclose(np.asarray(st.node_idle)[0], [4000.0, 8192.0, 0.0])
+    np.testing.assert_allclose(np.asarray(st.node_idle)[0], [4000.0, 8192.0, 0.0, 4000.0])
     assert int(np.asarray(st.task_valid).sum()) == 0
 
 

@@ -100,3 +100,40 @@ def test_nodeorder_spread_prefers_emptier_node():
 def test_nodeorder_default_first_fit():
     binds = run(_three_node_cluster())
     assert binds["t0"] == "n0"  # lowest index with capacity
+
+
+def test_deferred_decode_gated_on_first_fit_and_pairing_stable():
+    """Advisor round-2 finding: the deferred decode assigns group ranks in
+    node-ordinal order while the immediate path routes slots through the
+    binpack/spread score permutation — so deferring under those policies
+    silently changed task->node PAIRING with snapshot size.  The gate must
+    refuse binpack/spread, and under first-fit both paths must produce
+    identical pairings."""
+    import kube_arbitrator_tpu.ops.allocate as alloc_mod
+    from kube_arbitrator_tpu.cache import generate_cluster
+    from kube_arbitrator_tpu.framework import load_conf
+    from kube_arbitrator_tpu.ops.ordering import DEFAULT_TIERS
+
+    cfg = load_conf(NODEORDER_CONF.format(policy="binpack"))
+    sim = generate_cluster(num_nodes=20, num_jobs=6, tasks_per_job=5,
+                           num_queues=2, seed=11)
+    snap = build_snapshot(sim.cluster)
+    assert not alloc_mod._use_deferred_decode(snap.tensors, cfg.tiers)
+    assert alloc_mod._use_deferred_decode(snap.tensors, DEFAULT_TIERS)
+
+    # first-fit: deferred and immediate paths must pair identically
+    dec_deferred = schedule_cycle(snap.tensors)
+    orig = alloc_mod.DEFER_MAX_CELLS
+    try:
+        alloc_mod.DEFER_MAX_CELLS = 0  # force the immediate path
+        schedule_cycle.clear_cache()
+        dec_imm = schedule_cycle(snap.tensors)
+    finally:
+        alloc_mod.DEFER_MAX_CELLS = orig
+        schedule_cycle.clear_cache()
+    np.testing.assert_array_equal(
+        np.asarray(dec_deferred.task_node), np.asarray(dec_imm.task_node)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(dec_deferred.bind_mask), np.asarray(dec_imm.bind_mask)
+    )

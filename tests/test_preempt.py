@@ -223,7 +223,10 @@ def test_property_full_actions_vs_oracle(seed):
     """Random loaded clusters, full action list: the batched kernel and
     the sequential oracle (which now implements preempt/reclaim with
     statement semantics) must agree on per-job gang readiness and on
-    aggregate binds/evictions within batching slack."""
+    aggregate binds/evictions within a 2-task window (the round-2 claim
+    rework plus the round-3 sequential-exact reclaim brought the paths to
+    near-bind-for-bind agreement; measured deltas are <=1 on these
+    seeds — slack 2 guards butterfly divergence, not semantics gaps)."""
     from kube_arbitrator_tpu.cache import generate_cluster
     from kube_arbitrator_tpu.oracle import SequentialScheduler
 
@@ -247,8 +250,8 @@ def test_property_full_actions_vs_oracle(seed):
 
     n_bind_o = len(oracle.binds)
     n_evict_o = len(oracle.evicts)
-    bind_slack = max(3, n_bind_o // 3)
-    evict_slack = max(3, n_evict_o // 3)
+    bind_slack = 2
+    evict_slack = 2
     assert abs(len(binds) - n_bind_o) <= bind_slack, (
         f"kernel {len(binds)} binds vs oracle {n_bind_o}"
     )

@@ -30,8 +30,8 @@ def test_device_units_and_idle():
     snap = build_snapshot(_mk_basic().cluster)
     t = snap.tensors
     # memory is in MiB on device
-    np.testing.assert_allclose(t.node_alloc[0], [4000.0, 8192.0, 0.0])
-    np.testing.assert_allclose(t.task_resreq[0], [1000.0, 1024.0, 0.0])
+    np.testing.assert_allclose(t.node_alloc[0], [4000.0, 8192.0, 0.0, 4000.0])  # attach x100
+    np.testing.assert_allclose(t.task_resreq[0], [1000.0, 1024.0, 0.0, 0.0])
 
 
 def test_running_task_affects_idle_and_counts():
@@ -41,7 +41,7 @@ def test_running_task_affects_idle_and_counts():
     snap = build_snapshot(sim.cluster)
     t = snap.tensors
     n1 = next(n.ordinal for n in snap.index.nodes if n.name == "n1")
-    np.testing.assert_allclose(t.node_idle[n1], [3000.0, 7168.0, 0.0])
+    np.testing.assert_allclose(t.node_idle[n1], [3000.0, 7168.0, 0.0, 4000.0])
     assert int(t.node_num_tasks[n1]) == 1
     # the running task's node ordinal is recorded
     running = [i for i, ti in enumerate(snap.index.tasks) if ti.status == TaskStatus.RUNNING]
@@ -102,4 +102,4 @@ def test_others_usage():
     sim = _mk_basic()
     sim.add_other_task("n2", cpu_milli=500, memory=1024**3)
     snap = build_snapshot(sim.cluster)
-    np.testing.assert_allclose(snap.tensors.others_used, [500.0, 1024.0, 0.0])
+    np.testing.assert_allclose(snap.tensors.others_used, [500.0, 1024.0, 0.0, 0.0])
