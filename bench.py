@@ -1,12 +1,23 @@
-"""Benchmark driver: one scheduling cycle at BASELINE scale.
+"""Benchmark driver: the BASELINE ladder + the north-star primary line.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line on stdout (the driver's contract): the north-star
+config — 100k pending pods x 10k nodes, allocate+backfill — against the
+sequential oracle (the reference Go loop's stand-in; note vs_baseline is
+vs that PYTHON oracle, so the true Go multiple is smaller — the absolute
+cycle time is the honest number).
 
-Config (BASELINE.md #3 by default): 10k pending pods x 1k nodes on the
-available accelerator.  The baseline is the sequential host implementation
-(kube_arbitrator_tpu.oracle) — the faithful stand-in for the reference's Go
-allocate loop — timed on the same snapshot.  Override with env vars
-BENCH_TASKS / BENCH_NODES / BENCH_ORACLE_CAP_S.
+Before it, every BASELINE.md row is emitted as its own JSON line on
+stderr (the ladder the round-2 verdict asked to be recorded):
+
+  config 2:  1k x 100   allocate (drf+gang)
+  config 3:  10k x 1k   allocate (predicates on, default conf)
+  config 4:  50k x 5k   FULL action list (reclaim,allocate,backfill,
+             preempt) at 50% running — the 1 s cadence contract row
+  + q512:    50k x 5k   full actions with 512 namespace-queues
+  config 5:  100k x 10k allocate+backfill (north star, the primary)
+
+Env overrides: BENCH_TASKS / BENCH_NODES / BENCH_ORACLE_CAP_S change the
+primary config; BENCH_LADDER=0 skips the stderr ladder.
 """
 from __future__ import annotations
 
@@ -17,12 +28,42 @@ import time
 
 import numpy as np
 
+FULL_ACTIONS = ("reclaim", "allocate", "backfill", "preempt")
+
+
+def _emit(obj, stream=sys.stdout):
+    print(json.dumps(obj), file=stream, flush=True)
+
+
+def _time_cycle(schedule_cycle, tensors, actions, reps=3):
+    dec = schedule_cycle(tensors, actions=actions)
+    dec.task_node.block_until_ready()
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        dec = schedule_cycle(tensors, actions=actions)
+        dec.task_node.block_until_ready()
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times)), dec
+
+
+def _cluster(num_tasks, num_nodes, num_queues, running_fraction, seed=42):
+    from kube_arbitrator_tpu.cache import build_snapshot, generate_cluster
+
+    sim = generate_cluster(
+        num_nodes=num_nodes,
+        num_jobs=max(1, num_tasks // 100),
+        tasks_per_job=100,
+        num_queues=num_queues,
+        seed=seed,
+        running_fraction=running_fraction,
+    )
+    return build_snapshot(sim.cluster)
+
 
 def main() -> None:
     import jax
 
-    # Persistent compilation cache: the 10k×1k program takes tens of seconds
-    # to compile on first run; cache it so driver re-runs pay only execution.
     cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/kat-jax-cache")
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
@@ -34,44 +75,53 @@ def main() -> None:
 
     ensure_jax_backend()
 
-    num_tasks = int(os.environ.get("BENCH_TASKS", 10_000))
-    num_nodes = int(os.environ.get("BENCH_NODES", 1_000))
-    oracle_cap_s = float(os.environ.get("BENCH_ORACLE_CAP_S", 120.0))
-    tasks_per_job = 100
-    num_jobs = max(1, num_tasks // tasks_per_job)
-
-    from kube_arbitrator_tpu.cache import build_snapshot, generate_cluster
-    from kube_arbitrator_tpu.oracle import SequentialScheduler
     from kube_arbitrator_tpu.ops import schedule_cycle
 
-    sim = generate_cluster(
-        num_nodes=num_nodes,
-        num_jobs=num_jobs,
-        tasks_per_job=tasks_per_job,
-        num_queues=8,
-        seed=42,
-    )
-    snap = build_snapshot(sim.cluster)
+    num_tasks = int(os.environ.get("BENCH_TASKS", 100_000))
+    num_nodes = int(os.environ.get("BENCH_NODES", 10_000))
+    oracle_cap_s = float(os.environ.get("BENCH_ORACLE_CAP_S", 120.0))
+    run_ladder = os.environ.get("BENCH_LADDER", "1") != "0"
 
-    # --- kernel: compile, then time warm cycles (p50 of 5) ---
-    dec = schedule_cycle(snap.tensors)
-    dec.task_node.block_until_ready()
-    times = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        dec = schedule_cycle(snap.tensors)
-        dec.task_node.block_until_ready()
-        times.append(time.perf_counter() - t0)
-    cycle_s = float(np.median(times))
+    # --- the BASELINE ladder (stderr rows) ---
+    if run_ladder:
+        ladder = [
+            # (metric, T, N, Q, running_fraction, actions)
+            ("allocate@1000x100", 1_000, 100, 8, 0.0, ("allocate", "backfill")),
+            ("allocate@10000x1000", 10_000, 1_000, 8, 0.0, ("allocate", "backfill")),
+            ("full_actions@50000x5000", 50_000, 5_000, 8, 0.5, FULL_ACTIONS),
+            ("full_actions_q512@50000x5000", 50_000, 5_000, 512, 0.5, FULL_ACTIONS),
+        ]
+        for metric, T, N, Q, frac, actions in ladder:
+            snap = _cluster(T, N, Q, frac)
+            cycle_s, dec = _time_cycle(schedule_cycle, snap.tensors, actions)
+            placed = int(np.asarray(dec.bind_mask).sum())
+            evicted = int(np.asarray(dec.evict_mask).sum())
+            _emit(
+                {
+                    "metric": metric,
+                    "value": round(placed / cycle_s, 1) if cycle_s > 0 else 0.0,
+                    "unit": "pods/s",
+                    "cycle_ms": round(cycle_s * 1000, 1),
+                    "binds": placed,
+                    "evicts": evicted,
+                    "cadence_contract_s": 1.0,
+                },
+                stream=sys.stderr,
+            )
+
+    # --- primary: the north-star config vs the sequential oracle ---
+    from kube_arbitrator_tpu.cache import generate_cluster
+    from kube_arbitrator_tpu.oracle import SequentialScheduler
+
+    snap = _cluster(num_tasks, num_nodes, 8, 0.0)
+    cycle_s, dec = _time_cycle(schedule_cycle, snap.tensors, ("allocate", "backfill"), reps=5)
     n_placed = int(np.asarray(dec.bind_mask).sum())
     pods_per_sec = n_placed / cycle_s if cycle_s > 0 else 0.0
 
-    # --- baseline: sequential oracle on an identical cluster ---
-    # (the oracle mutates shared accounting state, so give it a fresh copy)
     sim_b = generate_cluster(
         num_nodes=num_nodes,
-        num_jobs=num_jobs,
-        tasks_per_job=tasks_per_job,
+        num_jobs=max(1, num_tasks // 100),
+        tasks_per_job=100,
         num_queues=8,
         seed=42,
     )
@@ -83,20 +133,20 @@ def main() -> None:
     oracle_placed = len(res.binds) if not res.truncated else len(res.session_alloc)
     oracle_pods_per_sec = oracle_placed / oracle_s if oracle_s > 0 else 0.0
 
-    vs_baseline = pods_per_sec / oracle_pods_per_sec if oracle_pods_per_sec > 0 else float("inf")
-    print(
-        json.dumps(
-            {
-                "metric": f"pods_scheduled_per_sec@{num_tasks}x{num_nodes}",
-                "value": round(pods_per_sec, 1),
-                "unit": "pods/s",
-                "vs_baseline": round(vs_baseline, 2),
-            }
-        )
+    vs_baseline = (
+        pods_per_sec / oracle_pods_per_sec if oracle_pods_per_sec > 0 else float("inf")
+    )
+    _emit(
+        {
+            "metric": f"pods_scheduled_per_sec@{num_tasks}x{num_nodes}",
+            "value": round(pods_per_sec, 1),
+            "unit": "pods/s",
+            "vs_baseline": round(vs_baseline, 2),
+        }
     )
     print(
-        f"# cycle={cycle_s*1000:.1f}ms placed={n_placed}/{num_tasks} "
-        f"| baseline={oracle_s*1000:.1f}ms placed={oracle_placed}"
+        f"# north-star cycle={cycle_s*1000:.1f}ms placed={n_placed}/{num_tasks} "
+        f"| python-oracle baseline={oracle_s*1000:.1f}ms placed={oracle_placed}"
         f"{' (capped, rate extrapolated)' if res.truncated else ''} "
         f"| devices={_device_desc()}",
         file=sys.stderr,

@@ -15,6 +15,7 @@ import numpy as np
 
 from ...api import resource as res
 from ...api.info import MatchExpression, Taint, Toleration
+from ...api.types import TaskStatus
 from ..snapshot import (
     DEVICE_SCALE,
     Snapshot,
@@ -23,6 +24,7 @@ from ..snapshot import (
     _node_affinity_matches,
     _selector_matches,
     _tolerates_all,
+    _volume_zone_matches,
 )
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -74,7 +76,7 @@ def _load():
     lib.hc_upsert_job.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p, c.c_int32, c.c_int32, c.c_double]
     lib.hc_upsert_task.argtypes = [
         c.c_void_p, c.c_char_p, c.c_char_p, f32p, c.c_int32, c.c_int32,
-        c.c_char_p, c.c_char_p, i32p, c.c_int32,
+        c.c_char_p, c.c_char_p, i32p, c.c_int32, c.c_int32,
     ]
     lib.hc_delete_task.argtypes = [c.c_void_p, c.c_char_p]
     lib.hc_delete_node.argtypes = [c.c_void_p, c.c_char_p]
@@ -115,6 +117,20 @@ class NativeCache:
         # class representatives for fit-table computation
         self._task_class_rep: Dict[str, Tuple[dict, list]] = {}
         self._node_class_rep: Dict[str, Tuple[dict, list]] = {}
+        # pod-affinity metadata kept host-side: the columnar core carries
+        # only an interned discriminator so grouping splits like the
+        # Python plane; the term tensors are assembled from these at
+        # snapshot time via the shared cache/snapshot encoder.  The intern
+        # table is refcounted so pod churn cannot grow it without bound.
+        self._pa_sig_ids: Dict[tuple, int] = {}
+        self._pa_sig_refs: Dict[tuple, int] = {}
+        self._pa_next_id = 0
+        self._task_pa_sig: Dict[str, tuple] = {}
+        self._task_meta: Dict[str, tuple] = {}  # uid -> (ns, labels, terms)
+        self._node_labels: Dict[str, dict] = {}
+        # live tasks carrying terms/labels/non-default ns: while zero, the
+        # snapshot's pa tensors take the vectorized zero-axis fast path
+        self._n_pa_rich = 0
 
     def __del__(self):
         try:
@@ -144,6 +160,7 @@ class NativeCache:
         sig = repr((tuple(sorted(labels.items())),
                     tuple(sorted((t.key, t.value, t.effect) for t in taints))))
         self._node_class_rep.setdefault(sig, (labels, taints))
+        self._node_labels[name] = labels
         alloc = (np.asarray(allocatable_host_units, dtype=np.float64) * DEVICE_SCALE).astype(
             np.float32
         )
@@ -172,34 +189,75 @@ class NativeCache:
         node_affinity: Sequence[MatchExpression] = (),
         tolerations: Sequence[Toleration] = (),
         host_ports: Sequence[int] = (),
-        labels: Optional[Dict[str, str]] = None,  # reserved: pod-affinity stage
+        labels: Optional[Dict[str, str]] = None,
+        affinity: Sequence = (),   # PodAffinityTerm tuple
+        namespace: str = "default",
+        volume_zone: str = "",
     ) -> None:
         selector = dict(node_selector or {})
-        affinity = tuple(node_affinity)
+        node_aff = tuple(node_affinity)
         tols = list(tolerations)
         sig = repr((
             tuple(sorted(selector.items())),
-            tuple(sorted((e.key, e.operator, e.values) for e in affinity)),
+            tuple(sorted((e.key, e.operator, e.values) for e in node_aff)),
             tuple(sorted((t.key, t.operator, t.value, t.effect) for t in tols)),
+            volume_zone,
         ))
-        self._task_class_rep.setdefault(sig, (selector, affinity, tols))
+        self._task_class_rep.setdefault(sig, (selector, node_aff, tols, volume_zone))
+        labels = dict(labels or {})
+        terms = tuple(affinity)
+        # normalize like the Python plane's group key: grouping there is on
+        # (pa class, SORTED DE-DUPED term ids), so term order/duplicates
+        # must not split native groups
+        aff_norm = tuple(sorted({t for t in terms if not t.anti}, key=repr))
+        anti_norm = tuple(sorted({t for t in terms if t.anti}, key=repr))
+        pa_sig = (namespace, tuple(sorted(labels.items())), aff_norm, anti_norm)
+        self._drop_task_meta(uid)
+        pa_id = self._pa_sig_ids.get(pa_sig)
+        if pa_id is None:
+            pa_id = self._pa_next_id
+            self._pa_next_id += 1
+            self._pa_sig_ids[pa_sig] = pa_id
+        self._pa_sig_refs[pa_sig] = self._pa_sig_refs.get(pa_sig, 0) + 1
+        pa_disc = pa_id
+        self._task_pa_sig[uid] = pa_sig
+        self._task_meta[uid] = (namespace, labels, terms)
+        if terms or labels or namespace != "default":
+            self._n_pa_rich += 1
         req = (np.asarray(resreq_host_units, dtype=np.float64) * DEVICE_SCALE).astype(np.float32)
         ports = np.asarray(list(host_ports), dtype=np.int32)
         rc = self._lib.hc_upsert_task(
             self._h, uid.encode(), job_uid.encode(), _ptr(req, ctypes.c_float),
             int(status), priority, node_name.encode(), sig.encode(),
-            _ptr(ports, ctypes.c_int32), len(ports),
+            _ptr(ports, ctypes.c_int32), len(ports), pa_disc,
         )
         if rc < 0:
             raise ValueError(self._err())
 
+    def _drop_task_meta(self, uid: str) -> None:
+        meta = self._task_meta.pop(uid, None)
+        if meta is not None:
+            ns, labels, terms = meta
+            if terms or labels or ns != "default":
+                self._n_pa_rich -= 1
+        sig = self._task_pa_sig.pop(uid, None)
+        if sig is not None:
+            refs = self._pa_sig_refs.get(sig, 0) - 1
+            if refs <= 0:
+                self._pa_sig_refs.pop(sig, None)
+                self._pa_sig_ids.pop(sig, None)
+            else:
+                self._pa_sig_refs[sig] = refs
+
     def delete_task(self, uid: str) -> None:
         if self._lib.hc_delete_task(self._h, uid.encode()) < 0:
             raise KeyError(self._err())
+        self._drop_task_meta(uid)
 
     def delete_node(self, name: str) -> None:
         if self._lib.hc_delete_node(self._h, name.encode()) < 0:
             raise KeyError(self._err())
+        self._node_labels.pop(name, None)
 
     def delete_job(self, uid: str) -> None:
         if self._lib.hc_delete_job(self._h, uid.encode()) < 0:
@@ -217,19 +275,24 @@ class NativeCache:
         class _T:  # minimal shims for the shared matcher helpers
             pass
 
-        for i, (tsig, (selector, affinity, tols)) in enumerate(self._task_class_rep.items()):
+        for i, (tsig, (selector, affinity, tols, vzone)) in enumerate(
+            self._task_class_rep.items()
+        ):
             trep = _T()
             trep.node_selector = selector
             trep.node_affinity = affinity
             trep.tolerations = tols
+            trep.volume_zone = vzone
             for jn, (nsig, (labels, taints)) in enumerate(self._node_class_rep.items()):
                 nrep = _T()
                 nrep.labels = labels
                 nrep.taints = taints
+                nrep.name = ""
                 fit[i, jn] = (
                     _selector_matches(selector, labels)
                     and _node_affinity_matches(trep, labels)
                     and _tolerates_all(trep, nrep)
+                    and _volume_zone_matches(trep, nrep)
                 )
         return fit
 
@@ -308,30 +371,109 @@ class NativeCache:
         bools = [k for k, a in buf.items() if a.dtype == np.uint8]
         for k in bools:
             buf[k] = buf[k].astype(bool)
-        # The native plane does not encode inter-pod affinity yet: emit the
-        # zero-sized term axes so the decision plane compiles the feature
-        # out (pods carrying affinity terms go through the Python snapshot
-        # plane, cache/snapshot.py).
+        # Pod-(anti-)affinity tensors: the columnar core carries the
+        # interned discriminator (so groups split like the Python plane);
+        # the term tensors are assembled here from the retained metadata
+        # through the SAME encoder the Python snapshot uses.
+        pa = self._build_pa(buf, T, N, G)
         tensors = SnapshotTensors(
             class_fit=self._class_fit(CT, CN),
-            task_pa_class=np.zeros(T, np.int32),
-            group_pa_class=np.zeros(G, np.int32),
-            group_aff_terms=np.zeros((G, 0), np.int32),
-            group_anti_terms=np.zeros((G, 0), np.int32),
-            node_dom=np.zeros((0, N), np.int32),
-            aff_key=np.zeros(0, np.int32),
-            anti_key=np.zeros(0, np.int32),
-            aff_static=np.zeros((0, 1), np.int32),
-            anti_static=np.zeros((0, 1), np.int32),
-            aff_static_total=np.zeros(0, np.int32),
-            aff_match=np.zeros((0, 1), bool),
-            anti_match=np.zeros((0, 1), bool),
-            symm_ok=np.zeros((0, N), bool),
             n_valid_queues=np.int32(buf["queue_valid"].sum()),
+            **pa,
             **buf,
         )
         index = NativeSnapshotIndex(self)
         return Snapshot(tensors=tensors, index=index)
+
+    def _build_pa(self, buf, T: int, N: int, G: int):
+        """Assemble the pod-affinity tensors from host-side metadata via
+        the shared encoder (cache/snapshot._build_pod_affinity), using the
+        native snapshot's ordinals — bit-identical to the Python plane.
+
+        Fast path: with no live task carrying terms/labels/non-default
+        namespaces, the Python plane degenerates to one pod-label class
+        and zero-sized term axes — emitted here without the O(T) shim
+        walk, keeping the columnar core's snapshot cost."""
+        if self._n_pa_rich == 0:
+            return dict(
+                task_pa_class=np.zeros(T, np.int32),
+                group_pa_class=np.zeros(G, np.int32),
+                group_aff_terms=np.zeros((G, 0), np.int32),
+                group_anti_terms=np.zeros((G, 0), np.int32),
+                node_dom=np.zeros((0, N), np.int32),
+                aff_key=np.zeros(0, np.int32),
+                anti_key=np.zeros(0, np.int32),
+                aff_static=np.zeros((0, 1), np.int32),
+                anti_static=np.zeros((0, 1), np.int32),
+                aff_static_total=np.zeros(0, np.int32),
+                aff_match=np.zeros((0, 1), bool),
+                anti_match=np.zeros((0, 1), bool),
+                symm_ok=np.zeros((0, N), bool),
+            )
+        from ..snapshot import _build_pod_affinity
+
+        class _Shim:
+            pass
+
+        tasks = []
+        for i in range(T):
+            if not buf["task_valid"][i]:
+                continue
+            uid = self.task_uid_at(i)
+            ns, labels, terms = self._task_meta.get(uid, ("default", {}, ()))
+            t = _Shim()
+            t.ordinal = i
+            t.uid = uid
+            t.status = TaskStatus(int(buf["task_status"][i]))
+            t.namespace = ns
+            t.labels = labels
+            t.affinity_terms = terms
+            nd = int(buf["task_node"][i])
+            t.node_name = self.node_name_at(nd) if nd >= 0 else ""
+            tasks.append(t)
+        nodes = []
+        node_by_ord = {}
+        for n in range(N):
+            if not buf["node_valid"][n]:
+                continue
+            nd = _Shim()
+            nd.ordinal = n
+            nd.name = self.node_name_at(n)
+            nd.labels = self._node_labels.get(nd.name, {})
+            nd.tasks = {}
+            nodes.append(nd)
+            node_by_ord[n] = nd
+        # existing pods per node (the encoder walks nn.tasks.values())
+        for t in tasks:
+            nd_ord = int(buf["task_node"][t.ordinal])
+            if nd_ord in node_by_ord:
+                node_by_ord[nd_ord].tasks[t.uid] = t
+
+        pa = _build_pod_affinity(tasks, nodes, T, N)
+        task_aff = pa.pop("task_aff")
+        task_anti = pa.pop("task_anti")
+        # per-group term columns from each group's representative member
+        # (groups are split on the pa discriminator, so members agree)
+        MA = max((len(set(v)) for v in task_aff.values()), default=0)
+        MB = max((len(set(v)) for v in task_anti.values()), default=0)
+        group_pa_class = np.zeros(G, np.int32)
+        group_aff_terms = np.full((G, MA), -1, np.int32)
+        group_anti_terms = np.full((G, MB), -1, np.int32)
+        tg = buf["task_group"]
+        tr = buf["task_group_rank"]
+        for i in range(T):
+            g = int(tg[i])
+            if g < 0 or int(tr[i]) != 0:
+                continue
+            group_pa_class[g] = pa["task_pa_class"][i]
+            for m, tid in enumerate(sorted(set(task_aff.get(i, ())))):
+                group_aff_terms[g, m] = tid
+            for m, tid in enumerate(sorted(set(task_anti.get(i, ())))):
+                group_anti_terms[g, m] = tid
+        pa["group_pa_class"] = group_pa_class
+        pa["group_aff_terms"] = group_aff_terms
+        pa["group_anti_terms"] = group_anti_terms
+        return pa
 
     # ---- decode-by-ordinal (valid until the next snapshot) ----
 

@@ -56,6 +56,11 @@ struct Task {
   int32_t klass = 0;      // predicate equivalence class
   int32_t ports[PORT_WORDS] = {0, 0};
   std::vector<int32_t> port_list;  // raw ports (masks rebuilt on universe growth)
+  // pod-affinity discriminator: interned (namespace, labels, terms) id the
+  // binding supplies so grouping splits exactly like the Python plane's
+  // (pa_class, aff ids, anti ids) key; the term tensors themselves are
+  // assembled host-side from the binding's retained metadata.
+  int32_t pa = 0;
   bool best_effort = true;
   bool alive = true;
 };
@@ -270,7 +275,8 @@ int32_t hc_upsert_job(void* h, const char* uid, const char* queue_uid,
 int32_t hc_upsert_task(void* h, const char* uid, const char* job_uid,
                        const float* resreq, int32_t status, int32_t priority,
                        const char* node_name, const char* class_sig,
-                       const int32_t* ports, int32_t n_ports) {
+                       const int32_t* ports, int32_t n_ports,
+                       int32_t pa_disc) {
   Cache& c = *static_cast<Cache*>(h);
   auto jit = c.job_by_uid.find(job_uid);
   if (jit == c.job_by_uid.end()) { c.error = std::string("unknown job ") + job_uid; return -1; }
@@ -303,6 +309,7 @@ int32_t hc_upsert_task(void* h, const char* uid, const char* job_uid,
   t.status = status;
   t.priority = priority;
   t.node = nidx;
+  t.pa = pa_disc;
   t.alive = true;
   t.best_effort = is_empty_res(t.resreq);
   auto cit = c.task_class_by_sig.emplace(class_sig, (int32_t)c.task_class_by_sig.size());
@@ -394,7 +401,9 @@ void hc_snapshot_sizes(void* h, int64_t* out) {
     return ta.uid < tb.uid;
   });
 
-  // task grouping (pending only): key = (job, resreq, klass, ports, prio)
+  // task grouping (pending only): key = (job, resreq over ALL R dims,
+  // klass, ports, prio, pa discriminator) — matching the Python plane's
+  // group key (snapshot.py) including the attach axis and pod-affinity
   std::unordered_map<std::string, int32_t> group_ids;
   L.group_of_task.assign(L.live_tasks.size(), -1);
   L.group_rank.assign(L.live_tasks.size(), 0);
@@ -402,10 +411,12 @@ void hc_snapshot_sizes(void* h, int64_t* out) {
   for (size_t k = 0; k < L.live_tasks.size(); ++k) {
     const Task& t = c.tasks[L.live_tasks[k]];
     if (t.status != PENDING) continue;
-    char key[256];
-    snprintf(key, sizeof key, "%d|%.6f|%.6f|%.6f|%d|%d|%d|%d|%d", t.job,
-             t.resreq[0], t.resreq[1], t.resreq[2], t.klass, t.ports[0],
-             t.ports[1], t.priority, (int)t.best_effort);
+    char key[320];
+    int off = snprintf(key, sizeof key, "%d|", t.job);
+    for (int r = 0; r < R; ++r)
+      off += snprintf(key + off, sizeof key - off, "%.6f|", t.resreq[r]);
+    snprintf(key + off, sizeof key - off, "%d|%d|%d|%d|%d|%d", t.klass,
+             t.ports[0], t.ports[1], t.priority, (int)t.best_effort, t.pa);
     auto ins = group_ids.emplace(key, (int32_t)group_ids.size());
     int32_t g = ins.first->second;
     if (ins.second) group_counts.push_back(0);

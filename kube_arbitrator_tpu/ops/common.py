@@ -82,7 +82,13 @@ def mm_cumsum(x: jnp.ndarray, block: int = 512) -> jnp.ndarray:
     op count per loop iteration.
 
     x: [T] or [T, C] float; returns same shape/dtype (f32 accumulation).
+
+    Backend-adaptive: the matmul reformulation wins on the TPU's MXU but
+    loses on CPU (the triangular matmul is real FLOPs there while XLA's
+    native cumsum is a cheap linear pass), so CPU traces keep jnp.cumsum.
     """
+    if jax.default_backend() == "cpu":
+        return jnp.cumsum(x, axis=0)
     squeeze = x.ndim == 1
     if squeeze:
         x = x[:, None]

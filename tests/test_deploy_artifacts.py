@@ -1,0 +1,48 @@
+"""Deployment artifacts: the CRD schemas are the wire contract the live
+plane consumes (reference config/crds/*.yaml + deployment/kube-batch)."""
+import os
+
+import yaml
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(rel):
+    with open(os.path.join(HERE, rel)) as f:
+        return list(yaml.safe_load_all(f))
+
+
+def test_podgroup_crd_matches_live_plane_contract():
+    (crd,) = _load("deploy/crds/scheduling_v1alpha1_podgroup.yaml")
+    assert crd["spec"]["group"] == "scheduling.incubator.k8s.io"
+    assert crd["spec"]["names"]["kind"] == "PodGroup"
+    ver = crd["spec"]["versions"][0]
+    assert ver["name"] == "v1alpha1" and ver["storage"]
+    props = ver["schema"]["openAPIV3Schema"]["properties"]
+    # exactly the fields cache/live.py reads and writes back
+    assert set(props["spec"]["properties"]) >= {"minMember", "queue"}
+    st = props["status"]["properties"]
+    assert set(st) >= {"phase", "running", "succeeded", "failed", "conditions"}
+    assert st["phase"]["enum"] == ["Pending", "Running", "Unknown"]
+    assert "status" in ver["subresources"]  # the PUT /status verb
+
+
+def test_queue_crd_contract():
+    (crd,) = _load("deploy/crds/scheduling_v1alpha1_queue.yaml")
+    assert crd["spec"]["names"]["kind"] == "Queue"
+    assert crd["spec"]["scope"] == "Cluster"  # cluster-scoped (types.go:152)
+    props = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]["properties"]
+    assert "weight" in props["spec"]["properties"]
+
+
+def test_deployment_manifests_carry_full_conf():
+    docs = _load("deploy/kube-arbitrator-tpu.yaml")
+    kinds = {d["kind"] for d in docs}
+    assert kinds == {"ServiceAccount", "ClusterRoleBinding", "ConfigMap", "Deployment"}
+    cm = next(d for d in docs if d["kind"] == "ConfigMap")
+    conf = cm["data"]["scheduler.conf"]
+    # the conf must parse through the real loader with all four actions
+    from kube_arbitrator_tpu.framework.conf import load_conf
+
+    cfg = load_conf(conf)
+    assert cfg.actions == ("reclaim", "allocate", "backfill", "preempt")

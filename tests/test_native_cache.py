@@ -29,7 +29,9 @@ def mirror_to_native(sim: SimCluster) -> NativeCache:
                 t.uid, j.uid, t.resreq, int(t.status), t.priority,
                 node_name=t.node_name, node_selector=t.node_selector,
                 node_affinity=t.node_affinity, tolerations=t.tolerations,
-                host_ports=t.host_ports,
+                host_ports=t.host_ports, labels=t.labels,
+                affinity=t.affinity_terms, namespace=t.namespace,
+                volume_zone=t.volume_zone,
             )
     if sim.cluster.others:
         nc.set_others_used(res.sum_resources(t.resreq for t in sim.cluster.others))
@@ -124,3 +126,48 @@ def test_native_cycle_end_to_end():
         for i in np.nonzero(bind)[0]
     }
     assert binds == {"p1": "n1", "p2": "n1"}
+
+
+def test_native_matches_python_snapshot_with_pod_affinity():
+    """VERDICT round-2 #8: the native plane must emit the pod-affinity
+    term tensors (predicates.go:186-198 semantics), not silently drop
+    them — bit-identical to the Python plane on an affinity cluster."""
+    from kube_arbitrator_tpu.api.info import PodAffinityTerm
+
+    sim = SimCluster()
+    sim.add_queue("q")
+    sim.add_node("z1", cpu_milli=8000, memory=16 * GB,
+                 labels={"topology.kubernetes.io/zone": "a"})
+    sim.add_node("z2", cpu_milli=8000, memory=16 * GB,
+                 labels={"topology.kubernetes.io/zone": "b"})
+    web = sim.add_job("web", queue="q", min_available=1, creation_ts=1)
+    sim.add_task(web, 1000, GB, name="web-0", labels={"app": "web"},
+                 status=TaskStatus.RUNNING, node="z1")
+    cache = sim.add_job("cache", queue="q", min_available=2, creation_ts=2)
+    near = PodAffinityTerm(match_labels=(("app", "web"),),
+                           topology_key="topology.kubernetes.io/zone")
+    apart = PodAffinityTerm(match_labels=(("app", "cache"),),
+                            topology_key="kubernetes.io/hostname", anti=True)
+    for i in range(2):
+        sim.add_task(cache, 500, GB // 2, name=f"cache-{i}",
+                     labels={"app": "cache"}, affinity=(near, apart))
+
+    py = build_snapshot(sim.cluster).tensors
+    nat = mirror_to_native(sim).snapshot().tensors
+    assert_tensors_equal(py, nat)
+    # the feature is actually ON in the native tensors
+    assert nat.group_aff_terms.shape[1] > 0
+    assert nat.group_anti_terms.shape[1] > 0
+
+
+def test_native_volume_zone_class_parity():
+    """The native class table includes the VolumeZone predicate."""
+    sim = SimCluster()
+    sim.add_queue("q")
+    sim.add_node("z1", cpu_milli=8000, labels={"topology.kubernetes.io/zone": "a"})
+    sim.add_node("z2", cpu_milli=8000, labels={"topology.kubernetes.io/zone": "b"})
+    j = sim.add_job("j", queue="q")
+    sim.add_task(j, 1000, 0, name="pinned", volumes=1, volume_zone="b")
+    py = build_snapshot(sim.cluster).tensors
+    nat = mirror_to_native(sim).snapshot().tensors
+    assert_tensors_equal(py, nat)
