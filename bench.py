@@ -1,10 +1,17 @@
 """Benchmark driver: the BASELINE ladder + the north-star primary line.
 
 Prints ONE JSON line on stdout (the driver's contract): the north-star
-config — 100k pending pods x 10k nodes, allocate+backfill — against the
-sequential oracle (the reference Go loop's stand-in; note vs_baseline is
-vs that PYTHON oracle, so the true Go multiple is smaller — the absolute
-cycle time is the honest number).
+config — 100k pending pods x 10k nodes, allocate+backfill.
+
+``vs_baseline`` is measured against a COMPILED sequential allocate loop
+(cache/native/seqbaseline.cpp, g++ -O2) shaped like allocate.go:41-176 —
+the Go-speed-class baseline the round-2 verdict asked for.  It is a
+CONSERVATIVE multiple: the C++ loop skips the reference's biggest cost
+(rebuilding a k8s NodeInfo per (task,node) predicate call,
+predicates.go:122-123 — SURVEY.md calls it "the main scaling sin"), so
+the real kube-batch loop is slower than this baseline and the true
+multiple is larger.  The Python oracle's rate is also emitted for
+continuity as ``vs_python_oracle``.
 
 Before it, every BASELINE.md row is emitted as its own JSON line on
 stderr (the ladder the round-2 verdict asked to be recorded):
@@ -109,7 +116,7 @@ def main() -> None:
                 stream=sys.stderr,
             )
 
-    # --- primary: the north-star config vs the sequential oracle ---
+    # --- primary: the north-star config vs the compiled sequential loop ---
     from kube_arbitrator_tpu.cache import generate_cluster
     from kube_arbitrator_tpu.oracle import SequentialScheduler
 
@@ -117,6 +124,26 @@ def main() -> None:
     cycle_s, dec = _time_cycle(schedule_cycle, snap.tensors, ("allocate", "backfill"), reps=5)
     n_placed = int(np.asarray(dec.bind_mask).sum())
     pods_per_sec = n_placed / cycle_s if cycle_s > 0 else 0.0
+
+    native_rate = None
+    try:
+        from kube_arbitrator_tpu.bench_baseline import run_native_baseline
+
+        nb_placed, nb_s = run_native_baseline(snap.tensors)
+        native_rate = nb_placed / nb_s if nb_s > 0 else 0.0
+        _emit(
+            {
+                "metric": f"seq_native_loop@{num_tasks}x{num_nodes}",
+                "value": round(native_rate, 1),
+                "unit": "pods/s",
+                "cycle_ms": round(nb_s * 1000, 1),
+                "binds": nb_placed,
+                "note": "compiled allocate.go-shaped loop; conservative (no per-pair NodeInfo rebuild)",
+            },
+            stream=sys.stderr,
+        )
+    except Exception as e:  # no toolchain: fall back to the python oracle
+        print(f"# native baseline unavailable: {e}", file=sys.stderr)
 
     sim_b = generate_cluster(
         num_nodes=num_nodes,
@@ -131,22 +158,23 @@ def main() -> None:
     # loop's early rate is its best rate (nodes empty, short scans), so the
     # extrapolation flatters the baseline, never the kernel.
     oracle_placed = len(res.binds) if not res.truncated else len(res.session_alloc)
-    oracle_pods_per_sec = oracle_placed / oracle_s if oracle_s > 0 else 0.0
+    oracle_rate = oracle_placed / oracle_s if oracle_s > 0 else 0.0
 
-    vs_baseline = (
-        pods_per_sec / oracle_pods_per_sec if oracle_pods_per_sec > 0 else float("inf")
-    )
+    base_rate = native_rate if native_rate else oracle_rate
+    vs_baseline = pods_per_sec / base_rate if base_rate > 0 else float("inf")
     _emit(
         {
             "metric": f"pods_scheduled_per_sec@{num_tasks}x{num_nodes}",
             "value": round(pods_per_sec, 1),
             "unit": "pods/s",
             "vs_baseline": round(vs_baseline, 2),
+            "baseline": "seq_native_loop" if native_rate else "python_oracle",
+            "vs_python_oracle": round(pods_per_sec / oracle_rate, 2) if oracle_rate > 0 else None,
         }
     )
     print(
         f"# north-star cycle={cycle_s*1000:.1f}ms placed={n_placed}/{num_tasks} "
-        f"| python-oracle baseline={oracle_s*1000:.1f}ms placed={oracle_placed}"
+        f"| python-oracle={oracle_s*1000:.1f}ms placed={oracle_placed}"
         f"{' (capped, rate extrapolated)' if res.truncated else ''} "
         f"| devices={_device_desc()}",
         file=sys.stderr,

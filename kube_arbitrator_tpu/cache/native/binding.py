@@ -7,6 +7,7 @@ so callers can fall back to the pure-Python snapshot plane.
 from __future__ import annotations
 
 import ctypes
+import dataclasses
 import os
 import subprocess
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -118,19 +119,23 @@ class NativeCache:
         self._task_class_rep: Dict[str, Tuple[dict, list]] = {}
         self._node_class_rep: Dict[str, Tuple[dict, list]] = {}
         # pod-affinity metadata kept host-side: the columnar core carries
-        # only an interned discriminator so grouping splits like the
-        # Python plane; the term tensors are assembled from these at
-        # snapshot time via the shared cache/snapshot encoder.  The intern
-        # table is refcounted so pod churn cannot grow it without bound.
+        # only an interned discriminator (bit 30 = task has terms) so
+        # grouping splits like the Python plane; the term tensors are
+        # assembled from these at snapshot time via the shared
+        # cache/snapshot encoder.  The intern table is refcounted so pod
+        # churn cannot grow it without bound.
         self._pa_sig_ids: Dict[tuple, int] = {}
         self._pa_sig_refs: Dict[tuple, int] = {}
         self._pa_next_id = 0
         self._task_pa_sig: Dict[str, tuple] = {}
         self._task_meta: Dict[str, tuple] = {}  # uid -> (ns, labels, terms)
+        self._tasks_of_job: Dict[str, set] = {}
+        self._task_job_uid: Dict[str, str] = {}
         self._node_labels: Dict[str, dict] = {}
-        # live tasks carrying terms/labels/non-default ns: while zero, the
-        # snapshot's pa tensors take the vectorized zero-axis fast path
-        self._n_pa_rich = 0
+        # live tasks carrying (anti-)affinity TERMS: while zero, both
+        # planes emit the trivial encoding (labels are only observable
+        # through terms) and the snapshot takes the zero-cost fast path
+        self._n_pa_terms = 0
 
     def __del__(self):
         try:
@@ -206,24 +211,24 @@ class NativeCache:
         self._task_class_rep.setdefault(sig, (selector, node_aff, tols, volume_zone))
         labels = dict(labels or {})
         terms = tuple(affinity)
-        # normalize like the Python plane's group key: grouping there is on
-        # (pa class, SORTED DE-DUPED term ids), so term order/duplicates
-        # must not split native groups
-        aff_norm = tuple(sorted({t for t in terms if not t.anti}, key=repr))
-        anti_norm = tuple(sorted({t for t in terms if t.anti}, key=repr))
+        # normalize like the Python plane's term ids: namespaces resolved
+        # to the pod's own (term_sig in cache/snapshot.py), then sorted and
+        # de-duplicated — term order/duplicates/spelled-out-default-ns must
+        # not split native groups
+        def _norm(ts):
+            resolved = {
+                dataclasses.replace(t, namespaces=tuple(sorted(t.namespaces or (namespace,))))
+                for t in ts
+            }
+            return tuple(sorted(resolved, key=repr))
+
+        aff_norm = _norm(t for t in terms if not t.anti)
+        anti_norm = _norm(t for t in terms if t.anti)
         pa_sig = (namespace, tuple(sorted(labels.items())), aff_norm, anti_norm)
-        self._drop_task_meta(uid)
         pa_id = self._pa_sig_ids.get(pa_sig)
-        if pa_id is None:
-            pa_id = self._pa_next_id
-            self._pa_next_id += 1
-            self._pa_sig_ids[pa_sig] = pa_id
-        self._pa_sig_refs[pa_sig] = self._pa_sig_refs.get(pa_sig, 0) + 1
-        pa_disc = pa_id
-        self._task_pa_sig[uid] = pa_sig
-        self._task_meta[uid] = (namespace, labels, terms)
-        if terms or labels or namespace != "default":
-            self._n_pa_rich += 1
+        pa_disc = self._pa_next_id if pa_id is None else pa_id
+        if terms:
+            pa_disc |= 1 << 30  # the C++ core's termed-task marker
         req = (np.asarray(resreq_host_units, dtype=np.float64) * DEVICE_SCALE).astype(np.float32)
         ports = np.asarray(list(host_ports), dtype=np.int32)
         rc = self._lib.hc_upsert_task(
@@ -233,13 +238,31 @@ class NativeCache:
         )
         if rc < 0:
             raise ValueError(self._err())
+        # host-side bookkeeping only after the core accepted the record —
+        # a rejected upsert must leave binding metadata consistent
+        self._drop_task_meta(uid)
+        if pa_id is None:
+            self._pa_sig_ids[pa_sig] = self._pa_next_id
+            self._pa_next_id += 1
+        self._pa_sig_refs[pa_sig] = self._pa_sig_refs.get(pa_sig, 0) + 1
+        self._task_pa_sig[uid] = pa_sig
+        self._task_meta[uid] = (namespace, labels, terms)
+        self._task_job_uid[uid] = job_uid
+        self._tasks_of_job.setdefault(job_uid, set()).add(uid)
+        if terms:
+            self._n_pa_terms += 1
 
     def _drop_task_meta(self, uid: str) -> None:
         meta = self._task_meta.pop(uid, None)
-        if meta is not None:
-            ns, labels, terms = meta
-            if terms or labels or ns != "default":
-                self._n_pa_rich -= 1
+        if meta is not None and meta[2]:
+            self._n_pa_terms -= 1
+        juid = self._task_job_uid.pop(uid, None)
+        if juid is not None:
+            peers = self._tasks_of_job.get(juid)
+            if peers is not None:
+                peers.discard(uid)
+                if not peers:
+                    del self._tasks_of_job[juid]
         sig = self._task_pa_sig.pop(uid, None)
         if sig is not None:
             refs = self._pa_sig_refs.get(sig, 0) - 1
@@ -262,6 +285,8 @@ class NativeCache:
     def delete_job(self, uid: str) -> None:
         if self._lib.hc_delete_job(self._h, uid.encode()) < 0:
             raise KeyError(self._err())
+        for tuid in list(self._tasks_of_job.get(uid, ())):
+            self._drop_task_meta(tuid)
 
     def set_others_used(self, used_host_units: np.ndarray) -> None:
         u = (np.asarray(used_host_units, dtype=np.float64) * DEVICE_SCALE).astype(np.float32)
@@ -390,11 +415,12 @@ class NativeCache:
         the shared encoder (cache/snapshot._build_pod_affinity), using the
         native snapshot's ordinals — bit-identical to the Python plane.
 
-        Fast path: with no live task carrying terms/labels/non-default
-        namespaces, the Python plane degenerates to one pod-label class
-        and zero-sized term axes — emitted here without the O(T) shim
-        walk, keeping the columnar core's snapshot cost."""
-        if self._n_pa_rich == 0:
+        Fast path: with no live task carrying (anti-)affinity terms, both
+        planes emit the trivial encoding (cache/snapshot.py
+        trivial_pod_affinity: labels are only observable through terms) —
+        here without the O(T) shim walk, keeping the columnar core's
+        snapshot cost even on labeled multi-namespace clusters."""
+        if self._n_pa_terms == 0:
             return dict(
                 task_pa_class=np.zeros(T, np.int32),
                 group_pa_class=np.zeros(G, np.int32),

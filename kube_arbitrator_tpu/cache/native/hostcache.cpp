@@ -58,8 +58,11 @@ struct Task {
   std::vector<int32_t> port_list;  // raw ports (masks rebuilt on universe growth)
   // pod-affinity discriminator: interned (namespace, labels, terms) id the
   // binding supplies so grouping splits exactly like the Python plane's
-  // (pa_class, aff ids, anti ids) key; the term tensors themselves are
-  // assembled host-side from the binding's retained metadata.
+  // (pa_class, aff ids, anti ids) key; bit 30 marks a task carrying terms.
+  // While NO live task carries terms, grouping ignores pa entirely (labels
+  // are only observable through terms — the Python plane's rule).  The
+  // term tensors themselves are assembled host-side from the binding's
+  // retained metadata.
   int32_t pa = 0;
   bool best_effort = true;
   bool alive = true;
@@ -104,6 +107,7 @@ struct SnapLayout {
 };
 
 struct Cache {
+  int64_t n_termed_tasks = 0;  // live tasks whose pa carries the term bit
   std::vector<Task> tasks;
   std::vector<Node> nodes;
   std::vector<Job> jobs;
@@ -312,6 +316,9 @@ int32_t hc_upsert_task(void* h, const char* uid, const char* job_uid,
   t.pa = pa_disc;
   t.alive = true;
   t.best_effort = is_empty_res(t.resreq);
+  constexpr int32_t TERM_BIT = 1 << 30;
+  if (existed && old.alive && (old.pa & TERM_BIT)) c.n_termed_tasks--;
+  if (t.pa & TERM_BIT) c.n_termed_tasks++;
   auto cit = c.task_class_by_sig.emplace(class_sig, (int32_t)c.task_class_by_sig.size());
   t.klass = cit.first->second;
   if (!set_ports(c, t, ports, n_ports)) return -1;
@@ -334,6 +341,7 @@ int32_t hc_delete_task(void* h, const char* uid) {
   if (it == c.task_by_uid.end()) { c.error = std::string("unknown task ") + uid; return -1; }
   Task& t = c.tasks[it->second];
   if (t.alive && t.node >= 0) node_remove_task(c, c.nodes[t.node], t);
+  if (t.alive && (t.pa & (1 << 30))) c.n_termed_tasks--;
   t.alive = false;
   t.node = -1;
   rebuild_node_ports(c);
@@ -359,6 +367,7 @@ int32_t hc_delete_job(void* h, const char* uid) {
   for (auto& t : c.tasks) {
     if (!t.alive || t.job != jidx) continue;
     if (t.node >= 0) node_remove_task(c, c.nodes[t.node], t);
+    if (t.pa & (1 << 30)) c.n_termed_tasks--;
     t.alive = false; t.node = -1;
   }
   rebuild_node_ports(c);
@@ -415,8 +424,12 @@ void hc_snapshot_sizes(void* h, int64_t* out) {
     int off = snprintf(key, sizeof key, "%d|", t.job);
     for (int r = 0; r < R; ++r)
       off += snprintf(key + off, sizeof key - off, "%.6f|", t.resreq[r]);
+    // pa splits groups only while some live task carries terms — with no
+    // terms anywhere, labels are unobservable and must not split (the
+    // Python plane's trivial_pod_affinity rule)
+    int32_t pa_eff = c.n_termed_tasks > 0 ? t.pa : 0;
     snprintf(key + off, sizeof key - off, "%d|%d|%d|%d|%d|%d", t.klass,
-             t.ports[0], t.ports[1], t.priority, (int)t.best_effort, t.pa);
+             t.ports[0], t.ports[1], t.priority, (int)t.best_effort, pa_eff);
     auto ins = group_ids.emplace(key, (int32_t)group_ids.size());
     int32_t g = ins.first->second;
     if (ins.second) group_counts.push_back(0);

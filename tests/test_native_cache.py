@@ -171,3 +171,68 @@ def test_native_volume_zone_class_parity():
     py = build_snapshot(sim.cluster).tensors
     nat = mirror_to_native(sim).snapshot().tensors
     assert_tensors_equal(py, nat)
+
+
+def test_seq_native_baseline_sanity():
+    """The compiled bench baseline (allocate.go-shaped loop) places the
+    same totals as the Python oracle on a simple cluster."""
+    from kube_arbitrator_tpu.bench_baseline import available, run_native_baseline
+    from kube_arbitrator_tpu.oracle import SequentialScheduler
+    from kube_arbitrator_tpu.cache import generate_cluster
+
+    if not available():
+        import pytest
+
+        pytest.skip("no native toolchain")
+    sim = generate_cluster(num_nodes=50, num_jobs=10, tasks_per_job=20,
+                           num_queues=4, seed=3)
+    snap = build_snapshot(sim.cluster)
+    placed, secs = run_native_baseline(snap.tensors)
+    oracle = SequentialScheduler(sim.cluster).run_cycle()
+    assert placed == len(oracle.binds)
+    assert secs < 1.0
+
+
+def test_native_pa_namespace_resolution_and_churn():
+    """Round-3 review findings: (a) a term spelling out its own namespace
+    must not split native groups vs the empty-namespaces default; (b)
+    delete_job must release the pod-affinity metadata so the trivial fast
+    path returns after churn."""
+    from kube_arbitrator_tpu.api.info import PodAffinityTerm
+
+    sim = SimCluster()
+    sim.add_queue("q")
+    sim.add_node("n1", cpu_milli=8000, memory=16 * GB)
+    j = sim.add_job("j", queue="q", min_available=1)
+    t_default = PodAffinityTerm(match_labels=(("app", "x"),))
+    t_spelled = PodAffinityTerm(match_labels=(("app", "x"),), namespaces=("default",))
+    sim.add_task(j, 500, GB // 2, name="a0", labels={"app": "x"}, affinity=(t_default,))
+    sim.add_task(j, 500, GB // 2, name="a1", labels={"app": "x"}, affinity=(t_spelled,))
+    py = build_snapshot(sim.cluster).tensors
+    nc = mirror_to_native(sim)
+    nat = nc.snapshot().tensors
+    assert_tensors_equal(py, nat)
+
+    # churn: delete the job; metadata must drain and the fast path return
+    nc.delete_job("j")
+    assert nc._n_pa_terms == 0 and not nc._task_meta and not nc._pa_sig_ids
+    st = nc.snapshot().tensors
+    assert st.group_aff_terms.shape[1] == 0  # trivial encoding again
+
+
+def test_native_labels_without_terms_stay_trivial():
+    """Labels are only observable through affinity terms: a labeled,
+    multi-namespace, term-free cluster takes the trivial encoding on BOTH
+    planes (and the native fast path), with no label-driven group split."""
+    sim = SimCluster()
+    sim.add_queue("q")
+    sim.add_node("n1", cpu_milli=8000, memory=16 * GB)
+    j = sim.add_job("j", queue="q")
+    sim.add_task(j, 500, GB // 2, name="a0", labels={"app": "x"})
+    sim.add_task(j, 500, GB // 2, name="a1", labels={"app": "y"})
+    py = build_snapshot(sim.cluster).tensors
+    nc = mirror_to_native(sim)
+    assert nc._n_pa_terms == 0
+    nat = nc.snapshot().tensors
+    assert_tensors_equal(py, nat)
+    assert int(np.asarray(py.group_valid).sum()) == 1  # one group, no label split
