@@ -96,6 +96,14 @@ def main() -> None:
             ("allocate@1000x100", 1_000, 100, 8, 0.0, ("allocate", "backfill")),
             ("allocate@10000x1000", 10_000, 1_000, 8, 0.0, ("allocate", "backfill")),
             ("full_actions@50000x5000", 50_000, 5_000, 8, 0.5, FULL_ACTIONS),
+            # queue-count scaling pair: identical workload, 8 vs 512
+            # namespace-queues (per-queue-turn overhead isolation); the
+            # full-action q512 row below does genuinely MORE work (512
+            # tiny deserved shares make most running pods reclaimable —
+            # see its evicts field), so it is a workload row, not an
+            # overhead row
+            ("allocate@50000x5000", 50_000, 5_000, 8, 0.0, ("allocate", "backfill")),
+            ("allocate_q512@50000x5000", 50_000, 5_000, 512, 0.0, ("allocate", "backfill")),
             ("full_actions_q512@50000x5000", 50_000, 5_000, 512, 0.5, FULL_ACTIONS),
         ]
         for metric, T, N, Q, frac, actions in ladder:

@@ -113,6 +113,39 @@ def _drf_before_gang(tiers: Tiers) -> bool:
     return False
 
 
+
+def group_live_mask(st, sess, group_placed, group_unfit, best_effort_pass=None):
+    """Eligible-group mask shared by the per-turn selection and the
+    round-level active-queue trip bound — ONE definition so the trip bound
+    can never drift from per-turn eligibility (a drifted round mask that
+    under-approximates would silently starve a schedulable queue).
+
+    ``best_effort_pass=None`` means resource-requesting groups only (the
+    eviction actions); a bool selects allocate's pass.  ``group_unfit``
+    may be None for actions that do not retire groups."""
+    m = (
+        st.group_valid
+        & (st.group_size - group_placed > 0)
+        & sess.job_sched_valid[st.group_job]
+    )
+    if best_effort_pass is None:
+        m = m & ~st.group_best_effort
+    else:
+        m = m & (st.group_best_effort == best_effort_pass)
+    if group_unfit is not None:
+        m = m & ~group_unfit
+    return m
+
+
+def queue_has_live_job(st, grp_live, job_extra=None):
+    """bool[Q]: queues owning at least one valid job with a live group."""
+    job_live = jnp.zeros(st.num_jobs, dtype=bool).at[st.group_job].max(grp_live)
+    job_live = job_live & st.job_valid
+    if job_extra is not None:
+        job_live = job_live & job_extra
+    return jnp.zeros(st.num_queues, dtype=bool).at[st.job_queue].max(job_live)
+
+
 def _status_in(status: jax.Array, members) -> jax.Array:
     m = jnp.zeros_like(status, dtype=bool)
     for s in members:
@@ -281,15 +314,11 @@ def _process_queue(
     # ---- eligibility masks (NOTE: a lax.cond gate skipping the rest of
     # the body for empty queues was measured SLOWER — the passthrough
     # branch copies the state pytree per skipped turn — so every turn runs
-    # the full body and padding queues are instead skipped via the
-    # n_valid_queues trip bound in _round) ----
+    # the full body and inactive/padding queues are instead skipped via
+    # the active-queue trip bound in _round) ----
     grp_remaining = st.group_size - state.group_placed
-    grp_elig = (
-        st.group_valid
-        & (st.group_best_effort == best_effort_pass)
-        & (grp_remaining > 0)
-        & ~state.group_unfit
-        & sess.job_sched_valid[st.group_job]
+    grp_elig = group_live_mask(
+        st, sess, state.group_placed, state.group_unfit, best_effort_pass
     )
     job_has_pending = jnp.zeros(J, dtype=bool).at[st.group_job].max(grp_elig)
     jmask = (st.job_queue == q) & job_has_pending & st.job_valid & q_ok
@@ -462,18 +491,29 @@ def _round(
     best_effort_pass: bool,
     gn=None,
 ):
-    # real queues only: invalid (padding) queues sort last under the BIG
-    # key, so bounding the trip count by the valid-queue scalar skips
-    # their full-cost no-op turns (traced bound -> no recompile when the
-    # queue count changes; fori_loop lowers it to a while_loop)
+    # ACTIVE queues only: a queue whose jobs have no eligible pending
+    # groups (or that is overused, for fairness passes) takes a strict
+    # no-op turn, so sorting inactive queues last and bounding the trip
+    # count by the active-queue scalar skips their full-cost turns — at
+    # 512 namespace-queues with a handful active this is the difference
+    # between 512 and ~8 turns per round (traced bound -> no recompile;
+    # fori_loop lowers to a while_loop)
     Q = st.num_queues
-    nq = jnp.asarray(st.n_valid_queues, jnp.int32)
-    Q = jnp.where((nq > 0) & (nq < Q), nq, Q)
+    grp_live = group_live_mask(
+        st, sess, state.group_placed, state.group_unfit, best_effort_pass
+    )
+    q_active = st.queue_valid & queue_has_live_job(st, grp_live)
+    if not best_effort_pass:
+        q_active = q_active & ~overused(state.queue_alloc, sess.deserved)
+    nq = jnp.sum(q_active.astype(jnp.int32))
+    trip = jnp.where(nq > 0, nq, 1)
     # queue processing order from the tiered key stack (the tensor analog
-    # of allocate.go:45's queue priority-queue over ssn.QueueOrderFn)
+    # of allocate.go:45's queue priority-queue over ssn.QueueOrderFn),
+    # inactive queues last
     q_share = queue_shares(state.queue_alloc, sess.deserved)
     keys = queue_order_keys(tiers, q_share, st.queue_uid_rank)
-    keys = [jnp.where(st.queue_valid, k, BIG) for k in keys]
+    keys = [jnp.where(q_active, k, BIG) for k in keys]
+    keys.insert(0, jnp.where(q_active, 0.0, 1.0))
     # jnp.lexsort treats the LAST key as primary
     perm = jnp.lexsort(tuple(reversed(keys)))
 
@@ -483,7 +523,7 @@ def _round(
             ns, _ = _process_queue(perm[qi], st, sess, s, tiers, s_max, best_effort_pass)
             return ns
 
-        state = jax.lax.fori_loop(0, Q, body, state)
+        state = jax.lax.fori_loop(0, trip, body, state)
     else:
 
         def body(qi, carry):
@@ -492,7 +532,7 @@ def _round(
                 perm[qi], st, sess, s, tiers, s_max, best_effort_pass, gn=g
             )
 
-        state, gn = jax.lax.fori_loop(0, Q, body, (state, gn))
+        state, gn = jax.lax.fori_loop(0, trip, body, (state, gn))
     return dataclasses.replace(state, rounds=state.rounds + 1), gn
 
 

@@ -38,7 +38,15 @@ import jax.numpy as jnp
 
 from ..api.types import TaskStatus
 from ..cache.snapshot import SnapshotTensors
-from .allocate import AllocState, PIPELINED, SessionCtx, _copies_fit, turn_budget
+from .allocate import (
+    AllocState,
+    PIPELINED,
+    SessionCtx,
+    _copies_fit,
+    group_live_mask,
+    queue_has_live_job,
+    turn_budget,
+)
 from .common import BIG, EPS, fair, lex_argmin, mm_cumsum, safe_share
 from .fairness import drf_shares, queue_shares
 from .ordering import Tiers, group_order_keys, job_order_keys, queue_order_keys
@@ -226,17 +234,11 @@ def _claim_turn(
 
     q_ok = st.queue_valid[q]  # preempt has no overused gate
 
-    # (padding queues are skipped via the n_valid_queues trip bound in
-    # _rounds, not a lax.cond — a cond's passthrough branch would copy the
-    # state pytree per turn)
+    # (inactive/padding queues are skipped via the active-queue trip
+    # bound in _rounds, not a lax.cond — a cond's passthrough branch would
+    # copy the state pytree per turn)
     grp_remaining = st.group_size - state.group_placed
-    grp_elig = (
-        st.group_valid
-        & ~st.group_best_effort
-        & (grp_remaining > 0)
-        & ~state.group_unfit
-        & sess.job_sched_valid[st.group_job]
-    )
+    grp_elig = group_live_mask(st, sess, state.group_placed, state.group_unfit)
     job_has_pending = jnp.zeros(J, dtype=bool).at[st.group_job].max(grp_elig)
     jmask = (st.job_queue == q) & job_has_pending & st.job_valid & q_ok
 
@@ -514,22 +516,28 @@ def _claim_turn(
 
 
 def _rounds(st, sess, state, tiers, s_max, max_rounds, mode, layouts):
-    # as in allocate._round: only real queues get turns (traced bound)
+    # as in allocate._round: only ACTIVE queues (with an eligible claimant
+    # job) get turns — a claimant-less queue's turn is a strict no-op, so
+    # 512 namespace-queues with a handful of preemptors pay ~a-handful of
+    # turns per round, not 512 (traced bound)
     Q = st.num_queues
-    nq = jnp.asarray(st.n_valid_queues, jnp.int32)
-    Q = jnp.where((nq > 0) & (nq < Q), nq, Q)
 
     def round_body(s):
         s = dataclasses.replace(s, progress=jnp.array(False))
+        grp_live = group_live_mask(st, sess, s.group_placed, s.group_unfit)
+        q_active = st.queue_valid & queue_has_live_job(st, grp_live)
+        nq = jnp.sum(q_active.astype(jnp.int32))
+        trip = jnp.where(nq > 0, nq, 1)
         q_share = queue_shares(s.queue_alloc, sess.deserved)
         keys = queue_order_keys(tiers, q_share, st.queue_uid_rank)
-        keys = [jnp.where(st.queue_valid, k, BIG) for k in keys]
+        keys = [jnp.where(q_active, k, BIG) for k in keys]
+        keys.insert(0, jnp.where(q_active, 0.0, 1.0))
         perm = jnp.lexsort(tuple(reversed(keys)))
 
         def body(qi, ss):
             return _claim_turn(perm[qi], st, sess, ss, tiers, s_max, mode, layouts)
 
-        s = jax.lax.fori_loop(0, Q, body, s)
+        s = jax.lax.fori_loop(0, trip, body, s)
         return dataclasses.replace(s, rounds=s.rounds + 1)
 
     def cond(s):
