@@ -658,12 +658,8 @@ def _reclaim_fast(
         active = st.queue_valid[q] & (q_entries[q] > 0)
 
         # ---- job pop (JobOrderFn over the queue's unconsumed jobs) ----
-        grp_remaining = st.group_size - state.group_placed
         grp_elig = (
-            st.group_valid
-            & ~st.group_best_effort
-            & (grp_remaining > 0)
-            & sess.job_sched_valid[st.group_job]
+            group_live_mask(st, sess, state.group_placed, None)
             & ~job_consumed[st.group_job]
         )
         job_has_pending = jnp.zeros(J, dtype=bool).at[st.group_job].max(grp_elig)
@@ -796,18 +792,25 @@ def _reclaim_fast(
         )
         return state, q_entries, job_consumed, perm
 
-    nq_valid = jnp.asarray(st.n_valid_queues, jnp.int32)
-    Q_trip = jnp.where((nq_valid > 0) & (nq_valid < Q), nq_valid, Q)
-
     def round_body(carry):
         state, q_entries, job_consumed = carry
         state = dataclasses.replace(state, progress=jnp.array(False))
+        # ACTIVE queues only: a queue with no entries left or no eligible
+        # unconsumed job can neither claim nor meaningfully burn entries —
+        # its turn is a strict no-op, so it sorts last and the trip bound
+        # skips it (512 namespace-queues cost ~the active count)
+        grp_live = group_live_mask(st, sess, state.group_placed, None)
+        q_has_job = queue_has_live_job(st, grp_live, job_extra=~job_consumed)
+        q_active = st.queue_valid & (q_entries > 0) & q_has_job
+        nq = jnp.sum(q_active.astype(jnp.int32))
+        trip = jnp.where(nq > 0, nq, 1)
         q_share = queue_shares(state.queue_alloc, sess.deserved)
         qkeys = queue_order_keys(tiers, q_share, st.queue_uid_rank)
-        qkeys = [jnp.where(st.queue_valid, k, BIG) for k in qkeys]
+        qkeys = [jnp.where(q_active, k, BIG) for k in qkeys]
+        qkeys.insert(0, jnp.where(q_active, 0.0, 1.0))
         perm = jnp.lexsort(tuple(reversed(qkeys)))
         state, q_entries, job_consumed, _ = jax.lax.fori_loop(
-            0, Q_trip, queue_turn, (state, q_entries, job_consumed, perm)
+            0, trip, queue_turn, (state, q_entries, job_consumed, perm)
         )
         return dataclasses.replace(state, rounds=state.rounds + 1), q_entries, job_consumed
 

@@ -1,0 +1,75 @@
+"""Cluster-model mutation detector — the analog of the k8s informer
+cache-mutation detector the reference's unit harness enables by default
+(``KUBE_CACHE_MUTATION_DETECTOR=true``, ``hack/make-rules/test.sh:26-29``:
+panic if anything mutates a shared informer object).
+
+Here the invariant is: the DECISION plane (snapshot build + the jitted
+cycle + decode) must never mutate the cluster model — only the actuation
+plane (apply_binds/apply_evicts, the informer handlers) may.  A fingerprint
+of the whole ClusterInfo object graph is taken before and compared after;
+tests wrap scheduling calls in :func:`assert_no_model_mutation`.
+"""
+from __future__ import annotations
+
+import contextlib
+import hashlib
+from typing import Iterator
+
+import numpy as np
+
+
+def _fp(h, obj) -> None:
+    """Order-stable structural fingerprint of the model's object graph.
+    Every value is framed with a type tag and length delimiter so adjacent
+    values can never concatenate ambiguously (e.g. (12, 3) vs (1, 23)),
+    and ndarray shape/dtype changes are visible even when the raw bytes
+    match (reshape/view)."""
+    if obj is None or isinstance(obj, (str, int, float, bool, bytes)):
+        r = repr(obj).encode()
+        h.update(f"<{type(obj).__name__}:{len(r)}>".encode())
+        h.update(r)
+    elif isinstance(obj, np.ndarray):
+        h.update(f"<nd:{obj.dtype}:{obj.shape}>".encode())
+        h.update(obj.tobytes())
+    elif isinstance(obj, dict):
+        for k in sorted(obj, key=repr):
+            h.update(repr(k).encode())
+            _fp(h, obj[k])
+    elif isinstance(obj, (list, tuple, set, frozenset)):
+        items = sorted(obj, key=repr) if isinstance(obj, (set, frozenset)) else obj
+        for x in items:
+            _fp(h, x)
+    elif hasattr(obj, "__dict__"):
+        for k in sorted(vars(obj)):
+            # documented exemption: the snapshot flattener stamps decode
+            # ordinals onto model objects (SnapshotIndex bookkeeping) — the
+            # one sanctioned write; everything else must be untouched
+            if k == "ordinal":
+                continue
+            h.update(k.encode())
+            _fp(h, vars(obj)[k])
+    else:
+        h.update(repr(obj).encode())
+
+
+def model_fingerprint(cluster) -> str:
+    h = hashlib.sha256()
+    _fp(h, cluster)
+    return h.hexdigest()
+
+
+class ModelMutated(AssertionError):
+    """The decision plane mutated the cluster model."""
+
+
+@contextlib.contextmanager
+def assert_no_model_mutation(cluster) -> Iterator[None]:
+    """Context manager: fingerprint the model before, verify after."""
+    before = model_fingerprint(cluster)
+    yield
+    after = model_fingerprint(cluster)
+    if before != after:
+        raise ModelMutated(
+            "decision plane mutated the cluster model (snapshot/cycle/decode "
+            "must be read-only; only actuation may write)"
+        )

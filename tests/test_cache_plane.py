@@ -226,3 +226,36 @@ def test_deferred_job_gc():
     assert "j1" not in sim.cluster.jobs
     # FIFO drained
     assert sim.collect_garbage(now=300.0) == []
+
+
+def test_decision_plane_never_mutates_model():
+    """Cache-mutation-detector analog (SURVEY §5: the reference's unit
+    harness sets KUBE_CACHE_MUTATION_DETECTOR=true, panicking when a
+    shared informer object is mutated).  Here: snapshot build + the full
+    jitted cycle + decode must leave the cluster model untouched — only
+    actuation writes."""
+    from kube_arbitrator_tpu.cache import build_snapshot, generate_cluster
+    from kube_arbitrator_tpu.cache.decode import decode_decisions
+    from kube_arbitrator_tpu.ops import schedule_cycle
+    from kube_arbitrator_tpu.utils.mutation_detector import assert_no_model_mutation
+
+    sim = generate_cluster(num_nodes=20, num_jobs=6, tasks_per_job=8,
+                           num_queues=3, seed=13, running_fraction=0.4)
+    with assert_no_model_mutation(sim.cluster):
+        snap = build_snapshot(sim.cluster)
+        dec = schedule_cycle(
+            snap.tensors, actions=("reclaim", "allocate", "backfill", "preempt")
+        )
+        decode_decisions(snap, dec)
+
+    # control: actuation IS a mutation the detector must catch
+    import pytest
+    from kube_arbitrator_tpu.utils.mutation_detector import ModelMutated
+
+    snap = build_snapshot(sim.cluster)
+    dec = schedule_cycle(snap.tensors)
+    binds, evicts = decode_decisions(snap, dec)
+    assert binds
+    with pytest.raises(ModelMutated):
+        with assert_no_model_mutation(sim.cluster):
+            sim.apply_binds(binds)
