@@ -86,7 +86,7 @@ def main() -> None:
 
     num_tasks = int(os.environ.get("BENCH_TASKS", 100_000))
     num_nodes = int(os.environ.get("BENCH_NODES", 10_000))
-    oracle_cap_s = float(os.environ.get("BENCH_ORACLE_CAP_S", 120.0))
+    oracle_cap_s = float(os.environ.get("BENCH_ORACLE_CAP_S", 60.0))
     run_ladder = os.environ.get("BENCH_LADDER", "1") != "0"
 
     # --- the BASELINE ladder (stderr rows) ---
@@ -107,22 +107,25 @@ def main() -> None:
             ("full_actions_q512@50000x5000", 50_000, 5_000, 512, 0.5, FULL_ACTIONS),
         ]
         for metric, T, N, Q, frac, actions in ladder:
-            snap = _cluster(T, N, Q, frac)
-            cycle_s, dec = _time_cycle(schedule_cycle, snap.tensors, actions)
-            placed = int(np.asarray(dec.bind_mask).sum())
-            evicted = int(np.asarray(dec.evict_mask).sum())
-            _emit(
-                {
-                    "metric": metric,
-                    "value": round(placed / cycle_s, 1) if cycle_s > 0 else 0.0,
-                    "unit": "pods/s",
-                    "cycle_ms": round(cycle_s * 1000, 1),
-                    "binds": placed,
-                    "evicts": evicted,
-                    "cadence_contract_s": 1.0,
-                },
-                stream=sys.stderr,
-            )
+            try:
+                snap = _cluster(T, N, Q, frac)
+                cycle_s, dec = _time_cycle(schedule_cycle, snap.tensors, actions)
+                placed = int(np.asarray(dec.bind_mask).sum())
+                evicted = int(np.asarray(dec.evict_mask).sum())
+                _emit(
+                    {
+                        "metric": metric,
+                        "value": round(placed / cycle_s, 1) if cycle_s > 0 else 0.0,
+                        "unit": "pods/s",
+                        "cycle_ms": round(cycle_s * 1000, 1),
+                        "binds": placed,
+                        "evicts": evicted,
+                        "cadence_contract_s": 1.0,
+                    },
+                    stream=sys.stderr,
+                )
+            except Exception as e:  # a failed row must not kill the primary line
+                print(f"# ladder row {metric} failed: {e}", file=sys.stderr)
 
     # --- primary: the north-star config vs the compiled sequential loop ---
     from kube_arbitrator_tpu.cache import generate_cluster

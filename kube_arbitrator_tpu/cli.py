@@ -72,6 +72,13 @@ def build_parser() -> argparse.ArgumentParser:
         default="",
         help="run as a decision sidecar bound to BIND (e.g. 0.0.0.0:8686) and serve forever",
     )
+    p.add_argument(
+        "--watch-stream",
+        default="",
+        help="schedule against a recorded apiserver watch stream (JSONL from "
+        "FakeApiServer.dump_stream) through the live-cluster plane instead "
+        "of the simulator",
+    )
     # snapshot trace record/replay (SURVEY §5: snapshot persistence)
     p.add_argument(
         "--record-trace",
@@ -137,16 +144,30 @@ def main(argv=None) -> int:
             print(json.dumps(line))
         return 0
 
-    from .cache.sim import generate_cluster
     from .framework import Scheduler
 
-    sim = generate_cluster(
-        num_nodes=args.sim_nodes,
-        num_jobs=args.sim_jobs,
-        tasks_per_job=args.sim_tasks_per_job,
-        num_queues=args.sim_queues,
-        seed=args.sim_seed,
-    )
+    if args.watch_stream:
+        # live-cluster plane over a recorded apiserver stream: list/watch
+        # ingestion, bind/evict/status actuation back into the replayed
+        # server (cache.go:225-306 surface; see cache/live.py)
+        from .cache import FakeApiServer, LiveCache
+
+        try:
+            api = FakeApiServer.from_stream(FakeApiServer.load_stream(args.watch_stream))
+        except (OSError, ValueError, KeyError) as e:
+            print(f"error: invalid watch stream {args.watch_stream}: {e}", file=sys.stderr)
+            return 1
+        sim = LiveCache(api)
+    else:
+        from .cache.sim import generate_cluster
+
+        sim = generate_cluster(
+            num_nodes=args.sim_nodes,
+            num_jobs=args.sim_jobs,
+            tasks_per_job=args.sim_tasks_per_job,
+            num_queues=args.sim_queues,
+            seed=args.sim_seed,
+        )
     decider = None
     if args.decision_endpoint:
         # fail fast on a bad endpoint instead of a mid-run traceback

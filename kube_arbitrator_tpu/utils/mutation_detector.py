@@ -32,13 +32,17 @@ def _fp(h, obj) -> None:
         h.update(f"<nd:{obj.dtype}:{obj.shape}>".encode())
         h.update(obj.tobytes())
     elif isinstance(obj, dict):
+        h.update(f"<dict:{len(obj)}>".encode())
         for k in sorted(obj, key=repr):
             h.update(repr(k).encode())
             _fp(h, obj[k])
+        h.update(b"</dict>")
     elif isinstance(obj, (list, tuple, set, frozenset)):
+        h.update(f"<{type(obj).__name__}:{len(obj)}>".encode())
         items = sorted(obj, key=repr) if isinstance(obj, (set, frozenset)) else obj
         for x in items:
             _fp(h, x)
+        h.update(b"</c>")
     elif hasattr(obj, "__dict__"):
         for k in sorted(vars(obj)):
             # documented exemption: the snapshot flattener stamps decode

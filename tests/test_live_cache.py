@@ -235,3 +235,23 @@ def test_namespace_as_queue_backend():
     live.sync()
     assert "team-a" in live.cluster.queues
     assert live.cluster.jobs["team-a/g"].queue_uid == "team-a"
+
+
+def test_cli_watch_stream_mode(tmp_path, capsys):
+    """The binary surface reaches the live plane: --watch-stream replays a
+    recorded apiserver stream, schedules through LiveCache, and actuates
+    back into the replayed server."""
+    api = FakeApiServer()
+    seed_gang_cluster(api, n_pods=4)
+    path = str(tmp_path / "stream.jsonl")
+    api.dump_stream(path)
+
+    from kube_arbitrator_tpu.cli import main
+
+    rc = main(["--watch-stream", path, "--cycles", "3", "--json"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    import json
+
+    lines = [json.loads(l) for l in out.strip().splitlines() if l.startswith("{")]
+    assert sum(l["binds"] for l in lines) == 4
