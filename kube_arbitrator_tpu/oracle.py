@@ -439,7 +439,7 @@ class SequentialScheduler:
 
     def _victims_drf(self, claimant, preemptees):
         """drf.go:80-107.  The per-call ``allocations`` map subtracts every
-        CONSIDERED victim (the mutating ``Sub`` at drf.go:94 persists even
+        CONSIDERED victim (the mutating ``Sub`` at drf.go:93 persists even
         when the victim is rejected), not just accepted ones."""
         out = []
         freed = res.zeros()

@@ -34,7 +34,7 @@ def _fp(h, obj) -> None:
     elif isinstance(obj, dict):
         h.update(f"<dict:{len(obj)}>".encode())
         for k in sorted(obj, key=repr):
-            h.update(repr(k).encode())
+            _fp(h, k)  # keys get the same frame as values
             _fp(h, obj[k])
         h.update(b"</dict>")
     elif isinstance(obj, (list, tuple, set, frozenset)):
@@ -50,7 +50,7 @@ def _fp(h, obj) -> None:
             # one sanctioned write; everything else must be untouched
             if k == "ordinal":
                 continue
-            h.update(k.encode())
+            _fp(h, k)
             _fp(h, vars(obj)[k])
     else:
         h.update(repr(obj).encode())
