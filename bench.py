@@ -78,37 +78,14 @@ def main() -> None:
     except Exception:
         pass
 
-    # Probe the accelerator in a subprocess first: a wedged TPU tunnel
-    # hangs jax.devices() uninterruptibly, which would hang the whole
-    # bench run.  On probe timeout, fall back to CPU — the emitted lines
-    # carry the device string, so a CPU run is honestly labeled.
-    probe_timeout = float(os.environ.get("BENCH_BACKEND_PROBE_TIMEOUT_S", 120.0))
-    already_cpu = (jax.config.jax_platforms or "").strip() == "cpu"
-    if probe_timeout > 0 and not already_cpu:
-        import subprocess
-
-        # Popen + wait(timeout), then ABANDON the child in its own session:
-        # a probe stuck uninterruptibly inside device init cannot be
-        # SIGKILL-reaped, and subprocess.run()'s kill-then-unbounded-wait
-        # would hang the parent with it.
-        proc = subprocess.Popen(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-            start_new_session=True,
-        )
-        try:
-            proc.wait(timeout=probe_timeout)
-        except subprocess.TimeoutExpired:
-            print(
-                f"# accelerator init exceeded {probe_timeout:.0f}s; "
-                "falling back to CPU", file=sys.stderr,
-            )
-            proc.kill()  # best effort; the orphan dies with its session
-            jax.config.update("jax_platforms", "cpu")
-
+    # Wedged-tunnel protection lives in the shared bootstrap (probe in a
+    # subprocess, CPU fallback) so every entry point gets it; the emitted
+    # lines carry the device string, so a CPU fallback run is honestly
+    # labeled.  BENCH_BACKEND_PROBE_TIMEOUT_S remains an override.
     from kube_arbitrator_tpu.platform import ensure_jax_backend
 
-    ensure_jax_backend()
+    probe = os.environ.get("BENCH_BACKEND_PROBE_TIMEOUT_S")
+    ensure_jax_backend(probe_timeout_s=float(probe) if probe else None)
 
     from kube_arbitrator_tpu.ops import schedule_cycle
 

@@ -1,13 +1,60 @@
 """Accelerator platform bootstrap shared by the CLI and bench entry points."""
 from __future__ import annotations
 
+import os
 
-def ensure_jax_backend() -> None:
+
+def probe_backend(timeout_s: float) -> bool:
+    """Probe accelerator init in a SUBPROCESS with a hard timeout.
+
+    A wedged TPU tunnel hangs ``jax.devices()`` uninterruptibly (D-state),
+    so the probe must be a separate process the parent can abandon: on
+    timeout the whole process GROUP is killed (``killpg`` — the child is a
+    session leader via start_new_session, and device init may fork
+    helpers that a single-pid kill would leak) and False is returned.
+    """
+    import signal
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        start_new_session=True,
+    )
+    try:
+        proc.wait(timeout=timeout_s)
+        return True
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        return False
+
+
+def ensure_jax_backend(probe_timeout_s: float | None = None) -> None:
     """Initialize the JAX backend, falling back to autodetection when the
     environment names a platform whose plugin isn't registered in this
-    process (e.g. a stripped PYTHONPATH dropped the sitecustomize that
-    registers the TPU plugin)."""
+    process, and falling back to CPU when accelerator init exceeds the
+    probe timeout (``KAT_BACKEND_PROBE_TIMEOUT_S``, default 120 s; 0
+    disables the probe) — shared by every entry point so none of them can
+    hang forever on a wedged device tunnel."""
+    import sys
+
     import jax
+
+    if probe_timeout_s is None:
+        probe_timeout_s = float(os.environ.get("KAT_BACKEND_PROBE_TIMEOUT_S", 120.0))
+    already_cpu = (jax.config.jax_platforms or "").strip() == "cpu"
+    if probe_timeout_s > 0 and not already_cpu:
+        if not probe_backend(probe_timeout_s):
+            print(
+                f"warning: accelerator init exceeded {probe_timeout_s:.0f}s; "
+                "falling back to CPU",
+                file=sys.stderr,
+            )
+            jax.config.update("jax_platforms", "cpu")
 
     try:
         jax.devices()
