@@ -23,6 +23,9 @@ RESOURCES = (
     "queues",
     "namespaces",
     "pdbs",
+    # the leader-election resourcelock kind (server.go:102-115 uses a
+    # ConfigMap resourcelock); the scheduler cache ignores these events
+    "configmaps",
 )
 
 ADDED = "ADDED"
@@ -32,7 +35,14 @@ DELETED = "DELETED"
 
 class ApiError(RuntimeError):
     """A failed REST call (non-2xx) — triggers the caller's errTasks
-    resync path, like a failed POST bind (cache.go:519-547)."""
+    resync path, like a failed POST bind (cache.go:519-547).
+
+    ``status`` carries the HTTP status code across the wire so clients
+    can branch on semantics (404 vs 409) instead of message prose."""
+
+    def __init__(self, message: str, status: int = 422):
+        super().__init__(message)
+        self.status = status
 
 
 def _key(obj: dict) -> Tuple[str, str]:
@@ -61,32 +71,62 @@ class FakeApiServer:
     def _bump(self, resource: str, etype: str, obj: dict) -> None:
         self._rv += 1
         obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
-        self.event_log.append((self._rv, resource, etype, copy.deepcopy(obj)))
+        # the scheduler cache declaredly ignores configmaps (the lock
+        # kind), and lease renewals write one every few seconds forever —
+        # logging them would grow the never-compacted event log and every
+        # watch_all scan without bound (review finding round 4)
+        if resource != "configmaps":
+            self.event_log.append((self._rv, resource, etype, copy.deepcopy(obj)))
 
     def create(self, resource: str, obj: dict) -> dict:
         k = _key(obj)
         if k in self._store[resource]:
-            raise ApiError(f"{resource} {k} already exists")
+            raise ApiError(f"{resource} {k} already exists", status=409)
         obj = copy.deepcopy(obj)
         self._store[resource][k] = obj
         self._bump(resource, ADDED, obj)
         return copy.deepcopy(obj)
 
-    def update(self, resource: str, obj: dict) -> dict:
+    def update(self, resource: str, obj: dict, expect_rv: Optional[str] = None) -> dict:
+        """PUT; ``expect_rv`` is the optimistic-concurrency precondition
+        (metadata.resourceVersion match) the reference's resourcelock
+        leader election relies on (server.go:102-125 via client-go
+        resourcelock CAS updates) — mismatch is a 409 Conflict."""
         k = _key(obj)
-        if k not in self._store[resource]:
-            raise ApiError(f"{resource} {k} not found")
+        cur = self._store[resource].get(k)
+        if cur is None:
+            raise ApiError(f"{resource} {k} not found", status=404)
+        self._check_rv(cur, resource, k, expect_rv)
         obj = copy.deepcopy(obj)
         self._store[resource][k] = obj
         self._bump(resource, MODIFIED, obj)
         return copy.deepcopy(obj)
 
-    def delete(self, resource: str, namespace: str, name: str) -> None:
+    @staticmethod
+    def _check_rv(cur: dict, resource: str, k, expect_rv: Optional[str]) -> None:
+        """Optimistic-concurrency precondition shared by PUT and DELETE."""
+        if expect_rv is None:
+            return
+        have = cur.get("metadata", {}).get("resourceVersion")
+        if have != str(expect_rv):
+            raise ApiError(
+                f"{resource} {k} conflict: resourceVersion {have} != {expect_rv}",
+                status=409,
+            )
+
+    def delete(
+        self, resource: str, namespace: str, name: str,
+        expect_rv: Optional[str] = None,
+    ) -> None:
+        """DELETE; ``expect_rv`` makes it a compare-and-delete so a stale
+        ex-leader cannot remove a lease a standby just re-acquired."""
         k = (namespace, name)
-        obj = self._store[resource].pop(k, None)
-        if obj is None:
-            raise ApiError(f"{resource} {k} not found")
-        self._bump(resource, DELETED, obj)
+        cur = self._store[resource].get(k)
+        if cur is None:
+            raise ApiError(f"{resource} {k} not found", status=404)
+        self._check_rv(cur, resource, k, expect_rv)
+        del self._store[resource][k]
+        self._bump(resource, DELETED, cur)
 
     def get(self, resource: str, namespace: str, name: str) -> Optional[dict]:
         obj = self._store[resource].get((namespace, name))
@@ -122,11 +162,11 @@ class FakeApiServer:
         (DefaultBinder, cache.go:88-104)."""
         pod = self._store["pods"].get((namespace, name))
         if pod is None:
-            raise ApiError(f"pod {namespace}/{name} not found")
+            raise ApiError(f"pod {namespace}/{name} not found", status=404)
         if pod.get("metadata", {}).get("uid") in self.fail_bind_uids:
             raise ApiError(f"bind {namespace}/{name} injected failure")
         if pod.get("spec", {}).get("nodeName"):
-            raise ApiError(f"pod {namespace}/{name} already bound")
+            raise ApiError(f"pod {namespace}/{name} already bound", status=409)
         pod.setdefault("spec", {})["nodeName"] = node_name
         self._bump("pods", MODIFIED, pod)
         if self.auto_run_bound_pods:
@@ -137,7 +177,7 @@ class FakeApiServer:
         """DELETE pod (DefaultEvictor, cache.go:106-123)."""
         pod = self._store["pods"].get((namespace, name))
         if pod is None:
-            raise ApiError(f"pod {namespace}/{name} not found")
+            raise ApiError(f"pod {namespace}/{name} not found", status=404)
         if pod.get("metadata", {}).get("uid") in self.fail_delete_uids:
             raise ApiError(f"evict {namespace}/{name} injected failure")
         self.delete("pods", namespace, name)
@@ -147,7 +187,7 @@ class FakeApiServer:
         cache.go:125-142): replaces the condition of the same type."""
         pod = self._store["pods"].get((namespace, name))
         if pod is None:
-            raise ApiError(f"pod {namespace}/{name} not found")
+            raise ApiError(f"pod {namespace}/{name} not found", status=404)
         conds = pod.setdefault("status", {}).setdefault("conditions", [])
         conds[:] = [c for c in conds if c.get("type") != condition.get("type")]
         conds.append(copy.deepcopy(condition))
@@ -157,7 +197,7 @@ class FakeApiServer:
         """PUT /status on a PodGroup (StatusUpdater, cache.go:665-675)."""
         pg = self._store["podgroups"].get((namespace, name))
         if pg is None:
-            raise ApiError(f"podgroup {namespace}/{name} not found")
+            raise ApiError(f"podgroup {namespace}/{name} not found", status=404)
         pg["status"] = copy.deepcopy(status)
         self._bump("podgroups", MODIFIED, pg)
         return copy.deepcopy(pg)

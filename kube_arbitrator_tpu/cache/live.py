@@ -248,12 +248,23 @@ class LiveCache:
         single-threaded)."""
         n = 0
         if not self._listed:
+            first_rv = None
             for resource in self._LIST_ORDER:
                 items, rv = self.api.list(resource)
+                if first_rv is None:
+                    first_rv = rv
                 for obj in items:
                     self._dispatch(resource, ADDED, obj)
                     n += 1
-                self._watch_rv = max(self._watch_rv, rv)
+            # Watch from the FIRST list's rv, not the last: a concurrent
+            # writer (possible now that the apiserver is an HTTP service)
+            # may touch an early-listed resource while later LISTs run;
+            # starting low replays some events already reflected in later
+            # lists, but every handler is an idempotent upsert/delete, so
+            # duplicates are harmless while a gap would be a permanently
+            # stale object (informers watch from each LIST's own rv;
+            # one global ordered stream lets one low-water mark do it).
+            self._watch_rv = max(self._watch_rv, first_rv or 0)
             self._listed = True
             return n
         for rv, resource, etype, obj in self.api.watch_all(self._watch_rv):
@@ -270,7 +281,9 @@ class LiveCache:
             "queues": self._on_queue,
             "namespaces": self._on_namespace,
             "pdbs": self._on_pdb,
-        }[resource]
+        }.get(resource)
+        if handler is None:
+            return  # kinds the scheduler does not watch (e.g. configmaps)
         handler(etype, obj)
 
     # ---- handlers (event_handlers.go) ----

@@ -1,7 +1,7 @@
 """Framework: session, conf, registries, scheduler loop."""
 from ..options import ServerOptions, options, reset_options, set_options
 from .conf import DEFAULT_CONF, SchedulerConfig, load_conf, load_conf_file
-from .leader import LeaderElector, LeaderLost, LeaseRecord
+from .leader import ApiLeaderElector, LeaderElector, LeaderLost, LeaseRecord
 from .registry import get_action, plugin_capabilities, register_action, register_plugin
 from .scheduler import CycleStats, Scheduler
 from .session import CycleResult, PodGroupCondition, PodGroupStatus, Session
@@ -21,6 +21,7 @@ __all__ = [
     "CycleResult",
     "PodGroupCondition",
     "PodGroupStatus",
+    "ApiLeaderElector",
     "LeaderElector",
     "LeaderLost",
     "LeaseRecord",

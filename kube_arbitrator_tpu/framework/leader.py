@@ -4,20 +4,30 @@ Reference ``cmd/kube-batch/app/server.go:102-125``: optional leader election
 over a ConfigMap resourcelock (15 s lease, 10 s renew deadline, 5 s retry);
 only the leader runs ``sched.Run``; losing the lease is fatal.
 
-The TPU-native equivalent keeps the same lease semantics over a shared
-filesystem lock object (the deployment analog of the ConfigMap: any path on
-storage all replicas mount).  Writes are atomic (temp file + rename) and
-serialized with an ``fcntl`` lock so two contenders on one host cannot both
-win a race for a stale lease.
+Two lock backends share one election state machine (`_ElectorBase`):
+
+* :class:`LeaderElector` — a filesystem lease (the deployment analog of the
+  ConfigMap: any path on storage all replicas mount).  Writes are atomic
+  (temp file + rename) and serialized with an ``fcntl`` lock so two
+  contenders on one host cannot both win a race for a stale lease.
+* :class:`ApiLeaderElector` — the reference's in-cluster shape: the
+  LeaderElectionRecord lives in a ConfigMap annotation and contenders race
+  through resourceVersion-preconditioned updates (client-go resourcelock
+  CAS semantics), so schedulers on DIFFERENT hosts contend through one
+  apiserver — ``api`` is anything speaking the FakeApiServer verbs, the
+  in-process store or :class:`cache.httpapi.HttpApiClient`.
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import fcntl
 import json
 import os
 import time
 import uuid
+
+from ..cache.fakeapi import ApiError
 from typing import Callable, Optional
 
 
@@ -41,7 +51,148 @@ class LeaseRecord:
         return cls(**json.loads(s))
 
 
-class LeaderElector:
+class TransientLockError(RuntimeError):
+    """Storage hiccup (apiserver unreachable / 5xx): the lease state is
+    UNKNOWN, as opposed to definitively lost."""
+
+
+class _ElectorBase:
+    """The client-go leaderelection state machine over abstract storage.
+
+    Subclasses provide ``_fetch() -> (token, LeaseRecord|None)`` (raising
+    :class:`TransientLockError` when the store cannot be read),
+    ``_push(token, rec) -> bool`` (False on a lost write race) and
+    ``_delete(token)``; ``_locked()`` may serialize the read-modify-write
+    for backends without compare-and-swap.
+
+    Two client-go behaviors matter for multi-host correctness:
+
+    * **Observer-local lease timing.**  A contender never compares its own
+      clock against the holder's embedded ``renew_ts`` (cross-host clock
+      skew would let a skewed standby steal a live lease and run two
+      leaders).  Instead it remembers WHEN IT FIRST OBSERVED the current
+      record on its own clock and only treats the lease as expired once a
+      full ``lease_duration_s`` passes without the record changing
+      (client-go's observedRecord/observedTime).
+    * **Renew-deadline grace.**  A transient storage error during renewal
+      keeps leadership until ``renew_deadline_s`` elapses since the last
+      SUCCESSFUL renewal; only then is the lease reported lost."""
+
+    identity: str
+    lease_duration_s: float
+    renew_deadline_s: float
+    retry_period_s: float
+    now: Callable[[], float]
+    _is_leader: bool = False
+    _observed_key = None      # (holder, renew_ts) of the last seen record
+    _observed_at: float = 0.0  # our clock when that record FIRST appeared
+    _last_renew_ok: float = 0.0
+
+    def _locked(self):
+        return contextlib.nullcontext()
+
+    def _observe(self, cur: Optional[LeaseRecord], now: float) -> None:
+        key = (cur.holder, cur.renew_ts) if cur is not None else None
+        if key != self._observed_key:
+            self._observed_key = key
+            self._observed_at = now
+
+    # ---- election decisions (shared) ----
+
+    def try_acquire(self) -> bool:
+        """One acquisition attempt: take the lease if unheld, expired (on
+        OUR observation clock), or already ours.  Returns leadership."""
+        with self._locked():
+            try:
+                token, cur = self._fetch()
+            except TransientLockError:
+                self._is_leader = False
+                return False  # can't read the lock: keep retrying
+            now = self.now()
+            self._observe(cur, now)
+            if cur is not None and cur.holder != self.identity:
+                if now - self._observed_at < cur.lease_duration_s:
+                    self._is_leader = False
+                    return False  # held by a live (recently-observed) leader
+            acquired = cur.acquired_ts if cur and cur.holder == self.identity else now
+            rec = LeaseRecord(
+                holder=self.identity,
+                acquired_ts=acquired,
+                renew_ts=now,
+                lease_duration_s=self.lease_duration_s,
+            )
+            self._is_leader = self._push(token, rec)
+            if self._is_leader:
+                self._last_renew_ok = now
+            return self._is_leader
+
+    def renew(self) -> bool:
+        """Renew our lease; False when another holder took it (we were
+        expired and usurped) or the renew deadline passed.  A transient
+        storage error keeps leadership within the renew deadline."""
+        with self._locked():
+            try:
+                token, cur = self._fetch()
+            except TransientLockError:
+                # deadline must use the clock AFTER the fetch: a hung
+                # apiserver call (client timeout ~ renew deadline) must
+                # not extend leadership past the deadline while a standby
+                # legitimately steals the stale lease (dual-leader hole)
+                now = self.now()
+                if self._is_leader and now - self._last_renew_ok <= self.renew_deadline_s:
+                    return True  # storage blip; retry next period
+                self._is_leader = False
+                return False
+            now = self.now()
+            self._observe(cur, now)
+            if cur is None or cur.holder != self.identity:
+                self._is_leader = False
+                return False
+            if now - self._last_renew_ok > self.renew_deadline_s:
+                # we failed to renew in time; treat as lost even if nobody
+                # has usurped yet (client-go renew-deadline semantics)
+                self._is_leader = False
+                return False
+            pushed = self._push(token, dataclasses.replace(cur, renew_ts=now))
+            if pushed:
+                self._last_renew_ok = now
+                self._is_leader = True
+            elif now - self._last_renew_ok <= self.renew_deadline_s:
+                return self._is_leader  # write blip/race; retry next period
+            else:
+                self._is_leader = False
+            return self._is_leader
+
+    def release(self) -> None:
+        """Voluntary release (delete the lock object) so a standby can take
+        over immediately instead of waiting out the lease."""
+        with self._locked():
+            try:
+                token, cur = self._fetch()
+            except TransientLockError:
+                self._is_leader = False  # best-effort: lease will expire
+                return
+            if cur is not None and cur.holder == self.identity:
+                self._delete(token)
+            self._is_leader = False
+
+    @property
+    def is_leader(self) -> bool:
+        return self._is_leader
+
+    def acquire_blocking(self, timeout_s: Optional[float] = None) -> bool:
+        """RunOrDie's acquisition loop: retry every retry_period until
+        leadership (or timeout, for tests/CLI)."""
+        start = self.now()
+        while True:
+            if self.try_acquire():
+                return True
+            if timeout_s is not None and self.now() - start >= timeout_s:
+                return False
+            time.sleep(self.retry_period_s)
+
+
+class LeaderElector(_ElectorBase):
     """File-lease leader election with the client-go leaderelection
     parameters (lease duration / renew deadline / retry period)."""
 
@@ -63,89 +214,115 @@ class LeaderElector:
         self._is_leader = False
         os.makedirs(os.path.dirname(os.path.abspath(lock_path)), exist_ok=True)
 
-    # ---- lease file primitives ----
+    # ---- storage hooks ----
 
-    def _mutex_path(self) -> str:
-        return self.lock_path + ".mutex"
+    @contextlib.contextmanager
+    def _locked(self):
+        # the file backend has no CAS; flock serializes read-modify-write
+        with open(self.lock_path + ".mutex", "w") as mf:
+            fcntl.flock(mf, fcntl.LOCK_EX)
+            yield
 
-    def _read(self) -> Optional[LeaseRecord]:
+    def _fetch(self):
         try:
             with open(self.lock_path) as f:
-                return LeaseRecord.from_json(f.read())
+                return None, LeaseRecord.from_json(f.read())
         except (FileNotFoundError, ValueError, TypeError, KeyError):
-            return None
+            return None, None
 
-    def _write(self, rec: LeaseRecord) -> None:
+    def _push(self, token, rec: LeaseRecord) -> bool:
         tmp = f"{self.lock_path}.{self.identity}.tmp"
         with open(tmp, "w") as f:
             f.write(rec.to_json())
         os.rename(tmp, self.lock_path)
+        return True  # the flock in _locked() already excluded racers
 
-    # ---- election ----
+    def _delete(self, token) -> None:
+        os.unlink(self.lock_path)
 
-    def try_acquire(self) -> bool:
-        """One acquisition attempt: take the lease if unheld, expired, or
-        already ours.  Returns leadership."""
-        with open(self._mutex_path(), "w") as mf:
-            fcntl.flock(mf, fcntl.LOCK_EX)
-            now = self.now()
-            cur = self._read()
-            if cur is not None and cur.holder != self.identity:
-                if now - cur.renew_ts < cur.lease_duration_s:
-                    self._is_leader = False
-                    return False  # held by a live leader
-            acquired = cur.acquired_ts if cur and cur.holder == self.identity else now
-            self._write(
-                LeaseRecord(
-                    holder=self.identity,
-                    acquired_ts=acquired,
-                    renew_ts=now,
-                    lease_duration_s=self.lease_duration_s,
+
+LOCK_CONFIGMAP = "kube-batch-lock"  # reference default lock object name
+LEASE_ANNOTATION = "control-plane.alpha.kubernetes.io/leader"
+
+
+class ApiLeaderElector(_ElectorBase):
+    """Leader election over an apiserver ConfigMap resourcelock
+    (``server.go:102-125`` via client-go's ConfigMapsResourceLock).
+
+    Storage races resolve through resourceVersion CAS instead of a host
+    mutex; transient apiserver failures (unreachable / 5xx) surface as a
+    lost attempt (False), never an exception — contenders keep retrying on
+    their retry period, matching client-go's tolerance of apiserver
+    blips.  Release is a compare-and-delete on the fetched rv so a stale
+    ex-leader cannot remove a lease a standby has since re-acquired."""
+
+    def __init__(
+        self,
+        api,
+        namespace: str = "kube-system",
+        name: str = LOCK_CONFIGMAP,
+        identity: str = "",
+        lease_duration_s: float = 15.0,
+        renew_deadline_s: float = 10.0,
+        retry_period_s: float = 5.0,
+        now_fn: Callable[[], float] = time.time,
+    ):
+        self.api = api
+        self.namespace = namespace
+        self.name = name
+        self.identity = identity or f"{os.uname().nodename}-{uuid.uuid4().hex[:8]}"
+        self.lease_duration_s = lease_duration_s
+        self.renew_deadline_s = renew_deadline_s
+        self.retry_period_s = retry_period_s
+        self.now = now_fn
+        self._is_leader = False
+
+    # ---- storage hooks ----
+
+    def _fetch(self):
+        try:
+            obj = self.api.get("configmaps", self.namespace, self.name)
+        except ApiError as err:
+            # unreadable lock (unreachable/5xx): state is UNKNOWN — the
+            # base machine keeps leadership within the renew deadline and
+            # keeps standbys retrying, like client-go on apiserver blips
+            raise TransientLockError(str(err)) from err
+        if obj is None:
+            return None, None
+        raw = obj.get("metadata", {}).get("annotations", {}).get(LEASE_ANNOTATION)
+        if not raw:
+            return obj, None
+        try:
+            return obj, LeaseRecord.from_json(raw)
+        except (ValueError, TypeError, KeyError):
+            return obj, None
+
+    def _push(self, obj, rec: LeaseRecord) -> bool:
+        try:
+            if obj is None:
+                self.api.create(
+                    "configmaps",
+                    {
+                        "metadata": {
+                            "namespace": self.namespace,
+                            "name": self.name,
+                            "annotations": {LEASE_ANNOTATION: rec.to_json()},
+                        }
+                    },
                 )
-            )
-            self._is_leader = True
+            else:
+                rv = obj.get("metadata", {}).get("resourceVersion")
+                obj.setdefault("metadata", {}).setdefault("annotations", {})[
+                    LEASE_ANNOTATION
+                ] = rec.to_json()
+                self.api.update("configmaps", obj, expect_rv=rv)
             return True
+        except ApiError:
+            return False  # lost the race (409) or the apiserver blipped
 
-    def renew(self) -> bool:
-        """Renew our lease; False when another holder took it (we were
-        expired and usurped) or the renew deadline passed."""
-        with open(self._mutex_path(), "w") as mf:
-            fcntl.flock(mf, fcntl.LOCK_EX)
-            now = self.now()
-            cur = self._read()
-            if cur is None or cur.holder != self.identity:
-                self._is_leader = False
-                return False
-            if now - cur.renew_ts > self.renew_deadline_s:
-                # we failed to renew in time; treat as lost even if nobody
-                # has usurped yet (client-go renew-deadline semantics)
-                self._is_leader = False
-                return False
-            self._write(dataclasses.replace(cur, renew_ts=now))
-            self._is_leader = True
-            return True
-
-    def release(self) -> None:
-        """Voluntary release (delete the lock object) so a standby can take
-        over immediately instead of waiting out the lease."""
-        with open(self._mutex_path(), "w") as mf:
-            fcntl.flock(mf, fcntl.LOCK_EX)
-            cur = self._read()
-            if cur is not None and cur.holder == self.identity:
-                os.unlink(self.lock_path)
-            self._is_leader = False
-
-    @property
-    def is_leader(self) -> bool:
-        return self._is_leader
-
-    def acquire_blocking(self, timeout_s: Optional[float] = None) -> bool:
-        """RunOrDie's acquisition loop: retry every retry_period until
-        leadership (or timeout, for tests/CLI)."""
-        start = self.now()
-        while True:
-            if self.try_acquire():
-                return True
-            if timeout_s is not None and self.now() - start >= timeout_s:
-                return False
-            time.sleep(self.retry_period_s)
+    def _delete(self, obj) -> None:
+        try:
+            rv = (obj or {}).get("metadata", {}).get("resourceVersion")
+            self.api.delete("configmaps", self.namespace, self.name, expect_rv=rv)
+        except ApiError:
+            pass  # already gone or re-acquired by a standby — both fine

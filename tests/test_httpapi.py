@@ -1,0 +1,323 @@
+"""HTTP apiserver shim + apiserver-backed leader election + live churn.
+
+Round-4 'done' criteria:
+
+* the live plane dials a URL: LiveCache over HttpApiClient schedules
+  end-to-end against serve_api on localhost (the client-go seam,
+  cache.go:202-223);
+* two schedulers contend through one apiserver ConfigMap resourcelock
+  (server.go:102-125); only the leaseholder actuates, lease-loss is fatal;
+* the dynamic taint/untaint and eviction-event e2e scenarios (sim-proven
+  in round 2) run through the WATCH plane (util.go:746-800, :419-438).
+"""
+import numpy as np
+import pytest
+
+from kube_arbitrator_tpu.api import TaskStatus
+from kube_arbitrator_tpu.cache import FakeApiServer, LiveCache
+from kube_arbitrator_tpu.cache.fakeapi import ApiError
+from kube_arbitrator_tpu.cache.httpapi import HttpApiClient, serve_api
+from kube_arbitrator_tpu.framework import ApiLeaderElector, Scheduler
+from kube_arbitrator_tpu.framework.conf import load_conf
+from kube_arbitrator_tpu.options import reset_options
+
+from test_live_cache import make_node, make_pod, make_podgroup, seed_gang_cluster
+
+FULL_CONF = (
+    'actions: "reclaim, allocate, backfill, preempt"\n'
+    "tiers:\n"
+    "- plugins:\n"
+    "  - name: priority\n"
+    "  - name: gang\n"
+    "- plugins:\n"
+    "  - name: drf\n"
+    "  - name: predicates\n"
+    "  - name: proportion\n"
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_options():
+    reset_options()
+    yield
+    reset_options()
+
+
+@pytest.fixture()
+def http_api():
+    api = FakeApiServer()
+    server, thread, url = serve_api(api)
+    yield api, HttpApiClient(url)
+    server.shutdown()
+
+
+# ---------------------------------------------------------------- HTTP verbs
+
+
+def test_http_crud_and_watch_roundtrip(http_api):
+    api, client = http_api
+    client.create("nodes", make_node("n0"))
+    items, rv = client.list("nodes")
+    assert len(items) == 1 and rv >= 1
+    assert client.get("nodes", "", "n0")["metadata"]["name"] == "n0"
+    assert client.get("nodes", "", "missing") is None
+
+    client.create("pods", make_pod("p0"))
+    events = client.watch_all(0)
+    assert [(r, t) for _, r, t, _ in events] == [("nodes", "ADDED"), ("pods", "ADDED")]
+
+    client.bind_pod("default", "p0", "n0")
+    pod = client.get("pods", "default", "p0")
+    assert pod["spec"]["nodeName"] == "n0"
+    # kubelet emulation produced the Running MODIFIED event
+    assert pod["status"]["phase"] == "Running"
+
+    with pytest.raises(ApiError) as ei:
+        client.bind_pod("default", "p0", "n0")
+    assert ei.value.status == 409  # already bound
+
+    client.evict_pod("default", "p0")
+    assert client.get("pods", "default", "p0") is None
+
+
+def test_http_conditional_update_and_delete(http_api):
+    api, client = http_api
+    obj = client.create("configmaps", {"metadata": {"namespace": "ns", "name": "cm"}})
+    rv = obj["metadata"]["resourceVersion"]
+    obj["data"] = {"k": "1"}
+    upd = client.update("configmaps", obj, expect_rv=rv)
+    with pytest.raises(ApiError) as ei:
+        client.update("configmaps", obj, expect_rv=rv)  # stale rv
+    assert ei.value.status == 409
+    with pytest.raises(ApiError) as ei:
+        client.delete("configmaps", "ns", "cm", expect_rv=rv)  # stale rv
+    assert ei.value.status == 409
+    client.delete("configmaps", "ns", "cm",
+                  expect_rv=upd["metadata"]["resourceVersion"])
+    assert client.get("configmaps", "ns", "cm") is None
+
+
+def test_scheduler_end_to_end_over_http(http_api):
+    """The round-4 'done' criterion: LiveCache scheduling end-to-end over
+    localhost HTTP — list/watch in, binds/status out, watch round-trip."""
+    api, client = http_api
+    seed_gang_cluster(api, n_pods=4)
+    live = LiveCache(client)  # the cache only ever speaks HTTP
+    sched = Scheduler(live)
+
+    result = sched.run_once()
+    assert len(result.binds) == 4
+    for i in range(4):
+        pod = api.get("pods", "default", f"p{i}")
+        assert pod["spec"]["nodeName"] in ("n0", "n1")
+    assert api.get("podgroups", "default", "pg1")["status"]["phase"] == "Running"
+
+    live.sync()
+    job = live.cluster.jobs["default/pg1"]
+    assert all(t.status == TaskStatus.RUNNING for t in job.tasks.values())
+    assert sched.run_once().binds == []
+
+
+def test_http_bind_failure_diverts_to_resync(http_api):
+    api, client = http_api
+    seed_gang_cluster(api, min_member=1, n_pods=2)
+    api.fail_bind_uids = {"uid-default-p0"}
+    live = LiveCache(client)
+    sched = Scheduler(live)
+    sched.run_once()
+    assert not api.get("pods", "default", "p0")["spec"]["nodeName"]
+    assert any(e.kind == "FailedScheduling" for e in live.events)
+    api.fail_bind_uids = set()
+    sched.run_once()
+    assert api.get("pods", "default", "p0")["spec"]["nodeName"]
+
+
+# ------------------------------------------------- apiserver leader election
+
+
+def _elector(api, ident, clock):
+    return ApiLeaderElector(api, identity=ident, lease_duration_s=15.0,
+                            renew_deadline_s=10.0, retry_period_s=0.0,
+                            now_fn=lambda: clock[0])
+
+
+def test_api_lease_first_contender_wins(http_api):
+    api, client = http_api
+    clock = [0.0]
+    a, b = _elector(client, "a", clock), _elector(client, "b", clock)
+    assert a.try_acquire()
+    assert not b.try_acquire()
+    assert a.is_leader and not b.is_leader
+    # the lock object is a real ConfigMap through the verbs
+    cm = api.get("configmaps", "kube-system", "kube-batch-lock")
+    assert "control-plane.alpha.kubernetes.io/leader" in cm["metadata"]["annotations"]
+
+
+def test_api_lease_renewal_and_stale_takeover(http_api):
+    _, client = http_api
+    clock = [0.0]
+    a, b = _elector(client, "a", clock), _elector(client, "b", clock)
+    assert a.try_acquire()
+    clock[0] = 8.0
+    assert a.renew()
+    clock[0] = 16.0
+    assert not b.try_acquire()  # b first observes the t=8 record here
+    clock[0] = 24.0
+    # the record is stale on a's own clock, but b must observe it
+    # unchanged for a full lease_duration on ITS clock (client-go
+    # observedTime semantics, cross-host skew protection)
+    assert not b.try_acquire()
+    clock[0] = 32.0
+    assert b.try_acquire()  # stale -> usurped
+    assert not a.renew() and not a.is_leader  # loss is fatal to a
+
+
+def test_api_lease_concurrent_cas_single_winner(http_api):
+    """Both contenders fetch the same expired lease; only one CAS wins —
+    the resourceVersion precondition resolves the race."""
+    api, client = http_api
+    clock = [0.0]
+    a, b = _elector(client, "a", clock), _elector(client, "b", clock)
+    assert a.try_acquire()
+    clock[0] = 100.0  # lease long dead
+    # simulate the interleaving: both read, then both push
+    from kube_arbitrator_tpu.framework import LeaseRecord
+
+    def rec(ident):
+        return LeaseRecord(holder=ident, acquired_ts=100.0, renew_ts=100.0,
+                           lease_duration_s=15.0)
+
+    tok_a, _ = a._fetch()
+    tok_b, _ = b._fetch()
+    assert a._push(tok_a, rec("a"))
+    assert not b._push(tok_b, rec("b"))  # 409 conflict
+
+
+def test_api_lease_release_is_compare_and_delete(http_api):
+    _, client = http_api
+    clock = [0.0]
+    a, b = _elector(client, "a", clock), _elector(client, "b", clock)
+    assert a.try_acquire()
+    assert not b.try_acquire()  # b observes a's record at t=0
+    # a goes stale; b takes over; a's release must NOT remove b's lease
+    clock[0] = 50.0
+    tok_a, cur_a = a._fetch()  # a still sees itself as holder
+    assert b.try_acquire()  # observed unchanged for 50s > lease_duration
+    assert cur_a.holder == "a"
+    a._delete(tok_a)  # stale compare-and-delete -> 409, swallowed
+    _, cur = b._fetch()
+    assert cur is not None and cur.holder == "b"
+    assert b.renew()
+
+
+def test_api_lease_transient_outage_does_not_crash():
+    """An unreachable apiserver surfaces as a failed attempt, not an
+    exception (client-go tolerance; review finding round 4)."""
+    client = HttpApiClient("http://127.0.0.1:1")  # nothing listens
+    clock = [0.0]
+    el = _elector(client, "a", clock)
+    assert not el.try_acquire()
+    assert not el.renew()
+    el.release()  # no raise
+
+
+def test_only_leaseholder_actuates(http_api):
+    """Two LiveCache schedulers against one apiserver: only the leaseholder
+    schedules (server.go:102-125 — RunOrDie gates sched.Run), and losing
+    the lease to a usurper is fatal (:119-121)."""
+    from kube_arbitrator_tpu.framework import LeaderLost
+
+    api, client = http_api
+    seed_gang_cluster(api, n_pods=4)
+    clock = [0.0]
+    leader_el = _elector(client, "leader", clock)
+    standby_el = _elector(client, "standby", clock)
+    assert leader_el.try_acquire()
+    assert not standby_el.try_acquire()  # standby stays gated
+
+    active = Scheduler(LiveCache(client), elector=leader_el)
+    active.run(max_cycles=1)
+    bound = [i for i in range(4)
+             if api.get("pods", "default", f"p{i}")["spec"]["nodeName"]]
+    assert len(bound) == 4
+
+    # leader goes stale; standby usurps; the ex-leader's next run is fatal
+    clock[0] = 30.0
+    assert standby_el.try_acquire()
+    with pytest.raises(LeaderLost):
+        active.run(max_cycles=1)
+
+
+# ------------------------------------------------------- live-plane churn e2e
+
+
+def test_live_taint_untaint_mid_run(http_api):
+    """util.go:746-800 through the WATCH plane: a taint PATCHed onto a node
+    between cycles redirects scheduling; untainting restores it."""
+    api, client = http_api
+    for i in range(3):
+        api.create("nodes", make_node(f"n{i}", cpu="4"))
+    api.create("queues", {"metadata": {"name": "default"}, "spec": {"weight": 1}})
+    api.create("podgroups", make_podgroup("warm", min_member=3))
+    for i in range(3):
+        api.create("pods", make_pod(f"w{i}", group="warm"))
+    live = LiveCache(client)
+    sched = Scheduler(live, config=load_conf(FULL_CONF))
+    assert len(sched.run_once().binds) == 3
+
+    # taint n2 via the apiserver (strategic-merge patch analog)
+    node = client.get("nodes", "", "n2")
+    node["spec"]["taints"] = [
+        {"key": "test-taint-key", "value": "taint-val", "effect": "NoSchedule"}
+    ]
+    client.update("nodes", node)
+    api.create("podgroups", make_podgroup("after-taint", min_member=1))
+    for i in range(6):
+        api.create("pods", make_pod(f"a{i}", group="after-taint", cpu="1"))
+    for _ in range(4):
+        sched.run_once()
+    placed = {
+        api.get("pods", "default", f"a{i}")["spec"].get("nodeName")
+        for i in range(6)
+    } - {"", None}
+    assert placed and "n2" not in placed
+
+    # untaint: new pods reach n2 again
+    node = client.get("nodes", "", "n2")
+    node["spec"]["taints"] = []
+    client.update("nodes", node)
+    api.create("podgroups", make_podgroup("after-untaint", min_member=1))
+    for i in range(3):
+        api.create("pods", make_pod(f"u{i}", group="after-untaint", cpu="1"))
+    for _ in range(4):
+        sched.run_once()
+    placed3 = {
+        api.get("pods", "default", f"u{i}")["spec"].get("nodeName")
+        for i in range(3)
+    } - {"", None}
+    assert "n2" in placed3
+
+
+def test_live_eviction_detected_via_events(http_api):
+    """util.go:419-438 waitTasksEvicted through the watch plane: reclaim
+    DELETEs victims at the apiserver, Evict events surface with uids, and
+    the deletions flow back through the watch into the model."""
+    api, client = http_api
+    api.create("nodes", make_node("n0", cpu="4"))
+    api.create("queues", {"metadata": {"name": "qa"}, "spec": {"weight": 1}})
+    api.create("queues", {"metadata": {"name": "qb"}, "spec": {"weight": 1}})
+    api.create("podgroups", make_podgroup("victims", min_member=0, queue="qa"))
+    api.create("podgroups", make_podgroup("claimer", min_member=1, queue="qb"))
+    for i in range(4):
+        api.create("pods", make_pod(f"v{i}", group="victims", cpu="1",
+                                    memory="256Mi", node="n0", phase="Running"))
+    api.create("pods", make_pod("c0", group="claimer", cpu="1", memory="256Mi"))
+    live = LiveCache(client)
+    sched = Scheduler(live, config=load_conf(FULL_CONF))
+    result = sched.run_once()
+    assert len(result.evicts) >= 1
+    evict_events = [e for e in live.events if e.kind == "Evict"]
+    assert evict_events and all(e.object_uid.startswith("uid-default-v")
+                                for e in evict_events)
+    live.sync()
+    assert len(live.cluster.jobs["default/victims"].tasks) == 4 - len(result.evicts)

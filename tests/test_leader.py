@@ -52,6 +52,12 @@ def test_stale_lease_taken_over(tmp_path):
     b = _elector(lock, "b", clock)
     assert a.try_acquire()
     clock.t += 16.0  # lease expired, never renewed
+    # observer-local lease timing (client-go observedTime): b never trusts
+    # the holder's embedded timestamp against its own clock — it must see
+    # the record UNCHANGED for a full lease_duration on its own clock
+    # before stealing (cross-host clock skew protection)
+    assert not b.try_acquire()
+    clock.t += 16.0  # observed unchanged past a full lease duration
     assert b.try_acquire()
     # usurped: a's renewal must now fail
     assert not a.renew()
