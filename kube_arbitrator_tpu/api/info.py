@@ -92,6 +92,34 @@ def _as_int(s: str):
         return None
 
 
+def normalize_node_affinity(aff) -> Tuple[Tuple[MatchExpression, ...], ...]:
+    """Canonical node-affinity form: a tuple of nodeSelectorTerms, ORed
+    across terms with match expressions ANDed within one (the vendored
+    helper the reference's PodMatchNodeSelector calls ORs across ALL
+    terms — helpers.go:303-315 MatchNodeSelectorTerms).
+
+    Accepts both shapes for compatibility: a flat sequence of
+    MatchExpression (ONE term — the single-term convenience every sim
+    test uses) or a sequence of expression sequences (multi-term)."""
+    items = tuple(aff or ())
+    if not items:
+        return ()
+    if isinstance(items[0], MatchExpression):
+        return (items,)
+    return tuple(tuple(term) for term in items)
+
+
+def node_affinity_matches(aff, labels: Dict[str, str]) -> bool:
+    """True when ANY nodeSelectorTerm matches in full (helpers.go:303-315:
+    'nil or empty term matches no objects; the terms are ORed') — hence an
+    EMPTY term (e.g. a matchFields-only term whose expressions did not
+    translate) contributes no match, rather than matching everything."""
+    terms = normalize_node_affinity(aff)
+    if not terms:
+        return True  # no affinity requirement at all
+    return any(term and all(e.matches(labels) for e in term) for term in terms)
+
+
 @dataclasses.dataclass(frozen=True)
 class PodAffinityTerm:
     """Required pod (anti-)affinity term (the v1.PodAffinityTerm subset the
@@ -132,7 +160,12 @@ class TaskInfo:
     priority: int = 1
     # Predicate inputs (tensorized via equivalence classes in the snapshot):
     node_selector: Dict[str, str] = dataclasses.field(default_factory=dict)
-    node_affinity: Tuple[MatchExpression, ...] = ()  # required terms, ANDed
+    # Required node affinity, CANONICAL form: a tuple of nodeSelectorTerms
+    # (each a tuple of MatchExpression), ORed across terms with
+    # expressions ANDed within one (helpers.go:303-315).  Constructors may
+    # pass the flat single-term convenience shape; __post_init__
+    # normalizes so every consumer sees terms-of-expressions.
+    node_affinity: Tuple = ()
     tolerations: List[Toleration] = dataclasses.field(default_factory=list)
     host_ports: Tuple[int, ...] = ()
     # Pod labels (what other pods' affinity terms select on) and this pod's
@@ -145,6 +178,12 @@ class TaskInfo:
     volume_zone: str = ""
     # Assigned by the snapshot flattener:
     ordinal: int = -1
+
+    def __post_init__(self) -> None:
+        # canonicalize at the boundary so every consumer iterates terms
+        # (a consumer iterating a flat shape would silently treat terms
+        # as expressions — the pre-round-4 AND-of-first-term bug)
+        self.node_affinity = normalize_node_affinity(self.node_affinity)
 
     @property
     def best_effort(self) -> bool:

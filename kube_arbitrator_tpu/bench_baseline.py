@@ -44,7 +44,7 @@ def _load():
             c.POINTER(c.c_int32), c.POINTER(c.c_int32), c.POINTER(c.c_float),
             c.POINTER(c.c_float), c.POINTER(c.c_int32), c.POINTER(c.c_int32),
             c.POINTER(c.c_int32), c.POINTER(c.c_uint8), c.c_int64,
-            c.POINTER(c.c_int32),
+            c.POINTER(c.c_int32), c.c_int32,
         ]
         _lib = lib
     except Exception as e:  # no toolchain: caller falls back to the oracle
@@ -56,9 +56,11 @@ def available() -> bool:
     return _load() is not None
 
 
-def run_native_baseline(tensors) -> Tuple[int, float]:
+def run_native_baseline(tensors, faithful: bool = False) -> Tuple[int, float]:
     """(tasks placed, wall seconds) for the compiled sequential loop over a
-    snapshot's pending tasks."""
+    snapshot's pending tasks.  ``faithful=True`` pays the reference's
+    per-(task,node) NodeInfo-rebuild cost (predicates.go:122-123) instead
+    of the conservative incremental-idle fit — see seqbaseline.cpp."""
     lib = _load()
     if lib is None:
         raise RuntimeError(f"seqbaseline unavailable: {_err}")
@@ -95,6 +97,6 @@ def run_native_baseline(tensors) -> Tuple[int, float]:
         p(job_queue, c.c_int32), p(job_order, c.c_int32), p(queue_weight, c.c_float),
         p(node_idle, c.c_float), p(node_klass, c.c_int32), p(node_max, c.c_int32),
         p(node_ntasks, c.c_int32), p(class_fit, c.c_uint8), class_fit.shape[1],
-        p(out, c.c_int32),
+        p(out, c.c_int32), 1 if faithful else 0,
     )
     return int(placed), time.perf_counter() - t0

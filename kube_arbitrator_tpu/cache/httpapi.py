@@ -44,7 +44,7 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Tuple
 
-from .fakeapi import ApiError, FakeApiServer, RESOURCES
+from .fakeapi import ApiError, FakeApiServer, RESOURCES, _key
 
 
 def _split(path: str) -> List[str]:
@@ -142,15 +142,27 @@ class _Handler(BaseHTTPRequestHandler):
             raise ApiError(f"POST {'/'.join(rest)} not found", status=404)
 
         if verb == "PUT":
-            if rest[-1] == "status" and rest[-3] == "podgroups":
+            # subresource paths have exactly 5 segments
+            # (namespaces/{ns}/podgroups/{name}/status), so an object
+            # legitimately NAMED "status" can never misroute here
+            if (len(rest) == 5 and rest[0] == "namespaces"
+                    and rest[2] == "podgroups" and rest[4] == "status"):
                 ns, resource, name = self._object_ref(rest[:-1])
                 return 200, api.update_podgroup_status(ns, name, body)
             ns, resource, name = self._object_ref(rest)
+            if _key(body) != (ns, name):
+                # the store keys off body metadata; a silent mismatch
+                # would modify a different object than the path names
+                raise ApiError(
+                    f"body identity {_key(body)} does not match path "
+                    f"{(ns, name)}", status=400,
+                )
             expect = query.get("expectResourceVersion", [None])[0]
             return 200, api.update(resource, body, expect_rv=expect)
 
         if verb == "PATCH":
-            if rest[-1] == "condition" and rest[-3] == "pods":
+            if (len(rest) == 5 and rest[0] == "namespaces"
+                    and rest[2] == "pods" and rest[4] == "condition"):
                 ns, resource, name = self._object_ref(rest[:-1])
                 api.update_pod_condition(ns, name, body)
                 return 200, {"status": "Success"}

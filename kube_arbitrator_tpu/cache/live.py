@@ -18,11 +18,12 @@ Differences from a real client-go stack, by design:
   queues between cycles);
 * the apiserver is any object speaking the verbs of
   :class:`fakeapi.FakeApiServer` — the in-memory store for tests, a
-  recorded JSONL stream for replay, or a real REST shim later;
-* pod inter-(anti)affinity JSON is not yet translated (node selector,
-  node affinity, tolerations, host ports, and resources are) — the
-  decision plane supports it; the translator gains it with the live REST
-  shim.
+  recorded JSONL stream for replay, or :class:`httpapi.HttpApiClient`
+  dialing the REST shim over localhost.
+
+The translator covers node selector, multi-term node affinity (ORed,
+helpers.go:303-315), pod inter-(anti)affinity terms (predicates.go:
+186-198), tolerations, host ports, and resources.
 
 Actuation is circular like the real thing: ``apply_binds`` POSTs the
 binding subresource and the model only learns the outcome from the watch
@@ -131,6 +132,31 @@ def _match_expressions(terms) -> Tuple[MatchExpression, ...]:
     return tuple(out)
 
 
+def _pod_affinity_terms(spec: dict) -> Tuple["PodAffinityTerm", ...]:
+    """spec.affinity.{podAffinity,podAntiAffinity}.requiredDuring... ->
+    PodAffinityTerm tuple (the inter-pod half of predicates.go:186-198;
+    the decision plane evaluates them in ops/podaffinity.py)."""
+    from ..api.info import PodAffinityTerm
+
+    out = []
+    aff = spec.get("affinity", {})
+    for kind, anti in (("podAffinity", False), ("podAntiAffinity", True)):
+        for term in aff.get(kind, {}).get(
+            "requiredDuringSchedulingIgnoredDuringExecution", []
+        ) or []:
+            sel = term.get("labelSelector", {}) or {}
+            out.append(
+                PodAffinityTerm(
+                    match_labels=tuple(sorted(sel.get("matchLabels", {}).items())),
+                    match_expressions=_match_expressions(sel.get("matchExpressions")),
+                    topology_key=term.get("topologyKey", "kubernetes.io/hostname"),
+                    anti=anti,
+                    namespaces=tuple(term.get("namespaces", ()) or ()),
+                )
+            )
+    return tuple(out)
+
+
 def pod_to_task(pod: dict, job_uid: str) -> TaskInfo:
     md = pod.get("metadata", {})
     spec = pod.get("spec", {})
@@ -140,14 +166,15 @@ def pod_to_task(pod: dict, job_uid: str) -> TaskInfo:
         for p in c.get("ports", [])
         if "hostPort" in p
     )
-    node_aff = ()
     aff = spec.get("affinity", {}).get("nodeAffinity", {})
     required = aff.get("requiredDuringSchedulingIgnoredDuringExecution", {})
-    terms = required.get("nodeSelectorTerms", [])
-    if terms:
-        # first term's matchExpressions, ANDed (predicates.go:130-141 adapts
-        # the same upstream helper)
-        node_aff = _match_expressions(terms[0].get("matchExpressions"))
+    # ALL nodeSelectorTerms, ORed across terms with expressions ANDed
+    # within one — the vendored MatchNodeSelectorTerms semantics
+    # (helpers.go:303-315) PodMatchNodeSelector adapts
+    node_aff = tuple(
+        _match_expressions(term.get("matchExpressions"))
+        for term in required.get("nodeSelectorTerms", [])
+    )
     tolerations = [
         Toleration(
             key=t.get("key", ""),
@@ -173,6 +200,7 @@ def pod_to_task(pod: dict, job_uid: str) -> TaskInfo:
         tolerations=tolerations,
         host_ports=ports,
         labels=dict(md.get("labels", {})),
+        affinity_terms=_pod_affinity_terms(spec),
     )
 
 
@@ -187,12 +215,17 @@ def node_to_info(node: dict) -> NodeInfo:
         Taint(key=t.get("key", ""), value=t.get("value", ""), effect=t.get("effect", ""))
         for t in node.get("spec", {}).get("taints", [])
     ]
+    labels = dict(md.get("labels", {}))
+    # the kubelet guarantees the hostname label on every node; pod
+    # (anti-)affinity over topology_key=hostname depends on it for its
+    # per-node domains, so default it like a real cluster would
+    labels.setdefault("kubernetes.io/hostname", md["name"])
     return NodeInfo(
         name=md["name"],
         allocatable=res.make(cpu, mem, gpu),
         capability=res.make(cpu, mem, gpu),
         max_tasks=int(alloc.get("pods", 110)),
-        labels=dict(md.get("labels", {})),
+        labels=labels,
         taints=taints,
         unschedulable=bool(node.get("spec", {}).get("unschedulable", False)),
     )

@@ -200,11 +200,16 @@ class NativeCache:
         volume_zone: str = "",
     ) -> None:
         selector = dict(node_selector or {})
-        node_aff = tuple(node_affinity)
+        from ...api.info import normalize_node_affinity
+
+        node_aff = normalize_node_affinity(node_affinity)
         tols = list(tolerations)
         sig = repr((
             tuple(sorted(selector.items())),
-            tuple(sorted((e.key, e.operator, e.values) for e in node_aff)),
+            tuple(sorted(
+                tuple(sorted((e.key, e.operator, e.values) for e in term))
+                for term in node_aff
+            )),
             tuple(sorted((t.key, t.operator, t.value, t.effect) for t in tols)),
             volume_zone,
         ))

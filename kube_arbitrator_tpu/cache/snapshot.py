@@ -189,9 +189,16 @@ class Snapshot:
 
 
 def _constraint_signature(t: TaskInfo) -> Tuple:
+    from ..api.info import normalize_node_affinity
+
     return (
         tuple(sorted(t.node_selector.items())),
-        tuple(sorted((e.key, e.operator, e.values) for e in t.node_affinity)),
+        # OR-of-terms structure: per-term sorted expression tuples, terms
+        # sorted — two pods share a class iff their term SETS agree
+        tuple(sorted(
+            tuple(sorted((e.key, e.operator, e.values) for e in term))
+            for term in normalize_node_affinity(t.node_affinity)
+        )),
         tuple(sorted((tl.key, tl.operator, tl.value, tl.effect) for tl in t.tolerations)),
         t.volume_zone,
     )
@@ -211,9 +218,12 @@ def _selector_matches(selector: Dict[str, str], labels: Dict[str, str]) -> bool:
 
 
 def _node_affinity_matches(task: TaskInfo, labels: Dict[str, str]) -> bool:
-    """Required node-affinity match expressions, ANDed (the
-    requiredDuringScheduling half of PodMatchNodeSelector)."""
-    return all(e.matches(labels) for e in task.node_affinity)
+    """Required node affinity (the requiredDuringScheduling half of
+    PodMatchNodeSelector): expressions AND within a term, terms ORed
+    (helpers.go:303-315 MatchNodeSelectorTerms)."""
+    from ..api.info import node_affinity_matches
+
+    return node_affinity_matches(task.node_affinity, labels)
 
 
 def _volume_zone_matches(task: TaskInfo, node: NodeInfo) -> bool:

@@ -37,8 +37,6 @@ tiers:
   - name: proportion
 """
 
-KNOWN_PLUGINS = ("priority", "gang", "drf", "predicates", "proportion", "nodeorder")
-
 _FLAG_KEYS = {
     "disableJobOrder": "job_order_disabled",
     "disableJobReady": "job_ready_disabled",
@@ -47,6 +45,19 @@ _FLAG_KEYS = {
     "disableReclaimable": "reclaimable_disabled",
     "disableQueueOrder": "queue_order_disabled",
     "disablePredicate": "predicate_disabled",
+}
+
+# disable flag -> the registry capability it gates (registry.py documents
+# each plugin's extension points; a flag on a plugin that never serves the
+# point is a conf bug, not a no-op)
+_FLAG_CAPABILITY = {
+    "disableJobOrder": "job_order",
+    "disableJobReady": "job_ready",
+    "disableTaskOrder": "task_order",
+    "disablePreemptable": "preemptable",
+    "disableReclaimable": "reclaimable",
+    "disableQueueOrder": "queue_order",
+    "disablePredicate": "predicate",
 }
 
 
@@ -66,6 +77,7 @@ def load_conf(conf_str: str) -> SchedulerConfig:
     import yaml
 
     from ..ops.cycle import ACTION_KERNELS
+    from .registry import plugin_capabilities, registered_plugins
 
     raw = yaml.safe_load(conf_str) or {}
     action_names = tuple(
@@ -79,8 +91,16 @@ def load_conf(conf_str: str) -> SchedulerConfig:
         plugins = []
         for p in tier_raw.get("plugins", []) or []:
             name = p.get("name", "")
-            if name not in KNOWN_PLUGINS:
+            if name not in registered_plugins():
                 raise ValueError(f"unknown plugin {name}")
+            caps = plugin_capabilities(name)
+            for yk in _FLAG_KEYS:
+                if yk in p and not caps.get(_FLAG_CAPABILITY[yk]):
+                    raise ValueError(
+                        f"plugin {name} does not serve the "
+                        f"{_FLAG_CAPABILITY[yk]} extension point; {yk} is "
+                        f"meaningless (capabilities: {sorted(caps)})"
+                    )
             kwargs = {attr: bool(p[yk]) for yk, attr in _FLAG_KEYS.items() if yk in p}
             args = p.get("arguments") or {}
             if args:

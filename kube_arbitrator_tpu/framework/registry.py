@@ -37,7 +37,9 @@ def get_action(name: str) -> ActionFn:
 def register_plugin(name: str, capabilities: dict) -> None:
     """capabilities documents which extension points the plugin serves
     (job_order, task_order, queue_order, preemptable, reclaimable,
-    predicate, job_ready, overused) — the conf disable flags gate these."""
+    predicate, job_ready, overused, node_order) — the conf loader
+    validates tier plugin names against the registry and each disable
+    flag against the plugin's capability set (framework/conf.py)."""
     _plugin_registry[name] = capabilities
 
 
@@ -45,8 +47,15 @@ def plugin_capabilities(name: str) -> dict:
     return _plugin_registry.get(name, {})
 
 
+def registered_plugins() -> tuple:
+    """Registered plugin names — the conf loader's validation domain
+    (the analog of the pluginBuilders registry consulted by OpenSession,
+    framework/plugins.go:23-66)."""
+    return tuple(_plugin_registry)
+
+
 # factory.go:34-49 equivalents: the four built-in actions are registered by
-# ops/cycle.py; plugins documented here.
+# ops/cycle.py; plugins registered here.
 register_plugin("priority", {"job_order": True, "task_order": True})
 register_plugin(
     "gang",
@@ -55,3 +64,4 @@ register_plugin(
 register_plugin("drf", {"job_order": True, "preemptable": True})
 register_plugin("proportion", {"queue_order": True, "reclaimable": True, "overused": True})
 register_plugin("predicates", {"predicate": True})
+register_plugin("nodeorder", {"node_order": True})

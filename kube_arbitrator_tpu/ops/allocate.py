@@ -95,8 +95,9 @@ class SessionCtx:
     # Effective gang minMember: zeros when the gang plugin is disabled
     # (JobReadyFn then trivially passes — session_plugins.go:158-176).
     min_avail: jax.Array      # i32[J]
-    # DRF equilibrium share level λ* (throughput floor for turn budgets).
-    drf_level: jax.Array      # f32 scalar
+    # DRF equilibrium share levels (throughput floor for turn budgets):
+    # per job, min(global λ*, the job's queue-capped λ*_q).
+    drf_level: jax.Array      # f32[J]
 
 
 def _drf_before_gang(tiers: Tiers) -> bool:
@@ -197,21 +198,40 @@ def turn_budget(
     # proportion: the t-th task is granted iff the queue is not yet
     # overused before it, i.e. some resource still has
     # deserved >= alloc + (t-1)*req + eps (check-before-pop,
-    # allocate.go:71-74 + proportion.go:188-193).  Max t is
-    # 1 + max_r floor((deserved - alloc - eps)/req_r); resources the
-    # group doesn't request keep the queue un-overused forever.
+    # allocate.go:71-74 + proportion.go:188-193).  The queue stays
+    # servable until EVERY requested dim crosses its deserved, but one
+    # batch must stop at the FIRST yet-uncrossed dim boundary: the
+    # sequential loop re-sorts jobs after every pop, so a cpu-heavy job
+    # batching all the way to the LAST crossing would blow past the
+    # queue's cpu deserved where the reference would have rotated to a
+    # mem-heavy job at the boundary (round-4 north-star shortfall
+    # diagnosis: max_r here cost ~16% placements at capacity-tight
+    # configs vs the oracle).  Later turns keep serving the queue while
+    # any dim is under (the q_ok/overused gate), so the tighter clamp
+    # only adds turns, never strands demand.
     if queue_clamp:
         # proportion's Resource is the fair set only; the attach axis
         # carries +inf deserved and must not defeat the clamp
         d_minus_a = fair(sess.deserved[q]) - fair(state.queue_alloc[q])
         req_f = fair(req)
+        under = (req_f > 0) & (d_minus_a >= EPS)
+        t_first = jnp.where(
+            under,
+            jnp.floor((d_minus_a - EPS) / jnp.maximum(req_f, 1e-30)) + 1.0,
+            BIG,
+        )
+        b_first = jnp.min(t_first)
+        # no requested dim still under: either an unrequested dim keeps
+        # the queue servable forever (grant freely) or everything
+        # crossed (grant the single check-before-pop task)
         f_r = jnp.where(
             req_f > 0,
             jnp.floor((d_minus_a - EPS) / jnp.maximum(req_f, 1e-30)),
             jnp.where(d_minus_a >= EPS, BIG, -1.0),
         )
         t_max = jnp.max(f_r) + 1.0
-        b_queue = jnp.where(t_max >= BIG / 2, s_max, jnp.maximum(t_max, 1.0)).astype(
+        b_rest = jnp.where(t_max >= BIG / 2, s_max, jnp.maximum(t_max, 1.0))
+        b_queue = jnp.where(b_first >= BIG / 2, b_rest, jnp.maximum(b_first, 1.0)).astype(
             jnp.int32
         )
     else:
@@ -224,7 +244,7 @@ def turn_budget(
     # yield to not-ready ones, gang.go:129-165) happens at the same
     # points as in the sequential loop.
     b_quota = jnp.floor(
-        (sess.drf_level - job_share[j]) / jnp.maximum(delta, 1e-9)
+        (sess.drf_level[j] - job_share[j]) / jnp.maximum(delta, 1e-9)
     ).astype(jnp.int32)
     # Under the default tiers, gang's creation-rank column strictly
     # precedes drf for not-ready pairs (gang.go:129-165), so a

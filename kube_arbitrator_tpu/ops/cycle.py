@@ -29,7 +29,7 @@ from .allocate import (
     backfill_action,
 )
 from .common import fair, safe_share
-from .fairness import drf_equilibrium_level, drf_shares, proportion_deserved
+from .fairness import drf_equilibrium_levels_per_job, drf_shares, proportion_deserved
 from .ordering import DEFAULT_ACTIONS, DEFAULT_TIERS, Tiers
 from .preempt import preempt_action, reclaim_action
 
@@ -125,8 +125,11 @@ def open_session(st: SnapshotTensors, tiers: Tiers) -> Tuple[SessionCtx, AllocSt
         # no proportion plugin: queues are never overused, shares are 0
         deserved = jnp.full((Q, R), jnp.float32(3.0e38))
 
-    # DRF equilibrium level from mean pending-task shapes (throughput floor
-    # for the allocate rounds; see fairness.drf_equilibrium_level).
+    # DRF equilibrium levels from mean pending-task shapes (throughput
+    # floor for the allocate rounds) — per JOB: min of the global λ* and
+    # the job's queue-capped λ*_q, so capacity-tight queues keep the
+    # sequential lockstep share growth (fairness.
+    # drf_equilibrium_levels_per_job; round-4 shortfall diagnosis).
     job_pending_cnt = jnp.zeros(J, jnp.int32).at[st.task_job].add(pending_now.astype(jnp.int32))
     job_pending_req = jnp.zeros((J, R)).at[st.task_job].add(res_or_0(pending_now))
     mean_req = job_pending_req / jnp.maximum(job_pending_cnt, 1)[:, None]
@@ -135,13 +138,18 @@ def open_session(st: SnapshotTensors, tiers: Tiers) -> Tuple[SessionCtx, AllocSt
     # actual free capacity (accounts for other schedulers' and running
     # tasks' usage) — λ* must not overestimate the reachable level
     headroom = jnp.sum(jnp.where(nv, st.node_idle, 0.0), axis=0)
-    drf_level = drf_equilibrium_level(
+    # unclamped: an already-crossed dim (negative headroom) must read as
+    # closed in the per-queue level's any-dim-open gate
+    queue_headroom = fair(deserved) - fair(queue_alloc)
+    drf_level = drf_equilibrium_levels_per_job(
         job_share0,
         job_delta,
         mean_req,
         job_pending_cnt,
         job_sched_valid & (job_pending_cnt > 0),
         headroom,
+        st.job_queue,
+        queue_headroom,
     )
 
     sess = SessionCtx(

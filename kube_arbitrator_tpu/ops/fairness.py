@@ -131,6 +131,71 @@ def drf_equilibrium_level(
     return lo
 
 
+def drf_equilibrium_levels_per_job(
+    job_share0: jnp.ndarray,    # f32[J]
+    job_delta: jnp.ndarray,     # f32[J]
+    job_mean_req: jnp.ndarray,  # f32[J, R] mean pending per-task resreq
+    job_pending: jnp.ndarray,   # i32[J]
+    eligible: jnp.ndarray,      # bool[J]
+    headroom: jnp.ndarray,      # f32[R] cluster headroom
+    job_queue: jnp.ndarray,     # i32[J]
+    queue_headroom: jnp.ndarray,  # f32[Q, F] fair-dim deserved minus alloc, >=0
+    iters: int = 30,
+) -> jnp.ndarray:
+    """Per-JOB equilibrium level: min(global λ*, the job's QUEUE λ*_q).
+
+    The global λ* (above) ignores proportion's per-queue deserved caps, so
+    in a capacity-tight queue the first-served job could jump to λ* and
+    eat the queue's remaining deserved before its cohort alternates in —
+    the sequential interleave raises cohort shares in lockstep, so when
+    the queue's overused gate closes, every job sits at roughly the same
+    share (round-4 north-star shortfall diagnosis: the unconstrained jump
+    cost ~0.4-16%% of placements at capacity-tight configs vs the oracle).
+    λ*_q bounds each queue's cohort by the queue's own fair-dim headroom;
+    both levels are conservative FLOORS — the tail beyond them still runs
+    through the exact per-turn b_drf share-crossing budgets — so an
+    under-estimate costs turns, never placements or invariants.
+    """
+    lam_g = drf_equilibrium_level(
+        job_share0, job_delta, job_mean_req, job_pending, eligible, headroom, iters
+    )
+    Q = queue_headroom.shape[0]
+    F = queue_headroom.shape[1]
+
+    def extra_at(lam_q):  # lam_q: f32[Q] -> per-job granted task counts
+        lam_j = lam_q[job_queue]
+        k = jnp.floor((lam_j - job_share0) / jnp.maximum(job_delta, 1e-9))
+        k = jnp.clip(k, 0.0, job_pending.astype(jnp.float32))
+        return jnp.where(eligible, k, 0.0)
+
+    def feasible(lam_q):  # bool[Q]: the queue's overused gate still open
+        k = extra_at(lam_q)
+        usage = jnp.zeros((Q, F)).at[job_queue].add(
+            k[:, None] * fair(job_mean_req)
+        )
+        # check-before-pop serves the queue while ANY fair dim is under
+        # its deserved (overused needs ALL dims over), so the lockstep
+        # cohort grows until the LAST dim crosses.  A dim is under iff
+        # NOT(deserved < alloc + EPS) — the exact negation of the
+        # overused test — hence the strict "- EPS": a zero-headroom dim
+        # (gpu with deserved == alloc == 0) must read CLOSED, else it
+        # holds the gate open forever and the level degenerates to the
+        # global one (measured: that over-granted the first-served job
+        # and reproduced the round-3 shortfall).
+        return jnp.any(usage <= queue_headroom - EPS, axis=-1)
+
+    def body(_, lohi):
+        lo, hi = lohi
+        mid = 0.5 * (lo + hi)
+        ok = feasible(mid)
+        return (jnp.where(ok, mid, lo), jnp.where(ok, hi, mid))
+
+    lo, _ = jax.lax.fori_loop(
+        0, iters, body, (jnp.zeros(Q, jnp.float32), jnp.ones(Q, jnp.float32))
+    )
+    return jnp.minimum(lam_g, lo[job_queue])
+
+
 def queue_shares(queue_alloc: jnp.ndarray, deserved: jnp.ndarray) -> jnp.ndarray:
     """[Q] proportion share = max_r allocated/deserved
     (proportion.go:225-237)."""

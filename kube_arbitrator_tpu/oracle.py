@@ -19,7 +19,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from .api import resource as res
-from .api.info import ZONE_LABEL, ClusterInfo, JobInfo, NodeInfo, TaskInfo
+from .api.info import ZONE_LABEL, ClusterInfo, JobInfo, NodeInfo, TaskInfo, node_affinity_matches
 from .api.types import TaskStatus, is_allocated_status
 from .ops.ordering import DEFAULT_TIERS, Tiers
 
@@ -240,7 +240,7 @@ class SequentialScheduler:
             return False
         if any(n.labels.get(k) != v for k, v in t.node_selector.items()):
             return False
-        if any(not e.matches(n.labels) for e in t.node_affinity):
+        if not node_affinity_matches(t.node_affinity, n.labels):
             return False
         for taint in n.taints:
             if taint.effect == "PreferNoSchedule":

@@ -46,6 +46,35 @@ def test_unknown_action_rejected():
         load_conf('actions: "allocate, fnord"')
 
 
+def test_unknown_plugin_rejected_via_registry():
+    """The conf loader validates tier plugin names against the plugin
+    registry (the pluginBuilders analog, framework/plugins.go:23-66)."""
+    with pytest.raises(ValueError, match="unknown plugin fnord"):
+        load_conf('actions: "allocate"\ntiers:\n- plugins:\n  - name: fnord\n')
+
+
+def test_disable_flag_validated_against_capabilities():
+    """A disable flag for an extension point the plugin never serves is a
+    conf bug, caught against registry.plugin_capabilities: priority has no
+    Reclaimable verdict, predicates has no JobOrder."""
+    with pytest.raises(ValueError, match="priority does not serve the reclaimable"):
+        load_conf(
+            'actions: "allocate"\n'
+            "tiers:\n- plugins:\n  - name: priority\n    disableReclaimable: true\n"
+        )
+    with pytest.raises(ValueError, match="predicates does not serve the job_order"):
+        load_conf(
+            'actions: "allocate"\n'
+            "tiers:\n- plugins:\n  - name: predicates\n    disableJobOrder: true\n"
+        )
+    # flags matching a served capability stay accepted
+    cfg = load_conf(
+        'actions: "allocate"\n'
+        "tiers:\n- plugins:\n  - name: gang\n    disableReclaimable: true\n"
+    )
+    assert cfg.tiers[0].plugins[0].reclaimable_disabled
+
+
 def test_session_status_writeback():
     sim = SimCluster()
     sim.add_queue("q")
