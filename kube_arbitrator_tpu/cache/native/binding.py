@@ -406,11 +406,18 @@ class NativeCache:
         # the term tensors are assembled here from the retained metadata
         # through the SAME encoder the Python snapshot uses.
         pa = self._build_pa(buf, T, N, G)
+        from ..snapshot import build_reclaim_pack
+
         tensors = SnapshotTensors(
             class_fit=self._class_fit(CT, CN),
             n_valid_queues=np.int32(buf["queue_valid"].sum()),
             **pa,
             **buf,
+            **build_reclaim_pack(
+                buf["task_status"], buf["task_node"], buf["task_valid"],
+                buf["task_job"], buf["task_priority"], buf["task_uid_rank"],
+                buf["job_queue"], N,
+            ),
         )
         index = NativeSnapshotIndex(self)
         return Snapshot(tensors=tensors, index=index)

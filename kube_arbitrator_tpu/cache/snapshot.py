@@ -149,6 +149,26 @@ class SnapshotTensors:
     n_valid_queues: jax.Array = dataclasses.field(
         default_factory=lambda: np.int32(0)
     )
+    # ---- reclaim canon pack (host-precomputed, see build_reclaim_pack) ----
+    # RUNNING tasks compacted and sorted by (node, queue, job, priority,
+    # uid): every per-(node,job)/(node,queue)/node segment structure the
+    # reclaim kernel needs is CONTIGUOUS in this one order, so per-turn
+    # work is segmented scans + elementwise ops instead of per-turn
+    # sorted-space gathers.  Victim identity is fixed at snapshot time
+    # (no action creates RUNNING tasks mid-cycle), so one host sort
+    # serves the whole cycle regardless of action order.
+    rv_idx: jax.Array = dataclasses.field(           # i32[Vp] task index
+        default_factory=lambda: np.zeros(0, np.int32))
+    rv_valid: jax.Array = dataclasses.field(         # bool[Vp]
+        default_factory=lambda: np.zeros(0, bool))
+    rv_nj_start: jax.Array = dataclasses.field(      # bool[Vp] (node,job) seg start
+        default_factory=lambda: np.zeros(0, bool))
+    rv_nq_start: jax.Array = dataclasses.field(      # bool[Vp] (node,queue) seg start
+        default_factory=lambda: np.zeros(0, bool))
+    rv_block_start: jax.Array = dataclasses.field(   # i32[N+1] canon pos of node block
+        default_factory=lambda: np.zeros(0, np.int32))
+    # max node-block length, STATIC (bounds the per-claim eviction window)
+    rv_window: int = dataclasses.field(default=0, metadata=dict(static=True))
 
     @property
     def num_tasks(self) -> int:
@@ -169,6 +189,91 @@ class SnapshotTensors:
     @property
     def num_queues(self) -> int:
         return self.queue_weight.shape[0]
+
+
+def build_reclaim_pack(
+    task_status: np.ndarray,
+    task_node: np.ndarray,
+    task_valid: np.ndarray,
+    task_job: np.ndarray,
+    task_priority: np.ndarray,
+    task_uid_rank: np.ndarray,
+    job_queue: np.ndarray,
+    num_nodes: int,
+) -> dict:
+    """Host-side canon ordering of reclaim victim candidates.
+
+    Candidates are the snapshot's RUNNING tasks on a node, sorted by
+    (node, queue, job, priority, uid) so that node blocks, (node,queue)
+    segments and (node,job) segments are all CONTIGUOUS — the reclaim
+    kernel's per-turn machinery becomes segmented scans + one bounded
+    window per claim.  The within-node victim order (queue, job,
+    priority, uid) is a valid determinization of the reference's
+    randomized map iteration (reclaim.go:121-134 walks node.Tasks, a Go
+    map); the oracle sorts identically (oracle._filter_victims).
+
+    Returns numpy arrays; ``window`` (the max node-block length, padded a
+    little to damp recompiles) is the static bound for the per-claim
+    eviction window."""
+    from ..api.types import TaskStatus
+
+    running = (
+        (np.asarray(task_status) == int(TaskStatus.RUNNING))
+        & np.asarray(task_valid)
+        & (np.asarray(task_node) >= 0)
+    )
+    idx = np.nonzero(running)[0].astype(np.int32)
+    tj = np.asarray(task_job)[idx]
+    tq = np.asarray(job_queue)[tj]
+    order = np.lexsort((
+        np.asarray(task_uid_rank)[idx],
+        np.asarray(task_priority)[idx],
+        tj,
+        tq,
+        np.asarray(task_node)[idx],
+    ))
+    idx = idx[order]
+    V = len(idx)
+    # window before sizing: the eviction window dynamic-slices [start, W)
+    # and XLA clamps out-of-bounds starts (which would silently shift the
+    # window), so the arrays carry >= W padding past the last block
+    counts0 = np.bincount(np.asarray(task_node)[idx], minlength=num_nodes)[:num_nodes]
+    window = int(counts0.max()) if V else 0
+    window = _bucket(window, 8, 8)
+    Vp = _bucket(V + window, 256, 256)
+    rv_idx = np.zeros(Vp, np.int32)
+    rv_idx[:V] = idx
+    rv_valid = np.zeros(Vp, bool)
+    rv_valid[:V] = True
+
+    node_s = np.full(Vp, num_nodes, np.int32)
+    node_s[:V] = np.asarray(task_node)[idx]
+    job_s = np.full(Vp, -1, np.int32)
+    job_s[:V] = np.asarray(task_job)[idx]
+    queue_s = np.full(Vp, -1, np.int32)
+    queue_s[:V] = np.asarray(job_queue)[job_s[:V]]
+
+    def seg_start(*keys):
+        s = np.zeros(Vp, bool)
+        s[0] = True
+        for k in keys:
+            s[1:] |= k[1:] != k[:-1]
+        return s
+
+    rv_nj_start = seg_start(node_s, job_s)
+    rv_nq_start = seg_start(node_s, queue_s)
+
+    # node block extents over the canon order (blocks appear in node order)
+    rv_block_start = np.zeros(num_nodes + 1, np.int32)
+    rv_block_start[1:] = np.cumsum(counts0).astype(np.int32)
+    return dict(
+        rv_idx=rv_idx,
+        rv_valid=rv_valid,
+        rv_nj_start=rv_nj_start,
+        rv_nq_start=rv_nq_start,
+        rv_block_start=rv_block_start,
+        rv_window=window,
+    )
 
 
 @dataclasses.dataclass
@@ -685,6 +790,10 @@ def build_snapshot(cluster: ClusterInfo) -> Snapshot:
         symm_ok=pa["symm_ok"],
         others_used=others_used,
         n_valid_queues=np.int32(len(queues)),
+        **build_reclaim_pack(
+            task_status, task_node, task_valid, task_job,
+            task_priority, task_uid_rank, job_queue, N,
+        ),
     )
     index = SnapshotIndex(tasks=tasks, nodes=nodes, jobs=jobs, queues=queues, port_universe=universe)
     return Snapshot(tensors=tensors, index=index)

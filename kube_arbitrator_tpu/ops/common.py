@@ -71,6 +71,29 @@ def ceil_div_pos(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return jnp.maximum(jnp.ceil(a / jnp.maximum(b, 1e-30)), 0.0).astype(jnp.int32)
 
 
+def seg_cumsum(x: jnp.ndarray, seg_start: jnp.ndarray) -> jnp.ndarray:
+    """Segmented INCLUSIVE prefix sum along axis 0.
+
+    ``x`` is [V] or [V, C]; ``seg_start`` bool[V] marks the first element
+    of each segment.  Log-depth associative scan over (reset-flag, value)
+    pairs — fully vectorized, no gathers — so per-turn segment cumulatives
+    in the reclaim canon layout cost a scan instead of sorted-space
+    gather chains."""
+    squeeze = x.ndim == 1
+    if squeeze:
+        x = x[:, None]
+    flags = seg_start
+
+    def combine(a, b):
+        af, av = a
+        bf, bv = b
+        return af | bf, bv + jnp.where(bf[:, None], 0.0, av)
+
+    _, out = jax.lax.associative_scan(combine, (flags, x.astype(jnp.float32)), axis=0)
+    out = out.astype(x.dtype) if jnp.issubdtype(x.dtype, jnp.floating) else out
+    return out[:, 0] if squeeze else out
+
+
 def mm_cumsum(x: jnp.ndarray, block: int = 512) -> jnp.ndarray:
     """Inclusive prefix sum along axis 0 via triangular matmuls.
 

@@ -47,7 +47,7 @@ from .allocate import (
     queue_has_live_job,
     turn_budget,
 )
-from .common import BIG, EPS, fair, lex_argmin, mm_cumsum, safe_share
+from .common import BIG, EPS, fair, lex_argmin, mm_cumsum, safe_share, seg_cumsum
 from .fairness import drf_shares, queue_shares
 from .ordering import Tiers, group_order_keys, job_order_keys, queue_order_keys
 from .podaffinity import apply_domain_cap, apply_seed, pa_enabled, pod_affinity_fit
@@ -82,14 +82,18 @@ class SortLayout:
 
     @classmethod
     def build(cls, segment, priority: jax.Array, uid_rank: jax.Array,
-              resreq: jax.Array):
+              resreq: jax.Array, extra_keys=()):
         """``segment`` is one i32[T] key or a tuple of them (composite
-        segments, e.g. (node, job) — grouped by all keys jointly)."""
+        segments, e.g. (node, job) — grouped by all keys jointly).
+        ``extra_keys`` sort WITHIN a segment ahead of (priority, uid)
+        without subdividing the segments — e.g. reclaim's within-node
+        (queue, job, priority, uid) victim order (minor-to-major here,
+        matching lexsort's last-key-primary convention)."""
         segs = segment if isinstance(segment, tuple) else (segment,)
         T = segs[0].shape[0]
         # jnp.lexsort: LAST key is primary; any segment nesting order works
         # as long as equal composite keys end up contiguous.
-        order = jnp.lexsort((uid_rank, priority) + tuple(segs))
+        order = jnp.lexsort((uid_rank, priority) + tuple(extra_keys) + tuple(segs))
         pos = jnp.arange(T)
         seg_start = jnp.zeros(T, bool).at[0].set(True)
         for s in segs:
@@ -585,6 +589,29 @@ def _reclaim_verdict_names(tiers: Tiers):
 
 
 
+def _replay_claim_log(st, task_status, task_node, log_g, log_n, log_r):
+    """Deferred claimant decode shared by the reclaim kernels: claim k
+    pipelined group ``log_g[k]``'s task of rank ``log_r[k]`` onto node
+    ``log_n[k]``; replayed with exact per-turn pairing via a
+    (group, rank) key join.  At most one claim per job bounds the log at
+    [J] and makes keys unique; the caller's dispatch gate guarantees the
+    key fits int32."""
+    T = st.num_tasks
+    J = log_g.shape[0]
+    Gmax = st.num_groups
+    claim_key = jnp.where(log_g >= 0, log_g * (T + 1) + log_r, jnp.iinfo(jnp.int32).max)
+    key_order = jnp.argsort(claim_key)
+    keys_sorted = claim_key[key_order]
+    task_key = jnp.clip(st.task_group, 0, Gmax - 1) * (T + 1) + st.task_group_rank
+    pos = jnp.searchsorted(keys_sorted, task_key)
+    pos_c = jnp.clip(pos, 0, J - 1)
+    hit = (keys_sorted[pos_c] == task_key) & (st.task_group >= 0) & st.task_valid
+    tnode = log_n[key_order][pos_c]
+    task_status = jnp.where(hit, PIPELINED, task_status)
+    task_node = jnp.where(hit, tnode, task_node)
+    return task_status, task_node
+
+
 def _reclaim_fast(
     st: SnapshotTensors,
     sess: SessionCtx,
@@ -662,7 +689,12 @@ def _reclaim_fast(
     use_prop = "proportion" in verdict_names
 
     node_key = jnp.maximum(state.task_node, 0)
-    L_node = SortLayout.build(node_key, st.task_priority, st.task_uid_rank, rr)
+    # Within-node victim order (queue, job, priority, uid) — the reclaim
+    # determinization shared with the canon kernel and the oracle
+    # (_running_on(reclaim=True)); extra keys are minor-to-major.
+    L_node = SortLayout.build(
+        node_key, st.task_priority, st.task_uid_rank, rr, extra_keys=(vj, vq)
+    )
     node_sorted = node_key[L_node.order]
 
     # Action-entry candidate set.  Only RUNNING tasks are reclaim victims
@@ -676,7 +708,9 @@ def _reclaim_fast(
         rank0_nj, _ = L_nj.rank_and_cum(cand0)
         tbase_nj = L_nj.base_idx[L_nj.inv]
     if use_prop:
-        L_nq = SortLayout.build((vq, node_key), st.task_priority, st.task_uid_rank, rr)
+        L_nq = SortLayout.build(
+            (vq, node_key), st.task_priority, st.task_uid_rank, rr, extra_keys=(vj,)
+        )
 
     q_entries0 = jnp.zeros(Q, jnp.int32).at[st.job_queue].add(
         st.job_valid.astype(jnp.int32)
@@ -905,22 +939,269 @@ def _reclaim_fast(
     log_g, log_n, log_r, _ = log
     evicted = cand0 & ~cand
     task_status = jnp.where(evicted, RELEASING, state.task_status)
-    # claim k pipelined group log_g[k]'s task of rank log_r[k] onto node
-    # log_n[k]; replay with exact per-turn pairing via a (group, rank) key
-    # join (at most one claim per job, so the log is J-bounded and keys
-    # are unique; the key fits int32 by the ``defer`` gate)
-    Gmax = st.num_groups
-    claim_key = jnp.where(log_g >= 0, log_g * (T + 1) + log_r, jnp.iinfo(jnp.int32).max)
-    key_order = jnp.argsort(claim_key)
-    keys_sorted = claim_key[key_order]
-    task_key = jnp.clip(st.task_group, 0, Gmax - 1) * (T + 1) + st.task_group_rank
-    pos = jnp.searchsorted(keys_sorted, task_key)
-    pos_c = jnp.clip(pos, 0, J - 1)
-    hit = (keys_sorted[pos_c] == task_key) & (st.task_group >= 0) & st.task_valid
-    tnode = log_n[key_order][pos_c]
-    task_status = jnp.where(hit, PIPELINED, task_status)
-    task_node = jnp.where(hit, tnode, state.task_node)
+    task_status, task_node = _replay_claim_log(
+        st, task_status, state.task_node, log_g, log_n, log_r
+    )
     return dataclasses.replace(state, task_status=task_status, task_node=task_node)
+
+
+def _reclaim_canon(
+    st: SnapshotTensors,
+    sess: SessionCtx,
+    state: AllocState,
+    tiers: Tiers,
+    max_rounds: int,
+) -> AllocState:
+    """Cross-queue reclaim over the snapshot's CANON victim layout —
+    semantics identical to :func:`_reclaim_fast` (same queue-entry
+    budgets, job-consumed pops, verdict scoping, weak validateVictims,
+    first-fit node choice, covering-prefix evictions) with the per-turn
+    cost collapsed to segmented scans and one bounded window:
+
+    * victims live compacted and pre-sorted by (node, queue, job,
+      priority, uid) — ``build_reclaim_pack`` — so the gang rank and the
+      proportion cumulative are SEGMENTED CUMSUMS (log-depth scans, no
+      sorted-space gathers), per-node victim sums are one plain cumsum
+      plus [N]-row boundary gathers, and a claim's covering prefix is
+      computed inside a static window of the chosen node's contiguous
+      block (``rv_window`` = max block length).
+    * the within-node victim order is (queue, job, priority, uid) — a
+      valid determinization of the reference's randomized node.Tasks map
+      walk (reclaim.go:121-134), mirrored by the oracle.
+    * task-array writebacks (RELEASING statuses, evicted_for marks,
+      claimant decode) happen ONCE at action end: nothing mid-action
+      reads them — the live candidate set is the carried canon mask, and
+      later actions see the final statuses.  Pod-affinity snapshots fall
+      back to :func:`_reclaim_fast` (the affinity fit reads live task
+      placements mid-action).
+    """
+    J, Q, N, T = st.num_jobs, st.num_queues, st.num_nodes, st.num_tasks
+    R = st.task_resreq.shape[1]
+    W = st.rv_window
+    Vp = st.rv_idx.shape[0]
+    verdict_names = _reclaim_verdict_names(tiers)
+    preds_on = _plugin_on(tiers, "predicates", "predicate_disabled")
+    use_gang = "gang" in verdict_names
+    use_prop = "proportion" in verdict_names
+
+    # ---- one-time canon gathers (static indices, hoisted out of turns) ----
+    vidx = st.rv_idx
+    cvalid = st.rv_valid
+    cj = jnp.where(cvalid, st.task_job[vidx], J - 1)
+    cq = jnp.where(cvalid, st.job_queue[jnp.clip(cj, 0, J - 1)], Q - 1)
+    cres = jnp.where(cvalid[:, None], st.task_resreq[vidx], 0.0)
+    nj_start = st.rv_nj_start
+    nq_start = st.rv_nq_start
+    bstart = st.rv_block_start  # i32[N+1]
+    deserved_c = fair(sess.deserved)[cq]  # one-time gather; sess is fixed
+
+    q_entries0 = jnp.zeros(Q, jnp.int32).at[st.job_queue].add(
+        st.job_valid.astype(jnp.int32)
+    )
+
+    def queue_turn(qi, carry):
+        (state, q_entries, job_consumed, perm, cand, evicted_c,
+         log_g, log_n, log_r, n_claims) = carry
+        q = perm[qi]
+
+        # single-queue OverusedFn row (proportion.go:188-193)
+        q_over = jnp.all(fair(sess.deserved[q]) < fair(state.queue_alloc[q]) + EPS)
+        active = st.queue_valid[q] & (q_entries[q] > 0)
+
+        # ---- job pop (JobOrderFn over the queue's unconsumed jobs) ----
+        grp_elig = (
+            group_live_mask(st, sess, state.group_placed, None)
+            & ~job_consumed[st.group_job]
+        )
+        job_has_pending = jnp.zeros(J, dtype=bool).at[st.group_job].max(grp_elig)
+        jmask = (
+            (st.job_queue == q) & job_has_pending & st.job_valid & active & ~q_over
+        )
+        job_ready = state.job_ready_cnt >= sess.min_avail
+        job_share = drf_shares(state.job_alloc, sess.drf_total)
+        jkeys = job_order_keys(
+            tiers, st.job_priority, job_ready, st.job_creation_rank, job_share
+        )
+        j, has_job = lex_argmin(jkeys, jmask)
+        pop = active & ~q_over & has_job
+        burn_now = active & (q_over | ~has_job)
+
+        gmask = (st.group_job == j) & grp_elig & pop
+        gkeys = group_order_keys(tiers, st.group_priority, st.group_uid_rank)
+        g, has_grp = lex_argmin(gkeys, gmask)
+        req = st.group_resreq[g]
+
+        # ---- victim eligibility: segmented scans over the canon order ----
+        candf = cand.astype(jnp.float32)
+        elig = cand
+        if use_gang:
+            rank = seg_cumsum(candf, nj_start) - candf  # exclusive in-(n,j) rank
+            cap = jnp.maximum(state.job_ready_cnt - sess.min_avail, 0)
+            elig = elig & (rank < cap[cj].astype(jnp.float32))
+        if use_prop:
+            cum = seg_cumsum(jnp.where(cand[:, None], fair(cres), 0.0), nq_start)
+            after = fair(state.queue_alloc)[cq] - cum
+            elig = elig & jnp.all(deserved_c < after + EPS, axis=-1)
+        if not verdict_names:
+            elig = jnp.zeros_like(cand)
+        mask_v = elig & (cq != q)
+
+        # ---- per-node victim sums: one cumsum + [N]-row boundary gathers ----
+        stat = jnp.concatenate(
+            [mask_v.astype(jnp.float32)[:, None], jnp.where(mask_v[:, None], cres, 0.0)],
+            axis=1,
+        )
+        cum_g = jnp.cumsum(stat, axis=0)
+        cum_g0 = jnp.concatenate([jnp.zeros((1, R + 1)), cum_g], axis=0)
+        per_node = cum_g0[bstart[1:]] - cum_g0[bstart[:-1]]  # [N, R+1]
+        vic_cnt, vic_res = per_node[:, 0], per_node[:, 1:]
+
+        # ---- first-fit node choice ----
+        if preds_on:
+            node_ok = (
+                st.class_fit[st.group_klass[g], st.node_klass]
+                & st.node_valid
+                & ~st.node_unsched
+            )
+            g_ports = st.group_ports[g]
+            node_ok = node_ok & jnp.all((g_ports[None, :] & state.node_ports) == 0, axis=-1)
+            node_ok = node_ok & (st.node_max_tasks - state.node_num_tasks > 0)
+        else:
+            node_ok = st.node_valid
+        weak_ok = ~jnp.all(vic_res < req[None, :], axis=-1)
+        feas = node_ok & (vic_cnt > 0) & weak_ok & pop & has_grp
+        has_node = jnp.any(feas)
+        n_star = jnp.argmin(jnp.where(feas, jnp.arange(N), N)).astype(jnp.int32)
+        claimed = pop & has_grp & has_node
+        fail = pop & ~claimed
+        q_entries = q_entries.at[q].add(-(burn_now | fail).astype(jnp.int32))
+        job_consumed = job_consumed.at[j].set(job_consumed[j] | pop)
+
+        # ---- evict the covering prefix inside the node's canon window ----
+        start = bstart[n_star]
+        blen = bstart[n_star + 1] - start
+        w_iota = jnp.arange(W)
+        m_w = jax.lax.dynamic_slice(mask_v, (start,), (W,)) & (w_iota < blen)
+        v_w = jax.lax.dynamic_slice(cres, (start, 0), (W, R))
+        v_wm = jnp.where(m_w[:, None], v_w, 0.0)
+        cum_w = jnp.cumsum(v_wm, axis=0)
+        evict_w = m_w & claimed & jnp.any(cum_w - v_wm < req[None, :] - EPS, axis=-1)
+        ev_res_w = jnp.where(evict_w[:, None], v_w, 0.0)
+        freed = jnp.sum(ev_res_w, axis=0)
+
+        cand_w = jax.lax.dynamic_slice(cand, (start,), (W,)) & ~evict_w
+        cand = jax.lax.dynamic_update_slice(cand, cand_w, (start,))
+        evic_w = jax.lax.dynamic_slice(evicted_c, (start,), (W,)) | evict_w
+        evicted_c = jax.lax.dynamic_update_slice(evicted_c, evic_w, (start,))
+
+        # ---- accounting from the window (W-wide scatters) ----
+        vj_w = jax.lax.dynamic_slice(cj, (start,), (W,))
+        vq_w = jax.lax.dynamic_slice(cq, (start,), (W,))
+        ev_cnt_res = jnp.concatenate(
+            [evict_w.astype(jnp.float32)[:, None], ev_res_w], axis=1
+        )
+        jstat = jnp.zeros((J, R + 1)).at[
+            jnp.where(evict_w, vj_w, J)
+        ].add(ev_cnt_res, mode="drop")
+        qstat = jnp.zeros((Q, R + 1)).at[
+            jnp.where(evict_w, vq_w, Q)
+        ].add(ev_cnt_res, mode="drop")
+        creq = req * claimed
+        job_alloc = state.job_alloc - jstat[:, 1:]
+        job_alloc = job_alloc.at[j].add(creq)
+        queue_alloc = state.queue_alloc - qstat[:, 1:]
+        queue_alloc = queue_alloc.at[q].add(creq)
+        job_ready_cnt = state.job_ready_cnt - jstat[:, 0].astype(jnp.int32)
+        job_ready_cnt = job_ready_cnt.at[j].add(claimed.astype(jnp.int32))
+
+        # ---- claim log (claimant decode deferred to action end) ----
+        slot = jnp.where(claimed, n_claims, J)
+        log_g = log_g.at[slot].set(g, mode="drop")
+        log_n = log_n.at[slot].set(n_star, mode="drop")
+        log_r = log_r.at[slot].set(state.group_placed[g], mode="drop")
+        n_claims = n_claims + claimed.astype(jnp.int32)
+
+        rel = state.node_releasing.at[n_star].add(freed - creq)
+        ports = jnp.where(
+            claimed,
+            state.node_ports.at[n_star].set(state.node_ports[n_star] | st.group_ports[g]),
+            state.node_ports,
+        )
+        state = AllocState(
+            task_status=state.task_status,
+            task_node=state.task_node,
+            node_idle=state.node_idle,
+            node_releasing=rel,
+            node_ports=ports,
+            node_num_tasks=state.node_num_tasks.at[n_star].add(claimed.astype(jnp.int32)),
+            job_alloc=job_alloc,
+            queue_alloc=queue_alloc,
+            job_ready_cnt=job_ready_cnt,
+            group_placed=state.group_placed.at[g].add(claimed.astype(jnp.int32)),
+            group_unfit=state.group_unfit,
+            evicted_for=state.evicted_for,
+            progress=state.progress | pop,
+            rounds=state.rounds,
+        )
+        return (state, q_entries, job_consumed, perm, cand, evicted_c,
+                log_g, log_n, log_r, n_claims)
+
+    def round_body(carry):
+        state, q_entries, job_consumed, cand, evicted_c, log = carry
+        log_g, log_n, log_r, n_claims = log
+        state = dataclasses.replace(state, progress=jnp.array(False))
+        grp_live = group_live_mask(st, sess, state.group_placed, None)
+        q_has_job = queue_has_live_job(st, grp_live, job_extra=~job_consumed)
+        q_active = st.queue_valid & (q_entries > 0) & q_has_job
+        nq = jnp.sum(q_active.astype(jnp.int32))
+        trip = jnp.where(nq > 0, nq, 1)
+        q_share = queue_shares(state.queue_alloc, sess.deserved)
+        qkeys = queue_order_keys(tiers, q_share, st.queue_uid_rank)
+        qkeys = [jnp.where(q_active, k, BIG) for k in qkeys]
+        qkeys.insert(0, jnp.where(q_active, 0.0, 1.0))
+        perm = jnp.lexsort(tuple(reversed(qkeys)))
+        (state, q_entries, job_consumed, _, cand, evicted_c,
+         log_g, log_n, log_r, n_claims) = jax.lax.fori_loop(
+            0, trip, queue_turn,
+            (state, q_entries, job_consumed, perm, cand, evicted_c,
+             log_g, log_n, log_r, n_claims),
+        )
+        return (
+            dataclasses.replace(state, rounds=state.rounds + 1),
+            q_entries, job_consumed, cand, evicted_c,
+            (log_g, log_n, log_r, n_claims),
+        )
+
+    def cond(carry):
+        return carry[0].progress & (carry[0].rounds < max_rounds)
+
+    state = dataclasses.replace(state, progress=jnp.array(True), rounds=jnp.int32(0))
+    log0 = (
+        jnp.full(J, -1, jnp.int32),
+        jnp.zeros(J, jnp.int32),
+        jnp.zeros(J, jnp.int32),
+        jnp.int32(0),
+    )
+    # live candidate seed: the pack is snapshot-time, but an earlier
+    # action in a custom order (e.g. preempt before reclaim) may already
+    # have evicted some of its tasks — filter by live status
+    cand0 = cvalid & (state.task_status[vidx] == RUNNING)
+    state, _, _, _, evicted_c, log = jax.lax.while_loop(
+        cond, round_body,
+        (state, q_entries0, jnp.zeros(J, bool), cand0, jnp.zeros(Vp, bool), log0),
+    )
+
+    # ---- one-time write-back: evicted marks + statuses + claimant decode ----
+    log_g, log_n, log_r, _ = log
+    ev_t = jnp.where(evicted_c, vidx, T)
+    evicted_for = state.evicted_for.at[ev_t].set(jnp.int32(-2), mode="drop")
+    task_status = state.task_status.at[ev_t].set(RELEASING, mode="drop")
+    task_status, task_node = _replay_claim_log(
+        st, task_status, state.task_node, log_g, log_n, log_r
+    )
+    return dataclasses.replace(
+        state, task_status=task_status, task_node=task_node, evicted_for=evicted_for
+    )
 
 
 def reclaim_action(
@@ -933,6 +1214,19 @@ def reclaim_action(
 ) -> AllocState:
     """``s_max`` is accepted for ACTION_KERNELS signature uniformity but
     inert here: reclaim claims are single-task by construction
-    (reclaim.go:94-105 pops one task per job per cycle)."""
+    (reclaim.go:94-105 pops one task per job per cycle).
+
+    Dispatch: the canon-layout kernel when the snapshot carries the
+    reclaim pack and nothing forces live task placements mid-action
+    (pod affinity) — otherwise the sorted-space kernel."""
     del s_max
+    preds_on = _plugin_on(tiers, "predicates", "predicate_disabled")
+    pack_ok = (
+        st.rv_block_start.shape[0] == st.num_nodes + 1
+        and st.rv_idx.shape[0] > 0
+        and st.rv_window > 0
+        and st.num_groups * (st.num_tasks + 1) < 2**31
+    )
+    if pack_ok and not (preds_on and pa_enabled(st)):
+        return _reclaim_canon(st, sess, state, tiers, max_rounds)
     return _reclaim_fast(st, sess, state, tiers, max_rounds)

@@ -160,6 +160,7 @@ class SequentialScheduler:
         for rank, j in enumerate(sorted(self.jobs, key=lambda j: (j.creation_ts, j.uid))):
             self._creation_rank[j.uid] = rank
         self._task_job = {t.uid: j.uid for j in self.jobs for t in j.tasks.values()}
+        self._job_queue_uid = {j.uid: j.queue_uid for j in self.jobs}
 
         for action in actions:
             if action == "allocate":
@@ -394,15 +395,27 @@ class SequentialScheduler:
 
     # --- eviction-based actions (preempt.go:43-253, reclaim.go:41-188) ---
 
-    def _running_on(self, n: NodeInfo) -> List[TaskInfo]:
+    def _running_on(self, n: NodeInfo, reclaim: bool = False) -> List[TaskInfo]:
         """RUNNING tasks still present on node (not yet evicted this
-        session), deterministic (priority asc, uid asc)."""
+        session).  The reference walks node.Tasks, a Go map with
+        RANDOMIZED iteration order, so any consistent order is an equally
+        faithful determinization.  Preempt keeps (priority, uid); reclaim
+        uses (queue, job, priority, uid) — the canon layout the kernel's
+        segmented scans require (cache/snapshot.build_reclaim_pack)."""
         out = [
             t
             for t in self.node_pods[n.name]
             if t.status == TaskStatus.RUNNING and t.uid not in self.evicted
         ]
-        out.sort(key=lambda t: (t.priority, t.uid))
+        if reclaim:
+            def key(t):
+                juid = self._task_job.get(t.uid, "")
+                quid = self._job_queue_uid.get(juid, "")
+                return (quid, juid, t.priority, t.uid)
+
+            out.sort(key=key)
+        else:
+            out.sort(key=lambda t: (t.priority, t.uid))
         return out
 
     def _preemptable(self, claimant: TaskInfo, preemptees: List[TaskInfo], reclaim: bool) -> List[TaskInfo]:
@@ -539,7 +552,7 @@ class SequentialScheduler:
         for n in self.nodes:
             if not self._predicate(claimant, n):
                 continue
-            preemptees = [t for t in self._running_on(n) if node_filter(t)]
+            preemptees = [t for t in self._running_on(n, reclaim) if node_filter(t)]
             victims = self._preemptable(claimant, preemptees, reclaim)
             if not victims:
                 continue  # validateVictims: no victims

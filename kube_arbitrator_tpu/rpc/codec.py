@@ -53,10 +53,21 @@ def unpack_tensors(cls: Type[X], tensors, to_jax: bool = False) -> X:
     ]
     if missing:
         raise ValueError(f"{cls.__name__} wire payload missing fields: {missing}")
+    # static (pytree-meta) fields travel as 0-d arrays on the wire but
+    # must come back as hashable python scalars (e.g. rv_window sizes a
+    # dynamic-slice window at compile time)
+    static_names = {
+        f.name for f in dataclasses.fields(cls) if f.metadata.get("static")
+    }
+    for k in static_names & by_name.keys():
+        by_name[k] = by_name[k].item()
     if to_jax:
         import jax.numpy as jnp
 
-        by_name = {k: jnp.asarray(v) for k, v in by_name.items()}
+        by_name = {
+            k: v if k in static_names else jnp.asarray(v)
+            for k, v in by_name.items()
+        }
     return cls(**by_name)
 
 

@@ -392,3 +392,37 @@ def test_reclaim_exact_oracle_parity_random(seed):
         )[0]
     }
     assert k_pipe == oracle.pipelined
+
+
+def test_reclaim_after_preempt_uses_live_candidates():
+    """Round-4 review regression: with a custom action order that runs
+    preempt BEFORE reclaim, the reclaim kernel must seed its victim
+    candidates from LIVE task status, not the snapshot-time pack — a task
+    preempt already evicted is RELEASING and must not be evicted (and
+    double-accounted) again.
+
+    Directed: preempt (same queue) evicts v-0 (first in victim order) for
+    the high-priority pending p-0; reclaim (cross queue, for c-0) must
+    then take v-1 — a stale snapshot-time candidate set would re-take
+    v-0."""
+    from kube_arbitrator_tpu.oracle import SequentialScheduler
+
+    sim = SimCluster()
+    sim.add_queue("qa")
+    sim.add_queue("qb")
+    sim.add_node("n0", cpu_milli=2000, memory=8 * GB)
+    jv = sim.add_job("victims", queue="qa", min_available=0)
+    sim.add_task(jv, 1000, GB, name="v-0", status=TaskStatus.RUNNING, node="n0", priority=0)
+    sim.add_task(jv, 1000, GB, name="v-1", status=TaskStatus.RUNNING, node="n0", priority=0)
+    jp = sim.add_job("preemptor", queue="qa", min_available=1)
+    sim.add_task(jp, 1000, GB, name="p-0", priority=10)
+    jc = sim.add_job("claimer", queue="qb", min_available=1)
+    sim.add_task(jc, 1000, GB, name="c-0", priority=1)
+
+    actions = ("preempt", "reclaim")
+    snap, dec, binds, evicts = run(sim, actions=actions)
+    oracle = SequentialScheduler(sim.cluster).run_cycle(actions=actions)
+    k_evicts = sorted(e.task_uid for e in evicts)
+    assert k_evicts == sorted(oracle.evicts)
+    # both victims gone, each exactly once
+    assert k_evicts == ["v-0", "v-1"]
