@@ -26,8 +26,10 @@ effects of failed attempts (victims transiently unavailable to later
 claimants) are not rolled back mid-cycle — a transient inefficiency the
 next cycle clears, never an invariant violation.
 
-Victim ordering is deterministic (priority asc, UID rank asc) where the
-reference iterates Go maps in randomized order.
+Victim ordering is deterministic where the reference iterates Go maps in
+randomized order: preempt uses (priority asc, UID rank asc); reclaim uses
+(queue, job, priority, UID rank) — the canon layout its segmented-scan
+kernel requires — mirrored by the oracle (``_running_on(reclaim=True)``).
 """
 from __future__ import annotations
 
