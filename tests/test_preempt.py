@@ -426,3 +426,55 @@ def test_reclaim_after_preempt_uses_live_candidates():
     assert k_evicts == sorted(oracle.evicts)
     # both victims gone, each exactly once
     assert k_evicts == ["v-0", "v-1"]
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_panel_branch_matches_full(seed):
+    """The compacted victim-panel branch (preempt_action's lax.cond small
+    path) must be decision-identical to the full-width panel.  Production
+    snapshots only take the compacted branch at T >= 8192, above what the
+    rest of the suite builds, so this test forces it via ``panel_floor``
+    on a snapshot whose qualifying victim count provably fits T//8
+    (asserted below) and compares against the default full-width result
+    bit-for-bit on every decision-bearing field."""
+    import jax
+
+    from kube_arbitrator_tpu.cache import generate_cluster
+    from kube_arbitrator_tpu.framework.conf import SchedulerConfig
+    from kube_arbitrator_tpu.ops.cycle import open_session
+    from kube_arbitrator_tpu.ops.preempt import preempt_action
+
+    sim = generate_cluster(
+        num_nodes=32,
+        num_jobs=24,
+        tasks_per_job=80,
+        num_queues=6,
+        seed=seed,
+        running_fraction=0.08,  # few victims, so count <= T//8
+    )
+    snap = build_snapshot(sim.cluster)
+    st = snap.tensors
+    tiers = SchedulerConfig.default().tiers
+    sess, state0 = jax.jit(lambda s: open_session(s, tiers))(st)
+
+    # precondition: the running pool itself fits the compacted panel, so
+    # the cond really takes the small branch (qualify <= running <= T//8)
+    n_running = int(np.asarray((st.task_status == int(TaskStatus.RUNNING))
+                               & st.task_valid).sum())
+    assert n_running <= st.num_tasks // 8, (n_running, st.num_tasks)
+
+    out_full = jax.jit(
+        lambda st, sess, s: preempt_action(st, sess, s, tiers)
+    )(st, sess, state0)
+    out_panel = jax.jit(
+        lambda st, sess, s: preempt_action(st, sess, s, tiers, panel_floor=1)
+    )(st, sess, state0)
+
+    for field in ("task_status", "task_node", "evicted_for", "job_ready_cnt",
+                  "group_placed", "job_alloc", "queue_alloc"):
+        a = np.asarray(getattr(out_full, field))
+        b = np.asarray(getattr(out_panel, field))
+        assert np.array_equal(a, b), f"panel/full mismatch in {field}"
+    # the run must have actually done something, or the parity is vacuous
+    assert (np.asarray(out_panel.evicted_for) >= 0).any(), "no attributed evictions"
+    assert int((np.asarray(out_panel.task_status) == int(TaskStatus.RELEASING)).sum()) > 0
