@@ -23,6 +23,12 @@ RESOURCES = (
     "queues",
     "namespaces",
     "pdbs",
+    # the volume plane (cache.go:230-238 wires a volumebinder over PV/PVC/
+    # StorageClass informers, registrations :288-306): PVC-backed pod
+    # volumes resolve through these to zone + attach constraints
+    "persistentvolumes",
+    "persistentvolumeclaims",
+    "storageclasses",
     # the leader-election resourcelock kind (server.go:102-115 uses a
     # ConfigMap resourcelock); the scheduler cache ignores these events
     "configmaps",

@@ -36,6 +36,7 @@ etcd's MVCC — one coarse lock is the honest single-node equivalent).
 """
 from __future__ import annotations
 
+import hmac
 import json
 import threading
 import urllib.error
@@ -78,6 +79,28 @@ class _Handler(BaseHTTPRequestHandler):
     def _route(self, verb: str) -> None:
         api: FakeApiServer = self.server.api  # type: ignore[attr-defined]
         lock: threading.Lock = self.server.api_lock  # type: ignore[attr-defined]
+        # Bearer-token check BEFORE any dispatch (the reference's
+        # clientsets always authenticate, server.go:51-56; RBAC rides on
+        # the identity).  Constant-time compare: a timing oracle on a
+        # localhost seam is cheap paranoia, but it is one line.
+        required: Optional[str] = getattr(self.server, "api_token", None)
+        if required is not None:
+            presented = self.headers.get("Authorization", "")
+            # bytes compare: compare_digest raises TypeError on non-ASCII
+            # str (headers decode as latin-1, so arbitrary bytes reach us)
+            ok = hmac.compare_digest(
+                presented.encode("latin-1", "replace"),
+                f"Bearer {required}".encode(),
+            )
+            if not ok:
+                # the request body is still unread; close the connection
+                # instead of draining it so a keep-alive client cannot
+                # desync on the leftover bytes
+                self.close_connection = True
+                self._send(401, {"kind": "Status", "status": "Failure",
+                                 "reason": "Unauthorized",
+                                 "message": "invalid or missing bearer token"})
+                return
         url = urllib.parse.urlparse(self.path)
         parts = _split(url.path)
         query = urllib.parse.parse_qs(url.query)
@@ -206,13 +229,21 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 def serve_api(
-    api: FakeApiServer, host: str = "127.0.0.1", port: int = 0
+    api: FakeApiServer, host: str = "127.0.0.1", port: int = 0,
+    token: Optional[str] = None,
 ) -> Tuple[ThreadingHTTPServer, threading.Thread, str]:
     """Serve ``api`` over HTTP; returns (server, thread, base_url).
-    ``port=0`` picks a free port.  Call ``server.shutdown()`` to stop."""
+    ``port=0`` picks a free port.  Call ``server.shutdown()`` to stop.
+
+    ``token`` enables bearer-token auth: every request (reads included)
+    must carry ``Authorization: Bearer <token>`` or gets 401 — the seam
+    analog of the reference's authenticated rest.Config
+    (``app/server.go:51-56``), so the deploy artifact's RBAC story has a
+    credential to hang off."""
     server = ThreadingHTTPServer((host, port), _Handler)
     server.api = api  # type: ignore[attr-defined]
     server.api_lock = threading.Lock()  # type: ignore[attr-defined]
+    server.api_token = token  # type: ignore[attr-defined]
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     return server, thread, f"http://{host}:{server.server_address[1]}"
@@ -224,17 +255,28 @@ class HttpApiClient:
     :class:`cache.live.LiveCache` and the live plane runs over localhost
     exactly as it runs in-process (the client-go analog, cache.go:202-223)."""
 
-    def __init__(self, base_url: str, timeout_s: float = 10.0):
+    def __init__(self, base_url: str, timeout_s: float = 10.0,
+                 token: Optional[str] = None,
+                 token_file: Optional[str] = None):
+        """``token`` (or ``token_file``, the in-cluster serviceaccount
+        shape — /var/run/secrets/.../token) is sent as a bearer
+        credential on every call when the server requires one."""
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
+        if token is None and token_file is not None:
+            with open(token_file) as f:
+                token = f.read().strip()
+        self.token = token
 
     # ---- plumbing ----
 
     def _call(self, verb: str, path: str, body: Optional[dict] = None):
         data = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"} if data else {}
+        if self.token is not None:
+            headers["Authorization"] = f"Bearer {self.token}"
         req = urllib.request.Request(
-            self.base_url + path, data=data, method=verb,
-            headers={"Content-Type": "application/json"} if data else {},
+            self.base_url + path, data=data, method=verb, headers=headers,
         )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:

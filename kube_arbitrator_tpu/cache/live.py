@@ -23,7 +23,12 @@ Differences from a real client-go stack, by design:
 
 The translator covers node selector, multi-term node affinity (ORed,
 helpers.go:303-315), pod inter-(anti)affinity terms (predicates.go:
-186-198), tolerations, host ports, and resources.
+186-198), tolerations, host ports, resources, and the volume plane:
+PV/PVC/StorageClass objects are ingested (cache.go:230-238, informer
+registrations :288-306) and pod ``volumes`` resolve through the PVC -> PV
+chain into the model's zone pin (``TaskInfo.volume_zone``) and
+attach-count resource axis, feeding the existing zone-class predicate and
+attach-limit fit.
 
 Actuation is circular like the real thing: ``apply_binds`` POSTs the
 binding subresource and the model only learns the outcome from the watch
@@ -88,8 +93,11 @@ def parse_memory_bytes(q) -> float:
     return float(s)
 
 
-def pod_resreq(pod: dict):
-    """Sum of container requests (job_info.go:36-60 GetPodResourceRequest)."""
+def pod_resreq(pod: dict, n_attach: int = 0):
+    """Sum of container requests (job_info.go:36-60 GetPodResourceRequest);
+    ``n_attach`` rides the 4th (attach-count) resource axis — the rebuild's
+    form of the reference's volume attach limits (volumebinder,
+    cache.go:230-238)."""
     cpu = mem = gpu = 0.0
     for c in pod.get("spec", {}).get("containers", []):
         reqs = c.get("resources", {}).get("requests", {})
@@ -99,7 +107,7 @@ def pod_resreq(pod: dict):
             mem += parse_memory_bytes(reqs["memory"])
         if "nvidia.com/gpu" in reqs:
             gpu += float(reqs["nvidia.com/gpu"]) * 1000.0
-    return res.make(cpu, mem, gpu)
+    return res.make(cpu, mem, gpu, float(n_attach))
 
 
 def pod_status(pod: dict) -> TaskStatus:
@@ -157,7 +165,47 @@ def _pod_affinity_terms(spec: dict) -> Tuple["PodAffinityTerm", ...]:
     return tuple(out)
 
 
-def pod_to_task(pod: dict, job_uid: str) -> TaskInfo:
+def pod_claims(pod: dict) -> Tuple[str, ...]:
+    """Names of the pod's PVC-backed volumes (spec.volumes[].persistentVolumeClaim)."""
+    return tuple(
+        v["persistentVolumeClaim"]["claimName"]
+        for v in pod.get("spec", {}).get("volumes", []) or []
+        if v.get("persistentVolumeClaim", {}).get("claimName")
+    )
+
+
+def pv_zone(pv: dict) -> str:
+    """A PersistentVolume's zone pin: the topology label, else the first
+    zone value in spec.nodeAffinity required terms (how provisioners
+    express zonal volumes)."""
+    from ..api.info import ZONE_LABEL
+
+    labels = pv.get("metadata", {}).get("labels", {})
+    zone = labels.get(ZONE_LABEL) or labels.get(
+        "failure-domain.beta.kubernetes.io/zone"
+    )
+    if zone:
+        return zone
+    req = (
+        pv.get("spec", {}).get("nodeAffinity", {}).get("required", {})
+        or {}
+    )
+    for term in req.get("nodeSelectorTerms", []) or []:
+        for expr in term.get("matchExpressions", []) or []:
+            # only an In term is a pin; NotIn/Gt/Lt with values would be
+            # misread as pinning to the EXCLUDED zone
+            if (
+                expr.get("key")
+                in (ZONE_LABEL, "failure-domain.beta.kubernetes.io/zone")
+                and expr.get("operator", "In") == "In"
+                and expr.get("values")
+            ):
+                return expr["values"][0]
+    return ""
+
+
+def pod_to_task(pod: dict, job_uid: str, volume_zone: str = "",
+                n_attach: int = 0) -> TaskInfo:
     md = pod.get("metadata", {})
     spec = pod.get("spec", {})
     ports = tuple(
@@ -189,9 +237,10 @@ def pod_to_task(pod: dict, job_uid: str) -> TaskInfo:
         job_uid=job_uid,
         name=md["name"],
         namespace=md.get("namespace", "default"),
-        resreq=pod_resreq(pod),
+        resreq=pod_resreq(pod, n_attach),
         node_name=spec.get("nodeName", ""),
         status=pod_status(pod),
+        volume_zone=volume_zone,
         # k8s semantics: unset pod priority means 0 (job_info.go:66-70
         # reads *pod.Spec.Priority only when present)
         priority=int(spec.get("priority") or 0),
@@ -211,6 +260,14 @@ def node_to_info(node: dict) -> NodeInfo:
     cpu = parse_cpu_milli(alloc.get("cpu", 0))
     mem = parse_memory_bytes(alloc.get("memory", 0))
     gpu = float(alloc.get("nvidia.com/gpu", 0)) * 1000.0
+    # volume attach limit (the 4th resource axis): kubelets publish
+    # per-driver "attachable-volumes-<driver>" allocatable keys; sum them
+    # when PRESENT (an explicit 0 means zero attachments), defaulting to
+    # the sim's 40 when none are published
+    attach_keys = [k for k in alloc if k.startswith("attachable-volumes")]
+    attach = (
+        sum(float(alloc[k]) for k in attach_keys) if attach_keys else 40.0
+    )
     taints = [
         Taint(key=t.get("key", ""), value=t.get("value", ""), effect=t.get("effect", ""))
         for t in node.get("spec", {}).get("taints", [])
@@ -222,8 +279,8 @@ def node_to_info(node: dict) -> NodeInfo:
     labels.setdefault("kubernetes.io/hostname", md["name"])
     return NodeInfo(
         name=md["name"],
-        allocatable=res.make(cpu, mem, gpu),
-        capability=res.make(cpu, mem, gpu),
+        allocatable=res.make(cpu, mem, gpu, attach),
+        capability=res.make(cpu, mem, gpu, attach),
         max_tasks=int(alloc.get("pods", 110)),
         labels=labels,
         taints=taints,
@@ -265,6 +322,14 @@ class LiveCache:
         self._deleted_jobs: List[Tuple[str, float]] = []
         self._task_by_uid: Dict[str, TaskInfo] = {}
         self._other_by_uid: Dict[str, TaskInfo] = {}
+        # volume plane (cache.go:230-238): PV/PVC/StorageClass objects plus
+        # the claim -> pod reverse index used to retranslate pods when a
+        # late PV/PVC event changes their zone/attach constraints
+        self._pvs: Dict[str, dict] = {}
+        self._pvcs: Dict[Tuple[str, str], dict] = {}
+        self._scs: Dict[str, dict] = {}
+        self._raw_pod: Dict[str, dict] = {}
+        self._claim_pods: Dict[Tuple[str, str], set] = {}
 
     # ---- informer pump ----
 
@@ -273,7 +338,9 @@ class LiveCache:
     # (a real informer set gives no cross-resource ordering; nodes-first
     # list + placeholder nodes cover the gap like event_handlers.go's
     # auto-created empty NodeInfo).
-    _LIST_ORDER = ("nodes", "queues", "namespaces", "podgroups", "pdbs", "pods")
+    _LIST_ORDER = ("nodes", "queues", "namespaces", "storageclasses",
+                   "persistentvolumes", "persistentvolumeclaims",
+                   "podgroups", "pdbs", "pods")
 
     def sync(self) -> int:
         """One pump: initial LIST then incremental WATCH; returns events
@@ -314,6 +381,9 @@ class LiveCache:
             "queues": self._on_queue,
             "namespaces": self._on_namespace,
             "pdbs": self._on_pdb,
+            "persistentvolumes": self._on_pv,
+            "persistentvolumeclaims": self._on_pvc,
+            "storageclasses": self._on_storageclass,
         }.get(resource)
         if handler is None:
             return  # kinds the scheduler does not watch (e.g. configmaps)
@@ -355,11 +425,64 @@ class LiveCache:
             # without node accounting; the node update re-hosts it
             self.record_event("Unschedulable", t.uid, "NodeOvercommit", str(err))
 
+    def _volume_info(self, pod: dict) -> Tuple[str, int]:
+        """Resolve the pod's PVC-backed volumes through the ingested
+        PVC -> PV chain: (zone pin, attach count).  An unbound PVC (e.g. a
+        WaitForFirstConsumer class) still consumes an attach slot but pins
+        no zone — the binder resolves it at actuation, like the
+        reference's AllocateVolumes (interface.go:42-49)."""
+        md = pod.get("metadata", {})
+        ns = md.get("namespace", "default")
+        zones = []
+        claims = pod_claims(pod)
+        for claim in claims:
+            pvc = self._pvcs.get((ns, claim))
+            if not pvc:
+                continue
+            vol = pvc.get("spec", {}).get("volumeName", "")
+            pv = self._pvs.get(vol)
+            if pv:
+                z = pv_zone(pv)
+                if z and z not in zones:
+                    zones.append(z)
+        if len(zones) > 1:
+            # PVs in conflicting zones: no node can attach all volumes —
+            # the reference's VolumeZone predicate fails every node and
+            # the pod stays Pending; pin to an impossible sentinel zone
+            # (matches no node label) for the same effect, and say why
+            self.record_event(
+                "Unschedulable",
+                md.get("uid") or f"{ns}/{md.get('name', '?')}",
+                "VolumeZoneConflict",
+                f"volumes pinned to conflicting zones {zones}",
+            )
+            return "\x00conflicting-zones", len(claims)
+        return (zones[0] if zones else ""), len(claims)
+
+    def _index_claims(self, uid: str, pod: dict) -> None:
+        ns = pod.get("metadata", {}).get("namespace", "default")
+        for claim in pod_claims(pod):
+            self._claim_pods.setdefault((ns, claim), set()).add(uid)
+
+    def _unindex_claims(self, uid: str) -> None:
+        pod = self._raw_pod.get(uid)
+        if pod is None:
+            return
+        ns = pod.get("metadata", {}).get("namespace", "default")
+        for claim in pod_claims(pod):
+            members = self._claim_pods.get((ns, claim))
+            if members is not None:
+                members.discard(uid)
+                if not members:
+                    del self._claim_pods[(ns, claim)]
+
     def _on_pod(self, etype: str, pod: dict) -> None:
         md = pod.get("metadata", {})
         uid = md.get("uid") or f"{md.get('namespace', 'default')}/{md['name']}"
         # updatePod == deletePod + addPod (event_handlers.go:190-210)
         self._remove_task(uid)
+        self._unindex_claims(uid)
+        self._raw_pod.pop(uid, None)
         if etype == DELETED:
             self._pod_ref.pop(uid, None)
             return
@@ -372,6 +495,9 @@ class LiveCache:
         # schedulers' pods only while assigned and non-terminated
         if not responsible and not (assigned and not terminal):
             return
+        self._raw_pod[uid] = pod
+        self._index_claims(uid, pod)
+        volume_zone, n_attach = self._volume_info(pod)
         if responsible:
             job_uid = _job_uid_for_pod(pod)
             job = self.cluster.jobs.get(job_uid)
@@ -382,7 +508,7 @@ class LiveCache:
                 queue = ns if options().namespace_as_queue else options().default_queue
                 job = JobInfo(uid=job_uid, name=job_uid, namespace=ns, queue_uid=queue)
                 self.cluster.jobs[job_uid] = job
-            t = pod_to_task(pod, job_uid)
+            t = pod_to_task(pod, job_uid, volume_zone, n_attach)
             job.add_task(t)
             job.priority = max(job.priority, t.priority)
             if t.node_name:
@@ -390,10 +516,49 @@ class LiveCache:
             self._task_by_uid[uid] = t
             self._pod_ref[uid] = (t.namespace, md["name"])
         else:
-            t = pod_to_task(pod, "")
+            t = pod_to_task(pod, "", volume_zone, n_attach)
             self.cluster.others.append(t)
             self._host_task(t)
             self._other_by_uid[uid] = t
+
+    # ---- volume-plane handlers (cache.go:230-238, :288-306) ----
+
+    def _retranslate_claim(self, ns: str, claim: str) -> None:
+        """A PV/PVC change can flip zone/attach constraints of pods already
+        ingested (the LIST order makes this rare; WATCH races make it
+        possible) — re-run the pod handler from the stored raw object."""
+        for uid in list(self._claim_pods.get((ns, claim), ())):
+            pod = self._raw_pod.get(uid)
+            if pod is not None:
+                self._on_pod(MODIFIED, pod)
+
+    def _on_pv(self, etype: str, pv: dict) -> None:
+        name = pv["metadata"]["name"]
+        if etype == DELETED:
+            self._pvs.pop(name, None)
+        else:
+            self._pvs[name] = pv
+        # retranslate pods whose bound claims reference this PV
+        for (ns, claim), _uids in list(self._claim_pods.items()):
+            pvc = self._pvcs.get((ns, claim))
+            if pvc and pvc.get("spec", {}).get("volumeName") == name:
+                self._retranslate_claim(ns, claim)
+
+    def _on_pvc(self, etype: str, pvc: dict) -> None:
+        md = pvc.get("metadata", {})
+        key = (md.get("namespace", "default"), md["name"])
+        if etype == DELETED:
+            self._pvcs.pop(key, None)
+        else:
+            self._pvcs[key] = pvc
+        self._retranslate_claim(*key)
+
+    def _on_storageclass(self, etype: str, sc: dict) -> None:
+        name = sc["metadata"]["name"]
+        if etype == DELETED:
+            self._scs.pop(name, None)
+        else:
+            self._scs[name] = sc
 
     def _on_node(self, etype: str, node_obj: dict) -> None:
         name = node_obj["metadata"]["name"]

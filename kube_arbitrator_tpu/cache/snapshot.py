@@ -49,10 +49,54 @@ DEVICE_EPSILON = 10.0
 MAX_PORT_WORDS = 2  # 31 usable bits per int32 word -> 62 distinct host ports/snapshot
 
 
-def _bucket(n: int, multiple: int, minimum: int) -> int:
+_BUCKET_MEMO: dict = {}
+_STICKY_BUCKETS = True
+
+
+def set_sticky_buckets(enabled: bool) -> None:
+    """Enable/disable the sticky-shape memo (and clear it).
+
+    Multihost SPMD REQUIRES this off: every host must compile the
+    identical program, and the memo is process-local history — a host
+    that restarts mid-fleet (the leader-failover path) would come back
+    with an empty memo and pick a different bucket than its peers for
+    the same counts, wedging the collectives.
+    :func:`parallel.multihost.initialize_multihost` turns it off so
+    shapes are pure functions of the replicated watch state."""
+    global _STICKY_BUCKETS
+    _STICKY_BUCKETS = enabled
+    _BUCKET_MEMO.clear()
+
+
+def _bucket(n: int, multiple: int, minimum: int, key: str = "") -> int:
+    """Round ``n`` up to a jit-stable shape.
+
+    Two mechanisms keep a live cluster inside one compiled program while
+    its counts drift (a fixed multiple-of-8 bucket recompiled the
+    decision program on every +-8 net pod change — measured ~18 s per
+    compile at 2k pods, fatal to a 1 s cadence):
+
+    * GEOMETRIC granularity: multiples of max(``multiple``, ~n/16), so
+      padding stays under ~6% while small drift lands in the same bucket;
+    * STICKY shapes (``key`` != "", single-host only — see
+      :func:`set_sticky_buckets`): a process-level memo per axis reuses
+      the previous bucket while the new count still fits in it with at
+      most ~25% padding — otherwise counts oscillating across a bucket
+      boundary (e.g. reclaim's running-victim count as pods bind and
+      evict each cycle) recompile every few cycles anyway.
+
+    Decisions are padding-invariant (padding slots carry valid=False), so
+    stickiness affects compute cost only."""
     n = max(n, 1)
-    b = ((n + multiple - 1) // multiple) * multiple
-    return max(b, minimum)
+    gran = max(multiple, 1 << max(0, n.bit_length() - 5))
+    b = ((n + gran - 1) // gran) * gran
+    b = max(b, minimum)
+    if key and _STICKY_BUCKETS:
+        prev = _BUCKET_MEMO.get(key)
+        if prev is not None and n <= prev and prev * 4 <= b * 5:
+            return prev
+        _BUCKET_MEMO[key] = b
+    return b
 
 
 def to_device_units(vec_bytes: np.ndarray) -> np.ndarray:
@@ -210,7 +254,7 @@ def build_reclaim_pack(
     window per claim.  The within-node victim order (queue, job,
     priority, uid) is a valid determinization of the reference's
     randomized map iteration (reclaim.go:121-134 walks node.Tasks, a Go
-    map); the oracle sorts identically (oracle._filter_victims).
+    map); the oracle's ``_running_on(reclaim=True)`` sorts identically.
 
     Returns numpy arrays; ``window`` (the max node-block length, padded a
     little to damp recompiles) is the static bound for the per-claim
@@ -239,8 +283,14 @@ def build_reclaim_pack(
     # window), so the arrays carry >= W padding past the last block
     counts0 = np.bincount(np.asarray(task_node)[idx], minlength=num_nodes)[:num_nodes]
     window = int(counts0.max()) if V else 0
-    window = _bucket(window, 8, 8)
-    Vp = _bucket(V + window, 256, 256)
+    # COARSE buckets on purpose: window and Vp are jit shape parameters,
+    # and under live churn the max node-block length and the running count
+    # wobble every cycle — multiple-of-8 buckets recompiled the decision
+    # program almost every scheduling cycle (measured ~18 s/compile at 2k
+    # pods, round-5 soak test), which a 1 s cadence cannot absorb.  The
+    # price is a few % of padded scan width.
+    window = _bucket(window, 32, 32, key="rv_window")
+    Vp = _bucket(V + window, 1024, 1024, key="rv_vp")
     rv_idx = np.zeros(Vp, np.int32)
     rv_idx[:V] = idx
     rv_valid = np.zeros(Vp, bool)
@@ -540,10 +590,10 @@ def build_snapshot(cluster: ClusterInfo) -> Snapshot:
     queue_ord = {q.uid: q.ordinal for q in queues}
     node_ord = {n.name: n.ordinal for n in nodes}
 
-    T = _bucket(len(tasks), 8, 8)
-    N = _bucket(len(nodes), 128, 128)
-    J = _bucket(len(jobs), 8, 8)
-    Q = _bucket(len(queues), 8, 8)
+    T = _bucket(len(tasks), 8, 8, key="tasks")
+    N = _bucket(len(nodes), 128, 128, key="nodes")
+    J = _bucket(len(jobs), 32, 32, key="jobs")
+    Q = _bucket(len(queues), 8, 8, key="queues")
     R = res.NUM_RESOURCES
     W = MAX_PORT_WORDS
 
@@ -645,7 +695,10 @@ def build_snapshot(cluster: ClusterInfo) -> Snapshot:
             group_members.append([])
         group_members[g].append(t)
 
-    G = _bucket(len(group_members), 8, 8)
+    # floor 32: the pending-group count breathes every cycle under
+    # live churn (each arrival is a fresh group until placed) and a
+    # multiple-of-8 G axis recompiled on every backlog step
+    G = _bucket(len(group_members), 32, 32, key="groups")
     task_group = np.full(T, -1, dtype=np.int32)
     task_group_rank = np.zeros(T, dtype=np.int32)
     group_job = np.zeros(G, dtype=np.int32)

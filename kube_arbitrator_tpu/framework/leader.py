@@ -88,6 +88,27 @@ class _ElectorBase:
     _observed_at: float = 0.0  # our clock when that record FIRST appeared
     _last_renew_ok: float = 0.0
 
+    @staticmethod
+    def _validate_timing(lease_duration_s, renew_deadline_s, retry_period_s):
+        """client-go's NewLeaderElector ordering checks: the renew-blip
+        grace (renew() keeps leadership on a failed fetch/CAS while
+        now - _last_renew_ok <= renew_deadline_s) is only dual-leader-safe
+        because a standby needs a full unchanged lease_duration_s before
+        usurping — a renew_deadline >= lease_duration would let a wedged
+        leader believe itself live after a standby legally took over."""
+        if lease_duration_s <= renew_deadline_s:
+            raise ValueError(
+                f"lease_duration_s ({lease_duration_s}) must be greater than "
+                f"renew_deadline_s ({renew_deadline_s})"
+            )
+        if renew_deadline_s <= retry_period_s:
+            raise ValueError(
+                f"renew_deadline_s ({renew_deadline_s}) must be greater than "
+                f"retry_period_s ({retry_period_s})"
+            )
+        if retry_period_s <= 0:
+            raise ValueError(f"retry_period_s ({retry_period_s}) must be positive")
+
     def _locked(self):
         return contextlib.nullcontext()
 
@@ -205,6 +226,7 @@ class LeaderElector(_ElectorBase):
         retry_period_s: float = 5.0,
         now_fn: Callable[[], float] = time.time,
     ):
+        self._validate_timing(lease_duration_s, renew_deadline_s, retry_period_s)
         self.lock_path = lock_path
         self.identity = identity or f"{os.uname().nodename}-{uuid.uuid4().hex[:8]}"
         self.lease_duration_s = lease_duration_s
@@ -267,6 +289,7 @@ class ApiLeaderElector(_ElectorBase):
         retry_period_s: float = 5.0,
         now_fn: Callable[[], float] = time.time,
     ):
+        self._validate_timing(lease_duration_s, renew_deadline_s, retry_period_s)
         self.api = api
         self.namespace = namespace
         self.name = name

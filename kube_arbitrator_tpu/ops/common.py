@@ -78,7 +78,12 @@ def seg_cumsum(x: jnp.ndarray, seg_start: jnp.ndarray) -> jnp.ndarray:
     of each segment.  Log-depth associative scan over (reset-flag, value)
     pairs — fully vectorized, no gathers — so per-turn segment cumulatives
     in the reclaim canon layout cost a scan instead of sorted-space
-    gather chains."""
+    gather chains.
+
+    Dtype contract: the scan accumulates in float32.  Floating inputs come
+    back in their own dtype; INTEGER inputs come back as float32 (and lose
+    exactness past 2**24) — integer callers must cast the result themselves
+    if they need int semantics."""
     squeeze = x.ndim == 1
     if squeeze:
         x = x[:, None]

@@ -139,7 +139,12 @@ def drf_equilibrium_levels_per_job(
     eligible: jnp.ndarray,      # bool[J]
     headroom: jnp.ndarray,      # f32[R] cluster headroom
     job_queue: jnp.ndarray,     # i32[J]
-    queue_headroom: jnp.ndarray,  # f32[Q, F] fair-dim deserved minus alloc, >=0
+    # f32[Q, F] fair-dim deserved minus alloc, passed UNCLAMPED: dims the
+    # queue has already crossed are NEGATIVE and must stay negative so the
+    # feasible() gate reads them as closed — clamping to >= 0 would reopen
+    # crossed dims and reintroduce the round-4 placement shortfall (see
+    # the open_session call site, ops/cycle.py)
+    queue_headroom: jnp.ndarray,
     iters: int = 30,
 ) -> jnp.ndarray:
     """Per-JOB equilibrium level: min(global λ*, the job's QUEUE λ*_q).

@@ -43,6 +43,14 @@ def initialize_multihost(
     global _initialized
     if _initialized:
         return
+    # SPMD shape discipline: snapshot shapes must be pure functions of the
+    # replicated watch state, never process-local history — a host
+    # restarting mid-fleet with a warm peer memo would compile a different
+    # program and wedge the collectives (cache/snapshot.py
+    # set_sticky_buckets docstring).
+    from ..cache.snapshot import set_sticky_buckets
+
+    set_sticky_buckets(False)
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

@@ -137,7 +137,7 @@ def test_http_bind_failure_diverts_to_resync(http_api):
 
 def _elector(api, ident, clock):
     return ApiLeaderElector(api, identity=ident, lease_duration_s=15.0,
-                            renew_deadline_s=10.0, retry_period_s=0.0,
+                            renew_deadline_s=10.0, retry_period_s=1.0,
                             now_fn=lambda: clock[0])
 
 
@@ -321,3 +321,188 @@ def test_live_eviction_detected_via_events(http_api):
                                 for e in evict_events)
     live.sync()
     assert len(live.cluster.jobs["default/victims"].tasks) == 4 - len(result.evicts)
+
+
+# ------------------------------------------------------- bearer-token auth
+
+
+def test_bearer_token_rejects_unauthenticated_writes():
+    """serve_api(token=...) is the authenticated-rest.Config seam
+    (app/server.go:51-56): writes AND reads without the credential are
+    401, a wrong token is 401, and the full client surface works with
+    the right one."""
+    api = FakeApiServer()
+    server, _, url = serve_api(api, token="s3cret")
+    try:
+        anon = HttpApiClient(url)
+        with pytest.raises(ApiError) as err:
+            anon.create("pods", {"metadata": {"namespace": "default", "name": "p0"}})
+        assert err.value.status == 401
+        with pytest.raises(ApiError) as err:
+            anon.list("pods")
+        assert err.value.status == 401
+
+        wrong = HttpApiClient(url, token="nope")
+        with pytest.raises(ApiError) as err:
+            wrong.bind_pod("default", "p0", "n0")
+        assert err.value.status == 401
+
+        good = HttpApiClient(url, token="s3cret")
+        good.create("nodes", {"metadata": {"name": "n0"},
+                              "status": {"allocatable": {"cpu": "4"}}})
+        good.create("pods", {"metadata": {"namespace": "default", "name": "p0"}})
+        good.bind_pod("default", "p0", "n0")
+        assert good.get("pods", "default", "p0")["spec"]["nodeName"] == "n0"
+        # the store never saw the unauthenticated create
+        items, _ = good.list("pods")
+        assert len(items) == 1
+    finally:
+        server.shutdown()
+
+
+def test_bearer_token_file_plumbing(tmp_path):
+    """token_file mirrors the in-cluster serviceaccount credential path."""
+    api = FakeApiServer()
+    server, _, url = serve_api(api, token="tok-abc")
+    try:
+        tf = tmp_path / "token"
+        tf.write_text("tok-abc\n")
+        client = HttpApiClient(url, token_file=str(tf))
+        client.create("queues", {"metadata": {"name": "q1"}, "spec": {"weight": 2}})
+        assert client.get("queues", "", "q1")["spec"]["weight"] == 2
+    finally:
+        server.shutdown()
+
+
+# ------------------------------------------------------ volume plane (PV/PVC)
+
+
+def test_zonal_pv_pins_placement_over_http():
+    """Directive: PV/PVC/StorageClass ingestion in the live plane
+    (cache.go:230-238, :288-306).  A pod whose PVC is bound to a zone-b
+    PV must land on the zone-b node even though the zone-a node is
+    first-fit, end-to-end over HTTP."""
+    api = FakeApiServer()
+    server, _, url = serve_api(api)
+    try:
+        client = HttpApiClient(url)
+        na = make_node("na")
+        na["metadata"]["labels"]["topology.kubernetes.io/zone"] = "zone-a"
+        nb = make_node("nb")
+        nb["metadata"]["labels"]["topology.kubernetes.io/zone"] = "zone-b"
+        client.create("nodes", na)
+        client.create("nodes", nb)
+        client.create("queues", {"metadata": {"name": "default"}, "spec": {"weight": 1}})
+        client.create("storageclasses", {"metadata": {"name": "standard"},
+                                         "provisioner": "kat.io/fake"})
+        client.create("persistentvolumes", {
+            "metadata": {"name": "pv-b",
+                         "labels": {"topology.kubernetes.io/zone": "zone-b"}},
+            "spec": {"capacity": {"storage": "10Gi"}},
+        })
+        client.create("persistentvolumeclaims", {
+            "metadata": {"namespace": "default", "name": "claim-b"},
+            "spec": {"volumeName": "pv-b", "storageClassName": "standard"},
+        })
+        client.create("podgroups", make_podgroup("pg1", min_member=1))
+        pod = make_pod("p0", group="pg1")
+        pod["spec"]["volumes"] = [
+            {"name": "data", "persistentVolumeClaim": {"claimName": "claim-b"}}
+        ]
+        client.create("pods", pod)
+
+        live = LiveCache(client)
+        sched = Scheduler(live, config=load_conf(FULL_CONF))
+        result = sched.run_once()
+        assert len(result.binds) == 1
+        assert api.get("pods", "default", "p0")["spec"]["nodeName"] == "nb"
+        # the model carries the resolved zone pin
+        task = next(iter(live.cluster.jobs["default/pg1"].tasks.values()))
+        assert task.volume_zone == "zone-b"
+    finally:
+        server.shutdown()
+
+
+def test_attach_limit_rejects_cpu_feasible_node_over_http():
+    """The attach-count axis: a node with one attach slot already consumed
+    by a running PVC pod rejects a second volume pod despite having the
+    cpu for it; the pod lands on the other node."""
+    api = FakeApiServer()
+    server, _, url = serve_api(api)
+    try:
+        client = HttpApiClient(url)
+        n0 = make_node("n0")
+        n0["status"]["allocatable"]["attachable-volumes-csi"] = 1
+        n1 = make_node("n1")
+        n1["status"]["allocatable"]["attachable-volumes-csi"] = 4
+        client.create("nodes", n0)
+        client.create("nodes", n1)
+        client.create("queues", {"metadata": {"name": "default"}, "spec": {"weight": 1}})
+        for i, claim in enumerate(("c0", "c1")):
+            client.create("persistentvolumeclaims", {
+                "metadata": {"namespace": "default", "name": claim},
+                "spec": {"volumeName": f"pv{i}"},
+            })
+            client.create("persistentvolumes", {
+                "metadata": {"name": f"pv{i}"},
+                "spec": {"capacity": {"storage": "1Gi"}},
+            })
+        # a running pod on n0 holds its single attach slot
+        holder = make_pod("holder", node="n0", phase="Running", cpu="1")
+        holder["spec"]["volumes"] = [
+            {"name": "v", "persistentVolumeClaim": {"claimName": "c0"}}
+        ]
+        client.create("pods", holder)
+        client.create("podgroups", make_podgroup("pg1", min_member=1))
+        pod = make_pod("p0", group="pg1", cpu="1")
+        pod["spec"]["volumes"] = [
+            {"name": "v", "persistentVolumeClaim": {"claimName": "c1"}}
+        ]
+        client.create("pods", pod)
+
+        live = LiveCache(client)
+        # n0 has cpu headroom (4 - 1 = 3 cores) but zero attach headroom
+        assert live is not None
+        sched = Scheduler(live, config=load_conf(FULL_CONF))
+        result = sched.run_once()
+        assert len(result.binds) == 1
+        assert api.get("pods", "default", "p0")["spec"]["nodeName"] == "n1"
+    finally:
+        server.shutdown()
+
+
+def test_late_pv_event_retranslates_pod():
+    """WATCH-race tolerance: a pod ingested before its PV/PVC appears gets
+    retranslated when the volume objects arrive (the informer-order gap
+    the reference's volumebinder absorbs internally)."""
+    api = FakeApiServer()
+    server, _, url = serve_api(api)
+    try:
+        client = HttpApiClient(url)
+        client.create("nodes", make_node("n0"))
+        client.create("queues", {"metadata": {"name": "default"}, "spec": {"weight": 1}})
+        client.create("podgroups", make_podgroup("pg1", min_member=1))
+        pod = make_pod("p0", group="pg1")
+        pod["spec"]["volumes"] = [
+            {"name": "v", "persistentVolumeClaim": {"claimName": "late"}}
+        ]
+        client.create("pods", pod)
+        live = LiveCache(client)
+        live.sync()
+        task = next(iter(live.cluster.jobs["default/pg1"].tasks.values()))
+        assert task.volume_zone == ""  # PVC not seen yet: no zone pin
+        # PV + PVC arrive later through the watch
+        client.create("persistentvolumes", {
+            "metadata": {"name": "pvx",
+                         "labels": {"topology.kubernetes.io/zone": "z9"}},
+            "spec": {},
+        })
+        client.create("persistentvolumeclaims", {
+            "metadata": {"namespace": "default", "name": "late"},
+            "spec": {"volumeName": "pvx"},
+        })
+        live.sync()
+        task = next(iter(live.cluster.jobs["default/pg1"].tasks.values()))
+        assert task.volume_zone == "z9"
+    finally:
+        server.shutdown()

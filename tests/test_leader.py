@@ -108,3 +108,24 @@ def test_scheduler_gated_on_leadership_and_loss_is_fatal(tmp_path):
     assert standby.try_acquire()
     with pytest.raises(LeaderLost):
         sched.run(max_cycles=1)
+
+
+def test_timing_ordering_validated(tmp_path):
+    """client-go's NewLeaderElector ordering: lease_duration >
+    renew_deadline > retry_period > 0 — a misconfigured pair (e.g.
+    renew_deadline >= lease_duration) would silently permit two
+    concurrent leaders via the renew-blip grace, so both electors must
+    refuse to construct."""
+    import pytest
+
+    from kube_arbitrator_tpu.framework.leader import LeaderElector
+
+    path = str(tmp_path / "lock")
+    with pytest.raises(ValueError, match="lease_duration"):
+        LeaderElector(path, lease_duration_s=10.0, renew_deadline_s=10.0)
+    with pytest.raises(ValueError, match="renew_deadline"):
+        LeaderElector(path, lease_duration_s=15.0, renew_deadline_s=5.0,
+                      retry_period_s=5.0)
+    with pytest.raises(ValueError, match="retry_period"):
+        LeaderElector(path, lease_duration_s=15.0, renew_deadline_s=10.0,
+                      retry_period_s=0.0)

@@ -88,7 +88,9 @@ def test_list_watch_sync_builds_model():
     live.sync()
 
     assert set(live.cluster.nodes) == {"n0", "n1"}
-    assert np.allclose(live.cluster.nodes["n0"].allocatable, res.make(4000, 8 * GB))
+    # attach axis defaults to 40 when the kubelet publishes no
+    # attachable-volumes-* allocatable key (sim parity)
+    assert np.allclose(live.cluster.nodes["n0"].allocatable, res.make(4000, 8 * GB, 0, 40))
     assert "default" in live.cluster.queues
     job = live.cluster.jobs["default/pg1"]
     assert job.min_available == 3 and len(job.tasks) == 3
@@ -96,7 +98,7 @@ def test_list_watch_sync_builds_model():
     assert np.allclose(t.resreq, res.make(1000, GB))
     assert len(live.cluster.others) == 1
     # the alien pod consumes node capacity
-    assert np.allclose(live.cluster.nodes["n0"].idle, res.make(2000, 7 * GB))
+    assert np.allclose(live.cluster.nodes["n0"].idle, res.make(2000, 7 * GB, 0, 40))
 
 
 def test_scheduler_binds_through_adapter_and_watch_roundtrip():
@@ -333,3 +335,55 @@ def test_cli_watch_stream_mode(tmp_path, capsys):
 
     lines = [json.loads(l) for l in out.strip().splitlines() if l.startswith("{")]
     assert sum(l["binds"] for l in lines) == 4
+
+
+def test_pv_zone_ignores_non_in_operators():
+    """A NotIn/Gt zone term is an exclusion, not a pin — misreading it
+    would pin the pod to exactly the zone the PV excludes."""
+    from kube_arbitrator_tpu.cache.live import pv_zone
+
+    pv = {"metadata": {"name": "pv1"},
+          "spec": {"nodeAffinity": {"required": {"nodeSelectorTerms": [
+              {"matchExpressions": [
+                  {"key": "topology.kubernetes.io/zone",
+                   "operator": "NotIn", "values": ["zone-a"]}]}]}}}}
+    assert pv_zone(pv) == ""
+    pv["spec"]["nodeAffinity"]["required"]["nodeSelectorTerms"][0][
+        "matchExpressions"][0]["operator"] = "In"
+    assert pv_zone(pv) == "zone-a"
+
+
+def test_conflicting_pv_zones_make_pod_unschedulable():
+    """Two PVCs bound to PVs in different zones: no node can attach both —
+    the pod must stay pending (VolumeZone-predicate behavior), not bind to
+    the first zone."""
+    from kube_arbitrator_tpu.cache import FakeApiServer, LiveCache
+    from kube_arbitrator_tpu.framework import Scheduler
+
+    api = FakeApiServer()
+    for zone, n in (("zone-a", "n0"), ("zone-b", "n1")):
+        node = make_node(n)
+        node["metadata"]["labels"]["topology.kubernetes.io/zone"] = zone
+        api.create("nodes", node)
+    api.create("queues", {"metadata": {"name": "default"}, "spec": {"weight": 1}})
+    for zone, pv, claim in (("zone-a", "pva", "ca"), ("zone-b", "pvb", "cb")):
+        api.create("persistentvolumes", {
+            "metadata": {"name": pv,
+                         "labels": {"topology.kubernetes.io/zone": zone}},
+            "spec": {}})
+        api.create("persistentvolumeclaims", {
+            "metadata": {"namespace": "default", "name": claim},
+            "spec": {"volumeName": pv}})
+    api.create("podgroups", make_podgroup("pg1", min_member=1))
+    pod = make_pod("p0", group="pg1")
+    pod["spec"]["volumes"] = [
+        {"name": "va", "persistentVolumeClaim": {"claimName": "ca"}},
+        {"name": "vb", "persistentVolumeClaim": {"claimName": "cb"}},
+    ]
+    api.create("pods", pod)
+    live = LiveCache(api)
+    sched = Scheduler(live)
+    result = sched.run_once()
+    assert result.binds == []
+    assert not api.get("pods", "default", "p0")["spec"]["nodeName"]
+    assert any(e.reason == "VolumeZoneConflict" for e in live.events)
