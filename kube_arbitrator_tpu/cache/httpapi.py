@@ -93,9 +93,17 @@ class _Handler(BaseHTTPRequestHandler):
                 f"Bearer {required}".encode(),
             )
             if not ok:
-                # the request body is still unread; close the connection
-                # instead of draining it so a keep-alive client cannot
-                # desync on the leftover bytes
+                # Drain a BOUNDED amount of the unread body so a client
+                # mid-send sees the 401 instead of a connection reset
+                # (EPIPE would surface as a transient network error and
+                # be retried forever), then close the connection so a
+                # keep-alive client cannot desync on any remainder.
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    if 0 < n <= 1 << 20:
+                        self.rfile.read(n)
+                except (ValueError, OSError):
+                    pass
                 self.close_connection = True
                 self._send(401, {"kind": "Status", "status": "Failure",
                                  "reason": "Unauthorized",

@@ -192,13 +192,18 @@ def pv_zone(pv: dict) -> str:
     )
     for term in req.get("nodeSelectorTerms", []) or []:
         for expr in term.get("matchExpressions", []) or []:
-            # only an In term is a pin; NotIn/Gt/Lt with values would be
-            # misread as pinning to the EXCLUDED zone
+            # only a SINGLE-value In term is a pin: NotIn/Gt/Lt would be
+            # misread as pinning to the EXCLUDED zone, and a multi-value
+            # In (regional PV) legally attaches in any listed zone — the
+            # single-zone class predicate cannot express that, so leave
+            # it unconstrained here; the volume binder re-checks zones at
+            # actuation (cache/sim.py FakeVolumeBinder, the reference's
+            # AllocateVolumes seam)
             if (
                 expr.get("key")
                 in (ZONE_LABEL, "failure-domain.beta.kubernetes.io/zone")
                 and expr.get("operator", "In") == "In"
-                and expr.get("values")
+                and len(expr.get("values") or ()) == 1
             ):
                 return expr["values"][0]
     return ""
@@ -324,12 +329,18 @@ class LiveCache:
         self._other_by_uid: Dict[str, TaskInfo] = {}
         # volume plane (cache.go:230-238): PV/PVC/StorageClass objects plus
         # the claim -> pod reverse index used to retranslate pods when a
-        # late PV/PVC event changes their zone/attach constraints
+        # late PV/PVC event changes their zone/attach constraints.
+        # _raw_pod holds raw dicts for PVC-BEARING pods only (they are the
+        # only retranslation targets; keeping every pod would double
+        # live-plane memory at 100k-pod scale); _pv_claims is the
+        # volumeName -> claims reverse index so a PV event resolves its
+        # bound claims in O(1) instead of scanning every indexed claim.
         self._pvs: Dict[str, dict] = {}
         self._pvcs: Dict[Tuple[str, str], dict] = {}
         self._scs: Dict[str, dict] = {}
         self._raw_pod: Dict[str, dict] = {}
         self._claim_pods: Dict[Tuple[str, str], set] = {}
+        self._pv_claims: Dict[str, set] = {}
 
     # ---- informer pump ----
 
@@ -495,8 +506,9 @@ class LiveCache:
         # schedulers' pods only while assigned and non-terminated
         if not responsible and not (assigned and not terminal):
             return
-        self._raw_pod[uid] = pod
-        self._index_claims(uid, pod)
+        if pod_claims(pod):  # only PVC-bearing pods can need retranslation
+            self._raw_pod[uid] = pod
+            self._index_claims(uid, pod)
         volume_zone, n_attach = self._volume_info(pod)
         if responsible:
             job_uid = _job_uid_for_pod(pod)
@@ -538,19 +550,29 @@ class LiveCache:
             self._pvs.pop(name, None)
         else:
             self._pvs[name] = pv
-        # retranslate pods whose bound claims reference this PV
-        for (ns, claim), _uids in list(self._claim_pods.items()):
-            pvc = self._pvcs.get((ns, claim))
-            if pvc and pvc.get("spec", {}).get("volumeName") == name:
-                self._retranslate_claim(ns, claim)
+        # retranslate pods whose bound claims reference this PV (O(1) via
+        # the volumeName reverse index maintained by _on_pvc)
+        for ns, claim in list(self._pv_claims.get(name, ())):
+            self._retranslate_claim(ns, claim)
 
     def _on_pvc(self, etype: str, pvc: dict) -> None:
         md = pvc.get("metadata", {})
         key = (md.get("namespace", "default"), md["name"])
+        old = self._pvcs.get(key)
+        old_vol = (old or {}).get("spec", {}).get("volumeName", "")
+        if old_vol:
+            members = self._pv_claims.get(old_vol)
+            if members is not None:
+                members.discard(key)
+                if not members:  # prune: dynamic provisioning churns names
+                    del self._pv_claims[old_vol]
         if etype == DELETED:
             self._pvcs.pop(key, None)
         else:
             self._pvcs[key] = pvc
+            vol = pvc.get("spec", {}).get("volumeName", "")
+            if vol:
+                self._pv_claims.setdefault(vol, set()).add(key)
         self._retranslate_claim(*key)
 
     def _on_storageclass(self, etype: str, sc: dict) -> None:

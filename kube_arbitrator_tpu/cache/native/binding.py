@@ -327,10 +327,21 @@ class NativeCache:
         return fit
 
     def snapshot(self) -> Snapshot:
+        from ..snapshot import _bucket
+
         lib = self._lib
         sizes = np.zeros(8, dtype=np.int64)
         lib.hc_snapshot_sizes(self._h, _ptr(sizes, ctypes.c_int64))
-        T, N, J, Q, G, CT, CN, W = (int(x) for x in sizes)
+        # hc_snapshot_sizes returns RAW live counts; the padding policy
+        # (geometric granularity + sticky memo) lives in snapshot._bucket
+        # with the SAME axis keys as the pure-Python plane, so both
+        # builders produce identical jit shapes from identical state
+        rT, rN, rJ, rQ, rG, CT, CN, W = (int(x) for x in sizes)
+        T = _bucket(rT, 8, 8, key="tasks")
+        N = _bucket(rN, 128, 128, key="nodes")
+        J = _bucket(rJ, 32, 32, key="jobs")
+        Q = _bucket(rQ, 8, 8, key="queues")
+        G = _bucket(rG, 32, 32, key="groups")
         Rr = res.NUM_RESOURCES
 
         buf = {

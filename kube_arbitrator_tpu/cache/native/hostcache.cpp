@@ -195,12 +195,6 @@ bool set_ports(Cache& c, Task& t, const int32_t* ports, int n_ports) {
   return true;
 }
 
-int64_t bucket(int64_t n, int64_t mult, int64_t min) {
-  n = n < 1 ? 1 : n;
-  int64_t b = ((n + mult - 1) / mult) * mult;
-  return b < min ? min : b;
-}
-
 }  // namespace
 
 extern "C" {
@@ -438,11 +432,15 @@ void hc_snapshot_sizes(void* h, int64_t* out) {
   }
   L.G = (int64_t)group_ids.size();
 
-  out[0] = bucket((int64_t)L.live_tasks.size(), 8, 8);
-  out[1] = bucket((int64_t)L.live_nodes.size(), 128, 128);
-  out[2] = bucket((int64_t)L.live_jobs.size(), 8, 8);
-  out[3] = bucket((int64_t)L.live_queues.size(), 8, 8);
-  out[4] = bucket(L.G, 8, 8);
+  // RAW live counts: the Python binding applies the padding policy
+  // (snapshot._bucket — geometric granularity + the process-wide sticky
+  // memo) so the native and pure-Python planes share one source of truth
+  // for jit shapes; clamp to >= 1 like _bucket's n floor.
+  out[0] = std::max<int64_t>((int64_t)L.live_tasks.size(), 1);
+  out[1] = std::max<int64_t>((int64_t)L.live_nodes.size(), 1);
+  out[2] = std::max<int64_t>((int64_t)L.live_jobs.size(), 1);
+  out[3] = std::max<int64_t>((int64_t)L.live_queues.size(), 1);
+  out[4] = std::max<int64_t>(L.G, 1);
   out[5] = (int64_t)std::max<size_t>(c.task_class_by_sig.size(), 1);
   out[6] = (int64_t)std::max<size_t>(c.node_class_by_sig.size(), 1);
   out[7] = PORT_WORDS;

@@ -15,9 +15,20 @@ class LocalDecider:
     decide() returns (CycleDecisions, device-time ms)."""
 
     def decide(self, st, config) -> Tuple[object, float]:
-        from ..ops.cycle import schedule_cycle
+        import contextlib
 
+        import jax
+
+        from ..ops.cycle import schedule_cycle
+        from ..platform import decision_device
+
+        # backend crossover: small snapshots run on the host CPU even when
+        # an accelerator is present — its ~70-90 ms fixed per-cycle cost
+        # dominates below ~30k tasks (platform.DEFAULT_TPU_MIN_TASKS)
+        dev = decision_device(int(st.task_valid.shape[0]))
+        ctx = jax.default_device(dev) if dev is not None else contextlib.nullcontext()
         t0 = time.perf_counter()
-        dec = schedule_cycle(st, tiers=config.tiers, actions=config.actions)
-        dec.task_node.block_until_ready()  # time the device program honestly
+        with ctx:
+            dec = schedule_cycle(st, tiers=config.tiers, actions=config.actions)
+            dec.task_node.block_until_ready()  # time the device program honestly
         return dec, (time.perf_counter() - t0) * 1000

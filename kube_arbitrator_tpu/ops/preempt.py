@@ -1241,9 +1241,7 @@ def _reclaim_canon(
             [mask_v.astype(jnp.float32)[:, None], jnp.where(mask_v[:, None], cres, 0.0)],
             axis=1,
         )
-        per_node = jnp.zeros((N, R + 1)).at[cnode].add(
-            jnp.where(mask_v[:, None], stat, 0.0), mode="drop"
-        )
+        per_node = jnp.zeros((N, R + 1)).at[cnode].add(stat, mode="drop")
         vic_cnt, vic_res = per_node[:, 0], per_node[:, 1:]
 
         # ---- first-fit node choice ----

@@ -1,7 +1,49 @@
-"""Accelerator platform bootstrap shared by the CLI and bench entry points."""
+"""Accelerator platform bootstrap shared by the CLI and bench entry points,
+plus the decision-backend crossover policy."""
 from __future__ import annotations
 
 import os
+
+# Measured backend crossover (BENCH_TPU_r04 vs BENCH_r04, v5e-1 vs 1-core
+# CPU host): the accelerator pays ~70-90 ms of fixed per-cycle cost
+# (host->device snapshot transfer, dispatch, decision read-back) that the
+# CPU path does not — allocate@1000x100 was 70.6 ms on the chip vs 0.8 ms
+# on CPU, allocate@10000x1000 91.8 vs 13.2 ms, while at 50k+ tasks the
+# chip wins (full actions 1.7 s chip vs 2.3 s CPU pre-canon).  Below this
+# many tasks the scheduler runs its decision program on the host CPU even
+# when an accelerator is present.  Override: KAT_TPU_MIN_TASKS (0 forces
+# the accelerator always).
+DEFAULT_TPU_MIN_TASKS = 30_000
+
+
+def tpu_min_tasks() -> int:
+    return int(os.environ.get("KAT_TPU_MIN_TASKS", DEFAULT_TPU_MIN_TASKS))
+
+
+def crossover_wants_cpu(num_tasks: int, default_backend: str) -> bool:
+    """The pure policy: run on CPU iff an accelerator is the default but
+    the snapshot sits below the measured crossover size."""
+    return default_backend != "cpu" and num_tasks < tpu_min_tasks()
+
+
+def decision_device(num_tasks: int):
+    """The device the decision program should run on for this snapshot
+    size, or None to use the platform default.
+
+    Returns a CPU device when (a) the default backend is an accelerator,
+    (b) a CPU backend is registered in this process, and (c) the snapshot
+    is below the measured crossover — small cycles are dominated by the
+    accelerator's fixed per-cycle overhead (see DEFAULT_TPU_MIN_TASKS).
+    """
+    import jax
+
+    if not crossover_wants_cpu(num_tasks, jax.default_backend()):
+        return None
+    try:
+        cpus = jax.devices("cpu")
+    except RuntimeError:
+        return None  # no CPU backend registered alongside the accelerator
+    return cpus[0] if cpus else None
 
 
 def probe_backend(timeout_s: float) -> bool:

@@ -130,3 +130,27 @@ def test_cli_runs():
     from kube_arbitrator_tpu.cli import main
 
     assert main(["--sim-nodes", "16", "--sim-jobs", "4", "--sim-tasks-per-job", "5", "--json"]) == 0
+
+
+def test_backend_crossover_policy(monkeypatch):
+    """Directive r5#4: the decision program runs on the host CPU below the
+    measured crossover size when an accelerator is the default backend
+    (its ~70-90 ms fixed per-cycle cost dominates small cycles), on the
+    accelerator above it, and the threshold is operator-tunable."""
+    from kube_arbitrator_tpu.platform import (
+        DEFAULT_TPU_MIN_TASKS, crossover_wants_cpu, decision_device)
+
+    assert crossover_wants_cpu(1_000, "tpu")
+    assert crossover_wants_cpu(DEFAULT_TPU_MIN_TASKS - 1, "tpu")
+    assert not crossover_wants_cpu(DEFAULT_TPU_MIN_TASKS, "tpu")
+    assert not crossover_wants_cpu(100_000, "tpu")
+    # CPU-only host: the policy never redirects
+    assert not crossover_wants_cpu(1_000, "cpu")
+    # operator override; 0 forces the accelerator always
+    monkeypatch.setenv("KAT_TPU_MIN_TASKS", "500")
+    assert not crossover_wants_cpu(1_000, "tpu")
+    monkeypatch.setenv("KAT_TPU_MIN_TASKS", "0")
+    assert not crossover_wants_cpu(1, "tpu")
+    monkeypatch.delenv("KAT_TPU_MIN_TASKS")
+    # in this CPU test process the device resolver is a no-op
+    assert decision_device(1_000) is None

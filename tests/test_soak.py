@@ -171,6 +171,7 @@ def test_live_plane_soak_50_cycles():
             sched.run_once()
 
         t0 = time.perf_counter()
+        cycle_times = []
         cordoned = None
         for cycle in range(N_CYCLES):
             # churn: replace CHURN_JOBS gangs with same-size fresh ones
@@ -190,7 +191,9 @@ def test_live_plane_soak_50_cycles():
                 node["spec"]["unschedulable"] = False
                 client.update("nodes", node)
                 cordoned = None
+            cycle_t0 = time.perf_counter()
             sched.run_once()
+            cycle_times.append(time.perf_counter() - cycle_t0)
         soak_s = time.perf_counter() - t0
 
         # final settle: drain remaining watch events, then compare
@@ -204,12 +207,14 @@ def test_live_plane_soak_50_cycles():
         assert placed > n_live * 0.6, (placed, n_live)
         # the soak itself (post-seed) must hold the cadence budget
         print(f"soak churn phase: {soak_s:.1f}s")
-        # Budget covers a COLD compile cache (~3 mid-churn shape compiles
-        # at ~15 s as the backlog climbs to steady state, measured 144 s
-        # worst); with the conftest persistent XLA cache warm the same
-        # phase measures 42 s.  Regressions to watch for: per-cycle cost
-        # creep (steady cycles are ~0.4 s) or a shape-stability break
-        # (snapshot._bucket stickiness) that recompiles every cycle.
-        assert soak_s < 200.0, f"soak took {soak_s:.1f}s"
+        # Two budgets, so a slow/loaded CI host cannot fake the regression
+        # this guards: the MEDIAN cycle catches a shape-stability break
+        # (recompile-per-cycle turns ~0.4 s steady cycles into ~15 s ones;
+        # a loaded host merely scales everything a few x), and a generous
+        # total bound catches runaway growth.  Cold compile cache measured
+        # 144 s total; warm (conftest persistent XLA cache) 42 s.
+        med = sorted(cycle_times)[len(cycle_times) // 2]
+        assert med < 5.0, f"median churn cycle {med:.2f}s — recompiling every cycle?"
+        assert soak_s < 400.0, f"soak took {soak_s:.1f}s"
     finally:
         server.shutdown()
