@@ -56,6 +56,12 @@ class _Handler(BaseHTTPRequestHandler):
     # the FakeApiServer and its lock ride on the server object
     server_version = "kat-fakeapi/1.0"
     protocol_version = "HTTP/1.1"
+    # Per-connection socket timeout (applied by BaseHTTPRequestHandler
+    # before each request): a client that claims a Content-Length and then
+    # stalls mid-send — authenticated or not — must not pin a handler
+    # thread forever.  No route long-polls (watch returns buffered events
+    # immediately), so a generous bound is safe.
+    timeout = 30.0
 
     def log_message(self, fmt, *args):  # quiet by default
         pass

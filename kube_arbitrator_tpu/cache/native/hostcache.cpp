@@ -374,9 +374,12 @@ void hc_set_others_used(void* h, const float* used) {
 }
 
 // ---- snapshot ----
-// Sizes: out[0..7] = T, N, J, Q, G, CT, CN, W (padded buckets).
-// A size query must be followed by hc_snapshot_fill with buffers of these
-// shapes; intervening events invalidate the sizes.
+// Sizes: out[0..7] = T, N, J, Q, G, CT, CN, W — RAW live counts.  The
+// Python binding applies the shared bucketing policy (snapshot._bucket)
+// before allocating fill buffers, so both snapshot builders produce
+// identical jit shapes; fill tolerates oversized buffers (only live
+// entries are written).  A size query must be followed by
+// hc_snapshot_fill; intervening events invalidate the sizes.
 
 void hc_snapshot_sizes(void* h, int64_t* out) {
   Cache& c = *static_cast<Cache*>(h);

@@ -306,54 +306,40 @@ def _use_deferred_decode(st: SnapshotTensors, tiers: Tiers) -> bool:
     )
 
 
-def _process_queue(
-    q: jax.Array,
-    st: SnapshotTensors,
-    sess: SessionCtx,
-    state: AllocState,
-    tiers: Tiers,
-    s_max: int,
-    best_effort_pass: bool,
-    gn: "Tuple[jax.Array, jax.Array] | None" = None,
-) -> "Tuple[AllocState, Tuple[jax.Array, jax.Array] | None]":
-    """One queue's turn within a round. All control flow is mask-based so a
-    skipped queue is a no-op state pass-through.
-
-    When ``gn`` is given (deferred decode), task arrays are left untouched
-    and placements accumulate into the (alloc, pipelined) [G, N] count
-    matrices instead."""
-    J = st.num_jobs
-
-    if best_effort_pass:
-        # backfill has no queue-fairness gating (backfill.go:40-71)
-        q_ok = st.queue_valid[q]
-    else:
-        q_over = overused(state.queue_alloc, sess.deserved)[q]
-        q_ok = st.queue_valid[q] & ~q_over
-
-    # ---- eligibility masks (NOTE: a lax.cond gate skipping the rest of
-    # the body for empty queues was measured SLOWER — the passthrough
-    # branch copies the state pytree per skipped turn — so every turn runs
-    # the full body and inactive/padding queues are instead skipped via
-    # the active-queue trip bound in _round) ----
+def _selection_shared(st, sess, state, tiers, best_effort_pass):
+    """Queue-independent arrays a turn's (job, group, budget) selection
+    reads — computed from the CURRENT aggregates.  The batched round
+    hoists one copy per round (valid because turns only write rows their
+    own queue owns); the immediate path rebuilds them per turn."""
     grp_remaining = st.group_size - state.group_placed
     grp_elig = group_live_mask(
         st, sess, state.group_placed, state.group_unfit, best_effort_pass
     )
-    job_has_pending = jnp.zeros(J, dtype=bool).at[st.group_job].max(grp_elig)
-    jmask = (st.job_queue == q) & job_has_pending & st.job_valid & q_ok
-
-    # ---- job selection (ssn.JobOrderFn over the queue's jobs) ----
+    job_has_pending = (
+        jnp.zeros(st.num_jobs, dtype=bool).at[st.group_job].max(grp_elig)
+    )
     job_ready = state.job_ready_cnt >= sess.min_avail
     job_share = drf_shares(state.job_alloc, sess.drf_total)
     jkeys = job_order_keys(
         tiers, st.job_priority, job_ready, st.job_creation_rank, job_share
     )
+    gkeys = group_order_keys(tiers, st.group_priority, st.group_uid_rank)
+    return grp_remaining, grp_elig, job_has_pending, job_ready, job_share, jkeys, gkeys
+
+
+def _select_turn(st, sess, state, tiers, s_max, best_effort_pass, shared, q, q_ok):
+    """One queue turn's selection — the single definition both the
+    immediate path (``_process_queue``) and the batched round use, so the
+    bit-exactness of the two paths cannot drift."""
+    (grp_remaining, grp_elig, job_has_pending, job_ready, job_share,
+     jkeys, gkeys) = shared
+    jmask = (st.job_queue == q) & job_has_pending & st.job_valid & q_ok
+
+    # ---- job selection (ssn.JobOrderFn over the queue's jobs) ----
     j, has_job = lex_argmin(jkeys, jmask)
 
     # ---- group selection (ssn.TaskOrderFn within the job) ----
     gmask = (st.group_job == j) & grp_elig & has_job
-    gkeys = group_order_keys(tiers, st.group_priority, st.group_uid_rank)
     g, has_grp = lex_argmin(gkeys, gmask)
 
     req = st.group_resreq[g]  # [R]
@@ -367,6 +353,39 @@ def _process_queue(
         )
     budget = jnp.clip(budget, 0, s_max)
     budget = jnp.where(has_grp, jnp.minimum(budget, grp_remaining[g]), 0)
+    return j, g, has_grp, req, budget
+
+
+def _process_queue(
+    q: jax.Array,
+    st: SnapshotTensors,
+    sess: SessionCtx,
+    state: AllocState,
+    tiers: Tiers,
+    s_max: int,
+    best_effort_pass: bool,
+) -> AllocState:
+    """One queue's turn within a round, on the IMMEDIATE-decode path
+    (binpack/spread node order or pod affinity, which read per-task
+    placements mid-loop).  All control flow is mask-based so a skipped
+    queue is a no-op state pass-through.  The deferred-decode path runs
+    the batched round (``_round_batched``) instead."""
+    if best_effort_pass:
+        # backfill has no queue-fairness gating (backfill.go:40-71)
+        q_ok = st.queue_valid[q]
+    else:
+        q_over = overused(state.queue_alloc, sess.deserved)[q]
+        q_ok = st.queue_valid[q] & ~q_over
+
+    # (NOTE: a lax.cond gate skipping the rest of the body for empty
+    # queues was measured SLOWER — the passthrough branch copies the state
+    # pytree per skipped turn — so every turn runs the full body and
+    # inactive/padding queues are instead skipped via the active-queue
+    # trip bound in _round)
+    shared = _selection_shared(st, sess, state, tiers, best_effort_pass)
+    j, g, has_grp, req, budget = _select_turn(
+        st, sess, state, tiers, s_max, best_effort_pass, shared, q, q_ok
+    )
 
     # ---- static feasibility on nodes (predicates minus resources) ----
     # The predicates plugin owns selector/taint/port/max-pod/unschedulable
@@ -440,34 +459,23 @@ def _process_queue(
     p_p = jnp.clip(placed_total - (cum - k_p), 0, k_p)  # i32[N] (packing order)
     p = p_p if nperm is None else jnp.zeros_like(p_p).at[nperm].set(p_p)
 
-    if gn is None:
-        # ---- decode: assign concrete tasks (group ranks) to node slots ----
-        placed_before = state.group_placed[g]
-        slots = jnp.arange(s_max)
-        node_of_slot = jnp.searchsorted(cum, slots, side="right").astype(jnp.int32)
-        if nperm is not None:
-            node_of_slot = nperm[jnp.clip(node_of_slot, 0, N - 1)]
-        slot_of_task = st.task_group_rank - placed_before
-        assigned = (
-            (st.task_group == g)
-            & (slot_of_task >= 0)
-            & (slot_of_task < placed_total)
-            & st.task_valid
-        )
-        tnode = node_of_slot[jnp.clip(slot_of_task, 0, s_max - 1)]
-        new_status = jnp.where(use_rel, PIPELINED, ALLOCATED)
-        task_status = jnp.where(assigned, new_status, state.task_status)
-        task_node = jnp.where(assigned, tnode, state.task_node)
-        gn_out = None
-    else:
-        # deferred decode: only the [G, N] counters change per turn
-        task_status = state.task_status
-        task_node = state.task_node
-        gn_a, gn_p = gn
-        gn_out = (
-            gn_a.at[g].add(jnp.where(use_rel, 0, p)),
-            gn_p.at[g].add(jnp.where(use_rel, p, 0)),
-        )
+    # ---- decode: assign concrete tasks (group ranks) to node slots ----
+    placed_before = state.group_placed[g]
+    slots = jnp.arange(s_max)
+    node_of_slot = jnp.searchsorted(cum, slots, side="right").astype(jnp.int32)
+    if nperm is not None:
+        node_of_slot = nperm[jnp.clip(node_of_slot, 0, N - 1)]
+    slot_of_task = st.task_group_rank - placed_before
+    assigned = (
+        (st.task_group == g)
+        & (slot_of_task >= 0)
+        & (slot_of_task < placed_total)
+        & st.task_valid
+    )
+    tnode = node_of_slot[jnp.clip(slot_of_task, 0, s_max - 1)]
+    new_status = jnp.where(use_rel, PIPELINED, ALLOCATED)
+    task_status = jnp.where(assigned, new_status, state.task_status)
+    task_node = jnp.where(assigned, tnode, state.task_node)
 
     # ---- state updates (no-ops when placed_total == 0) ----
     pf = p.astype(jnp.float32)[:, None] * req[None, :]
@@ -499,7 +507,218 @@ def _process_queue(
         progress=state.progress | (placed_total > 0) | unfit_now,
         rounds=state.rounds,
     )
-    return new_state, gn_out
+    return new_state
+
+
+TURN_CHUNK = 8  # queue turns selected per batched chunk (deferred path)
+
+
+def _round_batched(
+    st: SnapshotTensors,
+    sess: SessionCtx,
+    state: AllocState,
+    tiers: Tiers,
+    s_max: int,
+    best_effort_pass: bool,
+    gn,
+    perm: jax.Array,
+    trip: jax.Array,
+):
+    """One round on the deferred-decode path: the (job, group, budget)
+    SELECTION of up to TURN_CHUNK queue turns runs as one vmapped batch;
+    only the node-placement phase stays sequential.
+
+    Bit-exact with the sequential turn loop (``_process_queue``): a turn's
+    selection reads ONLY queue-local aggregates — group_placed/unfit,
+    job_alloc, job_ready_cnt, queue_alloc — and a job belongs to exactly
+    one queue, so no other queue's turn in the same round can change what
+    this queue selects.  The node pool (idle / releasing / ports /
+    num_tasks) is the only cross-queue channel and is updated in the same
+    perm order the turn loop used.  Dispatch cost per round drops from
+    ~turns×full-turn-graph to one batched selection plus a thin [N]-only
+    loop (the round-4 north-star profile: 241 rounds × 8 turns at
+    ~0.29 ms/turn, over half of it per-turn thunk dispatch)."""
+    Q = st.num_queues
+    S = TURN_CHUNK
+
+    # ---- round-start shared selection arrays.  Valid for EVERY chunk of
+    # the round: earlier chunks commit only rows owned by queues already
+    # served, and later chunks' selections never read those rows. ----
+    shared = _selection_shared(st, sess, state, tiers, best_effort_pass)
+    if best_effort_pass:
+        q_served = st.queue_valid
+    else:
+        q_served = st.queue_valid & ~overused(state.queue_alloc, sess.deserved)
+
+    preds_on = any(
+        p.name == "predicates" and not p.predicate_disabled
+        for tier in tiers
+        for p in tier.plugins
+    )
+
+    def select(q, qok):
+        return _select_turn(
+            st, sess, state, tiers, s_max, best_effort_pass, shared, q, qok
+        )
+
+    def chunk_body(c, carry):
+        (node_idle, node_releasing, node_ports, node_num_tasks,
+         gn_a, gn_p, any_a, any_p, job_alloc, queue_alloc, job_ready_cnt,
+         group_placed, group_unfit, progress) = carry
+
+        idx = c * S + jnp.arange(S)
+        q_idx = perm[jnp.clip(idx, 0, Q - 1)]
+        j_sel, g_sel, has_grp, req_s, budget_s = jax.vmap(select)(
+            q_idx, q_served[q_idx] & (idx < trip)
+        )
+
+        if preds_on:
+            # static node feasibility for the S selected groups, batched
+            static_ok = (
+                st.class_fit[st.group_klass[g_sel]][:, st.node_klass]
+                & st.node_valid[None, :]
+                & ~st.node_unsched[None, :]
+            )  # bool[S, N]
+            ports_s = st.group_ports[g_sel]              # i32[S, W]
+            has_ports_s = jnp.any(ports_s != 0, axis=1)  # bool[S]
+
+        def slot_body(i, nc):
+            (node_idle, node_releasing, node_ports, node_num_tasks,
+             gn_a, gn_p, placed_v, use_rel_v) = nc
+            g = g_sel[i]
+            req = req_s[i]
+            budget = budget_s[i]
+            if preds_on:
+                has_ports = has_ports_s[i]
+                ports_ok = jnp.all((ports_s[i][None, :] & node_ports) == 0, axis=-1)
+                pods_head = st.node_max_tasks - node_num_tasks
+                ok = static_ok[i] & ports_ok & (pods_head > 0)
+            else:
+                pods_head = jnp.full_like(node_num_tasks, s_max)
+                ok = st.node_valid
+                has_ports = jnp.array(False)
+            if best_effort_pass:
+                # backfill: no resource constraint (backfill.go:40-71)
+                k_eff = jnp.where(
+                    ok, jnp.minimum(pods_head, jnp.where(has_ports, 1, s_max)), 0
+                ).astype(jnp.int32)
+                use_rel = jnp.array(False)
+            else:
+                k_idle = _node_capacity(node_idle, req, ok, pods_head, has_ports)
+                use_rel = (jnp.sum(k_idle) == 0) & (budget > 0)
+                # releasing capacity only matters on the rare pipeline
+                # fallback — skip its [N, R] scan otherwise
+                k_eff = jax.lax.cond(
+                    use_rel,
+                    lambda: _node_capacity(
+                        node_releasing, req, ok, pods_head, has_ports
+                    ),
+                    lambda: k_idle,
+                )
+            # prefix-fill WITHOUT a full [N] cumsum (XLA:CPU lowers that to
+            # a ~75 us serial scalar scan — dominant in the round loop at
+            # ~2k turns/action): chunks strictly before the boundary chunk
+            # place everything (excl_cum + k <= chunk_cum < placed_total),
+            # chunks after place nothing (excl_cum >= placed_total); only
+            # the boundary chunk needs exact per-node prefix sums, over 64
+            # elements
+            C2 = 64
+            nc2 = -(-k_eff.shape[0] // C2)
+            k_pad = (
+                k_eff
+                if nc2 * C2 == k_eff.shape[0]
+                else jnp.pad(k_eff, (0, nc2 * C2 - k_eff.shape[0]))
+            )
+            kc = k_pad.reshape(nc2, C2)
+            chunk_cum = jnp.cumsum(kc.sum(axis=1))  # [nc2] short serial scan
+            placed_total = jnp.minimum(budget, chunk_cum[-1])
+            b = jnp.clip(
+                jnp.searchsorted(chunk_cum, placed_total, side="left"), 0, nc2 - 1
+            )
+            base_b = jnp.where(b > 0, chunk_cum[jnp.maximum(b - 1, 0)], 0)
+            kb = jax.lax.dynamic_slice(k_pad, (b * C2,), (C2,))
+            cumb = jnp.cumsum(kb)
+            pb = jnp.clip(placed_total - base_b - (cumb - kb), 0, kb)
+            p = jax.lax.dynamic_update_slice(
+                jnp.where((jnp.arange(nc2) < b)[:, None], kc, 0).reshape(-1),
+                pb,
+                (b * C2,),
+            )[: k_eff.shape[0]]
+            p_idle = jnp.where(use_rel, 0, p)
+            p_rel = p - p_idle
+            node_idle = node_idle - p_idle.astype(jnp.float32)[:, None] * req[None, :]
+            node_releasing = (
+                node_releasing - p_rel.astype(jnp.float32)[:, None] * req[None, :]
+            )
+            if preds_on:
+                node_ports = jnp.where(
+                    ((p > 0) & has_ports)[:, None],
+                    node_ports | ports_s[i][None, :],
+                    node_ports,
+                )
+            node_num_tasks = node_num_tasks + p
+            gn_a = gn_a.at[g].add(p_idle)
+            if not best_effort_pass:
+                # backfill never pipelines; its gn_p is a [1, 1] dummy
+                gn_p = gn_p.at[g].add(p_rel)
+            placed_v = placed_v.at[i].set(placed_total)
+            use_rel_v = use_rel_v.at[i].set(use_rel)
+            return (node_idle, node_releasing, node_ports, node_num_tasks,
+                    gn_a, gn_p, placed_v, use_rel_v)
+
+        (node_idle, node_releasing, node_ports, node_num_tasks,
+         gn_a, gn_p, placed_v, use_rel_v) = jax.lax.fori_loop(
+            0,
+            jnp.minimum(trip - c * S, S),
+            slot_body,
+            (node_idle, node_releasing, node_ports, node_num_tasks,
+             gn_a, gn_p, jnp.zeros(S, jnp.int32), jnp.zeros(S, bool)),
+        )
+
+        # ---- batched aggregate commit: the S slots are DISTINCT queues,
+        # hence distinct job/group rows (empty slots add zeros) ----
+        if best_effort_pass:
+            unfit_now = has_grp & (placed_v < budget_s)
+        else:
+            unfit_now = has_grp & use_rel_v & (placed_v < budget_s)
+        ptf = placed_v.astype(jnp.float32)[:, None] * req_s
+        return (
+            node_idle, node_releasing, node_ports, node_num_tasks, gn_a, gn_p,
+            any_a | jnp.any((placed_v > 0) & ~use_rel_v),
+            any_p | jnp.any((placed_v > 0) & use_rel_v),
+            job_alloc.at[j_sel].add(ptf),
+            queue_alloc.at[q_idx].add(ptf),
+            job_ready_cnt.at[j_sel].add(placed_v),
+            group_placed.at[g_sel].add(placed_v),
+            group_unfit.at[g_sel].max(unfit_now),
+            progress | jnp.any(placed_v > 0) | jnp.any(unfit_now),
+        )
+
+    gn_a, gn_p, any_a, any_p = gn
+    n_chunks = (trip + S - 1) // S
+    (node_idle, node_releasing, node_ports, node_num_tasks,
+     gn_a, gn_p, any_a, any_p, job_alloc, queue_alloc, job_ready_cnt,
+     group_placed, group_unfit, progress) = jax.lax.fori_loop(
+        0, n_chunks, chunk_body,
+        (state.node_idle, state.node_releasing, state.node_ports,
+         state.node_num_tasks, gn_a, gn_p, any_a, any_p, state.job_alloc,
+         state.queue_alloc, state.job_ready_cnt, state.group_placed,
+         state.group_unfit, state.progress),
+    )
+    state = dataclasses.replace(
+        state,
+        node_idle=node_idle,
+        node_releasing=node_releasing,
+        node_ports=node_ports,
+        node_num_tasks=node_num_tasks,
+        job_alloc=job_alloc,
+        queue_alloc=queue_alloc,
+        job_ready_cnt=job_ready_cnt,
+        group_placed=group_placed,
+        group_unfit=group_unfit,
+        progress=progress,
+    )
+    return state, (gn_a, gn_p, any_a, any_p)
 
 
 def _round(
@@ -540,19 +759,13 @@ def _round(
     if gn is None:
 
         def body(qi, s):
-            ns, _ = _process_queue(perm[qi], st, sess, s, tiers, s_max, best_effort_pass)
-            return ns
+            return _process_queue(perm[qi], st, sess, s, tiers, s_max, best_effort_pass)
 
         state = jax.lax.fori_loop(0, trip, body, state)
     else:
-
-        def body(qi, carry):
-            s, g = carry
-            return _process_queue(
-                perm[qi], st, sess, s, tiers, s_max, best_effort_pass, gn=g
-            )
-
-        state, gn = jax.lax.fori_loop(0, trip, body, (state, gn))
+        state, gn = _round_batched(
+            st, sess, state, tiers, s_max, best_effort_pass, gn, perm, trip
+        )
     return dataclasses.replace(state, rounds=state.rounds + 1), gn
 
 
@@ -562,33 +775,67 @@ def _decode_deferred(
     entry_placed: jax.Array,  # i32[G] group_placed at action entry
     gn_a: jax.Array,  # i32[G, N] allocated counts
     gn_p: jax.Array,  # i32[G, N] pipelined counts
+    any_p: jax.Array,  # bool scalar — did any turn pipeline?
 ) -> AllocState:
     """Turn the per-(group, node) counts into concrete task placements in
     one vectorized pass.
 
     A group's pending tasks are interchangeable, so rank r (uid order,
     offset by what previous actions placed) maps onto nodes in node-ordinal
-    order: allocated slots first, then pipelined — a single searchsorted
-    into the row-flattened cumulative counts.  Flattening stays globally
-    monotone because each row is offset by the running total of previous
-    rows, so one searchsorted serves every group at once."""
+    order: allocated slots first, then pipelined — a searchsorted into the
+    flattened cumulative counts.  The scan is TWO-LEVEL: XLA:CPU lowers a
+    cumsum over the raw [G*N] cells to a serial scalar loop (~9 ns/cell —
+    95 of the round-4 decode's 187 ms at the north star), so the cells are
+    first reduced to C-wide chunk sums (a vectorized reduction), the 1D
+    cumsum runs over the C×-smaller chunk array, and each task resolves
+    its node within one gathered C-cell chunk via a C-step vector scan.
+    The pipelined-side lookup is gated on the loop-tracked ``any_p``
+    scalar: the releasing fallback is rare, and skipping its dead lookup
+    saves a full pass."""
     N = st.num_nodes
-
-    def flat_lookup(counts, rank, in_range_base):
-        cum = jnp.cumsum(counts, axis=1)          # [G, N]
-        total = cum[:, -1]                        # [G]
-        base = jnp.cumsum(total) - total          # [G] exclusive
-        flat = (cum + base[:, None]).reshape(-1)  # [G*N] non-decreasing
-        g = jnp.clip(st.task_group, 0, None)
-        hit = in_range_base & (rank >= 0) & (rank < total[g])
-        idx = jnp.searchsorted(flat, base[g] + rank, side="right")
-        return hit, (jnp.clip(idx, 0, flat.shape[0] - 1) % N).astype(jnp.int32), total
-
     gq = jnp.clip(st.task_group, 0, None)
     in_group = (st.task_group >= 0) & st.task_valid
+    C = 16
+    ncp = -(-N // C)  # chunks per node row
+
+    def flat_lookup(counts, rank, in_range_base):
+        if ncp * C != N:
+            counts = jnp.pad(counts, ((0, 0), (0, ncp * C - N)))
+        chunks = counts.reshape(-1, C)                 # [G*ncp, C]
+        flatc = jnp.cumsum(chunks.sum(axis=1))         # i32[G*ncp] inclusive
+        base = jnp.where(gq > 0, flatc[jnp.maximum(gq * ncp - 1, 0)], 0)  # [T]
+        total = flatc[gq * ncp + ncp - 1] - base                          # [T]
+        hit = in_range_base & (rank >= 0) & (rank < total)
+        qpos = base + rank
+        ci = jnp.clip(
+            jnp.searchsorted(flatc, qpos, side="right"), 0, flatc.shape[0] - 1
+        )
+        r_in = qpos - jnp.where(ci > 0, flatc[jnp.maximum(ci - 1, 0)], 0)
+        cells = chunks[ci]                             # [T, C] gather
+        # node-within-chunk = #cells whose inclusive cum <= r_in, folded
+        # into one C-step scan of [T]-vector adds (XLA:CPU's [T, C]-axis
+        # cumsum is 5x slower than these 2C vector ops)
+        def step(carry, c):
+            acc, n = carry
+            acc = acc + cells[:, c]
+            return (acc, n + (acc <= r_in).astype(jnp.int32)), None
+        (_, n_in), _ = jax.lax.scan(
+            step, (jnp.zeros_like(r_in), jnp.zeros_like(r_in)), jnp.arange(C)
+        )
+        node = (ci % ncp) * C + n_in
+        return hit, node.astype(jnp.int32), total
+
     r0 = st.task_group_rank - entry_placed[gq]
     in_a, node_a, total_a = flat_lookup(gn_a, r0, in_group)
-    in_p, node_p, _ = flat_lookup(gn_p, r0 - total_a[gq], in_group & ~in_a)
+    if gn_p.shape[0] != st.num_groups:
+        # backfill's statically-dummy gn_p: no pipelining possible
+        in_p, node_p = jnp.zeros_like(in_a), jnp.zeros_like(node_a)
+    else:
+        in_p, node_p = jax.lax.cond(
+            any_p,
+            lambda: flat_lookup(gn_p, r0 - total_a, in_group & ~in_a)[:2],
+            lambda: (jnp.zeros_like(in_a), jnp.zeros_like(node_a)),
+        )
 
     task_status = jnp.where(
         in_a, ALLOCATED, jnp.where(in_p, PIPELINED, state.task_status)
@@ -634,14 +881,21 @@ def allocate_action(
         return jax.lax.while_loop(cond, body, state)
     gn0 = (
         jnp.zeros((st.num_groups, st.num_nodes), jnp.int32),
-        jnp.zeros((st.num_groups, st.num_nodes), jnp.int32),
+        # backfill (best-effort) statically never pipelines — dummy buffer
+        jnp.zeros(
+            (1, 1) if best_effort_pass else (st.num_groups, st.num_nodes),
+            jnp.int32,
+        ),
+        jnp.array(False),  # any turn allocated (idle path)
+        jnp.array(False),  # any turn pipelined (releasing fallback)
     )
-    state, (gn_a, gn_p) = jax.lax.while_loop(cond, body, (state, gn0))
+    state, (gn_a, gn_p, any_a, any_p) = jax.lax.while_loop(cond, body, (state, gn0))
     # an action that placed nothing (e.g. a backfill pass with no
-    # best-effort groups) skips the [G*N] decode cumsums entirely
+    # best-effort groups) skips the [G*N] decode entirely; the gate is the
+    # loop-tracked scalar, not an 80 MB jnp.any over the count matrices
     return jax.lax.cond(
-        jnp.any(gn_a > 0) | jnp.any(gn_p > 0),
-        lambda s: _decode_deferred(st, s, entry_placed, gn_a, gn_p),
+        any_a | any_p,
+        lambda s: _decode_deferred(st, s, entry_placed, gn_a, gn_p, any_p),
         lambda s: s,
         state,
     )
