@@ -53,36 +53,63 @@ def _emit(obj, stream=sys.stdout):
     print(json.dumps(obj), file=stream, flush=True)
 
 
-def _time_cycle(schedule_cycle, tensors, actions, reps=3):
+def _time_cycle(schedule_cycle, instances, actions, reps=3):
+    """Time the cycle over DISTINCT-content instances of the same workload.
+
+    ``instances`` is a list of snapshot-tensor pytrees with identical
+    treedefs and leaf shapes (so one compiled program serves all) but
+    different values (different generator seeds).  Measurement rules
+    learned the hard way on the axon TPU tunnel:
+
+    - Value-identical repeats are untrustworthy: round 4 saw a bogus
+      1.0 ms q512 row from same-buffer memoization, and round 5 caught
+      the tunnel returning 3.4 ms for a ~1,000 ms program on the third+
+      execution of value-identical copies.  Every timed call therefore
+      runs content the process has never executed before.
+    - The first execution after a compile can absorb a multi-second
+      tunnel stall (observed 7-16 s for a 1 s program, twice), so the
+      warmup runs TWO settle executions before anything is timed.
+    - The timed region ends at a forced device→host transfer of the
+      bind mask (np.asarray), which production decoding pays anyway —
+      a premature async unblock cannot fake a row through it.
+
+    Returns (median seconds, per-rep ms list, decisions of the FIRST
+    instance — the canonical seed the parity suite pins).
+    """
     import jax
 
     def fresh(t):
-        # THE critical measurement detail on this JAX build: repeated jit
-        # calls on the IDENTICAL input buffers can return a memoized
-        # result in ~0 ms (verified: same buffer 0.1 ms vs fresh buffer
-        # with equal values 175 ms — the source of round-4's bogus
-        # 1.0 ms q512 row).  Re-materialize every leaf so each timed rep
-        # really executes; the copy happens OUTSIDE the timed region.
         return jax.tree.map(
             lambda a: a.copy() if hasattr(a, "copy") else a, t
         )
 
-    dec = schedule_cycle(fresh(tensors), actions=actions)
-    jax.block_until_ready(dec)  # whole pytree, not one leaf
+    dec0 = schedule_cycle(fresh(instances[0]), actions=actions)
+    jax.block_until_ready(dec0)  # compile + first-exec stall absorber
+    dec0 = schedule_cycle(instances[0], actions=actions)
+    np.asarray(dec0.bind_mask)  # settle exec: forces full pipeline once
     times = []
-    for _ in range(reps):
-        t = fresh(tensors)
+    for i in range(reps):
+        if len(instances) > 1:
+            t = instances[(i % (len(instances) - 1)) + 1]
+            if i >= len(instances) - 1:
+                # more reps than variants: a reused instance was already
+                # executed once, so re-materialize its buffers (fresh
+                # copy) — weaker than never-executed content, but never
+                # the same buffers (the round-4 memoization trigger)
+                t = fresh(t)
+        else:
+            t = fresh(instances[0])
         jax.block_until_ready(t)
         t0 = time.perf_counter()
         dec = schedule_cycle(t, actions=actions)
-        jax.block_until_ready(dec)
+        np.asarray(dec.bind_mask)  # honest end: decisions reach the host
         times.append(time.perf_counter() - t0)
     # wildly inconsistent reps are a measurement smell — surface them
     # instead of silently medianing
     if max(times) > 10 * max(min(times), 1e-9):
         print(f"# inconsistent reps for {actions}: "
               f"{[round(t * 1000, 1) for t in times]} ms", file=sys.stderr)
-    return float(np.median(times)), dec
+    return float(np.median(times)), [round(t * 1000, 1) for t in times], dec0
 
 
 def _cluster(num_tasks, num_nodes, num_queues, running_fraction, seed=42):
@@ -97,6 +124,34 @@ def _cluster(num_tasks, num_nodes, num_queues, running_fraction, seed=42):
         running_fraction=running_fraction,
     )
     return build_snapshot(sim.cluster)
+
+
+def _instances(num_tasks, num_nodes, num_queues, running_fraction, want=3):
+    """The canonical seed-42 snapshot plus up to ``want`` same-shaped
+    variant instances (different seeds) for distinct-content timing reps.
+
+    A variant whose padded/bucketed leaf shapes differ from the canonical
+    snapshot would recompile inside the timed region, so it is skipped;
+    if no variant matches (tiny configs near a bucket boundary), the
+    timer falls back to value-copies of the canonical instance.
+    """
+    import jax.tree_util as jtu
+
+    canon = _cluster(num_tasks, num_nodes, num_queues, running_fraction)
+    flat0, treedef0 = jtu.tree_flatten(canon.tensors)
+    shapes0 = [getattr(a, "shape", None) for a in flat0]
+    out = [canon.tensors]
+    seed = 43
+    while len(out) < want + 1 and seed < 43 + 2 * want + 4:
+        t = _cluster(num_tasks, num_nodes, num_queues, running_fraction, seed=seed).tensors
+        flat, treedef = jtu.tree_flatten(t)
+        if treedef == treedef0 and [getattr(a, "shape", None) for a in flat] == shapes0:
+            out.append(t)
+        else:
+            print(f"# variant seed {seed} bucketed to different shapes; skipped",
+                  file=sys.stderr)
+        seed += 1
+    return out
 
 
 def main() -> None:
@@ -235,10 +290,12 @@ def _measure_main() -> None:
             ("allocate_q512@50000x5000", 50_000, 5_000, 512, 0.0, ("allocate", "backfill")),
             ("full_actions_q512@50000x5000", 50_000, 5_000, 512, 0.5, FULL_ACTIONS),
         ]
+        from kube_arbitrator_tpu.platform import decision_device
+
         for metric, T, N, Q, frac, actions in ladder:
             try:
-                snap = _cluster(T, N, Q, frac)
-                cycle_s, dec = _time_cycle(schedule_cycle, snap.tensors, actions)
+                inst = _instances(T, N, Q, frac)
+                cycle_s, rep_ms, dec = _time_cycle(schedule_cycle, inst, actions)
                 placed = int(np.asarray(dec.bind_mask).sum())
                 evicted = int(np.asarray(dec.evict_mask).sum())
                 row = {
@@ -246,6 +303,8 @@ def _measure_main() -> None:
                     "value": round(placed / cycle_s, 1) if cycle_s > 0 else 0.0,
                     "unit": "pods/s",
                     "cycle_ms": round(cycle_s * 1000, 1),
+                    "rep_ms": rep_ms,
+                    "distinct_instances": len(inst) - 1,
                     "binds": placed,
                     "evicts": evicted,
                     "cadence_contract_s": 1.0,
@@ -253,6 +312,34 @@ def _measure_main() -> None:
                 ladder_rows.append(row)
                 _emit(row, stream=sys.stderr)
                 _spill(row)
+                # companion row: where the production crossover policy
+                # (platform.decision_device — size + evictive rules) would
+                # run this cycle on a DIFFERENT backend than the bench
+                # default, measure there too, so the artifact carries both
+                # the raw chip number and the policy number the scheduler
+                # actually ships.
+                evictive = bool(set(actions) & {"reclaim", "preempt"}) and frac > 0
+                dev = decision_device(T, evictive=evictive)
+                if dev is not None:
+                    with jax.default_device(dev):
+                        p_s, p_rep, p_dec = _time_cycle(schedule_cycle, inst, actions)
+                    p_placed = int(np.asarray(p_dec.bind_mask).sum())
+                    prow = {
+                        "metric": metric + "/policy",
+                        "value": round(p_placed / p_s, 1) if p_s > 0 else 0.0,
+                        "unit": "pods/s",
+                        "cycle_ms": round(p_s * 1000, 1),
+                        "rep_ms": p_rep,
+                        "distinct_instances": len(inst) - 1,
+                        "binds": p_placed,
+                        "evicts": int(np.asarray(p_dec.evict_mask).sum()),
+                        "backend": str(dev),
+                        "note": "backend the crossover policy selects in production",
+                        "cadence_contract_s": 1.0,
+                    }
+                    ladder_rows.append(prow)
+                    _emit(prow, stream=sys.stderr)
+                    _spill(prow)
             except Exception as e:  # a failed row must not kill the primary line
                 ladder_rows.append({"metric": metric, "error": str(e)[:200]})
                 _spill({"metric": metric, "error": str(e)[:200]})
@@ -262,8 +349,11 @@ def _measure_main() -> None:
     from kube_arbitrator_tpu.cache import generate_cluster
     from kube_arbitrator_tpu.oracle import SequentialScheduler
 
-    snap = _cluster(num_tasks, num_nodes, 8, 0.0)
-    cycle_s, dec = _time_cycle(schedule_cycle, snap.tensors, ("allocate", "backfill"), reps=5)
+    inst = _instances(num_tasks, num_nodes, 8, 0.0, want=5)
+    snap_tensors = inst[0]
+    cycle_s, rep_ms, dec = _time_cycle(
+        schedule_cycle, inst, ("allocate", "backfill"), reps=5
+    )
     n_placed = int(np.asarray(dec.bind_mask).sum())
     pods_per_sec = n_placed / cycle_s if cycle_s > 0 else 0.0
 
@@ -272,7 +362,7 @@ def _measure_main() -> None:
     try:
         from kube_arbitrator_tpu.bench_baseline import run_native_baseline
 
-        nb_placed, nb_s = run_native_baseline(snap.tensors)
+        nb_placed, nb_s = run_native_baseline(snap_tensors)
         native_rate = nb_placed / nb_s if nb_s > 0 else 0.0
         _emit(
             {
@@ -288,7 +378,7 @@ def _measure_main() -> None:
         # faithful per-pair cost mode: pays the reference's NodeInfo
         # rebuild per predicate call (predicates.go:122-123) — the
         # falsifiable baseline for the >=50x acceptance criterion
-        nbf_placed, nbf_s = run_native_baseline(snap.tensors, faithful=True)
+        nbf_placed, nbf_s = run_native_baseline(snap_tensors, faithful=True)
         faithful_rate = nbf_placed / nbf_s if nbf_s > 0 else 0.0
         _emit(
             {

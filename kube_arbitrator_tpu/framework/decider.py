@@ -22,10 +22,18 @@ class LocalDecider:
         from ..ops.cycle import schedule_cycle
         from ..platform import decision_device
 
+        from ..api.types import TaskStatus
+
         # backend crossover: small snapshots run on the host CPU even when
         # an accelerator is present — its ~70-90 ms fixed per-cycle cost
-        # dominates below ~30k tasks (platform.DEFAULT_TPU_MIN_TASKS)
-        dev = decision_device(int(st.task_valid.shape[0]))
+        # dominates below ~30k tasks (platform.DEFAULT_TPU_MIN_TASKS) —
+        # and so do EVICTIVE cycles (reclaim/preempt with running
+        # victims), whose claim-serialized turn loop is dispatch-bound on
+        # an accelerator at every measured size (platform module comment)
+        evictive = bool(
+            set(config.actions) & {"reclaim", "preempt"}
+        ) and bool((st.task_status == int(TaskStatus.RUNNING)).any())
+        dev = decision_device(int(st.task_valid.shape[0]), evictive=evictive)
         ctx = jax.default_device(dev) if dev is not None else contextlib.nullcontext()
         t0 = time.perf_counter()
         with ctx:

@@ -15,29 +15,50 @@ import os
 # the accelerator always).
 DEFAULT_TPU_MIN_TASKS = 30_000
 
+# EVICTIVE cycles (reclaim/preempt over a populated cluster) stay on the
+# host CPU at every measured size: their cost is the claim-serialized
+# turn loop — dozens of small dependent ops per single-task claim — which
+# is dispatch-bound on an accelerator and cache-friendly on the host.
+# Measured round 5 (v5e-1 vs CPU host, distinct-instance reps):
+# full_actions@50000x5000 430 ms CPU vs 539 ms chip;
+# full_actions_q512@50000x5000 628 ms CPU vs ~1,000 ms chip (median;
+# evict-heavy instances 2.9 s CPU vs 3.5 s chip).  Wide allocate-only
+# cycles are the accelerator's win (north star 252 ms chip vs 360 ms
+# CPU).  Override: KAT_TPU_EVICTIVE=1 forces evictive cycles onto the
+# accelerator anyway.
+
 
 def tpu_min_tasks() -> int:
     return int(os.environ.get("KAT_TPU_MIN_TASKS", DEFAULT_TPU_MIN_TASKS))
 
 
-def crossover_wants_cpu(num_tasks: int, default_backend: str) -> bool:
+def crossover_wants_cpu(
+    num_tasks: int, default_backend: str, evictive: bool = False
+) -> bool:
     """The pure policy: run on CPU iff an accelerator is the default but
-    the snapshot sits below the measured crossover size."""
-    return default_backend != "cpu" and num_tasks < tpu_min_tasks()
+    the snapshot sits below the measured crossover size, or the cycle is
+    evictive (reclaim/preempt with running victims — claim-serialized,
+    measured CPU-faster at every size; see module comment)."""
+    if default_backend == "cpu":
+        return False
+    if evictive and os.environ.get("KAT_TPU_EVICTIVE") != "1":
+        return True
+    return num_tasks < tpu_min_tasks()
 
 
-def decision_device(num_tasks: int):
-    """The device the decision program should run on for this snapshot
-    size, or None to use the platform default.
+def decision_device(num_tasks: int, evictive: bool = False):
+    """The device the decision program should run on for this snapshot,
+    or None to use the platform default.
 
     Returns a CPU device when (a) the default backend is an accelerator,
     (b) a CPU backend is registered in this process, and (c) the snapshot
-    is below the measured crossover — small cycles are dominated by the
-    accelerator's fixed per-cycle overhead (see DEFAULT_TPU_MIN_TASKS).
+    is below the measured crossover size — small cycles are dominated by
+    the accelerator's fixed per-cycle overhead (DEFAULT_TPU_MIN_TASKS) —
+    or the cycle is evictive (claim-serialized; module comment).
     """
     import jax
 
-    if not crossover_wants_cpu(num_tasks, jax.default_backend()):
+    if not crossover_wants_cpu(num_tasks, jax.default_backend(), evictive):
         return None
     try:
         cpus = jax.devices("cpu")

@@ -154,3 +154,16 @@ def test_backend_crossover_policy(monkeypatch):
     monkeypatch.delenv("KAT_TPU_MIN_TASKS")
     # in this CPU test process the device resolver is a no-op
     assert decision_device(1_000) is None
+
+    # EVICTIVE cycles route to CPU at every size (claim-serialized turn
+    # loop is dispatch-bound on an accelerator; measured round 5:
+    # full_actions@50kx5k 430 ms CPU vs 539 ms chip, q512 628 ms vs ~1 s)
+    assert crossover_wants_cpu(100_000, "tpu", evictive=True)
+    assert crossover_wants_cpu(50_000, "tpu", evictive=True)
+    assert not crossover_wants_cpu(50_000, "tpu", evictive=False)
+    assert not crossover_wants_cpu(50_000, "cpu", evictive=True)
+    # operator override forces evictive cycles onto the accelerator
+    monkeypatch.setenv("KAT_TPU_EVICTIVE", "1")
+    assert not crossover_wants_cpu(50_000, "tpu", evictive=True)
+    assert crossover_wants_cpu(1_000, "tpu", evictive=True)  # size rule still applies
+    monkeypatch.delenv("KAT_TPU_EVICTIVE")
