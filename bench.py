@@ -105,7 +105,8 @@ def _time_cycle(schedule_cycle, instances, actions, reps=3):
         np.asarray(dec.bind_mask)  # honest end: decisions reach the host
         times.append(time.perf_counter() - t0)
     # wildly inconsistent reps are a measurement smell — surface them
-    # instead of silently medianing
+    # instead of silently medianing (the flag also rides the row dict via
+    # the rep_ms list the caller records)
     if max(times) > 10 * max(min(times), 1e-9):
         print(f"# inconsistent reps for {actions}: "
               f"{[round(t * 1000, 1) for t in times]} ms", file=sys.stderr)

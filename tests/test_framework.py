@@ -167,3 +167,20 @@ def test_backend_crossover_policy(monkeypatch):
     assert not crossover_wants_cpu(50_000, "tpu", evictive=True)
     assert crossover_wants_cpu(1_000, "tpu", evictive=True)  # size rule still applies
     monkeypatch.delenv("KAT_TPU_EVICTIVE")
+
+
+def test_decision_device_resolves_cpu_when_accelerator_default(monkeypatch):
+    """The device resolver (not just the pure policy) hands back a real CPU
+    device when the default backend claims to be an accelerator — the seam
+    framework/decider.py routes evictive and small cycles through."""
+    import jax
+
+    from kube_arbitrator_tpu import platform as plat
+
+    monkeypatch.delenv("KAT_TPU_EVICTIVE", raising=False)
+    monkeypatch.delenv("KAT_TPU_MIN_TASKS", raising=False)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    dev = plat.decision_device(50_000, evictive=True)
+    assert dev is not None and dev.platform == "cpu"
+    assert plat.decision_device(50_000, evictive=False) is None
+    assert plat.decision_device(1_000) is not None  # size rule
