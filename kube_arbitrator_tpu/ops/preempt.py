@@ -678,47 +678,14 @@ def _rounds(st, sess, state, tiers, s_max, max_rounds, mode, view):
     return jax.lax.while_loop(cond, round_body, state)
 
 
-def preempt_action(
-    st: SnapshotTensors,
-    sess: SessionCtx,
-    state: AllocState,
-    tiers: Tiers,
-    s_max: int = 4096,
-    max_rounds: int = 100_000,
-    panel_floor: int = 1024,
-) -> AllocState:
-    """Phase 1 (inter-job within queue) then phase 2 (intra-job priority).
-
-    The victim view (panel + sort layouts) is built once and shared by
-    both phases: RUNNING tasks (the only victims) never change node
-    mid-action, the RUNNING pool only shrinks, and phase 2's scope
-    (claimant jobs' own tasks) is a subset of phase 1's (claimant
-    queues' tasks).  Large snapshots get a compacted T//8 panel when the
-    qualifying victim count fits (claimant-queue running tasks — the
-    common case once allocate has drained most queues), with a
-    ``lax.cond`` fallback to a full-width panel.
-
-    ``panel_floor`` gates the dual-compile path: snapshots with
-    T//8 < panel_floor use one full-width panel (tests lower it to force
-    the compacted branch on small snapshots — see
-    test_preempt.py::test_panel_branch_matches_full)."""
-    T = st.num_tasks
-    running0 = (
-        (state.task_status == RUNNING) & st.task_valid & (state.task_node >= 0)
-    )
-
-    def run_phases(view, state):
-        s = _rounds(st, sess, state, tiers, s_max, max_rounds, "preempt", view)
-        return _rounds(st, sess, s, tiers, s_max, max_rounds, "preempt_intra", view)
-
-    P = T // 8
-    if P < panel_floor:
-        # small snapshots: one full-width panel, no dual compile
-        return run_phases(_build_view(st, state, running0, T), state)
-
-    # Entry-time victims-possible refinement (same monotonicity argument
-    # as the per-round gate in _rounds: the running pool, live claimant
-    # groups and nrun only shrink, so entry-impossible stays impossible).
+def _entry_qualify(st, sess, state, running0):
+    """Entry-time victims-possible refinement for the panel-tier switch
+    (same monotonicity argument as the per-round gate in ``_rounds``: the
+    running pool, live claimant groups and nrun only shrink, so
+    entry-impossible stays impossible).  bool[T]: tasks that could be a
+    victim of phase 1 (same-queue other-job) or phase 2 (same-job lower
+    priority).  One definition, shared with the panel parity tests so the
+    tier-window preconditions can't drift from the product gate."""
     J, Q = st.num_jobs, st.num_queues
     grp_live0 = group_live_mask(st, sess, state.group_placed, None)
     tq = st.job_queue[st.task_job]
@@ -738,16 +705,69 @@ def preempt_action(
         jnp.where(grp_live0, st.group_priority, jnp.iinfo(jnp.int32).min)
     )
     qual2 = running0 & (st.task_priority < maxgp[st.task_job])
-    qualify = qual1 | qual2
+    return qual1 | qual2
+
+
+def preempt_action(
+    st: SnapshotTensors,
+    sess: SessionCtx,
+    state: AllocState,
+    tiers: Tiers,
+    s_max: int = 4096,
+    max_rounds: int = 100_000,
+    panel_floor: int = 1024,
+) -> AllocState:
+    """Phase 1 (inter-job within queue) then phase 2 (intra-job priority).
+
+    The victim view (panel + sort layouts) is built once and shared by
+    both phases: RUNNING tasks (the only victims) never change node
+    mid-action, the RUNNING pool only shrinks, and phase 2's scope
+    (claimant jobs' own tasks) is a subset of phase 1's (claimant
+    queues' tasks).  Large snapshots get a compacted T//8 panel when the
+    qualifying victim count fits (claimant-queue running tasks — the
+    common case once allocate has drained most queues), a T//4 panel
+    when it overflows by up to 2x (evict-heavy instances), and a
+    full-width panel beyond that (``lax.switch``).
+
+    ``panel_floor`` gates the multi-compile path: snapshots with
+    T//8 < panel_floor use one full-width panel (tests lower it to force
+    the compacted branches on small snapshots — see
+    test_preempt.py::test_panel_branch_matches_full)."""
+    T = st.num_tasks
+    running0 = (
+        (state.task_status == RUNNING) & st.task_valid & (state.task_node >= 0)
+    )
+
+    def run_phases(view, state):
+        s = _rounds(st, sess, state, tiers, s_max, max_rounds, "preempt", view)
+        return _rounds(st, sess, s, tiers, s_max, max_rounds, "preempt_intra", view)
+
+    P = T // 8
+    if P < panel_floor:
+        # small snapshots: one full-width panel, no dual compile
+        return run_phases(_build_view(st, state, running0, T), state)
+
+    qualify = _entry_qualify(st, sess, state, running0)
     count = jnp.sum(qualify.astype(jnp.int32))
 
+    # Three panel tiers: T//8, T//4, full.  Evict-heavy instances whose
+    # qualifying-victim count overflows the T//8 panel by a few percent
+    # (measured q512@50kx5k: most seeds 5.1-5.8k vs P=6.3k, outliers
+    # 6.7-7.0k) otherwise fall all the way to the full-width panel and
+    # pay ~8x per turn — the whole 2.9s-vs-0.65s instance variance on
+    # the q512 ladder row.  The middle tier costs one more compile of
+    # the phase machinery and keeps those outliers at 2x, not 8x.
     def small(state):
         return run_phases(_build_view(st, state, qualify, P), state)
+
+    def mid(state):
+        return run_phases(_build_view(st, state, qualify, T // 4), state)
 
     def full(state):
         return run_phases(_build_view(st, state, running0, T), state)
 
-    return jax.lax.cond(count <= P, small, full, state)
+    branch = (count > P).astype(jnp.int32) + (count > T // 4).astype(jnp.int32)
+    return jax.lax.switch(branch, [small, mid, full], state)
 
 
 def _reclaim_verdict_names(tiers: Tiers):
