@@ -253,18 +253,11 @@ def _measure_main() -> None:
     # cache shared across backends/hosts made XLA print a multi-KB
     # cross-host feature warning that flooded the round-3 driver capture
     # (BENCH_r03.json tail) — a per-fingerprint directory can never hold
-    # entries from another device or host CPU generation.
-    fingerprint = f"{jax.default_backend()}-{jax.devices()[0].device_kind}".replace(
-        " ", "_"
-    )
-    cache_dir = os.path.join(
-        os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/kat-jax-cache"), fingerprint
-    )
-    try:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass
+    # entries from another device or host CPU generation (the CPU
+    # fingerprint hashes the host's feature flags; platform.cache_fingerprint).
+    from kube_arbitrator_tpu.platform import enable_persistent_cache
+
+    enable_persistent_cache()
 
     from kube_arbitrator_tpu.ops import schedule_cycle
 

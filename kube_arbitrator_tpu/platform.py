@@ -67,6 +67,55 @@ def decision_device(num_tasks: int, evictive: bool = False):
     return cpus[0] if cpus else None
 
 
+def cache_fingerprint() -> str:
+    """Directory key for the persistent XLA compilation cache: backend +
+    device kind + (for CPU) a hash of the host's CPU feature flags.
+
+    The backend-and-kind pair alone is NOT generation-safe for CPU:
+    every x86 host reports ``TFRT_CPU_0``, and XLA:CPU AOT code compiled
+    with e.g. AMX/avx512fp16 enabled loads on an older host with a
+    machine-feature mismatch warning ("could lead to execution errors
+    such as SIGILL", cpu_aot_loader.cc) — observed round 5 when the
+    bench host changed between captures.  Hashing /proc/cpuinfo's flag
+    set gives each microarchitecture its own cache directory."""
+    import hashlib
+
+    import jax
+
+    fp = f"{jax.default_backend()}-{jax.devices()[0].device_kind}".replace(" ", "_")
+    if jax.default_backend() == "cpu":
+        try:
+            with open("/proc/cpuinfo") as f:
+                for line in f:
+                    # x86 reports "flags", aarch64 reports "Features"
+                    if line.startswith(("flags", "Features")):
+                        feats = "".join(sorted(line.split(":", 1)[1].split()))
+                        fp += "-" + hashlib.sha1(feats.encode()).hexdigest()[:10]
+                        break
+        except OSError:
+            pass
+    return fp
+
+
+def enable_persistent_cache() -> None:
+    """Point JAX's persistent compilation cache at a per-fingerprint
+    directory under ``JAX_COMPILATION_CACHE_DIR`` (default
+    /tmp/kat-jax-cache) — shared by bench.py and the test conftest so the
+    cache policy lives in one place.  Safe no-op on JAX builds without
+    the config knobs."""
+    import jax
+
+    cache_dir = os.path.join(
+        os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/kat-jax-cache"),
+        cache_fingerprint(),
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+
 def probe_backend(timeout_s: float) -> bool:
     """Probe accelerator init in a SUBPROCESS with a hard timeout.
 

@@ -17,18 +17,10 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent XLA compilation cache, keyed by backend fingerprint like
-# bench.py (a cache shared across machine generations replayed mismatched
-# AOT code — round-5 note): repeat suite runs skip the ~15 s compiles the
-# larger tests (soak, parity) otherwise pay.
-import jax as _jax
+# Persistent XLA compilation cache, keyed by the generation-aware backend
+# fingerprint (a cache shared across machine generations replayed
+# mismatched AOT code — round-5 note): repeat suite runs skip the ~15 s
+# compiles the larger tests (soak, parity) otherwise pay.
+from kube_arbitrator_tpu.platform import enable_persistent_cache as _epc
 
-_fp = f"{_jax.default_backend()}-{_jax.devices()[0].device_kind}".replace(" ", "_")
-_cache_dir = os.path.join(
-    os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/kat-jax-cache"), _fp
-)
-try:
-    _jax.config.update("jax_compilation_cache_dir", _cache_dir)
-    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-except Exception:
-    pass
+_epc()
