@@ -83,17 +83,21 @@ def cache_fingerprint() -> str:
     import jax
 
     fp = f"{jax.default_backend()}-{jax.devices()[0].device_kind}".replace(" ", "_")
-    if jax.default_backend() == "cpu":
-        try:
-            with open("/proc/cpuinfo") as f:
-                for line in f:
-                    # x86 reports "flags", aarch64 reports "Features"
-                    if line.startswith(("flags", "Features")):
-                        feats = "".join(sorted(line.split(":", 1)[1].split()))
-                        fp += "-" + hashlib.sha1(feats.encode()).hexdigest()[:10]
-                        break
-        except OSError:
-            pass
+    # ALWAYS key on the host CPU generation, not only when CPU is the
+    # default backend: an accelerator-default process still compiles CPU
+    # executables (the crossover policy routes small/evictive cycles to
+    # the host, decision_device), and those AOT entries land in this same
+    # directory.
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                # x86 reports "flags", aarch64 reports "Features"
+                if line.startswith(("flags", "Features")):
+                    feats = "".join(sorted(line.split(":", 1)[1].split()))
+                    fp += "-" + hashlib.sha1(feats.encode()).hexdigest()[:10]
+                    break
+    except OSError:
+        pass
     return fp
 
 
