@@ -598,3 +598,95 @@ def test_north_star_shaped_shortfall_is_pinned():
             f"queue {q} overshot deserved in every dim by more than one "
             f"task: {overshoot}"
         )
+
+
+def test_full_actions_mid_panel_scale_vs_oracle():
+    """Production-scale guard for the r5 three-tier victim panel: a
+    full-action cycle big enough (T~8.7k) that preempt_action's switch
+    takes the MIDDLE tier — asserted via the product's own gate — must
+    stay invariant-clean and land within the documented
+    invariant-equivalence window of the sequential oracle (SURVEY §7:
+    valid schedules may fragment differently; bit-parity is pinned
+    separately by test_panel_mid_tier_matches_full).  Measured on seeds
+    0-3: kernel readiness >= oracle - 1 with <= 6/104 bidirectional
+    mismatches; a panel-truncation regression (dropped victims) would
+    collapse evictions and readiness far outside these bounds."""
+    import jax
+
+    from kube_arbitrator_tpu.cache import build_snapshot, generate_cluster
+    from kube_arbitrator_tpu.framework.conf import SchedulerConfig
+    from kube_arbitrator_tpu.ops import schedule_cycle
+    from kube_arbitrator_tpu.ops.cycle import open_session
+    from kube_arbitrator_tpu.ops.preempt import RUNNING, _entry_qualify
+    from kube_arbitrator_tpu.oracle import SequentialScheduler
+
+    GB = 1024 ** 3
+    full = ("reclaim", "allocate", "backfill", "preempt")
+    sim = generate_cluster(
+        num_nodes=600,
+        num_jobs=104,
+        tasks_per_job=80,
+        num_queues=24,
+        seed=2,
+        node_cpu_milli=8000,
+        node_memory=16 * GB,
+        running_fraction=0.35,
+    )
+    snap = build_snapshot(sim.cluster)
+    st = snap.tensors
+
+    # the production panel switch must take the MIDDLE tier for this
+    # workload, or the test stops guarding what it exists to guard.  The
+    # switch evaluates the qualify count at PREEMPT ENTRY — after
+    # reclaim/allocate/backfill have shrunk the running pool — so the
+    # gate is asserted on that state, not on session open (review catch;
+    # measured: 1374-1624 qualifying at entry across seeds 0-3 vs the
+    # 1088/2176 tier bounds).
+    from kube_arbitrator_tpu.ops.cycle import ACTION_KERNELS
+
+    tiers = SchedulerConfig.default().tiers
+
+    @jax.jit
+    def entry_count(st):
+        import jax.numpy as jnp
+
+        sess, state = open_session(st, tiers)
+        for a in ("reclaim", "allocate", "backfill"):
+            state = ACTION_KERNELS[a](
+                st, sess, state, tiers, s_max=4096, max_rounds=100_000
+            )
+        running0 = (
+            (state.task_status == RUNNING) & st.task_valid & (state.task_node >= 0)
+        )
+        return jnp.sum(_entry_qualify(st, sess, state, running0).astype(jnp.int32))
+
+    count = int(entry_count(st))
+    T = st.num_tasks
+    assert T // 8 < count <= T // 4, (count, T // 8, T // 4)
+
+    dec = schedule_cycle(st, actions=full)
+
+    # invariants: no oversubscription; evictions only of running tasks;
+    # every committed bind carries a node
+    assert (np.asarray(dec.node_idle) > -1e-3).all()
+    em = np.asarray(dec.evict_mask)
+    assert em.sum() > 0, "no evictions — the victim path did not run"
+    assert (np.asarray(st.task_status)[em] == int(RUNNING)).all()
+    bm = np.asarray(dec.bind_mask)
+    assert bm.sum() > 0
+    assert (np.asarray(dec.task_node)[bm] >= 0).all()
+
+    oracle = SequentialScheduler(sim.cluster).run_cycle(actions=full)
+    jr = np.asarray(dec.job_ready)
+    job_ready_k = {j.uid: bool(jr[j.ordinal]) for j in snap.index.jobs}
+    mismatch = sum(
+        1 for u, v in job_ready_k.items() if v != oracle.job_ready.get(u, False)
+    )
+    n_ready_k = sum(job_ready_k.values())
+    n_ready_o = sum(oracle.job_ready.values())
+    assert n_ready_k >= n_ready_o - 1, (n_ready_k, n_ready_o)
+    assert mismatch <= 10, f"{mismatch} gang-readiness mismatches vs oracle"
+    n_binds = int(bm.sum())
+    assert abs(n_binds - len(oracle.binds)) <= max(40, len(oracle.binds) // 5), (
+        n_binds, len(oracle.binds)
+    )
