@@ -614,7 +614,6 @@ def test_full_actions_mid_panel_scale_vs_oracle():
     import jax
 
     from kube_arbitrator_tpu.cache import build_snapshot, generate_cluster
-    from kube_arbitrator_tpu.framework.conf import SchedulerConfig
     from kube_arbitrator_tpu.ops import schedule_cycle
     from kube_arbitrator_tpu.ops.cycle import open_session
     from kube_arbitrator_tpu.ops.preempt import RUNNING, _entry_qualify
@@ -643,8 +642,11 @@ def test_full_actions_mid_panel_scale_vs_oracle():
     # measured: 1374-1624 qualifying at entry across seeds 0-3 vs the
     # 1088/2176 tier bounds).
     from kube_arbitrator_tpu.ops.cycle import ACTION_KERNELS
+    from kube_arbitrator_tpu.ops.ordering import DEFAULT_TIERS
 
-    tiers = SchedulerConfig.default().tiers
+    # same tiers object the schedule_cycle default uses, so the gate is
+    # computed under exactly the plugin semantics of the cycle under test
+    tiers = DEFAULT_TIERS
 
     @jax.jit
     def entry_count(st):
@@ -662,6 +664,10 @@ def test_full_actions_mid_panel_scale_vs_oracle():
 
     count = int(entry_count(st))
     T = st.num_tasks
+    # the tier switch only exists at T//8 >= panel_floor (default 1024,
+    # preempt_action) — below it the action takes the single full-width
+    # path and this test would guard nothing
+    assert T // 8 >= 1024, f"padded T={T} too small for the panel switch"
     assert T // 8 < count <= T // 4, (count, T // 8, T // 4)
 
     dec = schedule_cycle(st, actions=full)

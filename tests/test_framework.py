@@ -184,3 +184,38 @@ def test_decision_device_resolves_cpu_when_accelerator_default(monkeypatch):
     assert dev is not None and dev.platform == "cpu"
     assert plat.decision_device(50_000, evictive=False) is None
     assert plat.decision_device(1_000) is not None  # size rule
+
+
+def test_bench_instances_share_compiled_shapes():
+    """bench._instances must hand back distinct-content variants whose
+    treedef and leaf shapes exactly match the canonical snapshot — a
+    mismatched variant would recompile inside the timed region and a
+    silent fallback to value-copies would reopen the round-4/-5 tunnel
+    memoization hole the distinct-instance methodology exists to close."""
+    import importlib.util
+    import os
+
+    import jax.tree_util as jtu
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    inst = bench._instances(400, 40, 4, 0.3, want=2)
+    assert len(inst) >= 2, "no same-shaped variant instance found"
+    flat0, tree0 = jtu.tree_flatten(inst[0])
+    for variant in inst[1:]:
+        flat, tree = jtu.tree_flatten(variant)
+        assert tree == tree0
+        assert [getattr(a, "shape", None) for a in flat] == [
+            getattr(a, "shape", None) for a in flat0
+        ]
+    # distinct content: at least one leaf differs from the canonical
+    import numpy as np
+
+    assert any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(flat0, jtu.tree_flatten(inst[1])[0])
+    ), "variant instance has identical content"
