@@ -147,6 +147,12 @@ class _ElectorBase:
                 self._last_renew_ok = now
             return self._is_leader
 
+    def _within_renew_deadline(self, now: float) -> bool:
+        """THE freshness window — one definition for renew()'s blip
+        grace, the renew-deadline loss check, and lease_fresh()'s
+        actuation fence, so the boundary can never drift between them."""
+        return now - self._last_renew_ok <= self.renew_deadline_s
+
     def renew(self) -> bool:
         """Renew our lease; False when another holder took it (we were
         expired and usurped) or the renew deadline passed.  A transient
@@ -160,7 +166,7 @@ class _ElectorBase:
                 # not extend leadership past the deadline while a standby
                 # legitimately steals the stale lease (dual-leader hole)
                 now = self.now()
-                if self._is_leader and now - self._last_renew_ok <= self.renew_deadline_s:
+                if self._is_leader and self._within_renew_deadline(now):
                     return True  # storage blip; retry next period
                 self._is_leader = False
                 return False
@@ -169,7 +175,7 @@ class _ElectorBase:
             if cur is None or cur.holder != self.identity:
                 self._is_leader = False
                 return False
-            if now - self._last_renew_ok > self.renew_deadline_s:
+            if not self._within_renew_deadline(now):
                 # we failed to renew in time; treat as lost even if nobody
                 # has usurped yet (client-go renew-deadline semantics)
                 self._is_leader = False
@@ -178,7 +184,7 @@ class _ElectorBase:
             if pushed:
                 self._last_renew_ok = now
                 self._is_leader = True
-            elif now - self._last_renew_ok <= self.renew_deadline_s:
+            elif self._within_renew_deadline(now):
                 return self._is_leader  # write blip/race; retry next period
             else:
                 self._is_leader = False
@@ -200,6 +206,21 @@ class _ElectorBase:
     @property
     def is_leader(self) -> bool:
         return self._is_leader
+
+    def lease_fresh(self) -> bool:
+        """RPC-free staleness check: True while the last successful renew
+        is within ``renew_deadline_s``.  The scheduler gates ACTUATION on
+        this — a decision program that hangs past the deadline (wedged
+        accelerator tunnel) must not apply its stale binds/evicts after a
+        standby has legitimately taken the lease.  Same clock and window
+        as renew()'s blip grace, so a fresh lease can always actuate.
+        A failed check DEMOTES: the caller is about to abandon the cycle,
+        and a supervisor's re-contention loop must see is_leader False so
+        it re-acquires instead of instantly re-raising."""
+        if self._is_leader and self._within_renew_deadline(self.now()):
+            return True
+        self._is_leader = False
+        return False
 
     def acquire_blocking(self, timeout_s: Optional[float] = None) -> bool:
         """RunOrDie's acquisition loop: retry every retry_period until

@@ -88,6 +88,21 @@ class Scheduler:
         if self.trace_recorder is not None:
             self.trace_recorder.record(result.snapshot.tensors)
         t1 = time.perf_counter()
+        # Actuation fence: the decision program can hang past the lease
+        # deadline (observed: wedged accelerator tunnel stalls a cycle for
+        # minutes), during which a standby legitimately takes over — the
+        # run() loop's renew() happens BEFORE the cycle, so without this
+        # gate the unwedged ex-leader would still apply its stale
+        # binds/evicts once.  Discard the cycle instead (the reference has
+        # the same decide/actuate race; its safety net is the apiserver's
+        # optimistic concurrency on the bind subresource — ours is this
+        # RPC-free freshness check plus that same CAS on live backends).
+        if self.elector is not None and not self.elector.lease_fresh():
+            raise LeaderLost(
+                f"lease stale after decision phase; discarding cycle "
+                f"({len(result.binds)} binds, {len(result.evicts)} evicts "
+                f"not actuated) — holder {self.elector.identity}"
+            )
         self.sim.apply_binds(result.binds)
         self.sim.apply_evicts(result.evicts)
         self.job_status.update(result.job_status)  # cache.UpdateJobStatus equivalent

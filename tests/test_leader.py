@@ -129,3 +129,49 @@ def test_timing_ordering_validated(tmp_path):
     with pytest.raises(ValueError, match="retry_period"):
         LeaderElector(path, lease_duration_s=15.0, renew_deadline_s=10.0,
                       retry_period_s=0.0)
+
+
+def test_stale_lease_after_decision_discards_cycle(tmp_path):
+    """A decision phase that outlasts the renew deadline (wedged
+    accelerator tunnel) must NOT actuate its stale binds: the actuation
+    fence in Scheduler._run_once_inner discards the cycle with LeaderLost
+    before apply_binds, so a standby that took the lease mid-decision
+    never co-exists with a stale actuator."""
+    clock = FakeClock()
+    lock = tmp_path / "kb.lock"
+    leader = _elector(lock, "leader", clock)
+    assert leader.try_acquire()
+
+    sim = SimCluster()
+    sim.add_queue("default")
+    sim.add_node("n1", cpu_milli=4000, memory=8 * GB)
+    job = sim.add_job("j1")
+    sim.add_task(job, cpu_milli=500, memory=GB)
+
+    # simulate the decision program hanging past the renew deadline:
+    # advance the fake clock inside the decide path
+    from kube_arbitrator_tpu.framework.decider import LocalDecider
+
+    class WedgedDecider(LocalDecider):
+        def decide(self, st, config):
+            out = super().decide(st, config)
+            clock.t += 1000.0  # decision "took" far past renew_deadline_s
+            return out
+
+    sched = Scheduler(sim, elector=leader, decider=WedgedDecider())
+    with pytest.raises(LeaderLost, match="not actuated"):
+        sched.run(max_cycles=1)
+    assert sim.binder.binds == {}, "stale cycle must not actuate"
+
+    # control: a fresh lease actuates normally
+    clock2 = FakeClock()
+    lock2 = tmp_path / "kb2.lock"
+    leader2 = _elector(lock2, "leader2", clock2)
+    assert leader2.try_acquire()
+    sim2 = SimCluster()
+    sim2.add_queue("default")
+    sim2.add_node("n1", cpu_milli=4000, memory=8 * GB)
+    j2 = sim2.add_job("j1")
+    sim2.add_task(j2, cpu_milli=500, memory=GB)
+    Scheduler(sim2, elector=leader2).run(max_cycles=1)
+    assert len(sim2.binder.binds) == 1
