@@ -120,7 +120,7 @@ def enable_persistent_cache() -> None:
         pass
 
 
-def probe_backend(timeout_s: float) -> bool:
+def probe_backend(timeout_s: float, _cmd=None) -> bool:
     """Probe accelerator init in a SUBPROCESS with a hard timeout.
 
     A wedged TPU tunnel hangs ``jax.devices()`` uninterruptibly (D-state),
@@ -128,13 +128,13 @@ def probe_backend(timeout_s: float) -> bool:
     timeout the whole process GROUP is killed (``killpg`` — the child is a
     session leader via start_new_session, and device init may fork
     helpers that a single-pid kill would leak) and False is returned.
-    """
+    ``_cmd`` overrides the probe command (tests simulate the wedge)."""
     import signal
     import subprocess
     import sys
 
     proc = subprocess.Popen(
-        [sys.executable, "-c", "import jax; jax.devices()"],
+        _cmd or [sys.executable, "-c", "import jax; jax.devices()"],
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
         start_new_session=True,
     )
@@ -146,6 +146,7 @@ def probe_backend(timeout_s: float) -> bool:
             os.killpg(proc.pid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):
             pass
+        proc.wait()  # reap: SIGKILL returns promptly; no zombie per probe
         return False
 
 

@@ -219,3 +219,30 @@ def test_bench_instances_share_compiled_shapes():
         not np.array_equal(np.asarray(a), np.asarray(b))
         for a, b in zip(flat0, jtu.tree_flatten(inst[1])[0])
     ), "variant instance has identical content"
+
+
+def test_backend_probe_kills_wedged_child():
+    """The wedged-tunnel probe (platform.probe_backend) must abandon a
+    child that hangs — the axon tunnel wedges jax.devices()
+    uninterruptibly, and every entry point's CPU fallback depends on this
+    probe returning False instead of hanging with it."""
+    import subprocess
+    import sys
+    import time
+    import uuid
+
+    from kube_arbitrator_tpu.platform import probe_backend
+
+    token = uuid.uuid4().hex  # unique cmdline so parallel runs can't collide
+    hang = f"_ = '{token}'\nimport time\ntime.sleep(60)"
+    t0 = time.monotonic()
+    assert probe_backend(0.5, _cmd=[sys.executable, "-c", hang]) is False
+    assert time.monotonic() - t0 < 10, "probe did not enforce its timeout"
+    # a healthy child passes
+    assert probe_backend(30.0, _cmd=[sys.executable, "-c", "pass"]) is True
+    # the hung child's process group is gone (killpg reached it)
+    try:
+        out = subprocess.run(["pgrep", "-f", token], capture_output=True)
+    except FileNotFoundError:
+        return  # no procps on this host; the timing assert above stands
+    assert out.returncode != 0, "wedged probe child leaked"
