@@ -19,10 +19,9 @@ class LocalDecider:
 
         import jax
 
+        from ..api.types import TaskStatus
         from ..ops.cycle import schedule_cycle
         from ..platform import decision_device
-
-        from ..api.types import TaskStatus
 
         # backend crossover: small snapshots run on the host CPU even when
         # an accelerator is present — its ~70-90 ms fixed per-cycle cost
