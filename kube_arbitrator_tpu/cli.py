@@ -122,9 +122,13 @@ def main(argv=None) -> int:
         return 1
     set_options(opts)
 
-    from .platform import ensure_jax_backend
+    from .platform import enable_persistent_cache, ensure_jax_backend
 
     ensure_jax_backend()
+    # restart = re-list, re-watch, continue (the reference's recovery
+    # stance) — the compiled cycle comes back from the persistent cache
+    # instead of a cold multi-second XLA compile on the first cycle
+    enable_persistent_cache()
 
     if args.sidecar:
         from .rpc.sidecar import main as sidecar_main
