@@ -226,7 +226,16 @@ def test_property_full_actions_vs_oracle(seed):
     aggregate binds/evictions within a 2-task window (the round-2 claim
     rework plus the round-3 sequential-exact reclaim brought the paths to
     near-bind-for-bind agreement; measured deltas are <=1 on these
-    seeds — slack 2 guards butterfly divergence, not semantics gaps)."""
+    seeds — slack 2 guards butterfly divergence, not semantics gaps).
+
+    These four seeds agree TIGHTLY; a wider 50-seed sweep (round 5)
+    measured the honest envelope of the invariant-equivalence doctrine:
+    gang readiness agreed on 49/50 (the one mismatch was
+    kernel-FAVORABLE — a different 9th eviction freed nodes that readied
+    a gang the oracle left pending), and bind deltas reached 6 with the
+    kernel placing more in nearly every divergent case.  This test pins
+    the tight seeds as a regression guard; the scale-level envelope is
+    pinned by test_e2e_parity.py::test_full_actions_mid_panel_scale_vs_oracle."""
     from kube_arbitrator_tpu.cache import generate_cluster
     from kube_arbitrator_tpu.oracle import SequentialScheduler
 
