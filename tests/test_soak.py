@@ -9,6 +9,12 @@ scheduler cycles, asserting at the end that the in-memory model and the
 apiserver agree exactly (no snapshot drift) and that node accounting
 closes.
 
+Scale headroom (round-5 one-off, not in the suite): the same harness at
+4x — 160 nodes, a 4k-pod live set, 12 jobs churned per cycle for 60
+cycles (~22k pods through the plane) — passed every assertion in 163 s
+with 1.7 GB RSS, no recompiles and no drift; the suite keeps the 1x
+configuration for wall-clock budget.
+
 Wall-clock note: churn replaces jobs with SAME-SIZE jobs and the
 snapshot's sticky geometric shape buckets (snapshot._bucket) absorb the
 remaining count drift, so steady-state cycles run ~0.4 s with no
