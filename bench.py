@@ -279,7 +279,15 @@ def _measure_main() -> None:
 
     enable_persistent_cache()
 
+    from functools import partial
+
     from kube_arbitrator_tpu.ops import schedule_cycle
+    from kube_arbitrator_tpu.platform import resolve_native_ops
+
+    # host-CPU programs use the C++ FFI kernels (ops/native) exactly as
+    # the production decider does; accelerator programs cannot
+    if resolve_native_ops():
+        schedule_cycle = partial(schedule_cycle, native_ops=True)
 
     num_tasks = int(os.environ.get("BENCH_TASKS", 100_000))
     num_nodes = int(os.environ.get("BENCH_NODES", 10_000))
@@ -342,8 +350,12 @@ def _measure_main() -> None:
                 evictive = bool(set(actions) & {"reclaim", "preempt"}) and frac > 0
                 dev = decision_device(T, evictive=evictive)
                 if dev is not None:
+                    cpu_cycle = (
+                        partial(schedule_cycle, native_ops=True)
+                        if resolve_native_ops(dev) else schedule_cycle
+                    )
                     with jax.default_device(dev):
-                        p_s, p_rep, p_dec = _time_cycle(schedule_cycle, inst, actions)
+                        p_s, p_rep, p_dec = _time_cycle(cpu_cycle, inst, actions)
                     p_placed = int(np.asarray(p_dec.bind_mask).sum())
                     prow = {
                         "metric": metric + "/policy",

@@ -36,21 +36,39 @@ _lib = None
 _build_error: Optional[str] = None
 
 
-def _build() -> Optional[str]:
+def build_native_so(src: str, so: str, extra_flags=(), timeout_s: float = 120.0) -> Optional[str]:
+    """Shared mtime-cached g++ build for the repo's native kernels
+    (hostcache, seqbaseline, ops/native/segsum): compile to a temp file
+    and ``os.replace`` into place, so concurrent builders (decider +
+    sidecar, pytest workers) can never dlopen a torn .so or leave a
+    corrupt artifact whose fresh mtime passes the staleness check.
+    Returns None on success, else the reason the kernel is unavailable."""
+    tmp = f"{so}.tmp.{os.getpid()}"
     try:
-        src_m = os.path.getmtime(_SRC)
-        if not os.path.exists(_SO) or os.path.getmtime(_SO) < src_m:
-            subprocess.run(
-                ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", _SO, _SRC],
-                check=True,
-                capture_output=True,
-                text=True,
-            )
+        if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
+            return None
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+             *extra_flags, "-o", tmp, src],
+            check=True, capture_output=True, text=True, timeout=timeout_s,
+        )
+        os.replace(tmp, so)
         return None
     except FileNotFoundError:
         return "g++ not found"
+    except subprocess.TimeoutExpired:
+        return "native build timed out"
     except subprocess.CalledProcessError as e:
-        return f"hostcache build failed:\n{e.stderr}"
+        return f"native build failed:\n{e.stderr[:400]}"
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def _build() -> Optional[str]:
+    return build_native_so(_SRC, _SO)
 
 
 def _load():

@@ -77,7 +77,9 @@ def replay_trace(path: str, conf=None) -> List[dict]:
 
     from ..framework.conf import SchedulerConfig, load_conf
     from ..ops.cycle import schedule_cycle
+    from ..platform import resolve_native_ops
 
+    _native_ops = resolve_native_ops()
     out = []
     conf_cache: dict = {}  # every record carries the same yaml; parse once
     for cycle, conf_yaml, st in load_trace(path):
@@ -89,7 +91,10 @@ def replay_trace(path: str, conf=None) -> List[dict]:
             cfg = load_conf(conf_yaml) if conf_yaml.strip() else SchedulerConfig.default()
             conf_cache[conf_yaml] = cfg
         t0 = time.perf_counter()
-        dec = schedule_cycle(st, tiers=cfg.tiers, actions=cfg.actions)
+        dec = schedule_cycle(
+            st, tiers=cfg.tiers, actions=cfg.actions,
+            native_ops=_native_ops,
+        )
         dec.task_node.block_until_ready()
         out.append(
             {

@@ -129,6 +129,11 @@ def main(argv=None) -> int:
     # stance) — the compiled cycle comes back from the persistent cache
     # instead of a cold multi-second XLA compile on the first cycle
     enable_persistent_cache()
+    # warm the native-kernel build (g++, disk-cached) off the decision
+    # path: the first evictive cycle must not pay a compile inline
+    from .ops.native import available as _warm_native
+
+    _warm_native()
 
     if args.sidecar:
         from .rpc.sidecar import main as sidecar_main

@@ -32,10 +32,18 @@ class LocalDecider:
         evictive = bool(
             set(config.actions) & {"reclaim", "preempt"}
         ) and bool((st.task_status == int(TaskStatus.RUNNING)).any())
+        from ..platform import resolve_native_ops
+
         dev = decision_device(int(st.task_valid.shape[0]), evictive=evictive)
         ctx = jax.default_device(dev) if dev is not None else contextlib.nullcontext()
+        # host-CPU programs swap XLA's weak ops for the C++ FFI kernels
+        # (ops/native); only legal when the program lowers for CPU
+        native_ops = resolve_native_ops(dev)
         t0 = time.perf_counter()
         with ctx:
-            dec = schedule_cycle(st, tiers=config.tiers, actions=config.actions)
+            dec = schedule_cycle(
+                st, tiers=config.tiers, actions=config.actions,
+                native_ops=native_ops,
+            )
             dec.task_node.block_until_ready()  # time the device program honestly
         return dec, (time.perf_counter() - t0) * 1000

@@ -853,6 +853,7 @@ def allocate_action(
     s_max: int = 4096,
     max_rounds: int = 100_000,
     best_effort_pass: bool = False,
+    native_ops: bool = False,  # ACTION_KERNELS uniformity; inert here
 ) -> AllocState:
     """Run rounds until a full round places nothing (queues drained)."""
     defer = _use_deferred_decode(st, tiers)
@@ -908,6 +909,7 @@ def backfill_action(
     tiers: Tiers,
     s_max: int = 4096,
     max_rounds: int = 100_000,
+    native_ops: bool = False,  # ACTION_KERNELS uniformity; inert here
 ) -> AllocState:
     """backfill.go:40-71: place BestEffort (empty-resreq) pending tasks on
     any node passing the non-resource predicates."""

@@ -178,15 +178,25 @@ def open_session(st: SnapshotTensors, tiers: Tiers) -> Tuple[SessionCtx, AllocSt
     return sess, state
 
 
-@partial(jax.jit, static_argnames=("tiers", "actions", "s_max", "max_rounds"))
+@partial(
+    jax.jit,
+    static_argnames=("tiers", "actions", "s_max", "max_rounds", "native_ops"),
+)
 def schedule_cycle(
     st: SnapshotTensors,
     tiers: Tiers = DEFAULT_TIERS,
     actions: Tuple[str, ...] = DEFAULT_ACTIONS,
     s_max: int = 4096,
     max_rounds: int = 100_000,
+    native_ops: bool = False,
 ) -> CycleDecisions:
-    """One full scheduling cycle as a single jitted program."""
+    """One full scheduling cycle as a single jitted program.
+
+    ``native_ops`` (static) swaps hot ops for C++ XLA-FFI kernels that
+    are only legal in programs lowered FOR THE HOST CPU — set it from the
+    device-selection seam (framework/decider.py / bench.py) when the
+    cycle runs on CPU and ops.native.available() is True, never from a
+    trace-time backend guess."""
     sess, state = open_session(st, tiers)
 
     for action in actions:  # static unroll — the conf's ordered action list
@@ -194,7 +204,10 @@ def schedule_cycle(
             kernel = ACTION_KERNELS[action]
         except KeyError:
             raise ValueError(f"unknown action: {action}") from None
-        state = kernel(st, sess, state, tiers, s_max=s_max, max_rounds=max_rounds)
+        state = kernel(
+            st, sess, state, tiers,
+            s_max=s_max, max_rounds=max_rounds, native_ops=native_ops,
+        )
 
     job_ready = state.job_ready_cnt >= sess.min_avail
     # eviction commit: unconditional (-2) or claimant-job-ready (>=0);

@@ -1,0 +1,13 @@
+"""Native (C++ XLA-FFI) kernels for host-CPU decision programs.
+
+The crossover policy routes evictive cycles to the host CPU
+(platform.decision_device), where the reclaim hot loop's per-node victim
+sums are XLA:CPU's weakest op (a serial scatter — see segsum.cc).  This
+package builds and registers the replacement kernel on first use; every
+caller must gate on :func:`available` and keep the pure-jnp form as the
+fallback, so a missing toolchain or a non-CPU lowering never breaks the
+cycle.  The kernel is only legal in programs compiled FOR CPU — callers
+thread the static ``native_ops`` flag from the device-selection seam
+(framework/decider.py, bench.py), never from a trace-time backend guess.
+"""
+from .segsum import available, per_node_sums  # noqa: F401

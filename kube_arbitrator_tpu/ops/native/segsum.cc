@@ -1,0 +1,57 @@
+// Native XLA-FFI kernel: masked per-node sums over the node-sorted canon
+// victim layout (ops/preempt.py::_reclaim_canon).
+//
+//   out[n, 0]   = count of slots in block n (bstart[n] <= slot < bstart[n+1])
+//                 with mask set
+//   out[n, 1+k] = sum of res[slot, k] over those slots
+//
+// This is the one op XLA:CPU lowers poorly on the reclaim hot path: the
+// equivalent scatter-add runs a serial ~8.5 ns/element loop (0.35 ms per
+// queue turn at Vp=25k), and neither two-level chunked prefix sums nor
+// sorted-indices hints improve it (measured round 5).  A plain C loop over
+// the contiguous node blocks does the same reduction in ~0.19 ms; at one
+// dispatched turn per single-task reclaim claim that is ~40% of the whole
+// evictive-cycle budget.  Summation order is slot order (left-to-right
+// within each node block), the same order the XLA scatter applies, so the
+// jnp and native paths produce bit-identical per-node sums.
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+static ffi::Error SegSumMaskedImpl(
+    ffi::Buffer<ffi::PRED> mask,     // [Vp]
+    ffi::Buffer<ffi::F32> res,       // [Vp, R]
+    ffi::Buffer<ffi::S32> bstart,    // [N+1]
+    ffi::ResultBuffer<ffi::F32> out  // [N, R+1]
+) {
+  const int64_t vp = mask.dimensions()[0];
+  const int64_t r = res.dimensions()[1];
+  const int64_t n = out->dimensions()[0];
+  const bool* m = mask.typed_data();
+  const float* s = res.typed_data();
+  const int32_t* b = bstart.typed_data();
+  float* o = out->typed_data();
+  const int64_t c = r + 1;
+  for (int64_t i = 0; i < n * c; ++i) o[i] = 0.0f;
+  for (int64_t node = 0; node < n; ++node) {
+    int64_t lo = b[node], hi = b[node + 1];
+    if (lo < 0) lo = 0;
+    if (hi > vp) hi = vp;
+    float* dst = o + node * c;
+    for (int64_t slot = lo; slot < hi; ++slot) {
+      if (!m[slot]) continue;  // branchy beats branchless at ~50% density
+      dst[0] += 1.0f;
+      const float* src = s + slot * r;
+      for (int64_t k = 0; k < r; ++k) dst[1 + k] += src[k];
+    }
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    SegSumMasked, SegSumMaskedImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::PRED>>()
+        .Arg<ffi::Buffer<ffi::F32>>()
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Ret<ffi::Buffer<ffi::F32>>());

@@ -1,0 +1,77 @@
+"""Build/load/register the masked segment-sum FFI kernel (segsum.cc).
+
+Follows cache/native/binding.py's pattern: g++ on first use, the .so
+cached next to the source and rebuilt when the source is newer.  The FFI
+target registers once per process under platform="cpu"; ``available()``
+is False (with the reason cached) on any failure, and callers fall back
+to the pure-jnp scatter.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "segsum.cc")
+_SO = os.path.join(_HERE, "libsegsum.so")
+
+_state: dict = {"ready": None, "why": None}  # tri-state: None = not tried
+
+
+def _jaxlib_include() -> Optional[str]:
+    try:
+        import jax
+
+        return jax.ffi.include_dir()
+    except Exception:
+        return None
+
+
+def _build() -> Optional[str]:
+    """Return None on success, else the reason the kernel is unavailable."""
+    inc = _jaxlib_include()
+    if inc is None:
+        return "jax.ffi.include_dir unavailable"
+    from ...cache.native.binding import build_native_so
+
+    return build_native_so(_SRC, _SO, extra_flags=("-w", f"-I{inc}"))
+
+
+def available() -> bool:
+    """Build + load + register on first call; cached afterwards."""
+    if _state["ready"] is not None:
+        return _state["ready"]
+    why = _build()
+    if why is None:
+        try:
+            import ctypes
+
+            import jax
+
+            lib = ctypes.cdll.LoadLibrary(_SO)
+            jax.ffi.register_ffi_target(
+                "kat_segsum_masked",
+                jax.ffi.pycapsule(lib.SegSumMasked),
+                platform="cpu",
+            )
+        except Exception as e:  # registration API drift, dlopen failure
+            why = f"load/register failed: {e}"
+    _state["ready"], _state["why"] = why is None, why
+    return _state["ready"]
+
+
+def why_unavailable() -> Optional[str]:
+    return _state["why"]
+
+
+def per_node_sums(mask, res, bstart, num_nodes: int):
+    """f32[N, R+1]: per-node (count, summed res) of masked slots in the
+    node-sorted canon layout.  Caller MUST have checked :func:`available`
+    and be tracing a program that will lower for CPU."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ffi.ffi_call(
+        "kat_segsum_masked",
+        jax.ShapeDtypeStruct((num_nodes, res.shape[1] + 1), jnp.float32),
+    )(mask, res, bstart)

@@ -716,6 +716,7 @@ def preempt_action(
     s_max: int = 4096,
     max_rounds: int = 100_000,
     panel_floor: int = 1024,
+    native_ops: bool = False,  # ACTION_KERNELS uniformity; inert here
 ) -> AllocState:
     """Phase 1 (inter-job within queue) then phase 2 (intra-job priority).
 
@@ -1148,6 +1149,7 @@ def _reclaim_canon(
     state: AllocState,
     tiers: Tiers,
     max_rounds: int,
+    native_ops: bool = False,
 ) -> AllocState:
     """Cross-queue reclaim over the snapshot's CANON victim layout —
     semantics identical to :func:`_reclaim_fast` (same queue-entry
@@ -1254,14 +1256,26 @@ def _reclaim_canon(
             elig = jnp.zeros_like(cand)
         mask_v = elig & (cq != q)
 
-        # ---- per-node victim sums: one fused scatter-add over the
-        # precomputed slot->node map (a [Vp, R+1] global cumsum plus
-        # boundary gathers measured ~4x slower on CPU at Vp=25k) ----
-        stat = jnp.concatenate(
-            [mask_v.astype(jnp.float32)[:, None], jnp.where(mask_v[:, None], cres, 0.0)],
-            axis=1,
-        )
-        per_node = jnp.zeros((N, R + 1)).at[cnode].add(stat, mode="drop")
+        # ---- per-node victim sums: the turn's dominant op.  Native
+        # C++ FFI kernel on host-CPU programs (ops/native/segsum.cc —
+        # XLA:CPU's scatter is a serial ~8.5 ns/element loop, ~2x the
+        # plain C reduction over the contiguous node blocks; two-level
+        # chunked prefix sums and sorted-indices hints both measured
+        # SLOWER, round 5); pure-jnp fused scatter-add over the
+        # precomputed slot->node map otherwise (a [Vp, R+1] global
+        # cumsum plus boundary gathers measured ~4x slower on CPU at
+        # Vp=25k).  Both paths sum in slot order — bit-identical. ----
+        if native_ops:
+            from .native import per_node_sums
+
+            per_node = per_node_sums(mask_v, cres, bstart, N)
+        else:
+            stat = jnp.concatenate(
+                [mask_v.astype(jnp.float32)[:, None],
+                 jnp.where(mask_v[:, None], cres, 0.0)],
+                axis=1,
+            )
+            per_node = jnp.zeros((N, R + 1)).at[cnode].add(stat, mode="drop")
         vic_cnt, vic_res = per_node[:, 0], per_node[:, 1:]
 
         # ---- first-fit node choice ----
@@ -1441,6 +1455,7 @@ def reclaim_action(
     tiers: Tiers,
     s_max: int = 4096,
     max_rounds: int = 100_000,
+    native_ops: bool = False,
 ) -> AllocState:
     """``s_max`` is accepted for ACTION_KERNELS signature uniformity but
     inert here: reclaim claims are single-task by construction
@@ -1448,7 +1463,10 @@ def reclaim_action(
 
     Dispatch: the canon-layout kernel when the snapshot carries the
     reclaim pack and nothing forces live task placements mid-action
-    (pod affinity) — otherwise the sorted-space kernel."""
+    (pod affinity) — otherwise the sorted-space kernel.  ``native_ops``
+    (static, set by the device-selection seam for host-CPU programs)
+    swaps the canon kernel's per-node victim sums for the C++ FFI
+    kernel."""
     del s_max
     preds_on = _plugin_on(tiers, "predicates", "predicate_disabled")
     pack_ok = (
@@ -1458,5 +1476,5 @@ def reclaim_action(
         and st.num_groups * (st.num_tasks + 1) < 2**31
     )
     if pack_ok and not (preds_on and pa_enabled(st)):
-        return _reclaim_canon(st, sess, state, tiers, max_rounds)
+        return _reclaim_canon(st, sess, state, tiers, max_rounds, native_ops)
     return _reclaim_fast(st, sess, state, tiers, max_rounds)

@@ -67,6 +67,23 @@ def decision_device(num_tasks: int, evictive: bool = False):
     return cpus[0] if cpus else None
 
 
+def resolve_native_ops(dev=None) -> bool:
+    """ONE device-selection seam for the static ``native_ops`` flag of
+    ``schedule_cycle``: True iff the program will lower for the host CPU
+    (``dev`` is the CPU device the crossover picked, or the default
+    backend is CPU) and the C++ FFI kernels are buildable
+    (ops.native.available).  Every schedule_cycle entry point — decider,
+    RPC sidecar, trace replay, bench — must route through this, so a new
+    entry point cannot silently keep XLA's slow scatter."""
+    import jax
+
+    if dev is None and jax.default_backend() != "cpu":
+        return False
+    from .ops.native import available
+
+    return available()
+
+
 def cache_fingerprint() -> str:
     """Directory key for the persistent XLA compilation cache: backend +
     device kind + (for CPU) a hash of the host's CPU feature flags.

@@ -58,10 +58,15 @@ class DecisionService:
         from ..cache.snapshot import SnapshotTensors
         from ..ops.cycle import schedule_cycle
 
+        from ..platform import resolve_native_ops
+
         actions, tiers = self._config(request.conf_yaml)
         st = unpack_tensors(SnapshotTensors, request.tensors, to_jax=True)
         t0 = time.perf_counter()
-        dec = schedule_cycle(st, tiers=tiers, actions=actions)
+        dec = schedule_cycle(
+            st, tiers=tiers, actions=actions,
+            native_ops=resolve_native_ops(),
+        )
         dec.task_node.block_until_ready()
         kernel_ms = (time.perf_counter() - t0) * 1000
         self.cycles_served += 1
