@@ -16,7 +16,7 @@ protobuf but not grpc.
 from __future__ import annotations
 
 import struct
-from typing import Iterator, List, Optional
+from typing import Iterator, List
 
 from .snapshot import SnapshotTensors
 
