@@ -10,4 +10,4 @@ cycle.  The kernel is only legal in programs compiled FOR CPU — callers
 thread the static ``native_ops`` flag from the device-selection seam
 (framework/decider.py, bench.py), never from a trace-time backend guess.
 """
-from .segsum import available, per_node_sums  # noqa: F401
+from .segsum import available, cumsum_f32, per_node_sums  # noqa: F401

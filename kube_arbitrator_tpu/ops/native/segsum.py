@@ -54,6 +54,11 @@ def available() -> bool:
                 jax.ffi.pycapsule(lib.SegSumMasked),
                 platform="cpu",
             )
+            jax.ffi.register_ffi_target(
+                "kat_cumsum_f32",
+                jax.ffi.pycapsule(lib.CumsumF32),
+                platform="cpu",
+            )
         except Exception as e:  # registration API drift, dlopen failure
             why = f"load/register failed: {e}"
     _state["ready"], _state["why"] = why is None, why
@@ -75,3 +80,15 @@ def per_node_sums(mask, res, bstart, num_nodes: int):
         "kat_segsum_masked",
         jax.ShapeDtypeStruct((num_nodes, res.shape[1] + 1), jnp.float32),
     )(mask, res, bstart)
+
+
+def cumsum_f32(x):
+    """Inclusive column-wise prefix sum of f32[P, C] in strict
+    left-to-right order (the sequential oracle's accumulation order).
+    Same caller contract as :func:`per_node_sums`."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ffi.ffi_call(
+        "kat_cumsum_f32", jax.ShapeDtypeStruct(x.shape, jnp.float32)
+    )(x)

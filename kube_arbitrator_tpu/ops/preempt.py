@@ -105,18 +105,34 @@ class SortLayout:
         inv = jnp.zeros(T, jnp.int32).at[order].set(pos.astype(jnp.int32))
         return cls(order=order, inv=inv, base_idx=base_idx, res_sorted=resreq[order])
 
-    def rank_and_cum(self, mask: jax.Array):
+    def rank_and_cum(self, mask: jax.Array, native_ops: bool = False):
         """Per-task exclusive in-segment candidate rank and INCLUSIVE
         cumulative resreq among candidates, in task-index space.
         Non-candidates get the rank/cum of the candidates before them.
 
-        The count column rides the same fused mm_cumsum as the resource
-        columns (one matmul instead of two log-depth scans per call); the
-        resreq gather is pre-staged in ``res_sorted`` at build time."""
+        The count column rides one fused prefix sum with the resource
+        columns; the resreq gather is pre-staged in ``res_sorted`` at
+        build time.  ``native_ops`` (host-CPU programs only) swaps the
+        blocked-matmul mm_cumsum (~0.29 ms at P=12.5k, three calls per
+        preempt turn) for the C++ FFI serial scan (~0.03 ms), whose
+        strict left-to-right order is the sequential oracle's
+        accumulation order.  NOTE: unlike the segsum kernel (same slot
+        order both paths), the two prefix-sum paths ASSOCIATE float adds
+        differently, so native/jnp decision equality is an empirical
+        property of the workloads (zero divergence across the pinned
+        parity seeds and a 20-seed full-action sweep), not a structural
+        guarantee — a >=1-ulp running-sum difference on pathological
+        resreqs could legally flip a tie."""
         m_s = mask[self.order]
         m_f = m_s.astype(jnp.float32)
         v_s = jnp.where(m_s[:, None], self.res_sorted, 0.0)
-        both = mm_cumsum(jnp.concatenate([m_f[:, None], v_s], axis=1))
+        cols = jnp.concatenate([m_f[:, None], v_s], axis=1)
+        if native_ops:
+            from .native import cumsum_f32
+
+            both = cumsum_f32(cols)
+        else:
+            both = mm_cumsum(cols)
         cnt, res = both[:, 0], both[:, 1:]
         cnt_base = cnt[self.base_idx] - m_f[self.base_idx]
         res_base = res[self.base_idx] - v_s[self.base_idx]
@@ -216,6 +232,7 @@ def _victim_verdict(
     claimant_job: jax.Array,  # scalar job ordinal
     req: jax.Array,  # f32[R] claimant per-task resreq
     view: VictimView,
+    native_ops: bool = False,
 ) -> jax.Array:
     """Tiered Preemptable victim filter for the preempt phases; reclaim
     verdicts live in ``_reclaim_fast`` (session_plugins.go:59-140: within
@@ -231,7 +248,7 @@ def _victim_verdict(
     vj = view.job
     layouts = view.layouts
 
-    job_rank, job_cum = layouts.by_job.rank_and_cum(candidates)
+    job_rank, job_cum = layouts.by_job.rank_and_cum(candidates, native_ops)
 
     def gang_ok():
         # victim's job must stay gang-viable as victims accumulate:
@@ -247,7 +264,7 @@ def _victim_verdict(
         # so a multi-task turn progresses ls exactly like the sequential
         # evict-one/place-one interleave.
         total = sess.drf_total
-        _, global_cum = layouts.global_.rank_and_cum(candidates)
+        _, global_cum = layouts.global_.rank_and_cum(candidates, native_ops)
         supported = jnp.min(
             jnp.where(req[None, :] > 0, global_cum / jnp.maximum(req[None, :], 1e-30), BIG),
             axis=-1,
@@ -294,6 +311,7 @@ def _claim_turn(
     s_max: int,
     mode: str,  # "preempt" | "preempt_intra"
     view: VictimView,
+    native_ops: bool = False,
 ) -> AllocState:
     """One queue turn of a preempt phase: select claimant job and group,
     select victims, evict the minimal prefix, pipeline claimant tasks onto
@@ -362,12 +380,12 @@ def _claim_turn(
     else:  # preempt_intra: lower-priority tasks of the same job
         scope = p_running & (vj == j) & (view.priority < st.group_priority[g])
     victims = (
-        _victim_verdict(st, state, sess, tiers, scope, j, req, view)
+        _victim_verdict(st, state, sess, tiers, scope, j, req, view, native_ops)
         & has_grp
     )
 
     # ---- per-node victim prefix sums (deterministic order) ----
-    node_rank, node_cum = view.layouts.by_node.rank_and_cum(victims)
+    node_rank, node_cum = view.layouts.by_node.rank_and_cum(victims, native_ops)
     vres = jnp.where(victims[:, None], view.resreq, 0.0)
     c_excl = node_cum - vres  # per-victim exclusive in-node prefix
 
@@ -595,7 +613,7 @@ def _claim_turn(
     )
 
 
-def _rounds(st, sess, state, tiers, s_max, max_rounds, mode, view):
+def _rounds(st, sess, state, tiers, s_max, max_rounds, mode, view, native_ops=False):
     # as in allocate._round: only ACTIVE queues (with an eligible claimant
     # job) get turns — a claimant-less queue's turn is a strict no-op, so
     # 512 namespace-queues with a handful of preemptors pay ~a-handful of
@@ -661,7 +679,9 @@ def _rounds(st, sess, state, tiers, s_max, max_rounds, mode, view):
         perm = jnp.lexsort(tuple(reversed(keys)))
 
         def body(qi, ss):
-            return _claim_turn(perm[qi], st, sess, ss, tiers, s_max, mode, view)
+            return _claim_turn(
+                perm[qi], st, sess, ss, tiers, s_max, mode, view, native_ops
+            )
 
         s = jax.lax.fori_loop(0, trip, body, s)
         return dataclasses.replace(s, rounds=s.rounds + 1)
@@ -716,7 +736,7 @@ def preempt_action(
     s_max: int = 4096,
     max_rounds: int = 100_000,
     panel_floor: int = 1024,
-    native_ops: bool = False,  # ACTION_KERNELS uniformity; inert here
+    native_ops: bool = False,
 ) -> AllocState:
     """Phase 1 (inter-job within queue) then phase 2 (intra-job priority).
 
@@ -740,8 +760,12 @@ def preempt_action(
     )
 
     def run_phases(view, state):
-        s = _rounds(st, sess, state, tiers, s_max, max_rounds, "preempt", view)
-        return _rounds(st, sess, s, tiers, s_max, max_rounds, "preempt_intra", view)
+        s = _rounds(
+            st, sess, state, tiers, s_max, max_rounds, "preempt", view, native_ops
+        )
+        return _rounds(
+            st, sess, s, tiers, s_max, max_rounds, "preempt_intra", view, native_ops
+        )
 
     P = T // 8
     if P < panel_floor:
@@ -816,6 +840,7 @@ def _reclaim_fast(
     state: AllocState,
     tiers: Tiers,
     max_rounds: int,
+    native_ops: bool = False,
 ) -> AllocState:
     """Cross-queue reclaim: sequential single-task claims whose per-turn
     cost is collapsed to O(1) prefix-sum CORRECTIONS over layouts fixed at
@@ -903,7 +928,7 @@ def _reclaim_fast(
     # Fixed gang rank base + task -> segment-base (sorted position) map.
     if use_gang:
         L_nj = SortLayout.build((vj, node_key), st.task_priority, st.task_uid_rank, rr)
-        rank0_nj, _ = L_nj.rank_and_cum(cand0)
+        rank0_nj, _ = L_nj.rank_and_cum(cand0, native_ops)
         tbase_nj = L_nj.base_idx[L_nj.inv]
     if use_prop:
         L_nq = SortLayout.build(
@@ -1477,4 +1502,4 @@ def reclaim_action(
     )
     if pack_ok and not (preds_on and pa_enabled(st)):
         return _reclaim_canon(st, sess, state, tiers, max_rounds, native_ops)
-    return _reclaim_fast(st, sess, state, tiers, max_rounds)
+    return _reclaim_fast(st, sess, state, tiers, max_rounds, native_ops)
