@@ -285,8 +285,13 @@ def _measure_main() -> None:
     from kube_arbitrator_tpu.platform import resolve_native_ops
 
     # host-CPU programs use the C++ FFI kernels (ops/native) exactly as
-    # the production decider does; accelerator programs cannot
-    if resolve_native_ops():
+    # the production decider does; accelerator programs cannot.  The
+    # resolved flag is recorded on every emitted row: the native serial
+    # scan and XLA's mm_cumsum reassociate float adds differently, so a
+    # replay that doesn't know which rank path produced a row can
+    # legally diverge from it (ADVICE.md determinism item).
+    use_native = resolve_native_ops()
+    if use_native:
         schedule_cycle = partial(schedule_cycle, native_ops=True)
 
     num_tasks = int(os.environ.get("BENCH_TASKS", 100_000))
@@ -299,6 +304,7 @@ def _measure_main() -> None:
     # carries it with an empty ladder; the parent's timeout path merges
     # every ladder row that completes afterwards. ---
     primary = _measure_primary(schedule_cycle, num_tasks, num_nodes, oracle_cap_s)
+    primary["native_ops"] = use_native
     _spill({"primary": primary, "final": False})
 
     # --- the BASELINE ladder (stderr rows + collected for the primary) ---
@@ -336,6 +342,7 @@ def _measure_main() -> None:
                     "distinct_instances": len(inst) - 1,
                     "binds": placed,
                     "evicts": evicted,
+                    "native_ops": use_native,
                     "cadence_contract_s": 1.0,
                 }
                 ladder_rows.append(row)
@@ -350,9 +357,10 @@ def _measure_main() -> None:
                 evictive = bool(set(actions) & {"reclaim", "preempt"}) and frac > 0
                 dev = decision_device(T, evictive=evictive)
                 if dev is not None:
+                    policy_native = resolve_native_ops(dev)
                     cpu_cycle = (
                         partial(schedule_cycle, native_ops=True)
-                        if resolve_native_ops(dev) else schedule_cycle
+                        if policy_native else schedule_cycle
                     )
                     with jax.default_device(dev):
                         p_s, p_rep, p_dec = _time_cycle(cpu_cycle, inst, actions)
@@ -366,6 +374,7 @@ def _measure_main() -> None:
                         "distinct_instances": len(inst) - 1,
                         "binds": p_placed,
                         "evicts": int(np.asarray(p_dec.evict_mask).sum()),
+                        "native_ops": policy_native,
                         "backend": str(dev),
                         "note": "backend the crossover policy selects in production",
                         "cadence_contract_s": 1.0,

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# CI / pre-merge gate: static analysis FIRST, then the test suite.
+#
+# The analyzer is the cheap front door — a syntax regression (KAT-SYN)
+# otherwise surfaces as a wall of pytest collection errors, and the
+# JAX-specific families (tracer hygiene, purity, retrace, config drift)
+# catch silent-performance bugs no test asserts on.  Keep this the shape
+# of the tier-1 command: lint gate, then pytest.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m kube_arbitrator_tpu.analysis kube_arbitrator_tpu tests
+
+exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' "$@"

@@ -1,0 +1,30 @@
+"""First-party static analysis for the JAX scheduling kernels.
+
+``python -m kube_arbitrator_tpu.analysis [paths]`` runs an AST pass over
+the package (and ``tests/``) and reports per-rule findings — rule id,
+``file:line``, severity, and a fix hint — exiting non-zero on violations,
+so it works as the pre-test gate in CI.
+
+Rule families (each rule module documents its sub-ids):
+
+- ``KAT-SYN`` — syntax/import gate: every module must parse under THIS
+  interpreter (catches Python-3.10 f-string regressions before pytest
+  turns them into 13 opaque collection errors).
+- ``KAT-TRC`` — tracer hygiene: Python control flow over traced jnp
+  expressions, ``bool()/int()/float()/.item()`` concretization, and raw
+  ``np.`` calls on traced operands inside jit kernels.
+- ``KAT-PUR`` — purity: in-place mutation of snapshot arguments,
+  discarded ``.at[...]`` functional updates, and appends to captured
+  state inside kernel bodies (the static counterpart to the runtime
+  ``utils/mutation_detector.py``).
+- ``KAT-RTR`` — retrace hazards: per-call ``jax.jit`` wrappers,
+  non-literal ``static_argnums``/``static_argnames``, and Python scalars
+  closed over by nested jit functions.
+- ``KAT-DRF`` — config drift: ``resolve_native_ops``/``native_ops``
+  usage that bypasses the ``platform.decision_device`` crossover routing
+  (the sidecar bug class from ADVICE.md).
+"""
+from .core import Finding, Project, analyze_paths, load_project
+from .rules import ALL_RULES
+
+__all__ = ["Finding", "Project", "analyze_paths", "load_project", "ALL_RULES"]

@@ -1,0 +1,6 @@
+"""``python -m kube_arbitrator_tpu.analysis`` entry point."""
+import sys
+
+from .cli import main
+
+sys.exit(main())

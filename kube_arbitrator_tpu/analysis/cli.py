@@ -1,0 +1,80 @@
+"""CLI: ``python -m kube_arbitrator_tpu.analysis [paths...]``.
+
+Exit status: 0 clean, 1 findings, 2 usage error.  With no paths it
+analyzes the installed package plus an adjacent ``tests/`` directory
+when one exists — the tier-1 pre-test gate shape
+(``python -m kube_arbitrator_tpu.analysis kube_arbitrator_tpu tests``).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from .core import analyze_paths
+from .report import render_json, render_text
+from .rules import ALL_RULES, RULES_BY_FAMILY
+
+
+def _default_paths() -> List[str]:
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = [pkg]
+    tests = os.path.join(os.path.dirname(pkg), "tests")
+    if os.path.isdir(tests):
+        paths.append(tests)
+    return paths
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m kube_arbitrator_tpu.analysis",
+        description="first-party static analysis for the JAX scheduling kernels",
+    )
+    ap.add_argument(
+        "paths", nargs="*",
+        help="files or directories (default: the package + adjacent tests/)",
+    )
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    ap.add_argument(
+        "--rules",
+        help="comma-separated rule families to run (e.g. KAT-SYN,KAT-TRC); "
+        "default: all",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print rule families and exit"
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            scope = "package+tests" if r.applies_to_tests else "package only"
+            print(f"{r.family}  {r.name}  [{scope}]")
+        return 0
+
+    rules = list(ALL_RULES)
+    if args.rules:
+        wanted = [s.strip() for s in args.rules.split(",") if s.strip()]
+        unknown = [w for w in wanted if w not in RULES_BY_FAMILY]
+        if unknown:
+            print(
+                f"unknown rule families: {', '.join(unknown)} "
+                f"(known: {', '.join(RULES_BY_FAMILY)})",
+                file=sys.stderr,
+            )
+            return 2
+        rules = [RULES_BY_FAMILY[w] for w in wanted]
+
+    paths = list(args.paths) or _default_paths()
+    try:
+        project, findings = analyze_paths(paths, rules)
+    except FileNotFoundError as e:
+        print(f"no such path: {e}", file=sys.stderr)
+        return 2
+
+    print(render_json(project, findings) if args.json else render_text(project, findings))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,411 @@
+"""Walker + rule framework for the first-party static analyzer.
+
+A *project* is the set of parsed modules under the requested paths.  The
+walker runs two phases: (1) parse every ``.py`` file (parse failures are
+themselves findings — the KAT-SYN gate — and such modules are invisible
+to the semantic rules); (2) hand each module to every rule together with
+project-wide context (the registered-kernel name set collected from
+``ACTION_KERNELS`` literals).
+
+Kernel-context detection is shared here because three rule families
+(tracer hygiene, purity, retrace) scope to it: a function is a *kernel*
+if it is decorated with a jit variant (``@jax.jit``, ``@jit``,
+``@partial(jax.jit, ...)``), if its name is registered in an
+``ACTION_KERNELS`` dict literal anywhere in the project, or if it is
+reachable from such a function through same-module calls (the staged
+helpers a kernel unrolls into its trace).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+SEVERITIES = ("error", "warning")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str  # e.g. "KAT-TRC-001"
+    severity: str  # "error" | "warning"
+    path: str  # path as reported (relative when possible)
+    line: int
+    message: str
+    hint: str = ""
+
+    def format(self) -> str:
+        loc = f"{self.path}:{self.line}"
+        out = f"{self.rule} {self.severity} {loc} — {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+
+@dataclasses.dataclass
+class ModuleUnit:
+    """One parsed source file."""
+
+    path: str  # absolute
+    rel: str  # pretty path used in findings
+    text: str
+    tree: Optional[ast.Module]  # None when the syntax gate failed
+    syntax_error: Optional[SyntaxError]
+    is_test: bool
+
+    # per-module import aliases, filled by load_project
+    jnp_aliases: Set[str] = dataclasses.field(default_factory=set)
+    np_aliases: Set[str] = dataclasses.field(default_factory=set)
+
+    def basename(self) -> str:
+        return os.path.basename(self.path)
+
+
+@dataclasses.dataclass
+class Project:
+    units: List[ModuleUnit]
+    kernel_names: Set[str]  # function names registered in ACTION_KERNELS
+
+
+class Rule:
+    """One rule family.  ``check`` yields findings for a single module;
+    ``family`` is the id prefix (sub-ids live in the findings)."""
+
+    family: str = "KAT-XXX"
+    name: str = ""
+    # retrace/drift hazards are production-code contracts; tests wrap
+    # ad-hoc jits and pin native_ops literals deliberately
+    applies_to_tests: bool = True
+
+    def check(self, unit: ModuleUnit, project: Project) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# file collection + parsing
+
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+
+def _collect_files(paths: Sequence[str]) -> List[str]:
+    files: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            files.append(os.path.abspath(p))
+        elif os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs if d not in _SKIP_DIRS and not d.startswith(".")
+                )
+                for n in sorted(names):
+                    if n.endswith(".py"):
+                        files.append(os.path.abspath(os.path.join(root, n)))
+        else:
+            raise FileNotFoundError(p)
+    # stable order, no duplicates when paths overlap
+    return sorted(dict.fromkeys(files))
+
+
+def _is_test_file(path: str) -> bool:
+    base = os.path.basename(path)
+    parts = path.replace(os.sep, "/").split("/")
+    return (
+        "tests" in parts
+        or base.startswith("test_")
+        or base == "conftest.py"
+    )
+
+
+def _rel(path: str) -> str:
+    try:
+        r = os.path.relpath(path)
+    except ValueError:  # different drive (windows)
+        return path
+    return path if r.startswith("..") else r
+
+
+def _module_aliases(tree: ast.Module) -> Tuple[Set[str], Set[str]]:
+    """(jnp aliases, np aliases) bound by this module's imports."""
+    jnp, np = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                name = a.asname or a.name.split(".")[0]
+                if a.name == "jax.numpy":
+                    # bare `import jax.numpy` binds `jax`; only the aliased
+                    # form adds a NEW jnp name — the dotted `jax.numpy.<fn>`
+                    # spelling is matched directly in jnp_evidence, and
+                    # adding `jax` here would make every `jax.*` call
+                    # (device_count, lax, ...) count as traced evidence
+                    if a.asname:
+                        jnp.add(a.asname)
+                elif a.name == "numpy":
+                    np.add(name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "numpy":
+                        jnp.add(a.asname or "numpy")
+            elif node.module == "jax.numpy":
+                # from jax.numpy import X — treat bare names as jnp calls
+                for a in node.names:
+                    jnp.add(a.asname or a.name)
+    # the repo-wide conventions always count, aliased or not
+    jnp.add("jnp")
+    np.add("np")
+    return jnp, np
+
+
+def load_project(paths: Sequence[str]) -> Project:
+    units: List[ModuleUnit] = []
+    for f in _collect_files(paths):
+        try:
+            with open(f, encoding="utf-8", errors="replace") as fh:
+                text = fh.read()
+        except OSError as e:
+            err = SyntaxError(f"unreadable: {e}")
+            err.lineno = 1
+            units.append(ModuleUnit(f, _rel(f), "", None, err, _is_test_file(f)))
+            continue
+        tree = syntax_error = None
+        try:
+            tree = ast.parse(text, filename=f)
+        except SyntaxError as e:
+            syntax_error = e
+        unit = ModuleUnit(f, _rel(f), text, tree, syntax_error, _is_test_file(f))
+        if tree is not None:
+            unit.jnp_aliases, unit.np_aliases = _module_aliases(tree)
+        units.append(unit)
+    return Project(units=units, kernel_names=_registered_kernel_names(units))
+
+
+def _registered_kernel_names(units: Sequence[ModuleUnit]) -> Set[str]:
+    """Function names appearing as values of an ``ACTION_KERNELS = {...}``
+    dict literal (ops/cycle.py) or an ``ACTION_KERNELS[...] = fn`` store
+    (framework/registry.py) anywhere in the project."""
+    names: Set[str] = set()
+    for u in units:
+        if u.tree is None:
+            continue
+        for node in ast.walk(u.tree):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if (
+                        isinstance(tgt, ast.Name)
+                        and tgt.id == "ACTION_KERNELS"
+                        and isinstance(node.value, ast.Dict)
+                    ):
+                        for v in node.value.values:
+                            if isinstance(v, ast.Name):
+                                names.add(v.id)
+                    elif (
+                        isinstance(tgt, ast.Subscript)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "ACTION_KERNELS"
+                        and isinstance(node.value, ast.Name)
+                    ):
+                        names.add(node.value.id)
+    return names
+
+
+def analyze_paths(paths: Sequence[str], rules: Sequence[Rule]) -> Tuple[Project, List[Finding]]:
+    project = load_project(paths)
+    findings: List[Finding] = []
+    for unit in project.units:
+        for rule in rules:
+            if unit.is_test and not rule.applies_to_tests:
+                continue
+            findings.extend(rule.check(unit, project))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return project, findings
+
+
+# ---------------------------------------------------------------------------
+# jit / kernel-context detection helpers (shared by TRC, PUR, RTR)
+
+FunctionNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'jax.numpy.sum' for nested Attribute/Name chains, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def is_jit_expr(node: ast.AST) -> bool:
+    """True for expressions that *are* the jit transform: ``jax.jit``,
+    bare ``jit``, ``partial(jax.jit, ...)``, ``functools.partial(jax.jit,
+    ...)``, and ``jax.jit(...)`` calls."""
+    dn = dotted_name(node)
+    if dn in ("jax.jit", "jit"):
+        return True
+    if isinstance(node, ast.Call):
+        fn = dotted_name(node.func)
+        if fn in ("jax.jit", "jit"):
+            return True
+        if fn in ("partial", "functools.partial") and node.args:
+            return is_jit_expr(node.args[0])
+    return False
+
+
+def jit_decorated(fn: ast.AST) -> bool:
+    return isinstance(fn, FunctionNode) and any(
+        is_jit_expr(d) for d in fn.decorator_list
+    )
+
+
+def _called_names(fn: ast.AST) -> Tuple[Set[str], Set[str]]:
+    """(plain function names, attribute method names) called inside fn."""
+    plain: Set[str] = set()
+    methods: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Name):
+                plain.add(node.func.id)
+            elif isinstance(node.func, ast.Attribute):
+                methods.add(node.func.attr)
+    return plain, methods
+
+
+def kernel_functions(unit: ModuleUnit, project: Project) -> List[ast.AST]:
+    """All function/method defs in this module that execute under a jit
+    trace: jit-decorated, ACTION_KERNELS-registered, or reachable from
+    either through same-module calls (fixpoint)."""
+    if unit.tree is None:
+        return []
+    mod_funcs: Dict[str, List[ast.AST]] = {}
+    method_funcs: Dict[str, List[ast.AST]] = {}
+    all_funcs: List[ast.AST] = []
+    for node in ast.walk(unit.tree):
+        if isinstance(node, FunctionNode):
+            all_funcs.append(node)
+            mod_funcs.setdefault(node.name, []).append(node)
+    for node in ast.walk(unit.tree):
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, FunctionNode):
+                    method_funcs.setdefault(item.name, []).append(item)
+
+    kernels: Set[ast.AST] = set()
+    for fn in all_funcs:
+        if jit_decorated(fn) or fn.name in project.kernel_names:
+            kernels.add(fn)
+    # same-module call closure: helpers a kernel inlines into its trace
+    changed = True
+    while changed:
+        changed = False
+        for fn in list(kernels):
+            plain, methods = _called_names(fn)
+            for name in plain:
+                for cand in mod_funcs.get(name, ()):
+                    if cand not in kernels:
+                        kernels.add(cand)
+                        changed = True
+            for name in methods:
+                for cand in method_funcs.get(name, ()):
+                    if cand not in kernels:
+                        kernels.add(cand)
+                        changed = True
+    return [f for f in all_funcs if f in kernels]
+
+
+# jnp calls that inspect static metadata (dtypes, shapes) — legal in
+# Python control flow because they never touch traced *values*
+STATIC_SAFE_JNP = {
+    "issubdtype", "result_type", "promote_types", "iinfo", "finfo",
+    "dtype", "ndim", "shape", "broadcast_shapes", "size",
+}
+
+
+def jnp_evidence(node: ast.AST, unit: ModuleUnit) -> Optional[ast.AST]:
+    """First sub-expression that syntactically produces a traced array:
+    a call to ``jnp.<fn>`` (module alias aware) with ``<fn>`` outside the
+    static-metadata whitelist.  Purely syntactic: absence of evidence
+    proves nothing, but presence is a near-certain tracer leak in kernel
+    context."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        fn = sub.func
+        if isinstance(fn, ast.Attribute):
+            root = fn.value
+            # jnp.sum(...) / jax.numpy.sum(...) / jnp.linalg.norm(...)
+            base = dotted_name(root)
+            base_root = base.split(".")[0] if base else ""
+            if (
+                (base_root in unit.jnp_aliases or base in ("jax.numpy",))
+                and fn.attr not in STATIC_SAFE_JNP
+            ):
+                return sub
+    return None
+
+
+def local_bindings(fn: ast.AST) -> Set[str]:
+    """Names bound inside fn: params, assignments, loop/with/except
+    targets, comprehension targets, nested defs — everything that makes a
+    Name local rather than captured."""
+    names: Set[str] = set()
+    declared_nonlocal: Set[str] = set()
+    args = fn.args
+    for a in (
+        list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+    ):
+        names.add(a.arg)
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+
+    def add_target(t: ast.AST) -> None:
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name):
+                names.add(n.id)
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                add_target(t)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            add_target(node.target)
+        elif isinstance(node, ast.For):
+            add_target(node.target)
+        elif isinstance(node, ast.withitem) and node.optional_vars:
+            add_target(node.optional_vars)
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            names.add(node.name)
+        elif isinstance(node, FunctionNode) and node is not fn:
+            names.add(node.name)
+        elif isinstance(node, ast.ClassDef):
+            names.add(node.name)
+        elif isinstance(node, (ast.comprehension,)):
+            add_target(node.target)
+        elif isinstance(node, ast.NamedExpr):
+            add_target(node.target)
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            # an explicit declaration makes the name global/captured even
+            # when the function also assigns it — subtract, never add
+            declared_nonlocal.update(node.names)
+    return names - declared_nonlocal
+
+
+def param_names(fn: ast.AST) -> Set[str]:
+    args = fn.args
+    out = {a.arg for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)}
+    if args.vararg:
+        out.add(args.vararg.arg)
+    if args.kwarg:
+        out.add(args.kwarg.arg)
+    return out
+
+
+def subscript_root(node: ast.AST) -> Optional[ast.Name]:
+    """The root Name of a subscript/attribute chain: st.task_valid[i] -> st."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        node = node.value
+    return node if isinstance(node, ast.Name) else None

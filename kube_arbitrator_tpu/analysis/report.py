@@ -1,0 +1,43 @@
+"""Finding rendering: human text (default) and ``--json`` machine form."""
+from __future__ import annotations
+
+import json
+from typing import List, Sequence
+
+from .core import Finding, Project
+
+
+def render_text(project: Project, findings: Sequence[Finding]) -> str:
+    lines: List[str] = [f.format() for f in findings]
+    n_err = sum(1 for f in findings if f.severity == "error")
+    n_warn = len(findings) - n_err
+    parsed = sum(1 for u in project.units if u.tree is not None)
+    summary = (
+        f"{len(findings)} finding(s) ({n_err} error(s), {n_warn} warning(s)) "
+        f"across {len(project.units)} file(s) ({parsed} parsed)"
+    )
+    if not findings:
+        summary = f"clean: 0 findings across {len(project.units)} file(s) ({parsed} parsed)"
+    lines.append(summary)
+    return "\n".join(lines)
+
+
+def render_json(project: Project, findings: Sequence[Finding]) -> str:
+    return json.dumps(
+        {
+            "files_scanned": len(project.units),
+            "files_parsed": sum(1 for u in project.units if u.tree is not None),
+            "findings": [
+                {
+                    "rule": f.rule,
+                    "severity": f.severity,
+                    "path": f.path,
+                    "line": f.line,
+                    "message": f.message,
+                    "hint": f.hint,
+                }
+                for f in findings
+            ],
+        },
+        indent=2,
+    )

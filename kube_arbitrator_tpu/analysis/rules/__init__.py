@@ -1,0 +1,18 @@
+"""Rule registry: one instance of every rule family, in report order."""
+from .drift import ConfigDriftRule
+from .purity import PurityRule
+from .retrace import RetraceRule
+from .syntax_gate import SyntaxGateRule
+from .tracer import TracerHygieneRule
+
+ALL_RULES = (
+    SyntaxGateRule(),
+    TracerHygieneRule(),
+    PurityRule(),
+    RetraceRule(),
+    ConfigDriftRule(),
+)
+
+RULES_BY_FAMILY = {r.family: r for r in ALL_RULES}
+
+__all__ = ["ALL_RULES", "RULES_BY_FAMILY"]

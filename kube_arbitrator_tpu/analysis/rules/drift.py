@@ -1,0 +1,100 @@
+"""KAT-DRF — config drift around the decision-device seam (production
+code only; tests pin both rank paths deliberately).
+
+``platform.py`` owns ONE seam for backend selection: the crossover
+policy (``decision_device``) picks the device, and ``resolve_native_ops``
+derives the static ``native_ops`` flag FROM that choice.  The sidecar bug
+class from ADVICE.md is an entry point using one half without the other —
+an accelerator-hosted sidecar that resolves native_ops but never routes
+evictive cycles to the CPU behaves differently from the in-process
+decider on the same snapshot.
+
+- KAT-DRF-001: a module calls ``resolve_native_ops`` but never
+  references ``decision_device`` (or the bundled ``decision_route``
+  helper) — the flag without the routing.
+- KAT-DRF-002: a call passes a literal ``native_ops=True/False`` in a
+  module that never touches the seam (``resolve_native_ops`` or
+  ``decision_route``) — hardcoding the rank path bypasses it entirely
+  (the native serial scan and XLA's mm_cumsum reassociate float adds
+  differently, so the hardcoded path can legally diverge from
+  production decisions).
+
+``platform.py`` (the seam itself) and ``ops/`` kernels (which only
+*plumb* the resolved flag through as a parameter) are exempt from
+DRF-001; passing ``native_ops=<name>`` through is always legal.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Finding, ModuleUnit, Project, Rule, dotted_name
+
+
+class ConfigDriftRule(Rule):
+    family = "KAT-DRF"
+    name = "decision-device config drift"
+    applies_to_tests = False
+
+    def check(self, unit: ModuleUnit, project: Project) -> Iterator[Finding]:
+        if unit.tree is None:
+            return
+        if unit.basename() == "platform.py":
+            return  # the seam's own definitions
+
+        # decision_route bundles device pick + flag resolve; referencing
+        # it is the preferred way to be on-seam
+        routing_names = {"decision_device", "decision_route"}
+        resolve_calls = []
+        route_calls = []
+        references_routing = False
+        native_literal_calls = []
+        for node in ast.walk(unit.tree):
+            if isinstance(node, ast.Call):
+                fn = dotted_name(node.func)
+                if fn.split(".")[-1] == "resolve_native_ops":
+                    resolve_calls.append(node)
+                elif fn.split(".")[-1] == "decision_route":
+                    route_calls.append(node)
+                for kw in node.keywords:
+                    if (
+                        kw.arg == "native_ops"
+                        and isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, bool)
+                    ):
+                        native_literal_calls.append((node, kw))
+            if isinstance(node, ast.Name) and node.id in routing_names:
+                references_routing = True
+            elif isinstance(node, ast.Attribute) and node.attr in routing_names:
+                references_routing = True
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                for a in node.names:
+                    if a.name in routing_names or a.asname in routing_names:
+                        references_routing = True
+
+        if resolve_calls and not references_routing:
+            for call in resolve_calls:
+                yield Finding(
+                    "KAT-DRF-001", "error", unit.rel, call.lineno,
+                    "resolve_native_ops() without the decision_device "
+                    "crossover routing — this entry point resolves the "
+                    "rank-path flag but never routes small/evictive "
+                    "cycles to the host CPU (the sidecar bug class, "
+                    "ADVICE.md)",
+                    hint="use platform.decision_route(T, actions, "
+                    "task_status) -> (ctx, dev, native_ops) and run the "
+                    "cycle under ctx, like framework/decider.py",
+                )
+
+        if native_literal_calls and not resolve_calls and not route_calls:
+            for call, kw in native_literal_calls:
+                yield Finding(
+                    "KAT-DRF-002", "error", unit.rel, call.lineno,
+                    f"literal `native_ops={kw.value.value}` without "
+                    "resolve_native_ops() in this module — the rank path "
+                    "is hardcoded instead of resolved through the "
+                    "platform seam",
+                    hint="route through platform.resolve_native_ops(dev) "
+                    "(or plumb the caller's resolved flag through as a "
+                    "variable) so every entry point picks the same path",
+                )

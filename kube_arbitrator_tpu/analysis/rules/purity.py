@@ -1,0 +1,129 @@
+"""KAT-PUR — purity inside jit kernels (static counterpart to the
+runtime ``utils/mutation_detector.py``).
+
+Scope: kernel-context functions (see ``core.kernel_functions``).
+
+- KAT-PUR-001: subscript store into a kernel *parameter* (or a field of
+  one): ``st.task_valid[i] = x`` / ``arr[i] += 1``.  Snapshot tensors
+  are immutable under trace — numpy-style stores either raise or, on a
+  host-numpy snapshot, silently corrupt the shared cycle input.
+- KAT-PUR-002: augmented assignment to a parameter's attribute
+  (``st.total += v``) — mutating snapshot fields the caller still holds.
+- KAT-PUR-003: ``.append``/``.extend``/``.add`` on a name that is not
+  bound inside the kernel — accumulating into captured host state makes
+  the trace impure (runs once at trace time, not per cycle).  Appends to
+  *local* lists are the repo's normal static-unroll idiom and stay legal.
+- KAT-PUR-004: discarded ``.at[...]`` functional update
+  (``x.at[i].set(v)`` as a bare statement) or a store into ``.at``
+  (``x.at[i] = v``) — the update is thrown away / a TypeError.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import (
+    Finding,
+    ModuleUnit,
+    Project,
+    Rule,
+    kernel_functions,
+    local_bindings,
+    param_names,
+    subscript_root,
+)
+
+_AT_METHODS = {"set", "add", "multiply", "divide", "power", "min", "max", "apply", "get"}
+_MUTATORS = {"append", "extend", "add", "insert", "update"}
+
+
+def _is_at_subscript(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Subscript)
+        and isinstance(node.value, ast.Attribute)
+        and node.value.attr == "at"
+    )
+
+
+class PurityRule(Rule):
+    family = "KAT-PUR"
+    name = "kernel purity"
+    applies_to_tests = True
+
+    def check(self, unit: ModuleUnit, project: Project) -> Iterator[Finding]:
+        if unit.tree is None:
+            return
+        for fn in kernel_functions(unit, project):
+            yield from self._check_kernel(fn, unit)
+
+    def _check_kernel(self, fn: ast.AST, unit: ModuleUnit) -> Iterator[Finding]:
+        kname = getattr(fn, "name", "<lambda>")
+        params = param_names(fn)
+        locals_ = local_bindings(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                for tgt in targets:
+                    if _is_at_subscript(tgt):
+                        yield Finding(
+                            "KAT-PUR-004", "error", unit.rel, node.lineno,
+                            f"assignment into `.at[...]` inside jit kernel "
+                            f"`{kname}` — `.at` is functional, not a store target",
+                            hint="write `x = x.at[i].set(v)` and rebind the result",
+                        )
+                    elif isinstance(tgt, ast.Subscript):
+                        root = subscript_root(tgt)
+                        if root is not None and root.id in params:
+                            yield Finding(
+                                "KAT-PUR-001", "error", unit.rel, node.lineno,
+                                f"in-place subscript store into parameter "
+                                f"`{root.id}` inside jit kernel `{kname}`",
+                                hint="use the functional update `x = "
+                                "x.at[i].set(v)`; traced arrays cannot be "
+                                "mutated and host-numpy snapshots are "
+                                "shared cycle inputs",
+                            )
+                    elif (
+                        isinstance(node, ast.AugAssign)
+                        and isinstance(tgt, ast.Attribute)
+                    ):
+                        root = subscript_root(tgt)
+                        if root is not None and root.id in params:
+                            yield Finding(
+                                "KAT-PUR-002", "error", unit.rel, node.lineno,
+                                f"augmented assignment mutates snapshot field "
+                                f"`{ast.unparse(tgt)}` inside jit kernel `{kname}`",
+                                hint="kernels return new state (dataclasses."
+                                "replace) instead of writing back into the "
+                                "snapshot the caller still holds",
+                            )
+            elif isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+                call = node.value
+                if (
+                    isinstance(call.func, ast.Attribute)
+                    and call.func.attr in _AT_METHODS
+                    and _is_at_subscript(call.func.value)
+                ):
+                    yield Finding(
+                        "KAT-PUR-004", "error", unit.rel, node.lineno,
+                        f"discarded `.at[...].{call.func.attr}(...)` result "
+                        f"inside jit kernel `{kname}` — functional updates "
+                        "return the new array; as a bare statement this is a no-op",
+                        hint="bind the result: `x = x.at[i]."
+                        f"{call.func.attr}(...)`",
+                    )
+                elif (
+                    isinstance(call.func, ast.Attribute)
+                    and call.func.attr in _MUTATORS
+                    and isinstance(call.func.value, ast.Name)
+                    and call.func.value.id not in locals_
+                ):
+                    yield Finding(
+                        "KAT-PUR-003", "error", unit.rel, node.lineno,
+                        f"`.{call.func.attr}()` on captured state "
+                        f"`{call.func.value.id}` inside jit kernel `{kname}` "
+                        "— mutation of closed-over host objects runs at "
+                        "trace time, not per cycle",
+                        hint="accumulate into a local and return it, or "
+                        "move the side effect outside the jit boundary",
+                    )

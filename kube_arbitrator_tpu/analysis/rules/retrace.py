@@ -1,0 +1,153 @@
+"""KAT-RTR — retrace hazards (production code only).
+
+Tests wrap ad-hoc ``jax.jit(...)`` one-shots deliberately, so this
+family skips test files.
+
+- KAT-RTR-001: a jit wrapper constructed inside a function body
+  (``jax.jit(f)`` / ``partial(jax.jit, ...)`` at call time).  Each call
+  builds a fresh wrapper with an empty cache — on a per-cycle path that
+  is a guaranteed retrace per cycle.
+- KAT-RTR-002: ``static_argnums``/``static_argnames`` whose value is not
+  a literal constant.  Statics computed from runtime data are how
+  per-cycle values sneak into the compilation key: every new value is a
+  silent recompile.
+- KAT-RTR-003: a nested jit function reading names bound in the
+  enclosing function.  Closed-over Python scalars are baked into the
+  trace at first call — stale forever after, or a retrace driver if the
+  wrapper is rebuilt (see KAT-RTR-001).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from ..core import (
+    Finding,
+    FunctionNode,
+    ModuleUnit,
+    Project,
+    Rule,
+    is_jit_expr,
+    jit_decorated,
+    local_bindings,
+)
+
+_STATIC_KWARGS = ("static_argnums", "static_argnames")
+
+
+def _is_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return all(_is_literal(e) for e in node.elts)
+    return False
+
+
+def _jit_call_nodes(tree: ast.AST):
+    """Every Call node that constructs a jit transform."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and is_jit_expr(node):
+            yield node
+
+
+def _own_nodes(fn: ast.AST):
+    """Nodes belonging to fn's own body — nested function subtrees are
+    owned by the nested function (so each call is attributed to its
+    innermost enclosing function exactly once), but their decorator
+    expressions run in fn's scope and stay with fn."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, FunctionNode):
+            for d in node.decorator_list:
+                stack.append(d)
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class RetraceRule(Rule):
+    family = "KAT-RTR"
+    name = "retrace hazards"
+    applies_to_tests = False
+
+    def check(self, unit: ModuleUnit, project: Project) -> Iterator[Finding]:
+        if unit.tree is None:
+            return
+        # decorator expressions are module-load-time, not per-call
+        decorator_nodes: Set[ast.AST] = set()
+        for node in ast.walk(unit.tree):
+            if isinstance(node, FunctionNode):
+                for d in node.decorator_list:
+                    decorator_nodes.update(ast.walk(d))
+
+        # KAT-RTR-001: jit wrappers built inside function bodies
+        for fn in ast.walk(unit.tree):
+            if not isinstance(fn, FunctionNode):
+                continue
+            for call in (
+                n
+                for n in _own_nodes(fn)
+                if isinstance(n, ast.Call) and is_jit_expr(n)
+            ):
+                if call in decorator_nodes:
+                    continue
+                yield Finding(
+                    "KAT-RTR-001", "error", unit.rel, call.lineno,
+                    f"jit wrapper constructed inside `{fn.name}` — every "
+                    "call starts with an empty compilation cache",
+                    hint="hoist the jitted function to module scope (or "
+                    "cache the wrapper once); on a per-cycle path this "
+                    "retraces every cycle",
+                )
+
+        # KAT-RTR-002: non-literal statics anywhere a jit is constructed
+        for call in _jit_call_nodes(unit.tree):
+            for kw in call.keywords:
+                if kw.arg in _STATIC_KWARGS and not _is_literal(kw.value):
+                    yield Finding(
+                        "KAT-RTR-002", "error", unit.rel, call.lineno,
+                        f"`{kw.arg}` is not a literal constant "
+                        f"(`{ast.unparse(kw.value)}`) — statics derived "
+                        "from runtime data put per-cycle values into the "
+                        "compilation key",
+                        hint="statics must name conf-stable arguments "
+                        "(tiers/actions/flags) as literals; per-cycle data "
+                        "belongs in traced arguments",
+                    )
+
+        # KAT-RTR-003: nested jit functions closing over enclosing locals
+        for outer in ast.walk(unit.tree):
+            if not isinstance(outer, FunctionNode):
+                continue
+            outer_locals = local_bindings(outer)
+            for inner in ast.walk(outer):
+                if (
+                    inner is outer
+                    or not isinstance(inner, FunctionNode)
+                    or not jit_decorated(inner)
+                ):
+                    continue
+                inner_locals = local_bindings(inner)
+                captured = sorted(
+                    {
+                        n.id
+                        for n in ast.walk(inner)
+                        if isinstance(n, ast.Name)
+                        and isinstance(n.ctx, ast.Load)
+                        and n.id in outer_locals
+                        and n.id not in inner_locals
+                        and n.id != inner.name
+                    }
+                )
+                if captured:
+                    yield Finding(
+                        "KAT-RTR-003", "error", unit.rel, inner.lineno,
+                        f"nested jit function `{inner.name}` closes over "
+                        f"`{', '.join(captured)}` from `{outer.name}` — "
+                        "closed-over Python values are baked into the "
+                        "trace at first call",
+                        hint="pass them as (static) arguments so changes "
+                        "are visible to the cache key instead of silently "
+                        "stale",
+                    )

@@ -1,0 +1,41 @@
+"""KAT-SYN — syntax/import gate.
+
+- KAT-SYN-001: the module does not parse under THIS interpreter.
+
+The seed shipped an f-string with a backslash escape inside the braces
+(``utils/metrics.py``) — legal on 3.12, a SyntaxError on the 3.10 this
+image runs — and the result was 13 opaque pytest collection errors.  A
+parse of every module is the cheapest possible gate against that whole
+regression class, and modules that fail it are invisible to every
+semantic rule, so this family runs first.
+"""
+from __future__ import annotations
+
+from typing import Iterator
+
+from ..core import Finding, ModuleUnit, Project, Rule
+
+
+class SyntaxGateRule(Rule):
+    family = "KAT-SYN"
+    name = "syntax/import gate"
+    applies_to_tests = True
+
+    def check(self, unit: ModuleUnit, project: Project) -> Iterator[Finding]:
+        err = unit.syntax_error
+        if err is None:
+            return
+        yield Finding(
+            rule="KAT-SYN-001",
+            severity="error",
+            path=unit.rel,
+            line=int(err.lineno or 1),
+            message=f"module does not parse: {err.msg}",
+            hint=(
+                "fix the syntax for the interpreter this repo targets "
+                "(>=3.10; e.g. no backslash escapes inside f-string "
+                "braces before 3.12) — until it parses, pytest reports "
+                "this as a collection error and every semantic rule is "
+                "blind to the file"
+            ),
+        )

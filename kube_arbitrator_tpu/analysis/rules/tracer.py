@@ -1,0 +1,120 @@
+"""KAT-TRC — tracer hygiene inside jit kernels.
+
+Scope: kernel-context functions (jit-decorated, ACTION_KERNELS-registered,
+or same-module helpers they call — see ``core.kernel_functions``).
+
+- KAT-TRC-001: Python ``if``/``while``/``for`` whose test/iterable
+  contains a traced jnp expression.  Under trace this either raises
+  (ConcretizationTypeError) or silently forces a host sync per cycle.
+- KAT-TRC-002: ``bool()``/``int()``/``float()`` or ``.item()`` applied
+  to a jnp expression — host concretization in the middle of the kernel.
+- KAT-TRC-003: raw ``np.`` call on a traced jnp operand — the value
+  round-trips through the host and XLA loses the fusion.
+
+Detection is syntactic (the operand must literally contain a
+``jnp.<fn>(...)`` call outside the static-metadata whitelist), so absence
+of findings proves nothing, but each finding is near-certainly real.
+Static branches on Python values (``if native_ops:``, ``for action in
+actions:``) are untouched — that is how these kernels do static unrolls.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import (
+    Finding,
+    ModuleUnit,
+    Project,
+    Rule,
+    dotted_name,
+    jnp_evidence,
+    kernel_functions,
+)
+
+_CONCRETIZERS = {"bool", "int", "float"}
+
+
+class TracerHygieneRule(Rule):
+    family = "KAT-TRC"
+    name = "tracer hygiene"
+    applies_to_tests = True  # a jit fixture in a test leaks tracers too
+
+    def check(self, unit: ModuleUnit, project: Project) -> Iterator[Finding]:
+        if unit.tree is None:
+            return
+        for fn in kernel_functions(unit, project):
+            yield from self._check_kernel(fn, unit)
+
+    def _check_kernel(self, fn: ast.AST, unit: ModuleUnit) -> Iterator[Finding]:
+        kname = getattr(fn, "name", "<lambda>")
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                ev = jnp_evidence(node.test, unit)
+                if ev is not None:
+                    kw = "if" if isinstance(node, ast.If) else "while"
+                    yield Finding(
+                        "KAT-TRC-001", "error", unit.rel, node.lineno,
+                        f"Python `{kw}` over a traced jnp expression "
+                        f"(`{ast.unparse(ev)}`) inside jit kernel `{kname}`",
+                        hint="use jnp.where/lax.cond (select on both "
+                        "branches), or hoist the condition to a static "
+                        "argument if it is per-conf, not per-cycle",
+                    )
+            elif isinstance(node, ast.IfExp):
+                ev = jnp_evidence(node.test, unit)
+                if ev is not None:
+                    yield Finding(
+                        "KAT-TRC-001", "error", unit.rel, node.lineno,
+                        f"conditional expression branches on a traced jnp "
+                        f"value (`{ast.unparse(ev)}`) inside jit kernel `{kname}`",
+                        hint="use jnp.where so both branches stay traced",
+                    )
+            elif isinstance(node, ast.For):
+                ev = jnp_evidence(node.iter, unit)
+                if ev is not None:
+                    yield Finding(
+                        "KAT-TRC-001", "error", unit.rel, node.lineno,
+                        f"Python `for` iterates a traced jnp expression "
+                        f"(`{ast.unparse(ev)}`) inside jit kernel `{kname}`",
+                        hint="vectorize the body, or use lax.fori_loop/"
+                        "lax.scan with a static trip count",
+                    )
+            elif isinstance(node, ast.Call):
+                fname = dotted_name(node.func)
+                if fname in _CONCRETIZERS and node.args:
+                    ev = jnp_evidence(node.args[0], unit)
+                    if ev is not None:
+                        yield Finding(
+                            "KAT-TRC-002", "error", unit.rel, node.lineno,
+                            f"`{fname}()` concretizes a traced value "
+                            f"(`{ast.unparse(ev)}`) inside jit kernel `{kname}`",
+                            hint="keep the value as a jnp array (astype/"
+                            "where); scalarize only outside the jit "
+                            "boundary, after block_until_ready",
+                        )
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item"
+                    and not node.args
+                    and jnp_evidence(node.func.value, unit) is not None
+                ):
+                    yield Finding(
+                        "KAT-TRC-002", "error", unit.rel, node.lineno,
+                        f"`.item()` on a traced value inside jit kernel `{kname}`",
+                        hint="item() forces a device sync per call; return "
+                        "the array and scalarize at the caller",
+                    )
+                elif isinstance(node.func, ast.Attribute):
+                    base = dotted_name(node.func.value)
+                    if base and base.split(".")[0] in unit.np_aliases and any(
+                        jnp_evidence(a, unit) is not None for a in node.args
+                    ):
+                        yield Finding(
+                            "KAT-TRC-003", "error", unit.rel, node.lineno,
+                            f"raw `{base}.{node.func.attr}` call on a traced "
+                            f"jnp operand inside jit kernel `{kname}`",
+                            hint="use the jnp equivalent so the op stays in "
+                            "the XLA program instead of bouncing through "
+                            "host numpy",
+                        )

@@ -10,6 +10,7 @@ import ctypes
 import dataclasses
 import os
 import subprocess
+import tempfile
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -43,10 +44,22 @@ def build_native_so(src: str, so: str, extra_flags=(), timeout_s: float = 120.0)
     sidecar, pytest workers) can never dlopen a torn .so or leave a
     corrupt artifact whose fresh mtime passes the staleness check.
     Returns None on success, else the reason the kernel is unavailable."""
-    tmp = f"{so}.tmp.{os.getpid()}"
+    # The source check runs before anything else so a missing .cpp reports
+    # as exactly that — with the pid-keyed tmp scheme it used to surface as
+    # "g++ not found" because getmtime's FileNotFoundError shared the
+    # g++-missing handler.
+    if not os.path.exists(src):
+        return f"native source missing: {src}"
+    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
+        return None
+    # mkstemp gives every builder (threads included — pid alone races the
+    # CLI native warmup against the first decide) a private temp path; the
+    # os.replace publish stays atomic.
+    fd, tmp = tempfile.mkstemp(
+        prefix=os.path.basename(so) + ".tmp.", dir=os.path.dirname(so) or "."
+    )
+    os.close(fd)
     try:
-        if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
-            return None
         subprocess.run(
             ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
              *extra_flags, "-o", tmp, src],

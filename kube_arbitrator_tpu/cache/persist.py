@@ -15,13 +15,29 @@ protobuf but not grpc.
 """
 from __future__ import annotations
 
+import json
 import struct
-from typing import Iterator, List
+from typing import Iterator, List, Optional
 
 from .snapshot import SnapshotTensors
 
 _MAGIC = b"KATS"  # kube-arbitrator-tpu snapshot trace
 _VERSION = 1
+
+
+def _meta_path(path: str) -> str:
+    return path + ".meta.json"
+
+
+def trace_meta(path: str) -> dict:
+    """Sidecar metadata recorded alongside a trace (``<path>.meta.json``):
+    the resolved ``native_ops`` flag and backend the recording process
+    used.  Traces predating the sidecar return ``{}``."""
+    try:
+        with open(_meta_path(path)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
 
 
 def save_trace(path: str, snapshots: List[SnapshotTensors], conf_yaml: str = "") -> None:
@@ -70,16 +86,25 @@ def load_trace(path: str) -> Iterator[tuple]:
 
 def replay_trace(path: str, conf=None) -> List[dict]:
     """Re-run the decision kernel over every recorded cycle; returns
-    per-cycle stats.  The recorded conf is used unless one is passed."""
+    per-cycle stats.  The recorded conf is used unless one is passed.
+
+    The rank path is pinned to the one that produced the trace: the
+    ``native_ops`` flag from the recording's meta sidecar wins when
+    present — the native serial scan and XLA's mm_cumsum reassociate
+    float adds differently, so replaying with the wrong flag can legally
+    produce different decisions from production (ADVICE.md).  Traces
+    without a sidecar mirror the production decider's routing
+    (platform.decision_device crossover + resolve_native_ops) instead of
+    a bare backend guess."""
     import time
 
     import numpy as np
 
     from ..framework.conf import SchedulerConfig, load_conf
     from ..ops.cycle import schedule_cycle
-    from ..platform import resolve_native_ops
+    from ..platform import decision_route
 
-    _native_ops = resolve_native_ops()
+    recorded = trace_meta(path).get("native_ops")
     out = []
     conf_cache: dict = {}  # every record carries the same yaml; parse once
     for cycle, conf_yaml, st in load_trace(path):
@@ -90,18 +115,30 @@ def replay_trace(path: str, conf=None) -> List[dict]:
         else:
             cfg = load_conf(conf_yaml) if conf_yaml.strip() else SchedulerConfig.default()
             conf_cache[conf_yaml] = cfg
-        t0 = time.perf_counter()
-        dec = schedule_cycle(
-            st, tiers=cfg.tiers, actions=cfg.actions,
-            native_ops=_native_ops,
+        ctx, _dev, native_ops = decision_route(
+            int(st.task_valid.shape[0]), cfg.actions, st.task_status
         )
-        dec.task_node.block_until_ready()
+        if recorded is False:
+            # pin the recorded rank path; a recorded True cannot be
+            # pinned blindly — decision_route's resolve is the only path
+            # that builds and registers the FFI targets, and a host that
+            # can't (no g++ / accelerator lowering) must fall back rather
+            # than crash, with the divergence visible in the row's flag
+            native_ops = False
+        t0 = time.perf_counter()
+        with ctx:
+            dec = schedule_cycle(
+                st, tiers=cfg.tiers, actions=cfg.actions,
+                native_ops=native_ops,
+            )
+            dec.task_node.block_until_ready()
         out.append(
             {
                 "cycle": int(cycle),
                 "kernel_ms": (time.perf_counter() - t0) * 1000,
                 "binds": int(np.asarray(dec.bind_mask).sum()),
                 "evicts": int(np.asarray(dec.evict_mask).sum()),
+                "native_ops": native_ops,
             }
         )
     return out
@@ -115,7 +152,7 @@ class TraceRecorder:
     the main thing worth debugging with a trace — keeps everything up to
     its last completed cycle, and nothing accumulates in memory."""
 
-    def __init__(self, path: str, conf_yaml: str = ""):
+    def __init__(self, path: str, conf_yaml: str = "", native_ops: Optional[bool] = None):
         self.path = path
         self.conf_yaml = conf_yaml
         self._count = 0
@@ -123,6 +160,22 @@ class TraceRecorder:
         self._f = open(self.path, "wb")
         self._f.write(_MAGIC + struct.pack("<I", _VERSION))
         self._f.flush()
+        # meta sidecar: pin the rank path (native_ops) and backend the
+        # recording process resolved, so replay_trace reproduces the
+        # production decisions instead of re-guessing from its own host
+        if native_ops is None:
+            from ..platform import resolve_native_ops
+
+            native_ops = resolve_native_ops()
+        meta = {"native_ops": bool(native_ops)}
+        try:
+            import jax
+
+            meta["backend"] = jax.default_backend()
+        except Exception:
+            pass
+        with open(_meta_path(path), "w") as f:
+            json.dump(meta, f)
 
     def record(self, tensors: SnapshotTensors) -> None:
         from ..rpc.codec import snapshot_request

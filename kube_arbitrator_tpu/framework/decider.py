@@ -15,30 +15,21 @@ class LocalDecider:
     decide() returns (CycleDecisions, device-time ms)."""
 
     def decide(self, st, config) -> Tuple[object, float]:
-        import contextlib
-
-        import jax
-
-        from ..api.types import TaskStatus
         from ..ops.cycle import schedule_cycle
-        from ..platform import decision_device
+        from ..platform import decision_route
 
-        # backend crossover: small snapshots run on the host CPU even when
-        # an accelerator is present — its ~70-90 ms fixed per-cycle cost
-        # dominates below ~30k tasks (platform.DEFAULT_TPU_MIN_TASKS) —
-        # and so do EVICTIVE cycles (reclaim/preempt with running
-        # victims), whose claim-serialized turn loop is dispatch-bound on
-        # an accelerator at every measured size (platform module comment)
-        evictive = bool(
-            set(config.actions) & {"reclaim", "preempt"}
-        ) and bool((st.task_status == int(TaskStatus.RUNNING)).any())
-        from ..platform import resolve_native_ops
-
-        dev = decision_device(int(st.task_valid.shape[0]), evictive=evictive)
-        ctx = jax.default_device(dev) if dev is not None else contextlib.nullcontext()
-        # host-CPU programs swap XLA's weak ops for the C++ FFI kernels
-        # (ops/native); only legal when the program lowers for CPU
-        native_ops = resolve_native_ops(dev)
+        # backend crossover (shared seam, platform.decision_route): small
+        # snapshots run on the host CPU even when an accelerator is
+        # present — its ~70-90 ms fixed per-cycle cost dominates below
+        # ~30k tasks (platform.DEFAULT_TPU_MIN_TASKS) — and so do
+        # EVICTIVE cycles (reclaim/preempt with running victims), whose
+        # claim-serialized turn loop is dispatch-bound on an accelerator
+        # at every measured size (platform module comment); host-CPU
+        # programs additionally swap XLA's weak ops for the C++ FFI
+        # kernels (native_ops, only legal when lowering for CPU).
+        ctx, _dev, native_ops = decision_route(
+            int(st.task_valid.shape[0]), config.actions, st.task_status
+        )
         t0 = time.perf_counter()
         with ctx:
             dec = schedule_cycle(

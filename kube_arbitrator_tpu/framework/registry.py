@@ -24,7 +24,13 @@ _plugin_registry: Dict[str, dict] = {}
 
 def register_action(name: str, fn: ActionFn) -> None:
     """Add a custom staged kernel selectable by name from the YAML conf
-    (the registry backs both schedule_cycle dispatch and conf validation)."""
+    (the registry backs both schedule_cycle dispatch and conf validation).
+
+    Registration is also the static-analysis contract: the analyzer
+    (``kube_arbitrator_tpu.analysis``) treats ``ACTION_KERNELS`` entries
+    — and the same-module helpers they call — as jit-kernel context, so
+    registered actions get the tracer-hygiene and purity lints without
+    needing a ``@jax.jit`` decorator of their own."""
     ACTION_KERNELS[name] = fn
 
 

@@ -35,6 +35,9 @@ from .preempt import preempt_action, reclaim_action
 
 # Name -> staged kernel. The framework registry (framework/registry.py)
 # adds custom actions here; the conf loader validates against these keys.
+# Entries double as the static analyzer's kernel roots: every function
+# named here (plus same-module helpers it calls) is linted under the
+# KAT-TRC/KAT-PUR jit-kernel rules even without a jit decorator.
 ACTION_KERNELS = {
     "allocate": allocate_action,
     "backfill": backfill_action,

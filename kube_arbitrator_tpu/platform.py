@@ -67,6 +67,33 @@ def decision_device(num_tasks: int, evictive: bool = False):
     return cpus[0] if cpus else None
 
 
+def decision_route(num_tasks: int, actions, task_status):
+    """THE shared routing block for every ``schedule_cycle`` entry point
+    (in-process decider, RPC sidecar, trace replay): classify the cycle
+    as evictive, pick the device through the crossover policy, and
+    resolve the static ``native_ops`` flag FROM that choice.
+
+    Returns ``(ctx, dev, native_ops)`` where ``ctx`` is the
+    ``jax.default_device`` context manager to run the cycle under (a
+    nullcontext when the platform default already applies).  Hand-rolling
+    this block per entry point is the drift class ADVICE.md's sidecar bug
+    belonged to — the KAT-DRF lint treats this helper (or the
+    ``decision_device`` + ``resolve_native_ops`` pair) as the seam."""
+    import contextlib
+
+    import jax
+    import numpy as np
+
+    from .api.types import TaskStatus
+
+    evictive = bool(set(actions) & {"reclaim", "preempt"}) and bool(
+        (np.asarray(task_status) == int(TaskStatus.RUNNING)).any()
+    )
+    dev = decision_device(num_tasks, evictive=evictive)
+    ctx = jax.default_device(dev) if dev is not None else contextlib.nullcontext()
+    return ctx, dev, resolve_native_ops(dev)
+
+
 def resolve_native_ops(dev=None) -> bool:
     """ONE device-selection seam for the static ``native_ops`` flag of
     ``schedule_cycle``: True iff the program will lower for the host CPU

@@ -57,17 +57,34 @@ class DecisionService:
     def Decide(self, request: "pb.SnapshotRequest", context) -> "pb.DecideReply":
         from ..cache.snapshot import SnapshotTensors
         from ..ops.cycle import schedule_cycle
-
-        from ..platform import resolve_native_ops
+        from ..platform import decision_route
 
         actions, tiers = self._config(request.conf_yaml)
-        st = unpack_tensors(SnapshotTensors, request.tensors, to_jax=True)
-        t0 = time.perf_counter()
-        dec = schedule_cycle(
-            st, tiers=tiers, actions=actions,
-            native_ops=resolve_native_ops(),
+        # Unpack to HOST numpy: the device the tensors belong on is the
+        # crossover's decision, and it needs task_status first.  Eagerly
+        # converting to jax here (the old to_jax=True) put the whole
+        # snapshot on the accelerator and then pulled it back for every
+        # cycle the policy routes to the CPU — paying the host->chip
+        # transfer the routing exists to avoid.  schedule_cycle moves the
+        # arrays onto the routed device itself.
+        st = unpack_tensors(SnapshotTensors, request.tensors)
+        # Same backend crossover as the in-process LocalDecider
+        # (platform.decision_route): small and EVICTIVE cycles run on the
+        # host CPU even when this sidecar owns an accelerator — without
+        # this an accelerator-hosted sidecar kept evictive cycles on the
+        # chip, the 2-4x-slower path the crossover policy exists to
+        # avoid, and sidecar vs in-process deployments made different
+        # decisions (ADVICE.md sidecar item).
+        ctx, _dev, native_ops = decision_route(
+            int(st.task_valid.shape[0]), actions, st.task_status
         )
-        dec.task_node.block_until_ready()
+        t0 = time.perf_counter()
+        with ctx:
+            dec = schedule_cycle(
+                st, tiers=tiers, actions=actions,
+                native_ops=native_ops,
+            )
+            dec.task_node.block_until_ready()
         kernel_ms = (time.perf_counter() - t0) * 1000
         self.cycles_served += 1
         return decide_reply(dec, cycle=request.cycle, kernel_ms=kernel_ms)

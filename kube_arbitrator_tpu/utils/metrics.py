@@ -127,12 +127,13 @@ class MetricsRegistry:
             cum = 0
             for i, b in enumerate(h.buckets):
                 cum += h.counts[i]
-                out.append(
-                    f"{full}_bucket{self._fmt_labels(labels, f'le=\"{b:g}\"')} {cum}"
-                )
-            out.append(
-                f"{full}_bucket{self._fmt_labels(labels, 'le=\"+Inf\"')} {h.n}"
-            )
+                # the le label is built outside the f-string braces: a
+                # backslash escape inside an f-string expression is a
+                # SyntaxError before Python 3.12
+                le = 'le="{:g}"'.format(b)
+                out.append(f"{full}_bucket{self._fmt_labels(labels, le)} {cum}")
+            le_inf = 'le="+Inf"'
+            out.append(f"{full}_bucket{self._fmt_labels(labels, le_inf)} {h.n}")
             out.append(f"{full}_sum{self._fmt_labels(labels)} {h.total:g}")
             out.append(f"{full}_count{self._fmt_labels(labels)} {h.n}")
         return "\n".join(out) + ("\n" if out else "")

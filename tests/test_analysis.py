@@ -1,0 +1,424 @@
+"""Self-tests for the first-party static analyzer
+(``kube_arbitrator_tpu.analysis``): one violating + one clean fixture per
+rule family, CLI exit-code contract, and the integration gate asserting
+the real tree is clean.
+"""
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from kube_arbitrator_tpu.analysis import ALL_RULES, analyze_paths
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def run_on(tmp_path, name, source, rules=ALL_RULES):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    _, findings = analyze_paths([str(f)], rules)
+    return findings
+
+
+def rule_ids(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# KAT-SYN — syntax gate
+
+
+def test_syn_flags_py312_only_fstring(tmp_path):
+    # the exact seed regression: backslash escape inside the f-string
+    # EXPRESSION part (format specs allow them; expressions do not pre-3.12)
+    src = 'x = "a"\ny = f"{x + \'\\\\n\'}"\n'
+    if sys.version_info >= (3, 12):
+        pytest.skip("3.12+ parses backslashes in f-string expressions")
+    findings = run_on(tmp_path, "bad.py", src)
+    assert rule_ids(findings) == {"KAT-SYN-001"}
+    assert findings[0].line == 2
+    assert findings[0].severity == "error"
+
+
+def test_syn_clean_module_passes(tmp_path):
+    findings = run_on(tmp_path, "ok.py", 'x = 1\ny = f"{x}"\n')
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# KAT-TRC — tracer hygiene
+
+
+def test_trc_flags_control_flow_and_concretization(tmp_path):
+    findings = run_on(
+        tmp_path,
+        "kern.py",
+        """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def kern(x):
+            if jnp.sum(x) > 0:          # TRC-001
+                x = x + 1
+            n = int(jnp.max(x))          # TRC-002
+            y = np.argsort(jnp.abs(x))   # TRC-003
+            return x * n + y
+        """,
+    )
+    assert rule_ids(findings) == {"KAT-TRC-001", "KAT-TRC-002", "KAT-TRC-003"}
+
+
+def test_trc_static_branches_and_metadata_are_clean(tmp_path):
+    # static unrolls and dtype-metadata checks are the repo's idiom
+    findings = run_on(
+        tmp_path,
+        "kern.py",
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kern(x, native_ops=False, actions=("allocate",)):
+            if native_ops:                      # static flag: legal
+                x = x * 2
+            for a in actions:                   # static unroll: legal
+                x = x + len(a)
+            if jnp.issubdtype(x.dtype, jnp.floating):  # metadata: legal
+                x = x.astype(jnp.float32)
+            return jnp.where(x > 0, x, 0)
+        """,
+    )
+    assert findings == []
+
+
+def test_trc_applies_to_action_kernel_registry_and_helpers(tmp_path):
+    # undecorated, but registered in ACTION_KERNELS and calling a
+    # same-module helper: both are kernel context
+    findings = run_on(
+        tmp_path,
+        "ops.py",
+        """
+        import jax.numpy as jnp
+
+        def _helper(x):
+            while jnp.any(x > 0):   # TRC-001, via closure
+                x = x - 1
+            return x
+
+        def my_action(st):
+            return _helper(st)
+
+        ACTION_KERNELS = {"my": my_action}
+        """,
+    )
+    assert rule_ids(findings) == {"KAT-TRC-001"}
+
+
+# ---------------------------------------------------------------------------
+# KAT-PUR — purity
+
+
+def test_pur_flags_mutation_of_snapshot_and_captured_state(tmp_path):
+    findings = run_on(
+        tmp_path,
+        "kern.py",
+        """
+        import jax
+
+        SEEN = []
+
+        @jax.jit
+        def kern(st, x):
+            st.weights[0] = 1.0     # PUR-001
+            st.total += 2.0         # PUR-002
+            SEEN.append(1)          # PUR-003
+            x.at[0].set(5.0)        # PUR-004 (discarded update)
+            return x
+        """,
+    )
+    assert rule_ids(findings) == {
+        "KAT-PUR-001", "KAT-PUR-002", "KAT-PUR-003", "KAT-PUR-004",
+    }
+
+
+def test_pur_local_accumulators_and_bound_at_updates_are_clean(tmp_path):
+    findings = run_on(
+        tmp_path,
+        "kern.py",
+        """
+        import jax
+
+        @jax.jit
+        def kern(x):
+            keys = []
+            keys.append(x)            # local static unroll: legal
+            x = x.at[0].set(5.0)      # bound functional update: legal
+            total = 0.0
+            total += 1.0              # local scalar: legal
+            return x, keys, total
+        """,
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# KAT-RTR — retrace hazards
+
+
+def test_rtr_flags_per_call_jit_and_dynamic_statics(tmp_path):
+    findings = run_on(
+        tmp_path,
+        "mod.py",
+        """
+        import jax
+
+        def percycle(f, x, names):
+            return jax.jit(f, static_argnames=names)(x)   # RTR-001 + RTR-002
+
+        def factory(scale):
+            @jax.jit
+            def inner(x):
+                return x * scale                          # RTR-003
+            return inner
+        """,
+    )
+    assert rule_ids(findings) == {"KAT-RTR-001", "KAT-RTR-002", "KAT-RTR-003"}
+
+
+def test_rtr_module_level_literal_statics_are_clean(tmp_path):
+    findings = run_on(
+        tmp_path,
+        "mod.py",
+        """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("tiers", "native_ops"))
+        def schedule(st, tiers=(), native_ops=False):
+            return st
+        """,
+    )
+    assert findings == []
+
+
+def test_rtr_skips_test_files(tmp_path):
+    # tests wrap ad-hoc one-shot jits deliberately
+    findings = run_on(
+        tmp_path,
+        "test_mod.py",
+        """
+        import jax
+
+        def test_thing():
+            out = jax.jit(lambda s: s + 1)(1.0)
+            assert out == 2.0
+        """,
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# KAT-DRF — config drift
+
+
+def test_drf_flags_resolve_without_decision_device(tmp_path):
+    findings = run_on(
+        tmp_path,
+        "sidecar.py",
+        """
+        from kube_arbitrator_tpu.platform import resolve_native_ops
+
+        def decide(st, schedule_cycle):
+            return schedule_cycle(st, native_ops=resolve_native_ops())
+        """,
+    )
+    assert rule_ids(findings) == {"KAT-DRF-001"}
+
+
+def test_drf_flags_hardcoded_native_ops_literal(tmp_path):
+    findings = run_on(
+        tmp_path,
+        "entry.py",
+        """
+        def decide(st, schedule_cycle):
+            return schedule_cycle(st, native_ops=True)
+        """,
+    )
+    assert rule_ids(findings) == {"KAT-DRF-002"}
+
+
+def test_drf_clean_when_routed_through_the_seam(tmp_path):
+    findings = run_on(
+        tmp_path,
+        "decider.py",
+        """
+        import contextlib
+        import jax
+        from kube_arbitrator_tpu.platform import decision_device, resolve_native_ops
+
+        def decide(st, schedule_cycle, evictive=False):
+            dev = decision_device(int(st.task_valid.shape[0]), evictive=evictive)
+            ctx = jax.default_device(dev) if dev is not None else contextlib.nullcontext()
+            with ctx:
+                return schedule_cycle(st, native_ops=resolve_native_ops(dev))
+        """,
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# integration: the real tree is clean, and the CLI contract holds
+
+
+def test_real_tree_is_clean():
+    _, findings = analyze_paths(
+        [str(REPO / "kube_arbitrator_tpu"), str(REPO / "tests")], ALL_RULES
+    )
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_cli_exit_codes(tmp_path):
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    (clean / "ok.py").write_text("x = 1\n")
+    dirty = tmp_path / "dirty"
+    dirty.mkdir()
+    (dirty / "bad.py").write_text("def f(:\n")
+
+    env_cmd = [sys.executable, "-m", "kube_arbitrator_tpu.analysis"]
+    r0 = subprocess.run(
+        env_cmd + [str(clean)], cwd=REPO, capture_output=True, text=True
+    )
+    assert r0.returncode == 0, r0.stdout + r0.stderr
+    assert "clean" in r0.stdout
+
+    r1 = subprocess.run(
+        env_cmd + [str(dirty)], cwd=REPO, capture_output=True, text=True
+    )
+    assert r1.returncode == 1, r1.stdout + r1.stderr
+    assert "KAT-SYN-001" in r1.stdout
+    assert "bad.py:1" in r1.stdout  # rule id + file:line in the report
+
+    r2 = subprocess.run(
+        env_cmd + ["--rules", "KAT-NOPE", str(clean)],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert r2.returncode == 2
+
+
+def test_cli_json_and_rule_filter(tmp_path):
+    import json
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(:\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "kube_arbitrator_tpu.analysis", "--json", str(bad)],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert r.returncode == 1
+    payload = json.loads(r.stdout)
+    assert payload["findings"][0]["rule"] == "KAT-SYN-001"
+    assert payload["findings"][0]["hint"]
+
+    # family filter: TRC-only run ignores the syntax error? No — a file
+    # that does not parse is invisible to semantic rules, so TRC alone
+    # reports nothing and exits 0.  That asymmetry is why the gate always
+    # runs first in the default set.
+    r_trc = subprocess.run(
+        [
+            sys.executable, "-m", "kube_arbitrator_tpu.analysis",
+            "--rules", "KAT-TRC", str(bad),
+        ],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert r_trc.returncode == 0
+
+
+# ---------------------------------------------------------------------------
+# regressions from review
+
+
+def test_trc_bare_jax_numpy_import_does_not_taint_jax_namespace(tmp_path):
+    # `import jax.numpy` binds `jax`; jax.device_count() etc. must not
+    # count as traced-jnp evidence (only jax.numpy.<fn> does)
+    findings = run_on(
+        tmp_path,
+        "kern.py",
+        """
+        import jax
+        import jax.numpy
+
+        @jax.jit
+        def kern(x):
+            if jax.device_count() > 1:     # host metadata: legal
+                x = x + 1
+            return jax.numpy.where(x > 0, x, 0)
+
+        @jax.jit
+        def kern2(x):
+            if jax.numpy.sum(x) > 0:       # dotted jnp call: still flagged
+                x = x + 1
+            return x
+        """,
+    )
+    assert rule_ids(findings) == {"KAT-TRC-001"}
+    assert len(findings) == 1 and findings[0].line == 13
+
+
+def test_rtr_nested_function_jit_call_reported_once(tmp_path):
+    findings = run_on(
+        tmp_path,
+        "mod.py",
+        """
+        import jax
+
+        def outer(f, x):
+            def inner():
+                return jax.jit(f)(x)
+            return inner()
+        """,
+    )
+    rtr1 = [f for f in findings if f.rule == "KAT-RTR-001"]
+    assert len(rtr1) == 1
+    assert "inner" in rtr1[0].message  # attributed to the innermost fn
+
+
+def test_pur_global_declaration_still_flags_captured_append(tmp_path):
+    findings = run_on(
+        tmp_path,
+        "kern.py",
+        """
+        import jax
+
+        SEEN = []
+
+        @jax.jit
+        def kern(x):
+            global SEEN
+            SEEN.append(1)
+            return x
+        """,
+    )
+    assert rule_ids(findings) == {"KAT-PUR-003"}
+
+
+def test_drf_decision_route_helper_counts_as_the_seam(tmp_path):
+    findings = run_on(
+        tmp_path,
+        "entry.py",
+        """
+        from kube_arbitrator_tpu.platform import decision_route
+
+        def decide(st, schedule_cycle, actions):
+            ctx, dev, native_ops = decision_route(
+                int(st.task_valid.shape[0]), actions, st.task_status
+            )
+            with ctx:
+                return schedule_cycle(st, native_ops=native_ops)
+        """,
+    )
+    assert findings == []

@@ -52,3 +52,62 @@ def test_bad_magic_rejected(tmp_path):
     p.write_bytes(b"NOPE" + b"\x00" * 16)
     with pytest.raises(ValueError, match="bad magic"):
         list(load_trace(str(p)))
+
+
+def test_trace_meta_records_and_pins_native_ops(tmp_path):
+    """The recorder leaves a ``<path>.meta.json`` sidecar with the
+    resolved native_ops flag, and replay honors the recorded flag over
+    re-resolving on the replay host (ADVICE.md determinism item: the
+    native serial scan and XLA's mm_cumsum can rank-tie differently)."""
+    import json
+
+    from kube_arbitrator_tpu.cache.persist import trace_meta
+
+    sim = generate_cluster(num_nodes=16, num_jobs=3, tasks_per_job=4, num_queues=2, seed=7)
+    snap = build_snapshot(sim.cluster).tensors
+
+    path = str(tmp_path / "pinned.kats")
+    rec = TraceRecorder(path, native_ops=False)
+    rec.record(snap)
+    rec.close()
+
+    meta = trace_meta(path)
+    assert meta["native_ops"] is False
+    assert json.load(open(path + ".meta.json"))["native_ops"] is False
+
+    replayed = replay_trace(path)
+    assert [r["native_ops"] for r in replayed] == [False]
+
+    # default construction resolves the flag itself (never absent)
+    path2 = str(tmp_path / "auto.kats")
+    TraceRecorder(path2).close()
+    assert trace_meta(path2).get("native_ops") in (True, False)
+
+    # traces predating the sidecar (no meta file) still replay
+    import os
+
+    os.unlink(path + ".meta.json")
+    assert trace_meta(path) == {}
+    assert [r["binds"] for r in replay_trace(path)] == [r["binds"] for r in replayed]
+
+
+def test_replay_with_recorded_native_true_cannot_crash(tmp_path):
+    """A meta pinning native_ops=true must route through the platform
+    seam on replay (the resolve is what builds/registers the FFI
+    targets); an incapable host falls back with the divergence visible
+    in the row's flag instead of crashing on an unregistered target."""
+    import json
+
+    sim = generate_cluster(num_nodes=16, num_jobs=3, tasks_per_job=4, num_queues=2, seed=11)
+    path = str(tmp_path / "native.kats")
+    rec = TraceRecorder(path, native_ops=False)
+    rec.record(build_snapshot(sim.cluster).tensors)
+    rec.close()
+    # simulate a trace recorded on a native-capable host
+    with open(path + ".meta.json", "w") as f:
+        json.dump({"native_ops": True, "backend": "cpu"}, f)
+
+    rows = replay_trace(path)
+    assert len(rows) == 1
+    assert rows[0]["native_ops"] in (True, False)  # resolved, never blind
+    assert rows[0]["binds"] >= 0
