@@ -3,7 +3,12 @@
 ``python -m kube_arbitrator_tpu.analysis [paths]`` runs an AST pass over
 the package (and ``tests/``) and reports per-rule findings — rule id,
 ``file:line``, severity, and a fix hint — exiting non-zero on violations,
-so it works as the pre-test gate in CI.
+so it works as the pre-test gate in CI.  When the analyzed scope contains
+the real decision pipeline it also runs the interprocedural contract
+pass (``analysis/contracts.py``): every ``ACTION_KERNELS`` entry is
+abstractly evaluated under ``jax.eval_shape`` against the declared
+snapshot/state schemas, with one tiny real snapshot build checking the
+producer side.
 
 Rule families (each rule module documents its sub-ids):
 
@@ -23,6 +28,19 @@ Rule families (each rule module documents its sub-ids):
 - ``KAT-DRF`` — config drift: ``resolve_native_ops``/``native_ops``
   usage that bypasses the ``platform.decision_device`` crossover routing
   (the sidecar bug class from ADVICE.md).
+- ``KAT-DTY`` — dtype discipline: ``np.float64`` constants/defaults
+  crossing into kernels, bool→arithmetic without an explicit cast, and
+  x64-dependent literals that wash to ``inf``/wrap under the float32
+  decision-plane contract.
+- ``KAT-LCK`` — lock discipline on the threaded planes: fields written
+  under a ``threading.Lock`` in one method but read bare in another, and
+  locks held across device-/network-blocking calls.
+- ``KAT-CTR`` — the snapshot→kernel contract pass (not an AST rule):
+  schema/producer/consumer verification by abstract evaluation.
+
+Reports render as text, ``--format json`` or ``--format sarif``; a
+``.kat-baseline.json`` suppression file supports incremental burn-down,
+and results are cached under ``.kat-cache/``.
 """
 from .core import Finding, Project, analyze_paths, load_project
 from .rules import ALL_RULES

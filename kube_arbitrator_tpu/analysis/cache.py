@@ -1,0 +1,177 @@
+"""Result cache for the analyzer: parsed-file findings and the
+eval_shape contract pass, keyed by content identity.
+
+The full-tree gate runs on every ``deploy/check.sh`` and in the editor
+loop, so repeat latency matters more than cold latency.  Re-parsing 90
+files is cheap; re-running every rule's AST walks and (especially) the
+abstract evaluation of four action kernels + the fused cycle is not.
+Both are pure functions of
+
+* the analyzed file's bytes — keyed as ``(path, mtime_ns, size)``;
+* the rule implementations — keyed as a fingerprint over the analysis
+  package's own source stats, so editing any rule invalidates everything;
+* the project kernel-name context (``ACTION_KERNELS`` registrations
+  anywhere in the project scope kernel-context rules), folded into the
+  per-file key — a new registration in module A legitimately changes
+  module B's findings;
+* for the contract pass: the source stats of every module the pipeline
+  imports (ops/, cache/, api/), since the schemas are checked against the
+  real kernels.
+
+Storage is one JSON file per concern under ``.kat-cache/`` (gitignored).
+Corrupt or version-mismatched caches are discarded silently — the cache
+can only ever cost a re-run, never a stale verdict.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from .core import Finding
+
+_VERSION = 1
+
+
+def _stat_fingerprint(paths: Iterable[str]) -> str:
+    h = hashlib.sha1()
+    for p in sorted(paths):
+        try:
+            st = os.stat(p)
+            h.update(f"{p}:{st.st_mtime_ns}:{st.st_size};".encode())
+        except OSError:
+            h.update(f"{p}:gone;".encode())
+    return h.hexdigest()
+
+
+def _py_files(root: str) -> List[str]:
+    out = []
+    for dirpath, dirs, names in os.walk(root):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        out.extend(os.path.join(dirpath, n) for n in names if n.endswith(".py"))
+    return out
+
+
+def ruleset_fingerprint(rule_families: Sequence[str]) -> str:
+    """Identity of the analyzer itself: the selected families plus the
+    source stats of the analysis package — editing a rule or selecting a
+    different family set invalidates every cached verdict."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha1(",".join(sorted(rule_families)).encode())
+    h.update(_stat_fingerprint(_py_files(here)).encode())
+    return h.hexdigest()
+
+
+def package_fingerprint() -> str:
+    """Identity of everything the contract pass abstractly evaluates:
+    the whole installed package's source stats."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return _stat_fingerprint(_py_files(pkg))
+
+
+def _finding_to_json(f: Finding) -> dict:
+    return dataclasses.asdict(f)
+
+
+def _finding_from_json(d: dict) -> Finding:
+    return Finding(**d)
+
+
+class AnalysisCache:
+    """``.kat-cache/`` store.  ``enabled=False`` turns every method into
+    a no-op so call sites need no branches."""
+
+    def __init__(self, cache_dir: str = ".kat-cache", enabled: bool = True):
+        self.dir = cache_dir
+        self.enabled = enabled
+        self.hits = 0
+        self.misses = 0
+        self._files: Dict[str, dict] = {}
+        self._dirty = False
+        if enabled:
+            self._files = self._load(os.path.join(self.dir, "findings.json"))
+
+    def _load_payload(self, path: str) -> dict:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                data = json.load(fh)
+            if data.get("version") == _VERSION:
+                return data
+        except (OSError, ValueError):
+            pass
+        return {}
+
+    def _load(self, path: str) -> Dict[str, dict]:
+        return self._load_payload(path).get("files", {})
+
+    # ---- per-file findings ----
+
+    def file_key(self, path: str, context_fp: str) -> Optional[str]:
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None
+        return f"{st.st_mtime_ns}:{st.st_size}:{context_fp}"
+
+    def get_findings(self, path: str, key: Optional[str]) -> Optional[List[Finding]]:
+        if not self.enabled or key is None:
+            return None
+        entry = self._files.get(path)
+        if entry is None or entry.get("key") != key:
+            self.misses += 1
+            return None
+        self.hits += 1
+        return [_finding_from_json(d) for d in entry["findings"]]
+
+    def put_findings(self, path: str, key: Optional[str], findings: Sequence[Finding]) -> None:
+        if not self.enabled or key is None:
+            return
+        self._files[path] = {
+            "key": key,
+            "findings": [_finding_to_json(f) for f in findings],
+        }
+        self._dirty = True
+
+    # ---- contract pass ----
+
+    def get_contracts(self, key: str) -> Optional[List[Finding]]:
+        if not self.enabled:
+            return None
+        data = self._load_payload(os.path.join(self.dir, "contracts.json"))
+        entry = data.get("contracts")
+        if entry is None or entry.get("key") != key:
+            return None
+        return [_finding_from_json(d) for d in entry["findings"]]
+
+    def put_contracts(self, key: str, findings: Sequence[Finding]) -> None:
+        if not self.enabled:
+            return
+        self._write(os.path.join(self.dir, "contracts.json"), {
+            "version": _VERSION,
+            "contracts": {
+                "key": key,
+                "findings": [_finding_to_json(f) for f in findings],
+            },
+        })
+
+    # ---- persistence ----
+
+    def _write(self, path: str, payload: dict) -> None:
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(payload, fh)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # a read-only checkout just runs uncached
+
+    def flush(self) -> None:
+        if self.enabled and self._dirty:
+            self._write(os.path.join(self.dir, "findings.json"), {
+                "version": _VERSION,
+                "files": self._files,
+            })
+            self._dirty = False

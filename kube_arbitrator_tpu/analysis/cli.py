@@ -1,20 +1,44 @@
-"""CLI: ``python -m kube_arbitrator_tpu.analysis [paths...]``.
+"""CLI: ``python -m kube_arbitrator_tpu.analysis [paths...]`` / ``kat-lint``.
 
 Exit status: 0 clean, 1 findings, 2 usage error.  With no paths it
 analyzes the installed package plus an adjacent ``tests/`` directory
 when one exists — the tier-1 pre-test gate shape
 (``python -m kube_arbitrator_tpu.analysis kube_arbitrator_tpu tests``).
+
+Beyond the AST rule families, whenever the analyzed scope contains the
+real decision pipeline (``ops/cycle.py`` with its ``ACTION_KERNELS``
+registry) the interprocedural contract pass runs too: every registered
+kernel is abstractly evaluated under ``jax.eval_shape`` against the
+declared snapshot/state schemas (``analysis/contracts.py``), plus one
+tiny real snapshot build verifying the producer side.  ``--no-contracts``
+skips it (e.g. when jax is unavailable).
+
+``--format json|sarif`` switch the report; ``--baseline`` /
+``--write-baseline`` manage the ``.kat-baseline.json`` suppression file
+so pre-existing findings can be burned down without blocking CI.
+Results are cached under ``.kat-cache/`` keyed by file stats + rule-set
+fingerprint; ``--no-cache`` forces a full re-run.
 """
 from __future__ import annotations
 
 import argparse
 import os
 import sys
+import time
 from typing import List, Optional, Sequence
 
+from .cache import AnalysisCache, package_fingerprint, ruleset_fingerprint
 from .core import analyze_paths
-from .report import render_json, render_text
+from .report import (
+    RENDERERS,
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
 from .rules import ALL_RULES, RULES_BY_FAMILY
+
+DEFAULT_BASELINE = ".kat-baseline.json"
+CONTRACTS_FAMILY = "KAT-CTR"
 
 
 def _default_paths() -> List[str]:
@@ -26,6 +50,29 @@ def _default_paths() -> List[str]:
     return paths
 
 
+def _scope_has_pipeline(project) -> bool:
+    """True when the analyzed units include the real decision pipeline —
+    the package's own ops/cycle.py (not a fixture that happens to define
+    an ACTION_KERNELS literal)."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cycle = os.path.join(pkg, "ops", "cycle.py")
+    return any(u.path == cycle for u in project.units)
+
+
+def _run_contract_pass(cache: AnalysisCache):
+    """The eval_shape contract pass, cached on the package fingerprint —
+    any source change under the package re-runs it."""
+    key = package_fingerprint()
+    cached = cache.get_contracts(key)
+    if cached is not None:
+        return cached, True
+    from .contracts import check_contracts
+
+    findings = check_contracts()
+    cache.put_contracts(key, findings)
+    return findings, False
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m kube_arbitrator_tpu.analysis",
@@ -35,45 +82,125 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "paths", nargs="*",
         help="files or directories (default: the package + adjacent tests/)",
     )
-    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    ap.add_argument(
+        "--format", choices=sorted(RENDERERS), default=None,
+        help="report format (default: text)",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="shorthand for --format json (kept for script compatibility; "
+        "conflicts with an explicit different --format)",
+    )
     ap.add_argument(
         "--rules",
         help="comma-separated rule families to run (e.g. KAT-SYN,KAT-TRC); "
-        "default: all",
+        f"default: all AST families + the {CONTRACTS_FAMILY} contract pass",
     )
     ap.add_argument(
         "--list-rules", action="store_true", help="print rule families and exit"
     )
+    ap.add_argument(
+        "--no-contracts", action="store_true",
+        help="skip the eval_shape contract pass even when the pipeline is "
+        "in scope (it needs an importable jax)",
+    )
+    ap.add_argument(
+        "--baseline", default=None, metavar="PATH",
+        help=f"suppression file (default: {DEFAULT_BASELINE} when present)",
+    )
+    ap.add_argument(
+        "--write-baseline", action="store_true",
+        help="record the current findings as the baseline and exit 0",
+    )
+    ap.add_argument(
+        "--no-cache", action="store_true",
+        help="ignore and do not write .kat-cache/",
+    )
+    ap.add_argument(
+        "--cache-dir", default=".kat-cache",
+        help="cache directory (default: .kat-cache)",
+    )
     args = ap.parse_args(argv)
+    if args.json and args.format not in (None, "json"):
+        ap.error(f"--json conflicts with --format {args.format}")
+    out_format = "json" if args.json else (args.format or "text")
 
     if args.list_rules:
         for r in ALL_RULES:
             scope = "package+tests" if r.applies_to_tests else "package only"
             print(f"{r.family}  {r.name}  [{scope}]")
+        print(
+            f"{CONTRACTS_FAMILY}  snapshot→kernel contract pass (eval_shape)"
+            "  [runs when ops/cycle.py is in scope]"
+        )
         return 0
 
     rules = list(ALL_RULES)
+    want_contracts = not args.no_contracts
     if args.rules:
         wanted = [s.strip() for s in args.rules.split(",") if s.strip()]
-        unknown = [w for w in wanted if w not in RULES_BY_FAMILY]
+        known = set(RULES_BY_FAMILY) | {CONTRACTS_FAMILY}
+        unknown = [w for w in wanted if w not in known]
         if unknown:
             print(
                 f"unknown rule families: {', '.join(unknown)} "
-                f"(known: {', '.join(RULES_BY_FAMILY)})",
+                f"(known: {', '.join(sorted(known))})",
                 file=sys.stderr,
             )
             return 2
-        rules = [RULES_BY_FAMILY[w] for w in wanted]
+        rules = [RULES_BY_FAMILY[w] for w in wanted if w in RULES_BY_FAMILY]
+        want_contracts = CONTRACTS_FAMILY in wanted
 
+    t0 = time.perf_counter()
+    cache = AnalysisCache(args.cache_dir, enabled=not args.no_cache)
+    families = [r.family for r in rules] + ([CONTRACTS_FAMILY] if want_contracts else [])
     paths = list(args.paths) or _default_paths()
     try:
-        project, findings = analyze_paths(paths, rules)
+        project, findings = analyze_paths(
+            paths, rules, cache=cache, context_fp=ruleset_fingerprint(families)
+        )
     except FileNotFoundError as e:
         print(f"no such path: {e}", file=sys.stderr)
         return 2
 
-    print(render_json(project, findings) if args.json else render_text(project, findings))
+    contracts_cached = False
+    if want_contracts and _scope_has_pipeline(project):
+        contract_findings, contracts_cached = _run_contract_pass(cache)
+        findings = sorted(
+            findings + contract_findings, key=lambda f: (f.path, f.line, f.rule)
+        )
+
+    baseline_path = args.baseline or (
+        DEFAULT_BASELINE if os.path.exists(DEFAULT_BASELINE) else None
+    )
+    if args.write_baseline:
+        out = args.baseline or DEFAULT_BASELINE
+        write_baseline(out, findings)
+        print(f"baseline: recorded {len(findings)} finding(s) -> {out}")
+        return 0
+    suppressed = 0
+    if baseline_path:
+        findings, suppressed = apply_baseline(findings, load_baseline(baseline_path))
+
+    wall_s = time.perf_counter() - t0
+    notes = []
+    if cache.enabled:
+        notes.append(f"{cache.hits}/{cache.hits + cache.misses} files cached")
+        if want_contracts:
+            notes.append(
+                "contracts cached" if contracts_cached else "contracts evaluated"
+            )
+    print(RENDERERS[out_format](
+        project, findings,
+        suppressed=suppressed, wall_s=wall_s, cache_note=", ".join(notes),
+    ))
     return 1 if findings else 0
+
+
+def main_sarif(argv: Optional[Sequence[str]] = None) -> int:
+    """``kat-sarif`` console entry: kat-lint pinned to SARIF output (the
+    shape CI uploads to code-scanning)."""
+    return main(["--format", "sarif", *(argv if argv is not None else sys.argv[1:])])
 
 
 if __name__ == "__main__":

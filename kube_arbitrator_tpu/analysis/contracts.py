@@ -1,0 +1,544 @@
+"""KAT-CTR — interprocedural contract verification of the snapshot→kernel
+pipeline.
+
+The AST rule families (KAT-SYN/TRC/PUR/RTR/DRF/DTY/LCK) are per-function
+lint: each looks at one module at a time.  The #1 silent-failure class in
+this codebase is *between* layers — a snapshot producer emitting a
+``np.float64``/``bool`` array that the float32 kernels silently downcast,
+or a padded-dimension drift between ``build_reclaim_pack`` and the
+``ACTION_KERNELS`` consumers — so this pass checks the actual interfaces:
+
+* **Schema** (:data:`SNAPSHOT_SCHEMA` / :data:`STATE_SCHEMA` /
+  :data:`SESSION_SCHEMA` / :data:`DECISIONS_SCHEMA`): the declared
+  contract for every field crossing a layer boundary, shapes in the
+  symbolic axis names the snapshot docstrings use (``T``/``N``/``G``/
+  ``J``/``Q``/``R``/``W``/…).
+* **Producer check**: build one tiny real snapshot (``SimCluster`` →
+  ``build_snapshot``) and verify every produced tensor against the
+  schema, resolving the symbolic axes from the arrays themselves.  Host
+  numpy preserves dtypes, so this is where a ``float64`` leak is caught
+  *before* the jit boundary silently washes it to float32.
+* **Consumer check**: run ``open_session``, every registered
+  ``ACTION_KERNELS`` entry, and the full ``schedule_cycle`` under
+  ``jax.eval_shape`` with symbolic-size ``ShapeDtypeStruct`` inputs on
+  the CPU backend — no device, no data — and verify that each stage
+  accepts the previous stage's output and returns exactly the state
+  contract the next stage (``ops/cycle.py`` threads ``AllocState``
+  through the conf's ordered action list) consumes.
+
+Sub-ids:
+
+- ``KAT-CTR-001``: schema / ``SnapshotTensors`` field-set drift (a field
+  added to the dataclass without a declared contract, or vice versa).
+- ``KAT-CTR-002``: producer mismatch — ``build_snapshot`` emits a tensor
+  whose dtype/shape disagrees with the schema (the ``np.float64`` scale
+  vector class).
+- ``KAT-CTR-003``: ``open_session`` output disagrees with the session /
+  state schema.
+- ``KAT-CTR-004``: a registered kernel fails abstract evaluation outright
+  (shape/dtype error raised under ``jax.eval_shape``).
+- ``KAT-CTR-005``: a kernel returns an ``AllocState`` whose field shapes
+  or dtypes disagree with what the next pipeline stage consumes.
+- ``KAT-CTR-006``: the fused ``schedule_cycle`` decisions disagree with
+  the actuation-side contract (``framework/session.py`` decodes them).
+
+The harness takes the schemas as parameters so the regression tests can
+seed one mutated dtype and assert the checker reports exactly the
+affected stage — the checker itself is under contract not to go green
+silently (``tests/test_contracts.py``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import inspect
+import os
+from typing import Dict, List, Mapping, Optional, Tuple
+
+from .core import Finding
+
+# ---------------------------------------------------------------------------
+# the declared contracts
+
+#: Concrete sizes the abstract evaluation assigns to the symbolic axes.
+#: Values are the snapshot's bucket floors where one exists; what matters
+#: is only that the kernels are shape-polymorphic over them.
+DEFAULT_AXES: Dict[str, int] = {
+    "T": 8,      # tasks (sublane bucket floor)
+    "N": 128,    # nodes (lane-width bucket floor)
+    "G": 32,     # task groups
+    "J": 64,     # jobs (≠ G on purpose: catches G/J transposes)
+    "Q": 8,      # queues
+    "R": 4,      # resource axes (api.resource.NUM_RESOURCES)
+    "W": 2,      # host-port mask words (snapshot.MAX_PORT_WORDS)
+    "CT": 3,     # task predicate classes
+    "CN": 5,     # node predicate classes
+    "K": 0,      # pod-affinity topology keys (0 = feature compiled out)
+    "TF": 0,     # affinity terms
+    "TA": 0,     # anti-affinity terms
+    "D": 1,      # topology domains
+    "CP": 1,     # pod label classes
+    "CS": 0,     # static anti-affinity symmetry rows
+    "MA": 0,     # max affinity terms per group
+    "MB": 0,     # max anti-affinity terms per group
+    "V": 1056,   # reclaim canon pack length (Vp)
+}
+
+# Field -> (symbolic shape, dtype name).  Scalars use ().  Dims may be a
+# symbol name or a "SYM+int" expression (rv_block_start is [N+1]).
+SNAPSHOT_SCHEMA: Dict[str, Tuple[Tuple[str, ...], str]] = {
+    # ---- tasks [T] ----
+    "task_resreq": (("T", "R"), "float32"),
+    "task_job": (("T",), "int32"),
+    "task_status": (("T",), "int32"),
+    "task_priority": (("T",), "int32"),
+    "task_uid_rank": (("T",), "int32"),
+    "task_klass": (("T",), "int32"),
+    "task_node": (("T",), "int32"),
+    "task_ports": (("T", "W"), "int32"),
+    "task_valid": (("T",), "bool"),
+    "task_best_effort": (("T",), "bool"),
+    # ---- task groups [G] ----
+    "task_group": (("T",), "int32"),
+    "task_group_rank": (("T",), "int32"),
+    "group_job": (("G",), "int32"),
+    "group_resreq": (("G", "R"), "float32"),
+    "group_klass": (("G",), "int32"),
+    "group_ports": (("G", "W"), "int32"),
+    "group_size": (("G",), "int32"),
+    "group_priority": (("G",), "int32"),
+    "group_uid_rank": (("G",), "int32"),
+    "group_best_effort": (("G",), "bool"),
+    "group_valid": (("G",), "bool"),
+    # ---- nodes [N] ----
+    "node_idle": (("N", "R"), "float32"),
+    "node_releasing": (("N", "R"), "float32"),
+    "node_alloc": (("N", "R"), "float32"),
+    "node_max_tasks": (("N",), "int32"),
+    "node_num_tasks": (("N",), "int32"),
+    "node_klass": (("N",), "int32"),
+    "node_ports": (("N", "W"), "int32"),
+    "node_unsched": (("N",), "bool"),
+    "node_valid": (("N",), "bool"),
+    # ---- jobs [J] ----
+    "job_queue": (("J",), "int32"),
+    "job_min_available": (("J",), "int32"),
+    "job_priority": (("J",), "int32"),
+    "job_creation_rank": (("J",), "int32"),
+    "job_valid": (("J",), "bool"),
+    # ---- queues [Q] ----
+    "queue_weight": (("Q",), "float32"),
+    "queue_uid_rank": (("Q",), "int32"),
+    "queue_valid": (("Q",), "bool"),
+    # ---- predicate class table ----
+    "class_fit": (("CT", "CN"), "bool"),
+    # ---- pod (anti-)affinity encoding ----
+    "task_pa_class": (("T",), "int32"),
+    "group_pa_class": (("G",), "int32"),
+    "group_aff_terms": (("G", "MA"), "int32"),
+    "group_anti_terms": (("G", "MB"), "int32"),
+    "node_dom": (("K", "N"), "int32"),
+    "aff_key": (("TF",), "int32"),
+    "anti_key": (("TA",), "int32"),
+    "aff_static": (("TF", "D"), "int32"),
+    "anti_static": (("TA", "D"), "int32"),
+    "aff_static_total": (("TF",), "int32"),
+    "aff_match": (("TF", "CP"), "bool"),
+    "anti_match": (("TA", "CP"), "bool"),
+    "symm_ok": (("CS", "N"), "bool"),
+    # ---- cluster-level ----
+    "others_used": (("R",), "float32"),
+    "n_valid_queues": ((), "int32"),
+    # ---- reclaim canon pack ----
+    "rv_idx": (("V",), "int32"),
+    "rv_valid": (("V",), "bool"),
+    "rv_nj_start": (("V",), "bool"),
+    "rv_nq_start": (("V",), "bool"),
+    "rv_block_start": (("N+1",), "int32"),
+}
+
+#: Static (non-array) SnapshotTensors fields and the value the abstract
+#: evaluation pins them to.
+SNAPSHOT_STATIC: Dict[str, int] = {"rv_window": 32}
+
+#: The state every ACTION_KERNELS entry consumes AND must return —
+#: ops/cycle.py threads one AllocState through the ordered action list,
+#: so stage n's return IS stage n+1's input.
+STATE_SCHEMA: Dict[str, Tuple[Tuple[str, ...], str]] = {
+    "task_status": (("T",), "int32"),
+    "task_node": (("T",), "int32"),
+    "node_idle": (("N", "R"), "float32"),
+    "node_releasing": (("N", "R"), "float32"),
+    "node_ports": (("N", "W"), "int32"),
+    "node_num_tasks": (("N",), "int32"),
+    "job_alloc": (("J", "R"), "float32"),
+    "queue_alloc": (("Q", "R"), "float32"),
+    "job_ready_cnt": (("J",), "int32"),
+    "group_placed": (("G",), "int32"),
+    "group_unfit": (("G",), "bool"),
+    "evicted_for": (("T",), "int32"),
+    "progress": ((), "bool"),
+    "rounds": ((), "int32"),
+}
+
+SESSION_SCHEMA: Dict[str, Tuple[Tuple[str, ...], str]] = {
+    "drf_total": (("R",), "float32"),
+    "deserved": (("Q", "R"), "float32"),
+    "job_sched_valid": (("J",), "bool"),
+    "min_avail": (("J",), "int32"),
+    "drf_level": (("J",), "float32"),
+}
+
+#: What framework/session.py's actuation decode consumes.
+DECISIONS_SCHEMA: Dict[str, Tuple[Tuple[str, ...], str]] = {
+    "task_node": (("T",), "int32"),
+    "task_status": (("T",), "int32"),
+    "bind_mask": (("T",), "bool"),
+    "evict_mask": (("T",), "bool"),
+    "job_ready": (("J",), "bool"),
+    "unready_alloc": (("T",), "bool"),
+    "node_idle": (("N", "R"), "float32"),
+    "node_num_tasks": (("N",), "int32"),
+    "node_ports": (("N", "W"), "int32"),
+}
+
+
+def mutated(
+    schema: Mapping[str, Tuple[Tuple[str, ...], str]], field: str, dtype: str
+) -> Dict[str, Tuple[Tuple[str, ...], str]]:
+    """A copy of ``schema`` with one field's dtype replaced — the seeded
+    violation the harness regression tests feed back in."""
+    out = dict(schema)
+    shape, _ = out[field]
+    out[field] = (shape, dtype)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# shape/dtype plumbing
+
+def _resolve_dim(dim: str, axes: Mapping[str, int]) -> int:
+    if dim in axes:
+        return axes[dim]
+    if "+" in dim:
+        sym, off = dim.split("+", 1)
+        return axes[sym.strip()] + int(off)
+    raise KeyError(f"unknown axis symbol {dim!r}")
+
+
+def _concrete_shape(shape: Tuple[str, ...], axes: Mapping[str, int]) -> Tuple[int, ...]:
+    return tuple(_resolve_dim(d, axes) for d in shape)
+
+
+def _rel(path: Optional[str]) -> str:
+    if not path:
+        return "kube_arbitrator_tpu"
+    try:
+        r = os.path.relpath(path)
+    except ValueError:
+        return path
+    return path if r.startswith("..") else r
+
+
+def _anchor(obj) -> Tuple[str, int]:
+    """(path, line) of a callable/class, for findings that point at real
+    code rather than at a fixture file."""
+    try:
+        path = inspect.getsourcefile(obj)
+        _, line = inspect.getsourcelines(obj)
+        return _rel(path), line
+    except (OSError, TypeError):
+        return "kube_arbitrator_tpu", 1
+
+
+def _describe(x) -> str:
+    return f"{getattr(x, 'dtype', type(x).__name__)}[{','.join(map(str, getattr(x, 'shape', ())))}]"
+
+
+def _check_fields(
+    obj,
+    schema: Mapping[str, Tuple[Tuple[str, ...], str]],
+    axes: Mapping[str, int],
+    rule: str,
+    path: str,
+    line: int,
+    stage: str,
+    hint: str,
+) -> List[Finding]:
+    """Compare a pytree dataclass's array fields against a schema."""
+    findings: List[Finding] = []
+    for name, (sym_shape, dtype) in schema.items():
+        if not hasattr(obj, name):
+            findings.append(Finding(
+                rule, "error", path, line,
+                f"{stage}: field `{name}` missing from {type(obj).__name__}",
+                hint=hint,
+            ))
+            continue
+        val = getattr(obj, name)
+        want_shape = _concrete_shape(sym_shape, axes)
+        got_shape = tuple(getattr(val, "shape", ()))
+        got_dtype = str(getattr(val, "dtype", type(val).__name__))
+        want = f"{dtype}[{','.join(map(str, want_shape))}]"
+        if got_shape != want_shape or got_dtype != dtype:
+            findings.append(Finding(
+                rule, "error", path, line,
+                f"{stage}: `{name}` is {_describe(val)}, contract says "
+                f"{want} (shape symbols {sym_shape})",
+                hint=hint,
+            ))
+    return findings
+
+
+def snapshot_struct(
+    schema: Optional[Mapping[str, Tuple[Tuple[str, ...], str]]] = None,
+    axes: Optional[Mapping[str, int]] = None,
+):
+    """A ``SnapshotTensors`` of ``ShapeDtypeStruct`` leaves per the schema
+    — the symbolic-size abstract input the eval_shape passes run on."""
+    import jax
+    import numpy as np
+
+    from ..cache.snapshot import SnapshotTensors
+
+    schema = schema or SNAPSHOT_SCHEMA
+    axes = axes or DEFAULT_AXES
+    kw = {
+        name: jax.ShapeDtypeStruct(_concrete_shape(shape, axes), np.dtype(dtype))
+        for name, (shape, dtype) in schema.items()
+    }
+    kw.update(SNAPSHOT_STATIC)
+    return SnapshotTensors(**kw)
+
+
+# ---------------------------------------------------------------------------
+# the passes
+
+def check_schema_fields() -> List[Finding]:
+    """KAT-CTR-001: the declared schema and the SnapshotTensors dataclass
+    must name exactly the same fields."""
+    from ..cache import snapshot as snapmod
+
+    path, line = _anchor(snapmod.SnapshotTensors)
+    declared = set(SNAPSHOT_SCHEMA) | set(SNAPSHOT_STATIC)
+    actual = {f.name for f in dataclasses.fields(snapmod.SnapshotTensors)}
+    findings = []
+    for name in sorted(actual - declared):
+        findings.append(Finding(
+            "KAT-CTR-001", "error", path, line,
+            f"SnapshotTensors field `{name}` has no declared contract in "
+            "analysis/contracts.py",
+            hint="add the field's symbolic shape and dtype to "
+            "SNAPSHOT_SCHEMA (or SNAPSHOT_STATIC) so both producer and "
+            "consumers are checked against it",
+        ))
+    for name in sorted(declared - actual):
+        findings.append(Finding(
+            "KAT-CTR-001", "error", path, line,
+            f"contract schema declares `{name}` but SnapshotTensors has "
+            "no such field",
+            hint="remove the stale schema entry or restore the field",
+        ))
+    return findings
+
+
+def check_producer(
+    schema: Optional[Mapping[str, Tuple[Tuple[str, ...], str]]] = None,
+) -> List[Finding]:
+    """KAT-CTR-002: build one small REAL snapshot and verify every tensor
+    against the schema.  Axis symbols are resolved from the built arrays
+    themselves, so the check is about dtype and axis *identity*, not the
+    padded sizes (which the sticky-bucket memo may vary)."""
+    from ..cache import snapshot as snapmod
+    from ..cache.sim import SimCluster
+
+    schema = schema or SNAPSHOT_SCHEMA
+    path, line = _anchor(snapmod.build_snapshot)
+    sim = SimCluster()
+    sim.add_queue("default", weight=1)
+    sim.add_node("n1", cpu_milli=4000, memory=8 * 1024**3)
+    j = sim.add_job("j1", queue="default", min_available=1)
+    sim.add_task(j, 1000, 1024**3)
+    from ..api.types import TaskStatus
+    j2 = sim.add_job("j2", queue="default")
+    sim.add_task(j2, 500, 1024**3, status=TaskStatus.RUNNING, node="n1")
+    try:
+        t = snapmod.build_snapshot(sim.cluster).tensors
+    except Exception as err:
+        # the producer's own runtime guard (_assert_pack_dtypes) raises on
+        # exactly the drift class this pass reports — convert instead of
+        # crashing the analyzer and losing every other finding of the run
+        return [Finding(
+            "KAT-CTR-002", "error", path, line,
+            f"build_snapshot failed on a minimal cluster: "
+            f"{type(err).__name__}: {err}",
+            hint="the snapshot producer no longer builds a clean pack — "
+            "fix the producer (or the schema, if the contract "
+            "legitimately changed)",
+        )]
+
+    axes = {
+        "T": t.task_resreq.shape[0],
+        "N": t.node_idle.shape[0],
+        "G": t.group_job.shape[0],
+        "J": t.job_queue.shape[0],
+        "Q": t.queue_weight.shape[0],
+        "R": t.task_resreq.shape[1],
+        "W": t.task_ports.shape[1],
+        "CT": t.class_fit.shape[0],
+        "CN": t.class_fit.shape[1],
+        "K": t.node_dom.shape[0],
+        "TF": t.aff_key.shape[0],
+        "TA": t.anti_key.shape[0],
+        "D": t.aff_static.shape[1],
+        "CP": t.aff_match.shape[1],
+        "CS": t.symm_ok.shape[0],
+        "MA": t.group_aff_terms.shape[1],
+        "MB": t.group_anti_terms.shape[1],
+        "V": t.rv_idx.shape[0],
+    }
+    return _check_fields(
+        t, schema, axes, "KAT-CTR-002", path, line,
+        stage="snapshot producer (build_snapshot)",
+        hint="the snapshot boundary must emit exactly the declared "
+        "device dtypes — an np.float64/int64 here is silently downcast "
+        "the moment it crosses into the float32/int32 kernels, skewing "
+        "decisions without an error (cast explicitly at the boundary "
+        "like to_device_units, or fix the schema if the contract "
+        "legitimately changed)",
+    )
+
+
+def check_kernels(
+    schema: Optional[Mapping[str, Tuple[Tuple[str, ...], str]]] = None,
+    axes: Optional[Mapping[str, int]] = None,
+    state_schema: Optional[Mapping[str, Tuple[Tuple[str, ...], str]]] = None,
+) -> List[Finding]:
+    """KAT-CTR-003/004/005/006: abstract-evaluate the whole decision
+    pipeline in ops/cycle.py order — ``open_session`` → every registered
+    ``ACTION_KERNELS`` entry → fused ``schedule_cycle`` — under
+    ``jax.eval_shape`` on the CPU backend, and verify each stage's output
+    against the contract its consumer assumes."""
+    import jax
+
+    from ..ops import cycle as cyc
+
+    axes = axes or DEFAULT_AXES
+    state_schema = state_schema or STATE_SCHEMA
+    findings: List[Finding] = []
+    tiers = cyc.DEFAULT_TIERS
+    st = snapshot_struct(schema, axes)
+
+    path, line = _anchor(cyc.open_session)
+    with jax.default_device(jax.devices("cpu")[0]):
+        try:
+            sess, state = jax.eval_shape(lambda s: cyc.open_session(s, tiers), st)
+        except Exception as err:
+            return findings + [Finding(
+                "KAT-CTR-003", "error", path, line,
+                f"open_session failed abstract evaluation against the "
+                f"snapshot schema: {type(err).__name__}: {err}",
+                hint="the session opener no longer accepts the declared "
+                "snapshot pack — fix the consumer or the schema",
+            )]
+        findings += _check_fields(
+            sess, SESSION_SCHEMA, axes, "KAT-CTR-003", path, line,
+            stage="open_session → SessionCtx",
+            hint="every action kernel consumes this SessionCtx; a drifted "
+            "field silently changes all of them",
+        )
+        findings += _check_fields(
+            state, state_schema, axes, "KAT-CTR-003", path, line,
+            stage="open_session → AllocState",
+            hint="this AllocState seeds the action pipeline; stage 0 must "
+            "emit exactly what the first kernel consumes",
+        )
+
+        # Each kernel consumes the previous stage's AllocState and must
+        # return the same contract — ops/cycle.py threads one state
+        # through the conf's ordered action list, so any drift here is a
+        # break between stage n and stage n+1.
+        state_in = _state_struct(state_schema, axes)
+        sess_in = _session_struct(axes)
+        for name, kernel in sorted(cyc.ACTION_KERNELS.items()):
+            kpath, kline = _anchor(kernel)
+            try:
+                out = jax.eval_shape(
+                    lambda s, se, sta: kernel(s, se, sta, tiers), st, sess_in, state_in
+                )
+            except Exception as err:
+                findings.append(Finding(
+                    "KAT-CTR-004", "error", kpath, kline,
+                    f"kernel `{name}` failed abstract evaluation against "
+                    f"the declared snapshot/state contract: "
+                    f"{type(err).__name__}: {err}",
+                    hint="run jax.eval_shape(kernel, snapshot_struct(), ...) "
+                    "to reproduce without a device; either the kernel or "
+                    "the schema drifted",
+                ))
+                continue
+            findings += _check_fields(
+                out, state_schema, axes, "KAT-CTR-005", kpath, kline,
+                stage=f"kernel `{name}` → AllocState",
+                hint="ops/cycle.py feeds this state to the NEXT action in "
+                "the conf order; a changed dtype/shape breaks the stage "
+                "after this one (or silently re-promotes every cycle)",
+            )
+
+        path, line = _anchor(cyc.schedule_cycle)
+        try:
+            dec = jax.eval_shape(lambda s: cyc.schedule_cycle(s), st)
+        except Exception as err:
+            findings.append(Finding(
+                "KAT-CTR-006", "error", path, line,
+                f"schedule_cycle failed abstract evaluation: "
+                f"{type(err).__name__}: {err}",
+                hint="the fused cycle no longer composes over the declared "
+                "snapshot pack",
+            ))
+        else:
+            findings += _check_fields(
+                dec, DECISIONS_SCHEMA, axes, "KAT-CTR-006", path, line,
+                stage="schedule_cycle → CycleDecisions",
+                hint="framework/session.py decodes these tensors for "
+                "actuation; drift here corrupts binds/evicts host-side",
+            )
+    return findings
+
+
+def _state_struct(state_schema, axes):
+    import jax
+    import numpy as np
+
+    from ..ops.allocate import AllocState
+
+    return AllocState(**{
+        name: jax.ShapeDtypeStruct(_concrete_shape(shape, axes), np.dtype(dtype))
+        for name, (shape, dtype) in state_schema.items()
+    })
+
+
+def _session_struct(axes):
+    import jax
+    import numpy as np
+
+    from ..ops.allocate import SessionCtx
+
+    return SessionCtx(**{
+        name: jax.ShapeDtypeStruct(_concrete_shape(shape, axes), np.dtype(dtype))
+        for name, (shape, dtype) in SESSION_SCHEMA.items()
+    })
+
+
+def check_contracts(
+    schema: Optional[Mapping[str, Tuple[Tuple[str, ...], str]]] = None,
+    state_schema: Optional[Mapping[str, Tuple[Tuple[str, ...], str]]] = None,
+) -> List[Finding]:
+    """The full contract pass: field-set, producer, then consumers.
+
+    Passing a mutated ``schema``/``state_schema`` seeds a violation; the
+    regression tests assert the seeded stage (and only it) is reported."""
+    findings = check_schema_fields()
+    findings += check_producer(schema)
+    findings += check_kernels(schema, state_schema=state_schema)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -41,6 +41,20 @@ class Finding:
             out += f"\n    hint: {self.hint}"
         return out
 
+    def fingerprint(self) -> str:
+        """Line-independent identity for baseline suppression: a finding
+        keeps its fingerprint when unrelated edits shift it down the
+        file, and changes it when the offending code itself changes.
+        Embedded "line N" references in messages (KAT-DTY-001,
+        KAT-LCK-001) are redacted before hashing for the same reason."""
+        import hashlib
+        import re
+
+        stable = re.sub(r"\bline \d+", "line <n>", self.message)
+        return hashlib.sha1(
+            f"{self.rule}|{self.path}|{stable}".encode()
+        ).hexdigest()[:16]
+
 
 @dataclasses.dataclass
 class ModuleUnit:
@@ -208,14 +222,43 @@ def _registered_kernel_names(units: Sequence[ModuleUnit]) -> Set[str]:
     return names
 
 
-def analyze_paths(paths: Sequence[str], rules: Sequence[Rule]) -> Tuple[Project, List[Finding]]:
+def analyze_paths(
+    paths: Sequence[str],
+    rules: Sequence[Rule],
+    cache=None,
+    context_fp: str = "",
+) -> Tuple[Project, List[Finding]]:
+    """Run ``rules`` over every module under ``paths``.
+
+    ``cache`` (an :class:`analysis.cache.AnalysisCache`) short-circuits
+    unchanged files; per-file verdicts depend on the file bytes, the rule
+    set (``context_fp``, the caller's ruleset fingerprint) and the
+    project-wide kernel-name context, so all three fold into the key."""
     project = load_project(paths)
+    file_ctx = context_fp
+    if cache is not None:
+        import hashlib
+
+        file_ctx = hashlib.sha1(
+            (context_fp + "|" + ",".join(sorted(project.kernel_names))).encode()
+        ).hexdigest()
     findings: List[Finding] = []
     for unit in project.units:
+        key = cache.file_key(unit.path, file_ctx) if cache is not None else None
+        cached = cache.get_findings(unit.path, key) if cache is not None else None
+        if cached is not None:
+            findings.extend(cached)
+            continue
+        unit_findings: List[Finding] = []
         for rule in rules:
             if unit.is_test and not rule.applies_to_tests:
                 continue
-            findings.extend(rule.check(unit, project))
+            unit_findings.extend(rule.check(unit, project))
+        if cache is not None:
+            cache.put_findings(unit.path, key, unit_findings)
+        findings.extend(unit_findings)
+    if cache is not None:
+        cache.flush()
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return project, findings
 

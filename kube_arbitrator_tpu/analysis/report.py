@@ -1,43 +1,218 @@
-"""Finding rendering: human text (default) and ``--json`` machine form."""
+"""Finding rendering (text / json / sarif) and baseline suppression.
+
+Formats:
+
+* ``text`` — human console output, one finding + hint per entry, with a
+  summary/timing footer.
+* ``json`` — the machine form CI scripts consume.
+* ``sarif`` — SARIF 2.1.0, the interchange format code-scanning UIs
+  (GitHub code scanning among them) ingest, so ``kat-lint --format
+  sarif`` plugs into the same annotation pipeline as any other analyzer.
+
+Baseline (``.kat-baseline.json``): pre-existing findings recorded as
+line-independent fingerprints with per-fingerprint counts.  A run
+suppresses up to the recorded count per fingerprint, reports the rest,
+and exits by the *unsuppressed* set — so an old tree can adopt a new rule
+family immediately and burn the debt down incrementally without the gate
+going blind to fresh violations of the same rule.
+"""
 from __future__ import annotations
 
 import json
-from typing import List, Sequence
+from collections import Counter
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from .core import Finding, Project
 
+BASELINE_VERSION = 1
 
-def render_text(project: Project, findings: Sequence[Finding]) -> str:
-    lines: List[str] = [f.format() for f in findings]
+
+# ---------------------------------------------------------------------------
+# baseline suppression
+
+def load_baseline(path: str) -> Dict[str, int]:
+    """fingerprint -> allowed count; {} when absent or unreadable."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    if data.get("version") != BASELINE_VERSION:
+        return {}
+    sup = data.get("suppressions")
+    if not isinstance(sup, dict):
+        return {}
+    out: Dict[str, int] = {}
+    for fp, entry in sup.items():
+        # tolerate hand-edited entries: a bare int means "count", and a
+        # malformed entry falls back to 1 (the file is user-maintained —
+        # the burn-down workflow must never crash the gate)
+        try:
+            out[fp] = int(entry.get("count", 1)) if isinstance(entry, dict) else int(entry)
+        except (TypeError, ValueError):
+            out[fp] = 1
+    return out
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    counts = Counter(f.fingerprint() for f in findings)
+    meta: Dict[str, dict] = {}
+    for f in findings:
+        fp = f.fingerprint()
+        meta.setdefault(fp, {
+            "rule": f.rule,
+            "path": f.path,
+            "message": f.message,
+            "count": counts[fp],
+        })
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": BASELINE_VERSION, "suppressions": meta}, fh, indent=2)
+        fh.write("\n")
+
+
+def apply_baseline(
+    findings: Sequence[Finding], allowed: Dict[str, int]
+) -> Tuple[List[Finding], int]:
+    """(unsuppressed findings, suppressed count).  Suppression is
+    count-bounded per fingerprint: the baseline forgives the recorded
+    occurrences, and the N+1th identical finding still fails the gate."""
+    budget = dict(allowed)
+    kept: List[Finding] = []
+    suppressed = 0
+    for f in findings:
+        fp = f.fingerprint()
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+            suppressed += 1
+        else:
+            kept.append(f)
+    return kept, suppressed
+
+
+# ---------------------------------------------------------------------------
+# rendering
+
+def _footer(
+    project: Project,
+    findings: Sequence[Finding],
+    suppressed: int,
+    wall_s: Optional[float],
+    cache_note: str,
+) -> str:
     n_err = sum(1 for f in findings if f.severity == "error")
     n_warn = len(findings) - n_err
     parsed = sum(1 for u in project.units if u.tree is not None)
-    summary = (
-        f"{len(findings)} finding(s) ({n_err} error(s), {n_warn} warning(s)) "
-        f"across {len(project.units)} file(s) ({parsed} parsed)"
-    )
-    if not findings:
+    if findings:
+        summary = (
+            f"{len(findings)} finding(s) ({n_err} error(s), {n_warn} warning(s)) "
+            f"across {len(project.units)} file(s) ({parsed} parsed)"
+        )
+    else:
         summary = f"clean: 0 findings across {len(project.units)} file(s) ({parsed} parsed)"
-    lines.append(summary)
+    if suppressed:
+        summary += f"; {suppressed} baseline-suppressed"
+    if wall_s is not None:
+        summary += f"; analysis wall time {wall_s:.2f}s"
+        if cache_note:
+            summary += f" ({cache_note})"
+    return summary
+
+
+def render_text(
+    project: Project,
+    findings: Sequence[Finding],
+    suppressed: int = 0,
+    wall_s: Optional[float] = None,
+    cache_note: str = "",
+) -> str:
+    lines: List[str] = [f.format() for f in findings]
+    lines.append(_footer(project, findings, suppressed, wall_s, cache_note))
     return "\n".join(lines)
 
 
-def render_json(project: Project, findings: Sequence[Finding]) -> str:
+def render_json(
+    project: Project,
+    findings: Sequence[Finding],
+    suppressed: int = 0,
+    wall_s: Optional[float] = None,
+    cache_note: str = "",
+) -> str:
+    payload = {
+        "files_scanned": len(project.units),
+        "files_parsed": sum(1 for u in project.units if u.tree is not None),
+        "suppressed": suppressed,
+        "findings": [
+            {
+                "rule": f.rule,
+                "severity": f.severity,
+                "path": f.path,
+                "line": f.line,
+                "message": f.message,
+                "hint": f.hint,
+                "fingerprint": f.fingerprint(),
+            }
+            for f in findings
+        ],
+    }
+    if wall_s is not None:
+        payload["wall_time_s"] = round(wall_s, 3)
+    return json.dumps(payload, indent=2)
+
+
+_SARIF_LEVEL = {"error": "error", "warning": "warning"}
+
+
+def render_sarif(
+    project: Project,
+    findings: Sequence[Finding],
+    suppressed: int = 0,
+    wall_s: Optional[float] = None,
+    cache_note: str = "",
+) -> str:
+    """SARIF 2.1.0 with one reportingDescriptor per rule id seen."""
+    rules_seen: Dict[str, dict] = {}
+    results = []
+    for f in findings:
+        rules_seen.setdefault(f.rule, {
+            "id": f.rule,
+            "defaultConfiguration": {"level": _SARIF_LEVEL.get(f.severity, "warning")},
+            **({"help": {"text": f.hint}} if f.hint else {}),
+        })
+        results.append({
+            "ruleId": f.rule,
+            "level": _SARIF_LEVEL.get(f.severity, "warning"),
+            "message": {"text": f.message + (f"\nhint: {f.hint}" if f.hint else "")},
+            "partialFingerprints": {"katFingerprint/v1": f.fingerprint()},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path.replace("\\", "/")},
+                    "region": {"startLine": max(1, f.line)},
+                }
+            }],
+        })
+    run = {
+        "tool": {
+            "driver": {
+                "name": "kat-lint",
+                "informationUri": "https://github.com/kube-arbitrator-tpu",
+                "rules": [rules_seen[k] for k in sorted(rules_seen)],
+            }
+        },
+        "results": results,
+        "properties": {
+            "filesScanned": len(project.units),
+            "suppressed": suppressed,
+            **({"wallTimeS": round(wall_s, 3)} if wall_s is not None else {}),
+        },
+    }
     return json.dumps(
         {
-            "files_scanned": len(project.units),
-            "files_parsed": sum(1 for u in project.units if u.tree is not None),
-            "findings": [
-                {
-                    "rule": f.rule,
-                    "severity": f.severity,
-                    "path": f.path,
-                    "line": f.line,
-                    "message": f.message,
-                    "hint": f.hint,
-                }
-                for f in findings
-            ],
+            "$schema": "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+            "version": "2.1.0",
+            "runs": [run],
         },
         indent=2,
     )
+
+
+RENDERERS = {"text": render_text, "json": render_json, "sarif": render_sarif}

@@ -1,5 +1,7 @@
 """Rule registry: one instance of every rule family, in report order."""
 from .drift import ConfigDriftRule
+from .dtypes import DtypeDisciplineRule
+from .locks import LockDisciplineRule
 from .purity import PurityRule
 from .retrace import RetraceRule
 from .syntax_gate import SyntaxGateRule
@@ -11,6 +13,8 @@ ALL_RULES = (
     PurityRule(),
     RetraceRule(),
     ConfigDriftRule(),
+    DtypeDisciplineRule(),
+    LockDisciplineRule(),
 )
 
 RULES_BY_FAMILY = {r.family: r for r in ALL_RULES}

@@ -1,0 +1,239 @@
+"""KAT-DTY — implicit dtype-promotion hazards crossing into jit kernels.
+
+Scope: kernel-context functions (jit-decorated, ACTION_KERNELS-registered,
+or same-module helpers they call — ``core.kernel_functions``), plus the
+module-level constants those kernels close over (the same-module dataflow
+half: a ``np.float64`` array bound at module scope is only a hazard once a
+kernel references it).
+
+The decision plane is float32/int32 by contract
+(``analysis/contracts.py``).  With x64 disabled JAX silently *washes*
+float64 operands to float32 inside a trace, so none of these raise — they
+skew magnitudes (a 64-bit-only constant becomes ``inf``), change
+comparison results, or flip tie-breaks, which corrupts *decisions*
+rather than crashing.  Exactly the silent-failure class Gavel-style
+heterogeneity schedulers document for mis-scaled resource tensors.
+
+- KAT-DTY-001: a ``np.float64`` value crossing into a kernel — a
+  module-level numpy constant built with ``dtype=np.float64`` (or with
+  numpy's float64 default: ``np.array([1.0, ...])``, ``np.zeros(n)``
+  with no dtype) referenced inside a kernel, a ``np.float64(...)`` /
+  ``dtype=np.float64`` spelled directly in a kernel body, or a float64
+  default value on a kernel parameter.
+- KAT-DTY-002: bool→arithmetic without an explicit cast: ``+``/``-``/
+  ``*`` where an operand is syntactically a comparison (or ``~``-negated
+  comparison).  Promotion makes it "work", but the intent (count? mask?)
+  is invisible and weak-typing rules shift with backend/x64 config —
+  the repo idiom is ``mask.astype(jnp.int32)`` / ``jnp.where``.
+- KAT-DTY-003: an x64-dependent literal in kernel context: a float
+  constant beyond float32 range (becomes ``inf`` when washed) or an int
+  constant beyond int32 range (wraps/raises depending on path).  Use
+  ``ops.common.BIG`` (3.0e38, a legal f32) for sentinel comparisons.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Set
+
+from ..core import (
+    Finding,
+    ModuleUnit,
+    Project,
+    Rule,
+    dotted_name,
+    kernel_functions,
+)
+
+F32_MAX = 3.4028235e38
+I32_MAX = 2**31 - 1
+
+# numpy constructors whose default dtype is float64 when fed floats
+_NP_FLOAT_DEFAULT = {"array", "asarray", "zeros", "ones", "full", "empty",
+                     "arange", "linspace", "eye"}
+
+
+def _has_float64_dtype_kw(call: ast.Call, np_aliases: Set[str]) -> bool:
+    """dtype=np.float64 / dtype="float64" / dtype=float on a call."""
+    for kw in call.keywords:
+        if kw.arg != "dtype":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and v.value in ("float64", "int64"):
+            return True
+        dn = dotted_name(v)
+        if dn in ("float",) or dn.split(".")[-1] in ("float64", "int64", "double"):
+            if "." not in dn or dn.split(".")[0] in np_aliases:
+                return True
+    return False
+
+
+def _has_dtype_kw(call: ast.Call) -> bool:
+    return any(kw.arg == "dtype" for kw in call.keywords)
+
+
+def _contains_float_literal(node: ast.AST) -> bool:
+    return any(
+        isinstance(sub, ast.Constant) and isinstance(sub.value, float)
+        for sub in ast.walk(node)
+    )
+
+
+def _is_f64_expr(node: ast.AST, np_aliases: Set[str]) -> bool:
+    """Syntactically produces a float64 numpy value: ``np.float64(...)``,
+    a float-defaulting constructor without dtype, or any constructor with
+    an explicit 64-bit dtype kw."""
+    if not isinstance(node, ast.Call):
+        return False
+    dn = dotted_name(node.func)
+    if not dn:
+        return False
+    root, leaf = dn.split(".")[0], dn.split(".")[-1]
+    if root not in np_aliases:
+        return False
+    if leaf in ("float64", "double"):
+        return True
+    if leaf not in _NP_FLOAT_DEFAULT:
+        return False
+    if _has_float64_dtype_kw(node, np_aliases):
+        return True
+    if _has_dtype_kw(node):
+        return False  # explicit non-64 dtype: the cast is the fix
+    # no dtype kw: float64 by numpy default for zeros/ones/empty, and for
+    # array/asarray/full when the payload carries a float literal
+    if leaf in ("zeros", "ones", "empty", "linspace"):
+        return True
+    return _contains_float_literal(node)
+
+
+def _module_f64_constants(unit: ModuleUnit) -> Dict[str, int]:
+    """Module-level names bound to a float64-producing numpy expression
+    (name -> lineno of the binding)."""
+    out: Dict[str, int] = {}
+    for node in unit.tree.body:
+        value = None
+        targets = []
+        if isinstance(node, ast.Assign):
+            value, targets = node.value, node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            value, targets = node.value, [node.target]
+        if value is None or not _is_f64_expr(value, unit.np_aliases):
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = node.lineno
+    return out
+
+
+def _is_compare_like(node: ast.AST) -> bool:
+    if isinstance(node, ast.Compare):
+        return True
+    return (
+        isinstance(node, ast.UnaryOp)
+        and isinstance(node.op, ast.Not)
+        and isinstance(node.operand, ast.Compare)
+    )
+
+
+class DtypeDisciplineRule(Rule):
+    family = "KAT-DTY"
+    name = "dtype promotion discipline"
+    applies_to_tests = True  # a jit fixture downcasts the same way
+
+    def check(self, unit: ModuleUnit, project: Project) -> Iterator[Finding]:
+        if unit.tree is None:
+            return
+        kernels = kernel_functions(unit, project)
+        if not kernels:
+            return
+        f64_names = _module_f64_constants(unit)
+        for fn in kernels:
+            yield from self._check_kernel(fn, unit, f64_names)
+
+    def _check_kernel(
+        self, fn: ast.AST, unit: ModuleUnit, f64_names: Dict[str, int]
+    ) -> Iterator[Finding]:
+        kname = getattr(fn, "name", "<lambda>")
+        # parameter defaults are evaluated host-side and baked into the
+        # trace — a float64 default crosses the boundary on every call
+        defaults = list(fn.args.defaults) + [
+            d for d in fn.args.kw_defaults if d is not None
+        ]
+        default_nodes = {id(s) for d in defaults for s in ast.walk(d)}
+        for default in defaults:
+            if _is_f64_expr(default, unit.np_aliases):
+                yield Finding(
+                    "KAT-DTY-001", "error", unit.rel, default.lineno,
+                    f"float64 default value crosses into jit kernel "
+                    f"`{kname}` (`{ast.unparse(default)}`)",
+                    hint="give the default an explicit 32-bit dtype "
+                    "(dtype=np.float32) — with x64 disabled the trace "
+                    "silently downcasts it, so host-side math and the "
+                    "kernel disagree about the same constant",
+                )
+        for node in ast.walk(fn):
+            if id(node) in default_nodes:
+                continue  # defaults were checked (once) above
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                if node.id in f64_names:
+                    yield Finding(
+                        "KAT-DTY-001", "error", unit.rel, node.lineno,
+                        f"module constant `{node.id}` (float64, bound at "
+                        f"line {f64_names[node.id]}) crosses into jit "
+                        f"kernel `{kname}` without an explicit cast",
+                        hint="cast at the boundary "
+                        f"(`jnp.asarray({node.id}, jnp.float32)`) or give "
+                        "the constant an explicit 32-bit dtype; the "
+                        "silent downcast skews every comparison against "
+                        "device-side float32 values",
+                    )
+            elif isinstance(node, ast.Call) and _is_f64_expr(node, unit.np_aliases):
+                yield Finding(
+                    "KAT-DTY-001", "error", unit.rel, node.lineno,
+                    f"float64-producing numpy expression inside jit "
+                    f"kernel `{kname}` (`{ast.unparse(node)[:60]}`)",
+                    hint="spell the device dtype explicitly "
+                    "(dtype=np.float32 / use jnp) — numpy defaults to "
+                    "float64 and the trace washes it back, so the "
+                    "spelled precision is a lie",
+                )
+            elif isinstance(node, ast.BinOp) and isinstance(
+                node.op, (ast.Add, ast.Sub, ast.Mult)
+            ):
+                for side in (node.left, node.right):
+                    if _is_compare_like(side):
+                        op = type(node.op).__name__.lower()
+                        yield Finding(
+                            "KAT-DTY-002", "error", unit.rel, node.lineno,
+                            f"bool comparison used directly in `{op}` "
+                            f"arithmetic inside jit kernel `{kname}` "
+                            f"(`{ast.unparse(node)[:60]}`)",
+                            hint="cast the mask explicitly "
+                            "(`(cond).astype(jnp.int32)`) or use "
+                            "jnp.where — implicit bool promotion hides "
+                            "whether this counts or masks, and the "
+                            "promotion rules depend on x64 config",
+                        )
+                        break
+            elif isinstance(node, ast.Constant) and isinstance(
+                node.value, (int, float)
+            ) and not isinstance(node.value, bool):
+                v = node.value
+                if isinstance(v, float) and abs(v) > F32_MAX:
+                    yield Finding(
+                        "KAT-DTY-003", "error", unit.rel, node.lineno,
+                        f"float literal {v!r} exceeds float32 range "
+                        f"inside jit kernel `{kname}` — it becomes inf "
+                        "when the trace washes it to f32",
+                        hint="use ops.common.BIG (3.0e38, a legal f32 "
+                        "sentinel) or jnp.inf if infinity is the intent",
+                    )
+                elif isinstance(v, int) and abs(v) > I32_MAX:
+                    yield Finding(
+                        "KAT-DTY-003", "error", unit.rel, node.lineno,
+                        f"int literal {v!r} exceeds int32 range inside "
+                        f"jit kernel `{kname}` — with x64 disabled the "
+                        "traced value wraps or overflows",
+                        hint="stay within int32, or restructure (bit "
+                        "masks over MAX_PORT_WORDS words is the repo's "
+                        "pattern for wide sets)",
+                    )

@@ -1,0 +1,290 @@
+"""KAT-LCK — lock discipline on the threaded planes.
+
+The decision plane is single-threaded by design, but four modules run
+real threads: the HTTP apiserver shim (``cache/httpapi.py``, a
+ThreadingHTTPServer), the gRPC decision sidecar (``rpc/sidecar.py``, a
+ThreadPoolExecutor of handlers), the live-plane pump driven under them,
+and leader election (``framework/leader.py``).  Two discipline rules keep
+those honest, both *syntactic within one class* (presence of a finding is
+near-certain; absence proves nothing):
+
+- KAT-LCK-001: an instance field written under a ``threading.Lock`` /
+  ``RLock`` / ``Condition`` held via ``with self.<lock>:`` in one method
+  is read (or written) bare in another method of the same class.  A field
+  the class bothers to guard anywhere is shared state everywhere —
+  a bare read sees torn/stale values on free-threaded builds and is a
+  data race on any build.  ``__init__`` is construction-time and exempt;
+  methods named ``*_locked`` declare "caller holds the lock" and are
+  exempt (the helper convention).
+- KAT-LCK-002: a device-blocking or network-blocking call while a lock
+  is held (any ``with`` over an expression whose name mentions "lock"):
+  ``block_until_ready`` (device sync — unbounded when the accelerator is
+  wedged), RPC sends (``Decide``/``urlopen``/``send``/``sendall``),
+  ``sleep``, ``serve_forever``, ``wait_for_termination``,
+  ``acquire_blocking``.  A lock held across one of these turns every
+  other thread's bounded critical section into an unbounded stall — the
+  leader's renew loop racing its deadline is the concrete casualty
+  (``cache/httpapi.py`` keeps socket I/O outside the store lock for
+  exactly this reason).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set, Tuple
+
+from ..core import Finding, FunctionNode, ModuleUnit, Project, Rule, dotted_name
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+
+# calls that block unboundedly (device sync, network, sleep)
+_BLOCKING_CALLS = {
+    "block_until_ready", "sleep", "urlopen", "serve_forever",
+    "wait_for_termination", "acquire_blocking", "send", "sendall",
+    "recv", "Decide",
+}
+
+
+def _is_lock_factory(call: ast.AST) -> bool:
+    if not isinstance(call, ast.Call):
+        return False
+    dn = dotted_name(call.func)
+    return bool(dn) and dn.split(".")[-1] in _LOCK_FACTORIES
+
+
+def _self_attr(node: ast.AST) -> str:
+    """'x' for a bare ``self.x`` attribute node, '' otherwise."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return ""
+
+
+def _lockish_with_item(item: ast.withitem) -> bool:
+    """True when the with-expression reads like lock acquisition: the
+    dotted name of the expression (or call target) mentions 'lock'."""
+    expr = item.context_expr
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    dn = dotted_name(expr).lower()
+    return "lock" in dn or "mutex" in dn
+
+
+class _MethodScan:
+    """Per-method field accesses, split by whether a class lock was held."""
+
+    def __init__(self, cls_locks: Set[str]):
+        self.cls_locks = cls_locks
+        self.guarded_writes: List[Tuple[str, int]] = []
+        self.guarded_reads: List[Tuple[str, int]] = []
+        self.bare_writes: List[Tuple[str, int]] = []
+        self.bare_reads: List[Tuple[str, int]] = []
+        # (call name, line, lock expr) of blocking calls under ANY lock
+        self.blocking_under_lock: List[Tuple[str, int, str]] = []
+
+    def scan(self, fn: ast.AST) -> None:
+        self._walk(fn.body, held=False)
+
+    # structured walk: ast.walk has no scope, so recurse manually and
+    # carry the held-lock flag through with-bodies
+    def _walk(self, stmts, held: bool) -> None:
+        for stmt in stmts:
+            self._stmt(stmt, held)
+
+    def _stmt(self, stmt: ast.stmt, held: bool) -> None:
+        if isinstance(stmt, ast.With) or isinstance(stmt, ast.AsyncWith):
+            takes_class_lock = any(
+                _self_attr(i.context_expr) in self.cls_locks for i in stmt.items
+            )
+            takes_any_lock = takes_class_lock or any(
+                _lockish_with_item(i) for i in stmt.items
+            )
+            for i in stmt.items:
+                self._expr(i.context_expr, held)
+            self._walk(stmt.body, held or takes_class_lock)
+            if takes_any_lock:
+                self._note_blocking(stmt.body, stmt.items)
+            return
+        if isinstance(stmt, FunctionNode):
+            return  # nested defs run later, with their own discipline
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                self._target(t, held)
+            self._expr(stmt.value, held)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._target(stmt.target, held)
+            # an augmented write is also a read of the same field
+            self._record(stmt.target, held, write=False)
+            self._expr(stmt.value, held)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            self._target(stmt.target, held)
+            if stmt.value is not None:
+                self._expr(stmt.value, held)
+            return
+        # generic: record reads in all child expressions, recurse bodies
+        for field in ("test", "value", "exc", "iter", "msg"):
+            v = getattr(stmt, field, None)
+            if isinstance(v, ast.expr):
+                self._expr(v, held)
+        if isinstance(stmt, ast.For):
+            self._target(stmt.target, held)
+        for field in ("body", "orelse", "finalbody"):
+            v = getattr(stmt, field, None)
+            if isinstance(v, list):
+                self._walk(v, held)
+        for h in getattr(stmt, "handlers", ()):
+            self._walk(h.body, held)
+
+    def _target(self, t: ast.AST, held: bool) -> None:
+        # self.x = / self.x[...] = / self.x.y = : all write field x
+        base = t
+        while isinstance(base, ast.Subscript):
+            self._expr(base.slice, held)
+            base = base.value
+        name = _self_attr(base)
+        if name:
+            self._record_name(name, base.lineno, held, write=True)
+            return
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._target(e, held)
+            return
+        self._expr(t, held)
+
+    def _record(self, node: ast.AST, held: bool, write: bool) -> None:
+        name = _self_attr(node)
+        if name:
+            self._record_name(name, node.lineno, held, write)
+
+    def _record_name(self, name: str, line: int, held: bool, write: bool) -> None:
+        if name in self.cls_locks:
+            return
+        bucket = (
+            (self.guarded_writes if write else self.guarded_reads)
+            if held
+            else (self.bare_writes if write else self.bare_reads)
+        )
+        bucket.append((name, line))
+
+    def _expr(self, e: ast.AST, held: bool) -> None:
+        for sub in ast.walk(e):
+            name = _self_attr(sub)
+            if name and isinstance(sub.ctx, ast.Load):
+                self._record_name(name, sub.lineno, held, write=False)
+
+    def _note_blocking(self, body, items) -> None:
+        lock_desc = ", ".join(ast.unparse(i.context_expr) for i in items)
+        for stmt in body:
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Call):
+                    continue
+                dn = dotted_name(sub.func)
+                leaf = dn.split(".")[-1] if dn else ""
+                if leaf in _BLOCKING_CALLS:
+                    self.blocking_under_lock.append((leaf, sub.lineno, lock_desc))
+
+
+class LockDisciplineRule(Rule):
+    family = "KAT-LCK"
+    name = "lock discipline (threaded planes)"
+    # tests spin threads against fixtures deliberately and serialize via
+    # joins; the discipline is a production-plane contract
+    applies_to_tests = False
+
+    def check(self, unit: ModuleUnit, project: Project) -> Iterator[Finding]:
+        if unit.tree is None:
+            return
+        for node in ast.walk(unit.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(node, unit)
+        # module-level lock regions (e.g. a handler function taking a
+        # server-wide lock) still get the blocking-call check
+        yield from self._module_level_blocking(unit)
+
+    def _check_class(self, cls: ast.ClassDef, unit: ModuleUnit) -> Iterator[Finding]:
+        methods = [n for n in cls.body if isinstance(n, FunctionNode)]
+        locks: Set[str] = set()
+        for m in methods:
+            for node in ast.walk(m):
+                if isinstance(node, ast.Assign) and _is_lock_factory(node.value):
+                    for t in node.targets:
+                        name = _self_attr(t)
+                        if name:
+                            locks.add(name)
+        scans: Dict[str, _MethodScan] = {}
+        for m in methods:
+            scan = _MethodScan(locks)
+            scan.scan(m)
+            scans[m.name] = scan
+
+        # LCK-002 applies even to lock-free classes (a method may take a
+        # foreign lock); LCK-001 needs class locks to define "guarded"
+        for mname, scan in scans.items():
+            for call, line, lock_desc in scan.blocking_under_lock:
+                yield Finding(
+                    "KAT-LCK-002", "error", unit.rel, line,
+                    f"`{call}` called while holding `{lock_desc}` in "
+                    f"`{cls.name}.{mname}` — a blocking call under a lock "
+                    "stalls every other thread's critical section "
+                    "unboundedly (wedged device / slow peer)",
+                    hint="compute under the lock, block outside it: copy "
+                    "what you need inside the critical section, release, "
+                    "then sync/send (cache/httpapi.py keeps socket I/O "
+                    "outside the store lock the same way)",
+                )
+        if not locks:
+            return
+
+        guarded: Dict[str, Tuple[str, int]] = {}  # field -> first guarded write
+        for mname, scan in scans.items():
+            if mname in ("__init__", "__new__"):
+                continue
+            for field, line in scan.guarded_writes:
+                guarded.setdefault(field, (mname, line))
+        for mname, scan in scans.items():
+            if mname in ("__init__", "__new__") or mname.endswith("_locked"):
+                continue
+            for kind, accesses in (("read", scan.bare_reads), ("written", scan.bare_writes)):
+                for field, line in accesses:
+                    if field not in guarded:
+                        continue
+                    gm, gl = guarded[field]
+                    yield Finding(
+                        "KAT-LCK-001", "error", unit.rel, line,
+                        f"`self.{field}` {kind} without the lock in "
+                        f"`{cls.name}.{mname}`, but written under a lock "
+                        f"in `{gm}` (line {gl})",
+                        hint="take the same lock here (or rename the "
+                        "method `*_locked` if every caller already holds "
+                        "it) — a field guarded anywhere is shared state "
+                        "everywhere, and a bare access is a data race",
+                    )
+
+    def _module_level_blocking(self, unit: ModuleUnit) -> Iterator[Finding]:
+        # functions OUTSIDE classes holding a lockish `with` over a
+        # blocking call (class methods are covered in _check_class)
+        class_funcs = {
+            id(n)
+            for cls in ast.walk(unit.tree)
+            if isinstance(cls, ast.ClassDef)
+            for n in cls.body
+            if isinstance(n, FunctionNode)
+        }
+        for node in ast.walk(unit.tree):
+            if not isinstance(node, FunctionNode) or id(node) in class_funcs:
+                continue
+            scan = _MethodScan(set())
+            scan.scan(node)
+            for call, line, lock_desc in scan.blocking_under_lock:
+                yield Finding(
+                    "KAT-LCK-002", "error", unit.rel, line,
+                    f"`{call}` called while holding `{lock_desc}` in "
+                    f"`{node.name}` — a blocking call under a lock stalls "
+                    "every waiter unboundedly",
+                    hint="block outside the critical section; copy state "
+                    "under the lock, release, then sync/send",
+                )

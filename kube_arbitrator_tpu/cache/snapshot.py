@@ -40,9 +40,24 @@ from ..api.types import TaskStatus
 # Device-side units per resource axis: cpu milli (x1), memory bytes -> MiB,
 # gpu milli (x1), volume attachments (x100 so the uniform epsilon is a
 # tenth of a volume).
+#
+# Deliberately float64: host-side byte counts (Ti-scale memory) exceed
+# float32's 24-bit integer precision, so the scaling must happen in f64.
+# The result may NOT cross to the device at that width — the kernels are
+# float32 by contract and would silently downcast it, skewing decisions
+# without an error — so :func:`to_device_units` casts explicitly at the
+# crossover.  Two guards pin the boundary: row-assigned fields are
+# STRUCTURALLY pinned by their preallocated ``DEVICE_DTYPE`` buffers
+# (numpy row stores downcast into the buffer's dtype), and the
+# directly-constructed fields (others_used, the reclaim pack, class/
+# affinity tables) are checked by :func:`build_snapshot`'s pack assert
+# against the declared schema (analysis/contracts.py) before the pack
+# leaves this module.
 DEVICE_SCALE = np.array(
     [1.0, 1.0 / (1024.0 * 1024.0), 1.0, 100.0], dtype=np.float64
 )
+# The device-side dtype every float tensor crosses over to.
+DEVICE_DTYPE = np.float32
 # In device units the epsilon is uniform (10m cpu / 10MiB / 10m gpu / 0.1 vol).
 DEVICE_EPSILON = 10.0
 
@@ -100,7 +115,31 @@ def _bucket(n: int, multiple: int, minimum: int, key: str = "") -> int:
 
 
 def to_device_units(vec_bytes: np.ndarray) -> np.ndarray:
-    return (vec_bytes * DEVICE_SCALE).astype(np.float32)
+    """Host-unit resource vector -> device units.  The multiply runs in
+    float64 (byte counts need it); the cast is the explicit host->device
+    dtype crossover — keep it here and nowhere else."""
+    return (vec_bytes * DEVICE_SCALE).astype(DEVICE_DTYPE)
+
+
+def _assert_pack_dtypes(tensors: "SnapshotTensors") -> None:
+    """Fail fast if any produced tensor's dtype drifts from the declared
+    contract (analysis/contracts.py SNAPSHOT_SCHEMA).  A float64/int64
+    leak here would not raise downstream — the jit kernels silently
+    downcast it and decisions skew — so the producer asserts at pack
+    build time.  Row-assigned fields cannot trip this (their preallocated
+    buffers pin the dtype structurally); the teeth are for the
+    directly-constructed fields.  ~60 dtype compares per cycle, noise."""
+    from ..analysis.contracts import SNAPSHOT_SCHEMA  # no cycle: lazy both ways
+
+    for name, (_shape, dtype) in SNAPSHOT_SCHEMA.items():
+        got = np.dtype(getattr(tensors, name).dtype)
+        if got != np.dtype(dtype):
+            raise TypeError(
+                f"snapshot pack dtype contract violation: {name} built as "
+                f"{got}, contract (analysis/contracts.py) says {dtype} — "
+                "cast at the producer (to_device_units / an explicit "
+                "dtype= on the array constructor)"
+            )
 
 
 @jax.tree_util.register_dataclass
@@ -848,5 +887,6 @@ def build_snapshot(cluster: ClusterInfo) -> Snapshot:
             task_priority, task_uid_rank, job_queue, N,
         ),
     )
+    _assert_pack_dtypes(tensors)
     index = SnapshotIndex(tasks=tasks, nodes=nodes, jobs=jobs, queues=queues, port_universe=universe)
     return Snapshot(tensors=tensors, index=index)

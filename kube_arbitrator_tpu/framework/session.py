@@ -31,6 +31,22 @@ MAX_EXPLAINED_JOBS = 100
 from .conf import SchedulerConfig
 
 
+def _assert_decision_dtypes(dec: CycleDecisions) -> None:
+    """Decisions-side twin of cache/snapshot.py's pack assert: every
+    tensor the actuation decode consumes must carry the declared dtype
+    (analysis/contracts.py DECISIONS_SCHEMA).  ~9 dtype compares/cycle."""
+    from ..analysis.contracts import DECISIONS_SCHEMA  # lazy: no cycle
+
+    for name, (_shape, dtype) in DECISIONS_SCHEMA.items():
+        got = np.dtype(getattr(dec, name).dtype)
+        if got != np.dtype(dtype):
+            raise TypeError(
+                f"decision contract violation: {name} arrived as {got}, "
+                f"contract (analysis/contracts.py) says {dtype} — the "
+                "decision program or the RPC codec drifted"
+            )
+
+
 @dataclasses.dataclass
 class PodGroupCondition:
     """v1alpha1.PodGroupCondition equivalent (types.go:41-45)."""
@@ -106,6 +122,12 @@ class Session:
         # own); remote transport overhead is the decide-wall minus it
         dec, kernel_ms = decider.decide(snap.tensors, self.config)
         t2 = time.perf_counter()
+        # Decisions may have crossed an RPC codec (RemoteDecider): hold
+        # them to the same declared contract the producer side asserts
+        # (cache/snapshot.py _assert_pack_dtypes) before decoding them
+        # into binds/evicts — a drifted dtype here corrupts actuation
+        # host-side without raising.
+        _assert_decision_dtypes(dec)
         binds, evicts = decode_decisions(snap, dec)
         t3 = time.perf_counter()
         job_status = self._close(snap, dec)

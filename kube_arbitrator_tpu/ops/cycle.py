@@ -37,7 +37,11 @@ from .preempt import preempt_action, reclaim_action
 # adds custom actions here; the conf loader validates against these keys.
 # Entries double as the static analyzer's kernel roots: every function
 # named here (plus same-module helpers it calls) is linted under the
-# KAT-TRC/KAT-PUR jit-kernel rules even without a jit decorator.
+# KAT-TRC/KAT-PUR jit-kernel rules even without a jit decorator, and the
+# KAT-CTR contract pass abstractly evaluates every entry under
+# jax.eval_shape against the declared snapshot/state schemas
+# (analysis/contracts.py) — a registered kernel must accept the previous
+# stage's AllocState and return exactly the contract the next one reads.
 ACTION_KERNELS = {
     "allocate": allocate_action,
     "backfill": backfill_action,
@@ -100,14 +104,19 @@ def open_session(st: SnapshotTensors, tiers: Tiers) -> Tuple[SessionCtx, AllocSt
     valid_now = (ready_now | ((st.task_status == int(TaskStatus.PENDING)) & tv))
     pending_now = (st.task_status == int(TaskStatus.PENDING)) & tv
 
+    # Accumulator dtypes are SPELLED, not defaulted: these arrays seed
+    # AllocState and the contract pass (analysis/contracts.py
+    # STATE_SCHEMA) holds every kernel to f32/i32 — a default-dtype drift
+    # here (e.g. under an x64 config flip) would otherwise re-promote the
+    # whole pipeline silently.
     res_or_0 = lambda m: jnp.where(m[:, None], st.task_resreq, 0.0)
-    job_alloc = jnp.zeros((J, R)).at[st.task_job].add(res_or_0(alloc_now))
-    job_req = jnp.zeros((J, R)).at[st.task_job].add(res_or_0(alloc_now | pending_now))
+    job_alloc = jnp.zeros((J, R), jnp.float32).at[st.task_job].add(res_or_0(alloc_now))
+    job_req = jnp.zeros((J, R), jnp.float32).at[st.task_job].add(res_or_0(alloc_now | pending_now))
     job_ready_cnt = jnp.zeros(J, jnp.int32).at[st.task_job].add(ready_now.astype(jnp.int32))
     job_valid_cnt = jnp.zeros(J, jnp.int32).at[st.task_job].add(valid_now.astype(jnp.int32))
 
-    queue_alloc = jnp.zeros((Q, R)).at[st.job_queue].add(jnp.where(st.job_valid[:, None], job_alloc, 0.0))
-    queue_req = jnp.zeros((Q, R)).at[st.job_queue].add(jnp.where(st.job_valid[:, None], job_req, 0.0))
+    queue_alloc = jnp.zeros((Q, R), jnp.float32).at[st.job_queue].add(jnp.where(st.job_valid[:, None], job_alloc, 0.0))
+    queue_req = jnp.zeros((Q, R), jnp.float32).at[st.job_queue].add(jnp.where(st.job_valid[:, None], job_req, 0.0))
 
     gang_ready_on = any(
         p.name == "gang" and not p.job_ready_disabled for t in tiers for p in t.plugins
@@ -134,7 +143,7 @@ def open_session(st: SnapshotTensors, tiers: Tiers) -> Tuple[SessionCtx, AllocSt
     # sequential lockstep share growth (fairness.
     # drf_equilibrium_levels_per_job; round-4 shortfall diagnosis).
     job_pending_cnt = jnp.zeros(J, jnp.int32).at[st.task_job].add(pending_now.astype(jnp.int32))
-    job_pending_req = jnp.zeros((J, R)).at[st.task_job].add(res_or_0(pending_now))
+    job_pending_req = jnp.zeros((J, R), jnp.float32).at[st.task_job].add(res_or_0(pending_now))
     mean_req = job_pending_req / jnp.maximum(job_pending_cnt, 1)[:, None]
     job_share0 = drf_shares(job_alloc, drf_total)
     job_delta = jnp.max(safe_share(fair(mean_req), fair(drf_total)[None, :]), axis=-1)

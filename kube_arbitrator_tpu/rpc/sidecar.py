@@ -15,6 +15,7 @@ classes, so no grpc_tools stub generation is needed at build time.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from concurrent import futures
 from typing import Dict, Optional, Tuple
@@ -39,19 +40,29 @@ class DecisionService:
     """Implements DecisionPlane against the local jax backend."""
 
     def __init__(self):
+        # grpc.server runs handlers on a ThreadPoolExecutor, so Decide and
+        # Health race: the counter and the conf cache are shared state and
+        # every access takes _lock (KAT-LCK discipline: the lock guards
+        # ONLY dict/int ops — the blocking schedule_cycle/block_until_ready
+        # work stays outside the critical section)
+        self._lock = threading.Lock()
         self.cycles_served = 0
         # conf YAML -> parsed (actions, tiers); jax caches the compiled
         # program per (conf, shape-bucket) under its own jit cache
         self._conf_cache: Dict[str, Tuple] = {}
 
     def _config(self, conf_yaml: str):
-        cached = self._conf_cache.get(conf_yaml)
+        with self._lock:
+            cached = self._conf_cache.get(conf_yaml)
         if cached is None:
             from ..framework.conf import SchedulerConfig, load_conf
 
+            # parse outside the lock (YAML load is slow); a racing
+            # duplicate parse is idempotent and last-write-wins is fine
             cfg = load_conf(conf_yaml) if conf_yaml.strip() else SchedulerConfig.default()
             cached = (cfg.actions, cfg.tiers)
-            self._conf_cache[conf_yaml] = cached
+            with self._lock:
+                self._conf_cache[conf_yaml] = cached
         return cached
 
     def Decide(self, request: "pb.SnapshotRequest", context) -> "pb.DecideReply":
@@ -86,17 +97,22 @@ class DecisionService:
             )
             dec.task_node.block_until_ready()
         kernel_ms = (time.perf_counter() - t0) * 1000
-        self.cycles_served += 1
+        # block_until_ready above MUST stay outside this lock (KAT-LCK-002:
+        # a wedged device would stall every concurrent handler)
+        with self._lock:
+            self.cycles_served += 1
         return decide_reply(dec, cycle=request.cycle, kernel_ms=kernel_ms)
 
     def Health(self, request: "pb.HealthRequest", context) -> "pb.HealthReply":
         import jax
 
         devices = jax.devices()
+        with self._lock:
+            served = self.cycles_served
         return pb.HealthReply(
             platform=devices[0].platform if devices else "none",
             device_count=len(devices),
-            cycles_served=self.cycles_served,
+            cycles_served=served,
         )
 
 

@@ -271,6 +271,200 @@ def test_drf_clean_when_routed_through_the_seam(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# KAT-DTY — dtype promotion discipline
+
+
+def test_dty_flags_f64_constant_default_and_literal(tmp_path):
+    findings = run_on(
+        tmp_path,
+        "kern.py",
+        """
+        import jax
+        import numpy as np
+
+        SCALE = np.array([1.0, 2.0])          # float64 by default
+
+        @jax.jit
+        def kern(x, eps=np.float64(10.0)):     # DTY-001 (default)
+            y = x * SCALE                      # DTY-001 (module constant)
+            z = np.zeros(4)                    # DTY-001 (f64 in body)
+            return y + z + eps
+        """,
+    )
+    assert rule_ids(findings) == {"KAT-DTY-001"}
+    assert len(findings) == 3
+
+
+def test_dty_flags_bool_arithmetic_and_x64_literals(tmp_path):
+    findings = run_on(
+        tmp_path,
+        "kern.py",
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kern(x):
+            n = (x > 0) * 3              # DTY-002
+            big = jnp.where(x > 1e39, 0.0, x)   # DTY-003 (inf when f32)
+            wide = x + 4_000_000_000     # DTY-003 (int32 overflow)
+            return n + big + wide
+        """,
+    )
+    assert rule_ids(findings) == {"KAT-DTY-002", "KAT-DTY-003"}
+    assert sum(1 for f in findings if f.rule == "KAT-DTY-003") == 2
+
+
+def test_dty_explicit_casts_and_host_constants_are_clean(tmp_path):
+    # the repo idiom: explicit dtypes at the boundary, f64 module math
+    # that never enters a kernel, masks cast before arithmetic
+    findings = run_on(
+        tmp_path,
+        "mod.py",
+        """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        HOST_SCALE = np.array([1.0, 2.0])      # f64, host-side only
+        DEV_SCALE = np.array([1.0, 2.0], dtype=np.float32)
+
+        def to_device_units(v):
+            return (v * HOST_SCALE).astype(np.float32)
+
+        @jax.jit
+        def kern(x, mask):
+            counted = mask.astype(jnp.int32) * 3
+            y = x * DEV_SCALE
+            return jnp.where(y > 3.0e38, 0.0, y) + counted
+        """,
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# KAT-LCK — lock discipline
+
+
+def test_lck_flags_bare_read_of_guarded_field(tmp_path):
+    findings = run_on(
+        tmp_path,
+        "svc.py",
+        """
+        import threading
+
+        class Service:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def bump(self):
+                with self._lock:
+                    self.count += 1
+
+            def peek(self):
+                return self.count        # LCK-001: bare read
+        """,
+    )
+    assert rule_ids(findings) == {"KAT-LCK-001"}
+    assert "peek" in findings[0].message
+
+
+def test_lck_flags_blocking_call_under_lock(tmp_path):
+    findings = run_on(
+        tmp_path,
+        "svc.py",
+        """
+        import threading
+
+        class Service:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.last = None
+
+            def decide(self, dec):
+                with self._lock:
+                    dec.task_node.block_until_ready()   # LCK-002
+                    self.last = dec
+        """,
+    )
+    assert rule_ids(findings) == {"KAT-LCK-002"}
+    assert "block_until_ready" in findings[0].message
+
+
+def test_lck_disciplined_class_and_locked_helpers_are_clean(tmp_path):
+    findings = run_on(
+        tmp_path,
+        "svc.py",
+        """
+        import threading
+
+        class Service:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def bump(self):
+                with self._lock:
+                    self.count += 1
+                    self._note_locked()
+
+            def _note_locked(self):
+                self.count += 0          # caller holds the lock
+
+            def snapshot(self):
+                with self._lock:
+                    n = self.count
+                # blocking work OUTSIDE the critical section is the idiom
+                import time
+                time.sleep(0)
+                return n
+        """,
+    )
+    assert findings == []
+
+
+def test_lck_module_level_lock_blocking_call(tmp_path):
+    findings = run_on(
+        tmp_path,
+        "handler.py",
+        """
+        import threading
+        import urllib.request
+
+        def route(server, req):
+            lock = server.api_lock
+            with lock:
+                return urllib.request.urlopen(req)   # LCK-002
+        """,
+    )
+    assert rule_ids(findings) == {"KAT-LCK-002"}
+
+
+def test_lck_skips_test_files(tmp_path):
+    findings = run_on(
+        tmp_path,
+        "test_threads.py",
+        """
+        import threading
+
+        class Probe:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.seen = 0
+
+            def poke(self):
+                with self._lock:
+                    self.seen += 1
+
+            def check(self):
+                return self.seen
+        """,
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # integration: the real tree is clean, and the CLI contract holds
 
 
@@ -336,6 +530,135 @@ def test_cli_json_and_rule_filter(tmp_path):
         cwd=REPO, capture_output=True, text=True,
     )
     assert r_trc.returncode == 0
+
+
+def test_cli_sarif_format(tmp_path):
+    import json
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(:\n")
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "kube_arbitrator_tpu.analysis",
+            "--no-cache", "--format", "sarif", str(bad),
+        ],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert r.returncode == 1
+    sarif = json.loads(r.stdout)
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    assert run["tool"]["driver"]["name"] == "kat-lint"
+    assert run["results"][0]["ruleId"] == "KAT-SYN-001"
+    loc = run["results"][0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("bad.py")
+    assert loc["region"]["startLine"] == 1
+    assert run["results"][0]["partialFingerprints"]["katFingerprint/v1"]
+
+
+def test_cli_baseline_burn_down(tmp_path):
+    """The adoption workflow: record pre-existing findings, gate stays
+    green on them, and a NEW violation still fails the gate."""
+    src = tmp_path / "entry.py"
+    src.write_text(
+        "def decide(st, schedule_cycle):\n"
+        "    return schedule_cycle(st, native_ops=True)\n"
+    )
+    baseline = tmp_path / "kat-baseline.json"
+    cmd = [sys.executable, "-m", "kube_arbitrator_tpu.analysis", "--no-cache"]
+
+    r = subprocess.run(
+        cmd + ["--baseline", str(baseline), "--write-baseline", str(tmp_path)],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert baseline.exists()
+
+    r = subprocess.run(
+        cmd + ["--baseline", str(baseline), str(tmp_path)],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "1 baseline-suppressed" in r.stdout
+
+    # a fresh violation of the SAME rule in another file is NOT forgiven
+    (tmp_path / "entry2.py").write_text(
+        "def decide2(st, schedule_cycle):\n"
+        "    return schedule_cycle(st, native_ops=False)\n"
+    )
+    r = subprocess.run(
+        cmd + ["--baseline", str(baseline), str(tmp_path)],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert r.returncode == 1
+    assert "entry2.py" in r.stdout and "baseline-suppressed" in r.stdout
+
+
+def test_fingerprint_stable_across_line_shifts():
+    from kube_arbitrator_tpu.analysis.core import Finding
+
+    a = Finding("KAT-DTY-001", "error", "m.py", 6,
+                "module constant `S` (float64, bound at line 2) crosses")
+    b = Finding("KAT-DTY-001", "error", "m.py", 9,
+                "module constant `S` (float64, bound at line 5) crosses")
+    assert a.fingerprint() == b.fingerprint()  # unrelated shift: same id
+    c = Finding("KAT-DTY-001", "error", "m.py", 9,
+                "module constant `T` (float64, bound at line 5) crosses")
+    assert a.fingerprint() != c.fingerprint()  # different offender
+
+
+def test_baseline_tolerates_hand_edited_entries(tmp_path):
+    import json
+
+    from kube_arbitrator_tpu.analysis.report import load_baseline
+
+    p = tmp_path / "bl.json"
+    p.write_text(json.dumps({
+        "version": 1,
+        "suppressions": {"aa": 2, "bb": {"count": 3}, "cc": {"count": "x"}},
+    }))
+    assert load_baseline(str(p)) == {"aa": 2, "bb": 3, "cc": 1}
+
+
+def test_cli_json_conflicts_with_other_format(tmp_path):
+    ok = tmp_path / "ok.py"
+    ok.write_text("x = 1\n")
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "kube_arbitrator_tpu.analysis",
+            "--no-cache", "--json", "--format", "sarif", str(ok),
+        ],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert r.returncode == 2
+    assert "conflicts" in r.stderr
+
+
+def test_cache_roundtrip_and_invalidation(tmp_path):
+    from kube_arbitrator_tpu.analysis.cache import AnalysisCache
+    from kube_arbitrator_tpu.analysis.core import analyze_paths
+
+    src = tmp_path / "kern.py"
+    src.write_text(
+        "import jax\nimport jax.numpy as jnp\n\n"
+        "@jax.jit\ndef kern(x):\n"
+        "    if jnp.sum(x) > 0:\n        x = x + 1\n    return x\n"
+    )
+    cache = AnalysisCache(str(tmp_path / "cache"))
+    _, first = analyze_paths([str(src)], ALL_RULES, cache=cache, context_fp="fp")
+    assert {f.rule for f in first} == {"KAT-TRC-001"}
+    assert cache.hits == 0
+
+    cache2 = AnalysisCache(str(tmp_path / "cache"))
+    _, second = analyze_paths([str(src)], ALL_RULES, cache=cache2, context_fp="fp")
+    assert cache2.hits == 1 and cache2.misses == 0
+    assert [f.format() for f in second] == [f.format() for f in first]
+
+    # rule-set fingerprint change invalidates
+    cache3 = AnalysisCache(str(tmp_path / "cache"))
+    _, third = analyze_paths([str(src)], ALL_RULES, cache=cache3, context_fp="fp2")
+    assert cache3.misses == 1
+    assert {f.rule for f in third} == {"KAT-TRC-001"}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,154 @@
+"""Contract-pass self-tests: the eval_shape harness must (a) pass the
+real tree and (b) fail LOUDLY and PRECISELY on a seeded schema mutation —
+a checker that can silently go green is worse than none.
+"""
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from kube_arbitrator_tpu.analysis import contracts
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def kernels_named(findings):
+    return sorted({
+        f.message.split("`")[1]
+        for f in findings
+        if f.message.startswith("kernel ")
+    })
+
+
+def test_real_tree_contracts_are_clean():
+    findings = contracts.check_contracts()
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_schema_matches_snapshot_dataclass_exactly():
+    assert contracts.check_schema_fields() == []
+
+
+# ---------------------------------------------------------------------------
+# seeded violations: mutate ONE schema dtype, expect EXACTLY the affected
+# stage reported
+
+
+def test_mutated_snapshot_dtype_reports_exactly_the_consuming_kernel():
+    # rv_block_start is reclaim's canon-pack window index; as float32 the
+    # dynamic-slice start inside the reclaim kernel is no longer integral
+    seeded = contracts.mutated(
+        contracts.SNAPSHOT_SCHEMA, "rv_block_start", "float32"
+    )
+    findings = contracts.check_kernels(seeded)
+    assert findings, "seeded dtype violation went undetected"
+    assert {f.rule for f in findings} == {"KAT-CTR-004"}
+    assert kernels_named(findings) == ["reclaim"]
+    assert all("rv_block_start" in f.message or "reclaim" in f.message for f in findings)
+
+
+def test_mutated_producer_dtype_reports_exactly_that_field():
+    # declare task_resreq as float64: the real producer (correctly)
+    # emits float32, so the producer check must flag exactly this field —
+    # the direction the np.float64 DEVICE_SCALE bug class travels
+    seeded = contracts.mutated(contracts.SNAPSHOT_SCHEMA, "task_resreq", "float64")
+    findings = contracts.check_producer(seeded)
+    assert len(findings) == 1
+    assert findings[0].rule == "KAT-CTR-002"
+    assert "task_resreq" in findings[0].message
+
+
+def test_mutated_state_dtype_is_caught_at_the_stage_boundary():
+    # group_placed drifting to float32 must be caught for every kernel
+    # that threads state (the stage n -> n+1 seam), not silently washed
+    seeded = contracts.mutated(contracts.STATE_SCHEMA, "group_placed", "float32")
+    findings = contracts.check_kernels(state_schema=seeded)
+    assert findings
+    assert {"KAT-CTR-003"} <= {f.rule for f in findings}
+
+
+def test_snapshot_build_asserts_pack_dtypes():
+    # the producer-side runtime guard (cache/snapshot.py) enforces the
+    # same schema at pack build time: a float64 field that slipped past
+    # the explicit crossover cast must refuse to leave the producer
+    import dataclasses
+
+    from kube_arbitrator_tpu.cache import snapshot as snapmod
+    from kube_arbitrator_tpu.cache.sim import SimCluster
+
+    sim = SimCluster()
+    sim.add_queue("default")
+    sim.add_node("n1", cpu_milli=1000, memory=1024**3)
+    j = sim.add_job("j1", queue="default")
+    sim.add_task(j, 100, 1024**2)
+    snap = snapmod.build_snapshot(sim.cluster)  # clean build passes the guard
+    leaked = dataclasses.replace(
+        snap.tensors,
+        task_resreq=np.asarray(snap.tensors.task_resreq, dtype=np.float64),
+    )
+    with pytest.raises(TypeError, match="dtype contract"):
+        snapmod._assert_pack_dtypes(leaked)
+    assert snapmod.to_device_units(np.zeros(4)).dtype == snapmod.DEVICE_DTYPE
+
+
+def test_producer_crash_becomes_a_finding_not_a_traceback(monkeypatch):
+    # a build_snapshot that RAISES (e.g. its own pack-dtype guard firing)
+    # must surface as a KAT-CTR-002 finding, not crash the analyzer and
+    # drop every other finding of the run
+    from kube_arbitrator_tpu.cache import snapshot as snapmod
+
+    def boom(cluster):
+        raise TypeError("snapshot pack dtype contract violation: seeded")
+
+    monkeypatch.setattr(snapmod, "build_snapshot", boom)
+    findings = contracts.check_producer()
+    assert len(findings) == 1
+    assert findings[0].rule == "KAT-CTR-002"
+    assert "seeded" in findings[0].message
+
+
+def test_snapshot_struct_honors_schema_and_axes():
+    st = contracts.snapshot_struct()
+    assert st.task_resreq.shape == (
+        contracts.DEFAULT_AXES["T"], contracts.DEFAULT_AXES["R"]
+    )
+    assert st.task_resreq.dtype == np.float32
+    assert st.rv_block_start.shape == (contracts.DEFAULT_AXES["N"] + 1,)
+    assert st.rv_window == contracts.SNAPSHOT_STATIC["rv_window"]
+
+
+# ---------------------------------------------------------------------------
+# CLI integration: the contract pass rides the default gate
+
+
+@pytest.mark.slow
+def test_cli_runs_contract_pass_on_package_scope(tmp_path):
+    import json
+
+    def run():
+        r = subprocess.run(
+            [
+                sys.executable, "-m", "kube_arbitrator_tpu.analysis",
+                "--format", "json",
+                "--cache-dir", str(tmp_path / "kat-cache"),  # isolated cache
+                # an absent baseline path: the repo's own baseline (if
+                # any) must not mask findings this asserts on
+                "--baseline", str(tmp_path / "no-baseline.json"),
+                str(REPO / "kube_arbitrator_tpu"), str(REPO / "tests"),
+            ],
+            cwd=REPO,
+            capture_output=True, text=True, timeout=600,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        return json.loads(r.stdout)
+
+    cold = run()
+    assert cold["findings"] == []
+    warm = run()
+    assert warm["findings"] == []
+    # the <10s budget is the CACHED steady state (deploy/check.sh runs
+    # this every push); the cold run pays one eval_shape of the pipeline
+    assert warm["wall_time_s"] < 10.0, "full-tree gate must stay under 10s warm"
+    assert warm["wall_time_s"] < cold["wall_time_s"]
