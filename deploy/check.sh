@@ -25,8 +25,51 @@ else
   echo "lint job: ok"
 fi
 
+# OBS_SMOKE=1: boot the observability plane against a short sim run, curl
+# /metrics + /healthz, and re-lint the obs modules under the thread/dtype
+# families (KAT-LCK/KAT-DTY) — the concurrency-sensitive surface.
+rc_obs=0
+if [ "${OBS_SMOKE:-0}" = "1" ]; then
+  env JAX_PLATFORMS=cpu python - <<'EOF' || rc_obs=$?
+import json, sys, urllib.request
+from kube_arbitrator_tpu.cache.sim import generate_cluster
+from kube_arbitrator_tpu.framework import Scheduler
+from kube_arbitrator_tpu.obs import scheduler_status_fn, serve_obs
+from kube_arbitrator_tpu.utils.flightrec import FlightRecorder
+from kube_arbitrator_tpu.utils.tracing import tracer
+
+tracer().enable()
+sim = generate_cluster(num_nodes=16, num_jobs=3, tasks_per_job=4, num_queues=2, seed=0)
+flight = FlightRecorder(capacity=8)
+sched = Scheduler(sim, flight=flight)
+sched.run(max_cycles=2, until_idle=False)
+server, _t, url = serve_obs(flight=flight, status_fn=scheduler_status_fn(sched))
+try:
+    text = urllib.request.urlopen(url + "/metrics", timeout=10).read().decode()
+    for fam in ("e2e_scheduling_duration_seconds",
+                "kernel_action_duration_seconds", "cycles_total"):
+        assert fam in text, f"missing metric family {fam}"
+    health = json.load(urllib.request.urlopen(url + "/healthz", timeout=10))
+    assert health["ok"] and health["cycles"] == 2, health
+finally:
+    server.shutdown()
+print("obs smoke: /metrics + /healthz ok")
+EOF
+  python -m kube_arbitrator_tpu.analysis --rules KAT-LCK,KAT-DTY \
+    kube_arbitrator_tpu/utils/tracing.py \
+    kube_arbitrator_tpu/utils/flightrec.py \
+    kube_arbitrator_tpu/utils/metrics.py \
+    kube_arbitrator_tpu/obs.py || rc_obs=$?
+  if [ "${rc_obs}" -ne 0 ]; then
+    echo "obs smoke job: FAILED (exit ${rc_obs})" >&2
+  else
+    echo "obs smoke job: ok"
+  fi
+fi
+
 if [ "${LINT_ONLY:-0}" = "1" ]; then
-  exit "${rc_lint}"
+  if [ "${rc_lint}" -ne 0 ]; then exit "${rc_lint}"; fi
+  exit "${rc_obs}"
 fi
 
 rc_test=0
@@ -38,4 +81,5 @@ else
 fi
 
 if [ "${rc_lint}" -ne 0 ]; then exit "${rc_lint}"; fi
+if [ "${rc_obs}" -ne 0 ]; then exit "${rc_obs}"; fi
 exit "${rc_test}"

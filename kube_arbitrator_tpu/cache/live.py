@@ -56,6 +56,7 @@ from ..api.info import (
 )
 from ..api.types import TaskStatus
 from ..options import options
+from ..utils.metrics import metrics
 from .fakeapi import ADDED, DELETED, MODIFIED, RESOURCES, ApiError, FakeApiServer
 from .sim import BindIntent, Event, EvictIntent
 
@@ -341,6 +342,7 @@ class LiveCache:
         self._raw_pod: Dict[str, dict] = {}
         self._claim_pods: Dict[Tuple[str, str], set] = {}
         self._pv_claims: Dict[str, set] = {}
+        self._last_sync_ts: Optional[float] = None
 
     # ---- informer pump ----
 
@@ -357,6 +359,13 @@ class LiveCache:
         """One pump: initial LIST then incremental WATCH; returns events
         applied (WaitForCacheSync + handler goroutines, cache.go:311-351,
         single-threaded)."""
+        m = metrics()
+        now = _time.time()
+        # model age at pump time: the gap since the previous pump is how
+        # stale the snapshot the NEXT cycle builds from could have been
+        if self._last_sync_ts is not None:
+            m.gauge_set("cache_snapshot_staleness_seconds", now - self._last_sync_ts)
+        self._last_sync_ts = now
         n = 0
         if not self._listed:
             first_rv = None
@@ -377,11 +386,13 @@ class LiveCache:
             # one global ordered stream lets one low-water mark do it).
             self._watch_rv = max(self._watch_rv, first_rv or 0)
             self._listed = True
+            m.counter_add("cache_watch_events_total", n, labels={"phase": "list"})
             return n
         for rv, resource, etype, obj in self.api.watch_all(self._watch_rv):
             self._dispatch(resource, etype, obj)
             self._watch_rv = rv
             n += 1
+        m.counter_add("cache_watch_events_total", n, labels={"phase": "watch"})
         return n
 
     def _dispatch(self, resource: str, etype: str, obj: dict) -> None:
@@ -763,6 +774,9 @@ class LiveCache:
     def process_resync(self) -> int:
         """Pump the watch plane, then drain errTasks by re-GETting each pod
         and re-syncing it into the model (cache.go:519-547)."""
+        # depth BEFORE the drain: a persistently non-zero gauge is the
+        # "actuation keeps failing" signal (errTasks backlog)
+        metrics().gauge_set("cache_resync_depth", len(self.resync_queue))
         self.sync()
         repaired = 0
         queue, self.resync_queue = self.resync_queue, []

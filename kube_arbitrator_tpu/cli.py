@@ -60,6 +60,41 @@ def build_parser() -> argparse.ArgumentParser:
         default="",
         help="run cycles under jax.profiler.trace, emitting to this dir",
     )
+    # the served observability plane (obs.py): /metrics, /healthz,
+    # /readyz, /debug/cycles, /debug/trace/<corr_id>
+    p.add_argument(
+        "--obs-port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="serve the observability plane on this port (0 = ephemeral); "
+        "also enables span tracing + per-action kernel timing",
+    )
+    p.add_argument(
+        "--obs-host",
+        default="127.0.0.1",
+        help="bind address for --obs-port (default 127.0.0.1)",
+    )
+    p.add_argument(
+        "--flight-dump-dir",
+        default="",
+        help="flight recorder: dump the last --flight-ring cycles' digests "
+        "here as JSON whenever an anomaly fires (SLO breach, LeaderLost, "
+        "dtype contract violation, cycle-fatal RPC error)",
+    )
+    p.add_argument(
+        "--flight-ring",
+        type=int,
+        default=64,
+        help="flight-recorder ring capacity in cycles (default 64)",
+    )
+    p.add_argument(
+        "--cycle-slo-ms",
+        type=float,
+        default=0.0,
+        help="cycle-latency SLO in ms; a slower cycle triggers a "
+        "flight-recorder dump (0 = disabled)",
+    )
     # decision-plane RPC (SURVEY §5: the gRPC hop to the JAX sidecar)
     p.add_argument(
         "--decision-endpoint",
@@ -135,10 +170,42 @@ def main(argv=None) -> int:
 
     _warm_native()
 
+    # observability plane: any obs flag enables span tracing (and with it
+    # the staged per-action kernel timing); --obs-port serves the plane
+    obs_enabled = (
+        args.obs_port is not None or args.flight_dump_dir or args.cycle_slo_ms
+    )
+    flight = None
+    if obs_enabled:
+        from .utils.flightrec import FlightRecorder
+        from .utils.tracing import tracer
+
+        tracer().enable()
+        flight = FlightRecorder(
+            capacity=args.flight_ring, dump_dir=args.flight_dump_dir or None
+        )
+
+    def _serve_obs(status_fn=None):
+        if args.obs_port is None:
+            return None
+        from .obs import serve_obs
+
+        server, _thread, url = serve_obs(
+            host=args.obs_host, port=args.obs_port,
+            flight=flight, status_fn=status_fn,
+        )
+        print(f"observability plane on {url}", file=sys.stderr)
+        return server
+
     if args.sidecar:
         from .rpc.sidecar import main as sidecar_main
 
-        sidecar_main(args.sidecar)
+        obs_server = _serve_obs()  # sidecar serves its own plane
+        try:
+            sidecar_main(args.sidecar)
+        finally:
+            if obs_server is not None:
+                obs_server.shutdown()
         return 0
 
     if args.replay_trace:
@@ -214,6 +281,8 @@ def main(argv=None) -> int:
             elector=elector,
             profile_dir=args.profile_dir or None,
             decider=decider,
+            flight=flight,
+            cycle_slo_ms=args.cycle_slo_ms or None,
         )
     except (ValueError, OSError) as e:
         print(f"error: invalid scheduler conf: {e}", file=sys.stderr)
@@ -232,9 +301,14 @@ def main(argv=None) -> int:
 
         recorder = TraceRecorder(args.record_trace, conf_yaml=dump_conf(sched.config))
         sched.trace_recorder = recorder
+    from .obs import scheduler_status_fn
+
+    obs_server = _serve_obs(status_fn=scheduler_status_fn(sched))
     try:
         cycles = sched.run(max_cycles=args.cycles)
     finally:
+        if obs_server is not None:
+            obs_server.shutdown()
         if recorder is not None:
             recorder.close()
             print(

@@ -6,16 +6,27 @@ grpcio nor protobuf — the remote path lives in rpc/client.py.
 from __future__ import annotations
 
 import time
-from typing import Tuple
+from typing import Dict, Tuple
+
+from ..utils.tracing import tracer
 
 
 class LocalDecider:
     """Run the cycle in-process (the default path Session uses).
 
-    decide() returns (CycleDecisions, device-time ms)."""
+    decide() returns (CycleDecisions, device-time ms).  When tracing is
+    enabled the cycle runs through the staged per-action runner instead
+    of the fused program: each action becomes its own span and its wall
+    time lands in ``last_action_ms`` (the scheduler turns that into the
+    ``kernel_action_duration_seconds{action=...}`` histograms).  The
+    fused program stays the fast path when observability is off."""
+
+    def __init__(self):
+        # stage -> wall ms of the most recent decide (staged runs only)
+        self.last_action_ms: Dict[str, float] = {}
 
     def decide(self, st, config) -> Tuple[object, float]:
-        from ..ops.cycle import schedule_cycle
+        from ..ops.cycle import schedule_cycle, schedule_cycle_staged
         from ..platform import decision_route
 
         # backend crossover (shared seam, platform.decision_route): small
@@ -30,7 +41,19 @@ class LocalDecider:
         ctx, _dev, native_ops = decision_route(
             int(st.task_valid.shape[0]), config.actions, st.task_status
         )
+        tr = tracer()
+        self.last_action_ms = {}
         t0 = time.perf_counter()
+        if tr.enabled and tr.current_corr_id() is not None:
+            with ctx:
+                dec, stages = schedule_cycle_staged(
+                    st, tiers=config.tiers, actions=config.actions,
+                    native_ops=native_ops,
+                )
+            for stage, ts, ms in stages:
+                self.last_action_ms[stage] = ms
+                tr.record_span(f"kernel.{stage}", ts, ms / 1000)
+            return dec, (time.perf_counter() - t0) * 1000
         with ctx:
             dec = schedule_cycle(
                 st, tiers=config.tiers, actions=config.actions,

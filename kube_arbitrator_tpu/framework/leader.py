@@ -28,6 +28,7 @@ import time
 import uuid
 
 from ..cache.fakeapi import ApiError
+from ..utils.metrics import metrics
 from typing import Callable, Optional
 
 
@@ -120,9 +121,28 @@ class _ElectorBase:
 
     # ---- election decisions (shared) ----
 
+    def _note_transition(self, was_leader: bool) -> None:
+        """Leadership telemetry: the is-leader gauge plus a transitions
+        counter on every flip (the reference logs these; SURVEY §5 wants
+        them scrapeable — a flapping lease is invisible in averages)."""
+        m = metrics()
+        m.gauge_set("leader_is_leader", 1.0 if self._is_leader else 0.0)
+        if self._is_leader != was_leader:
+            m.counter_add(
+                "leader_transitions_total",
+                labels={"to": "leader" if self._is_leader else "standby"},
+            )
+
     def try_acquire(self) -> bool:
         """One acquisition attempt: take the lease if unheld, expired (on
         OUR observation clock), or already ours.  Returns leadership."""
+        was = self._is_leader
+        try:
+            return self._try_acquire_inner()
+        finally:
+            self._note_transition(was)
+
+    def _try_acquire_inner(self) -> bool:
         with self._locked():
             try:
                 token, cur = self._fetch()
@@ -157,6 +177,17 @@ class _ElectorBase:
         """Renew our lease; False when another holder took it (we were
         expired and usurped) or the renew deadline passed.  A transient
         storage error keeps leadership within the renew deadline."""
+        was = self._is_leader
+        t0 = time.perf_counter()
+        try:
+            return self._renew_inner()
+        finally:
+            metrics().observe(
+                "leader_renew_duration_seconds", time.perf_counter() - t0
+            )
+            self._note_transition(was)
+
+    def _renew_inner(self) -> bool:
         with self._locked():
             try:
                 token, cur = self._fetch()
@@ -193,6 +224,13 @@ class _ElectorBase:
     def release(self) -> None:
         """Voluntary release (delete the lock object) so a standby can take
         over immediately instead of waiting out the lease."""
+        was = self._is_leader
+        try:
+            self._release_inner()
+        finally:
+            self._note_transition(was)
+
+    def _release_inner(self) -> None:
         with self._locked():
             try:
                 token, cur = self._fetch()
@@ -219,7 +257,11 @@ class _ElectorBase:
         it re-acquires instead of instantly re-raising."""
         if self._is_leader and self._within_renew_deadline(self.now()):
             return True
+        was = self._is_leader
         self._is_leader = False
+        # the actuation-fence demotion must be scrapeable too: without
+        # this, a wedged-decide LeaderLost leaves leader_is_leader at 1
+        self._note_transition(was)
         return False
 
     def acquire_blocking(self, timeout_s: Optional[float] = None) -> bool:

@@ -14,7 +14,9 @@ import time
 from typing import Dict, List, Optional
 
 from ..cache.sim import SimCluster
+from ..utils.flightrec import CycleRecord, FlightRecorder
 from ..utils.metrics import metrics
+from ..utils.tracing import tracer
 from .conf import SchedulerConfig, load_conf_file
 from .leader import LeaderElector, LeaderLost
 from .session import CycleResult, PodGroupStatus, Session
@@ -47,6 +49,8 @@ class Scheduler:
         profile_dir: Optional[str] = None,
         decider=None,
         trace_recorder=None,
+        flight: Optional[FlightRecorder] = None,
+        cycle_slo_ms: Optional[float] = None,
     ):
         # conf is re-loadable per Run like the reference (scheduler.go:66-78)
         self.sim = sim
@@ -61,9 +65,17 @@ class Scheduler:
         self.decider = decider
         # cache.persist.TraceRecorder: records every cycle's snapshot
         self.trace_recorder = trace_recorder
+        # observability plane (utils/flightrec.py): ring of recent cycle
+        # digests, dumped on anomalies; None = not recording
+        self.flight = flight
+        # cycle-latency SLO in ms; a breach is a flight-recorder anomaly
+        self.cycle_slo_ms = cycle_slo_ms
         self.job_status: Dict[str, PodGroupStatus] = {}
         self.history: List[CycleStats] = []
+        self.last_cycle_ts: Optional[float] = None  # /readyz freshness
         self._last_event_msg: Dict[tuple, str] = {}
+        self._cycle_seq = 0
+        self._last_pending_hist: Dict[str, int] = {}
 
     def run_once(self) -> CycleResult:
         import contextlib
@@ -73,16 +85,96 @@ class Scheduler:
             import jax
 
             ctx = jax.profiler.trace(self.profile_dir)
-        with ctx:
-            return self._run_once_inner()
+        tr = tracer()
+        self._cycle_seq += 1
+        corr = tr.new_corr_id(self._cycle_seq) if tr.enabled else None
+        cycle_ts = time.time()
+        with ctx, tr.activate(corr):
+            try:
+                with tr.span("cycle", seq=self._cycle_seq):
+                    result = self._run_once_inner()
+            except Exception as err:  # record evidence, then fail as before
+                self._flight_failure(corr or "", cycle_ts, err)
+                raise
+        self.last_cycle_ts = time.time()
+        stats = self.history[-1]
+        if self.flight is not None:
+            self.flight.record(
+                CycleRecord(
+                    seq=self._cycle_seq,
+                    corr_id=corr or "",
+                    ts=cycle_ts,
+                    stats=dataclasses.asdict(stats),
+                    digests={
+                        "binds": stats.binds,
+                        "evicts": stats.evicts,
+                        "pending_before": stats.pending_before,
+                        "pending_per_job": dict(self._last_pending_hist),
+                        "action_ms": dict(result.action_ms),
+                    },
+                    spans=[s.to_dict() for s in tr.spans(corr)] if corr else [],
+                )
+            )
+            if self.cycle_slo_ms is not None and stats.cycle_ms > self.cycle_slo_ms:
+                self.flight.anomaly(
+                    "slo_breach",
+                    detail=f"cycle {self._cycle_seq} took {stats.cycle_ms:.1f} ms "
+                    f"(SLO {self.cycle_slo_ms:g} ms)",
+                )
+        return result
+
+    def _flight_failure(self, corr: str, cycle_ts: float, err: BaseException) -> None:
+        """A cycle died: append the failing cycle to the ring (its spans
+        up to the failure included), then dump — the last entry of every
+        failure dump IS the failing cycle."""
+        if self.flight is None:
+            return
+        if isinstance(err, LeaderLost):
+            kind = "leader_lost"
+        elif isinstance(err, TypeError) and "contract" in str(err):
+            kind = "dtype_contract"
+        else:  # RPC deadline/retry exhaustion and any other cycle killer
+            kind = "cycle_error"
+        spans = tracer().spans(corr) if corr else []
+        self.flight.record(
+            CycleRecord(
+                seq=self._cycle_seq,
+                corr_id=corr,
+                ts=cycle_ts,
+                error=f"{type(err).__name__}: {err}",
+                spans=[s.to_dict() for s in spans],
+            )
+        )
+        self.flight.anomaly(kind, detail=str(err))
+
+    @staticmethod
+    def _pending_histogram(per_job: List[int]) -> Dict[str, int]:
+        """Coarse pending-per-job distribution for the flight recorder."""
+        hist = {"0": 0, "1-9": 0, "10-99": 0, ">=100": 0}
+        for n in per_job:
+            if n == 0:
+                hist["0"] += 1
+            elif n < 10:
+                hist["1-9"] += 1
+            elif n < 100:
+                hist["10-99"] += 1
+            else:
+                hist[">=100"] += 1
+        return hist
 
     def _run_once_inner(self) -> CycleResult:
+        tr = tracer()
         t0 = time.perf_counter()
         # steady-state maintenance that runs as goroutines in the reference:
         # errTasks resync (cache.go:519-547) and deferred job GC (:476-517)
-        self.sim.process_resync()
-        self.sim.collect_garbage()
-        pending = sum(len(j.pending_tasks()) for j in self.sim.cluster.jobs.values())
+        with tr.span("resync"):
+            self.sim.process_resync()
+            self.sim.collect_garbage()
+        per_job_pending = [
+            len(j.pending_tasks()) for j in self.sim.cluster.jobs.values()
+        ]
+        pending = sum(per_job_pending)
+        self._last_pending_hist = self._pending_histogram(per_job_pending)
         session = Session(self.sim.cluster, self.config, decider=self.decider)
         result = session.run()
         if self.trace_recorder is not None:
@@ -103,8 +195,9 @@ class Scheduler:
                 f"({len(result.binds)} binds, {len(result.evicts)} evicts "
                 f"not actuated) — holder {self.elector.identity}"
             )
-        self.sim.apply_binds(result.binds)
-        self.sim.apply_evicts(result.evicts)
+        with tr.span("actuate", binds=len(result.binds), evicts=len(result.evicts)):
+            self.sim.apply_binds(result.binds)
+            self.sim.apply_evicts(result.evicts)
         self.job_status.update(result.job_status)  # cache.UpdateJobStatus equivalent
         # live backends PUT the PodGroup status back to the apiserver
         # (closeSession -> cache.UpdateJobStatus, session.go:130-144)
@@ -144,15 +237,13 @@ class Scheduler:
             transport_ms=result.transport_ms,
         )
         self.history.append(stats)
-        self._record_metrics(stats)
+        self._record_metrics(stats, result.action_ms)
         return result
 
-    def _record_metrics(self, s: CycleStats) -> None:
+    def _record_metrics(self, s: CycleStats, action_ms: Dict[str, float]) -> None:
+        # HELP text lives in utils/metrics.METRIC_HELP (one table for
+        # every family), not in per-cycle describe() calls
         m = metrics()
-        m.describe(
-            "e2e_scheduling_duration_seconds",
-            "Full cycle latency: snapshot through actuation.",
-        )
         m.observe("e2e_scheduling_duration_seconds", s.cycle_ms / 1000)
         for phase, ms in (
             ("snapshot", s.snapshot_ms),
@@ -165,6 +256,13 @@ class Scheduler:
             m.observe(
                 "cycle_phase_duration_seconds", ms / 1000, labels={"phase": phase}
             )
+        # staged runs only (tracing on): open_session / each action / commit
+        for stage, ms in action_ms.items():
+            m.observe(
+                "kernel_action_duration_seconds", ms / 1000,
+                labels={"action": stage},
+            )
+        m.counter_add("cycles_total")
         m.counter_add("binds_total", s.binds)
         m.counter_add("evicts_total", s.evicts)
         m.gauge_set("pending_tasks", s.pending_before)
@@ -182,6 +280,11 @@ class Scheduler:
         cycles = 0
         while True:
             if self.elector is not None and not self.elector.renew():
+                if self.flight is not None:
+                    self.flight.anomaly(
+                        "leader_lost",
+                        detail=f"renew failed for {self.elector.identity}",
+                    )
                 raise LeaderLost(
                     f"leader lease lost by {self.elector.identity}"
                 )

@@ -90,6 +90,9 @@ class CycleResult:
     close_ms: float = 0.0
     # decide-wall minus device time: ~0 in-process, RPC overhead remote
     transport_ms: float = 0.0
+    # stage -> wall ms from the staged per-action runner (tracing-enabled
+    # local decides only; empty for fused or remote cycles)
+    action_ms: Dict[str, float] = dataclasses.field(default_factory=dict)
 
 
 class Session:
@@ -110,17 +113,22 @@ class Session:
         self.uid = str(uuid.uuid4())
 
     def run(self) -> CycleResult:
+        from ..utils.tracing import tracer
+
+        tr = tracer()
         decider = self.decider
         if decider is None:
             from .decider import LocalDecider
 
             decider = LocalDecider()
         t0 = time.perf_counter()
-        snap = build_snapshot(self.cluster)
+        with tr.span("snapshot"):
+            snap = build_snapshot(self.cluster)
         t1 = time.perf_counter()
         # kernel_ms is device time in both modes (the sidecar measures its
         # own); remote transport overhead is the decide-wall minus it
-        dec, kernel_ms = decider.decide(snap.tensors, self.config)
+        with tr.span("decide", tasks=int(snap.tensors.num_tasks)):
+            dec, kernel_ms = decider.decide(snap.tensors, self.config)
         t2 = time.perf_counter()
         # Decisions may have crossed an RPC codec (RemoteDecider): hold
         # them to the same declared contract the producer side asserts
@@ -128,9 +136,11 @@ class Session:
         # into binds/evicts — a drifted dtype here corrupts actuation
         # host-side without raising.
         _assert_decision_dtypes(dec)
-        binds, evicts = decode_decisions(snap, dec)
+        with tr.span("decode"):
+            binds, evicts = decode_decisions(snap, dec)
         t3 = time.perf_counter()
-        job_status = self._close(snap, dec)
+        with tr.span("close"):
+            job_status = self._close(snap, dec)
         t4 = time.perf_counter()
         return CycleResult(
             session_uid=self.uid,
@@ -144,6 +154,7 @@ class Session:
             decode_ms=(t3 - t2) * 1000,
             close_ms=(t4 - t3) * 1000,
             transport_ms=max((t2 - t1) * 1000 - kernel_ms, 0.0),
+            action_ms=dict(getattr(decider, "last_action_ms", None) or {}),
         )
 
     # ---- CloseSession ----

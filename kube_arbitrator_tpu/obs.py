@@ -1,0 +1,171 @@
+"""The served observability plane: /metrics, health, and debug endpoints.
+
+SURVEY §5 on the reference: "no pprof endpoint, no Prometheus".  The
+rebuild's :mod:`utils.metrics` rendered Prometheus text but nothing
+served it; this module closes that gap with the same stdlib
+``ThreadingHTTPServer`` pattern the apiserver shim uses
+(:mod:`cache.httpapi`) — no client libraries, one daemon thread.
+
+Endpoints:
+
+=======================  ====================================================
+path                     serves
+=======================  ====================================================
+``/metrics``             Prometheus text exposition (``MetricsRegistry.render``)
+``/healthz``             liveness: 200 + process/device info JSON
+``/readyz``              readiness: 200 when scheduling (leader + fresh
+                         cycle), 503 otherwise — the k8s probe split
+``/debug/cycles``        recent flight-recorder entries as JSON
+``/debug/trace/<corr>``  one cycle's span tree as Chrome-trace/Perfetto JSON
+=======================  ====================================================
+
+Handlers only READ: the registry snapshots under its own lock, the flight
+recorder copies its ring under its lock, and the status callable reads
+scheduler attributes that are single-writer (the loop thread) — the
+observability plane must never be able to stall a cycle.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+
+from .utils.flightrec import FlightRecorder
+from .utils.metrics import MetricsRegistry, metrics
+from .utils.tracing import Tracer, tracer
+
+
+def device_info() -> Dict[str, object]:
+    """Device liveness for /healthz: platform + count, or the error that
+    made the backend unreachable (a wedged accelerator plugin shows up
+    here instead of as a silent hang)."""
+    try:
+        import jax
+
+        devices = jax.devices()
+        return {
+            "platform": devices[0].platform if devices else "none",
+            "device_count": len(devices),
+        }
+    except Exception as err:  # backend init failure IS the signal
+        return {"platform": "unavailable", "device_count": 0, "error": str(err)}
+
+
+def scheduler_status_fn(
+    sched, max_cycle_age_s: Optional[float] = None
+) -> Callable[[], Dict[str, object]]:
+    """Status callable over a :class:`framework.Scheduler`: leadership,
+    last-cycle age, cycle count, and the readiness verdict.  Reads are
+    cross-thread but single-writer (the scheduler loop), so the worst
+    case is a one-cycle-stale answer — fine for a probe."""
+    import time
+
+    def status() -> Dict[str, object]:
+        elector = sched.elector
+        leader = None if elector is None else bool(elector.is_leader)
+        last_ts = sched.last_cycle_ts
+        age = None if last_ts is None else time.time() - last_ts
+        ready = last_ts is not None and leader in (None, True)
+        if ready and max_cycle_age_s is not None and age > max_cycle_age_s:
+            ready = False
+        return {
+            "ready": ready,
+            "leader": leader,
+            "cycles": len(sched.history),
+            "last_cycle_age_s": age,
+        }
+
+    return status
+
+
+class _ObsHandler(BaseHTTPRequestHandler):
+    server_version = "kat-obs/1.0"
+    protocol_version = "HTTP/1.1"
+    # a stalled scraper must not pin a handler thread forever
+    timeout = 30.0
+
+    def log_message(self, fmt, *args):  # quiet like the apiserver shim
+        pass
+
+    def _send(self, code: int, body: bytes, content_type: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, obj) -> None:
+        self._send(code, json.dumps(obj, indent=1).encode(), "application/json")
+
+    def do_GET(self) -> None:
+        registry: MetricsRegistry = self.server.obs_registry  # type: ignore[attr-defined]
+        flight: Optional[FlightRecorder] = self.server.obs_flight  # type: ignore[attr-defined]
+        tr: Tracer = self.server.obs_tracer  # type: ignore[attr-defined]
+        status_fn = self.server.obs_status_fn  # type: ignore[attr-defined]
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        # fixed route vocabulary for the counter label: a scanner probing
+        # random paths must not mint unbounded label series in the
+        # process-wide registry (each series lives forever)
+        route = path if not path.startswith("/debug/trace/") else "/debug/trace"
+        if route not in ("/", "/metrics", "/healthz", "/readyz",
+                         "/debug/cycles", "/debug/trace"):
+            route = "other"
+        registry.counter_add("obs_requests_total", labels={"path": route})
+
+        if path == "/metrics":
+            self._send(
+                200, registry.render().encode(),
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+            return
+        if path == "/healthz":
+            self._send_json(200, {"ok": True, **device_info(), **status_fn()})
+            return
+        if path == "/readyz":
+            st = status_fn()
+            self._send_json(200 if st.get("ready") else 503, st)
+            return
+        if path == "/debug/cycles":
+            entries = flight.entries() if flight is not None else []
+            self._send_json(200, {"capacity": getattr(flight, "capacity", 0),
+                                  "cycles": entries})
+            return
+        if path.startswith("/debug/trace/"):
+            corr = path[len("/debug/trace/"):]
+            trace = tr.export_chrome(corr)
+            if not trace["traceEvents"]:
+                self._send_json(404, {"error": f"unknown trace {corr!r}",
+                                      "known": tr.trace_ids()[-20:]})
+                return
+            self._send_json(200, trace)
+            return
+        if path == "/":
+            self._send_json(200, {"endpoints": [
+                "/metrics", "/healthz", "/readyz",
+                "/debug/cycles", "/debug/trace/<corr_id>",
+            ]})
+            return
+        self._send_json(404, {"error": f"no route {path}"})
+
+
+def serve_obs(
+    host: str = "127.0.0.1",
+    port: int = 0,
+    registry: Optional[MetricsRegistry] = None,
+    flight: Optional[FlightRecorder] = None,
+    trace: Optional[Tracer] = None,
+    status_fn: Optional[Callable[[], Dict[str, object]]] = None,
+) -> Tuple[ThreadingHTTPServer, threading.Thread, str]:
+    """Serve the observability plane; returns (server, thread, base_url).
+    ``port=0`` picks a free port; ``server.shutdown()`` stops it.  The
+    defaults bind the process-wide registry/tracer, so a bare
+    ``serve_obs()`` next to any scheduler run already serves real data."""
+    server = ThreadingHTTPServer((host, port), _ObsHandler)
+    server.obs_registry = registry if registry is not None else metrics()  # type: ignore[attr-defined]
+    server.obs_flight = flight  # type: ignore[attr-defined]
+    server.obs_tracer = trace if trace is not None else tracer()  # type: ignore[attr-defined]
+    server.obs_status_fn = status_fn if status_fn is not None else (lambda: {"ready": True})  # type: ignore[attr-defined]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, thread, f"http://{host}:{server.server_address[1]}"

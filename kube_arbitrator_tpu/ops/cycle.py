@@ -221,6 +221,15 @@ def schedule_cycle(
             s_max=s_max, max_rounds=max_rounds, native_ops=native_ops,
         )
 
+    return commit_cycle(st, sess, state)
+
+
+def commit_cycle(
+    st: SnapshotTensors, sess: "SessionCtx", state: "AllocState"
+) -> CycleDecisions:
+    """The commit tail of the cycle: gang-masked bind/evict commit +
+    close-side readiness, shared by the fused program above and the
+    per-action staged runner below."""
     job_ready = state.job_ready_cnt >= sess.min_avail
     # eviction commit: unconditional (-2) or claimant-job-ready (>=0);
     # commit decisions use the raw post-action readiness
@@ -250,3 +259,74 @@ def schedule_cycle(
         node_num_tasks=state.node_num_tasks,
         node_ports=state.node_ports,
     )
+
+
+# ---- staged (per-action timed) runner — the observability plane's path ----
+
+
+@partial(
+    jax.jit,
+    static_argnames=("action", "tiers", "s_max", "max_rounds", "native_ops"),
+)
+def _run_stage(
+    st: SnapshotTensors,
+    sess: "SessionCtx",
+    state: "AllocState",
+    action: str,
+    tiers: Tiers,
+    s_max: int,
+    max_rounds: int,
+    native_ops: bool,
+) -> "AllocState":
+    """One action as its own XLA program (action is static: one compiled
+    program per action name, registry-added custom actions included)."""
+    return ACTION_KERNELS[action](
+        st, sess, state, tiers,
+        s_max=s_max, max_rounds=max_rounds, native_ops=native_ops,
+    )
+
+
+_open_session_jit = jax.jit(open_session, static_argnames=("tiers",))
+_commit_jit = jax.jit(commit_cycle)
+
+
+def schedule_cycle_staged(
+    st: SnapshotTensors,
+    tiers: Tiers = DEFAULT_TIERS,
+    actions: Tuple[str, ...] = DEFAULT_ACTIONS,
+    s_max: int = 4096,
+    max_rounds: int = 100_000,
+    native_ops: bool = False,
+):
+    """The same cycle as :func:`schedule_cycle`, run as one XLA program
+    PER STAGE (open → each action → commit) with a device sync between
+    stages, so each action's wall time is honestly measurable.
+
+    Returns ``(CycleDecisions, [(stage, wall_ts, dur_ms), ...])`` where
+    stage is ``open_session`` / each action name / ``commit``.  Used by
+    the deciders only when tracing is enabled: the fused program stays
+    the fast path (stage boundaries forfeit cross-action fusion and pay a
+    dispatch + sync per stage)."""
+    import time
+
+    timings = []
+
+    def _timed(stage, fn, *args, **kw):
+        ts = time.time()
+        t0 = time.perf_counter()
+        out = fn(*args, **kw)
+        jax.block_until_ready(out)
+        timings.append((stage, ts, (time.perf_counter() - t0) * 1000))
+        return out
+
+    sess, state = _timed("open_session", _open_session_jit, st, tiers=tiers)
+    for action in actions:
+        if action not in ACTION_KERNELS:
+            raise ValueError(f"unknown action: {action}")
+        state = _timed(
+            action, _run_stage, st, sess, state,
+            action=action, tiers=tiers, s_max=s_max, max_rounds=max_rounds,
+            native_ops=native_ops,
+        )
+    dec = _timed("commit", _commit_jit, st, sess, state)
+    return dec, timings

@@ -14,7 +14,9 @@ from typing import Tuple
 
 from ..cache.snapshot import SnapshotTensors
 from ..framework.decider import LocalDecider  # noqa: F401  (re-export; pb-free home)
-from .codec import snapshot_request, unpack_tensors
+from ..utils.metrics import metrics
+from ..utils.tracing import tracer
+from .codec import CORR_ID_METADATA_KEY, snapshot_request, unpack_tensors
 from .sidecar import CHANNEL_OPTIONS, SERVICE
 
 from . import decision_pb2 as pb
@@ -76,23 +78,39 @@ class RemoteDecider:
         from ..framework.conf import dump_conf
         from ..ops.cycle import CycleDecisions
 
+        tr = tracer()
         self._cycle += 1
-        req = snapshot_request(st, dump_conf(config), self._cycle)
+        with tr.span("rpc.encode"):
+            req = snapshot_request(st, dump_conf(config), self._cycle)
+        # the cycle's trace correlation id rides the request metadata so
+        # the sidecar's spans stitch into the SAME trace (utils/tracing.py)
+        corr = tr.current_corr_id()
+        md = ((CORR_ID_METADATA_KEY, corr),) if corr else None
         t0 = time.perf_counter()
         attempt = 0
-        while True:
-            try:
-                rep = self._decide(req, timeout=self.timeout_s)
-                break
-            except grpc.RpcError as e:
-                code = e.code().name if e.code() is not None else "UNKNOWN"
-                attempt += 1
-                if code not in self.RETRYABLE or attempt > self.retries:
-                    raise
-                time.sleep(self.retry_backoff_s * attempt)
+        with tr.span("rpc.call", target=self.target) as call_span:
+            while True:
+                try:
+                    rep = self._decide(req, timeout=self.timeout_s, metadata=md)
+                    break
+                except grpc.RpcError as e:
+                    code = e.code().name if e.code() is not None else "UNKNOWN"
+                    attempt += 1
+                    if code not in self.RETRYABLE or attempt > self.retries:
+                        metrics().counter_add(
+                            "rpc_decide_failures_total", labels={"code": code}
+                        )
+                        raise
+                    metrics().counter_add(
+                        "rpc_decide_retries_total", labels={"code": code}
+                    )
+                    time.sleep(self.retry_backoff_s * attempt)
+            if attempt and hasattr(call_span, "note"):
+                call_span.note(retries=attempt)
         self.last_roundtrip_ms = (time.perf_counter() - t0) * 1000
         self.last_kernel_ms = rep.kernel_ms
-        dec = unpack_tensors(CycleDecisions, rep.tensors)
+        with tr.span("rpc.decode"):
+            dec = unpack_tensors(CycleDecisions, rep.tensors)
         return dec, rep.kernel_ms
 
     def close(self) -> None:

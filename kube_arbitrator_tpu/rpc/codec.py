@@ -14,14 +14,21 @@ from typing import Dict, Type, TypeVar
 
 import numpy as np
 
+from ..utils.metrics import metrics
 from . import decision_pb2 as pb
 
 X = TypeVar("X")
+
+# gRPC metadata key carrying the cycle trace correlation id across the
+# scheduler <-> sidecar boundary (utils/tracing.py); lowercase per the
+# gRPC metadata-key rules.
+CORR_ID_METADATA_KEY = "kat-corr-id"
 
 
 def pack_tensors(obj, into) -> None:
     """Serialize every dataclass field of ``obj`` into ``into`` (a repeated
     Tensor proto field)."""
+    total = 0
     for f in dataclasses.fields(obj):
         arr = np.asarray(getattr(obj, f.name))
         # ascontiguousarray promotes 0-d to (1,); restore the true shape
@@ -31,17 +38,26 @@ def pack_tensors(obj, into) -> None:
         t.dtype = arr.dtype.str
         t.shape.extend(arr.shape)
         t.data = arr.tobytes()
+        total += len(t.data)
+    metrics().counter_add(
+        "rpc_codec_bytes_total", total, labels={"direction": "pack"}
+    )
 
 
 def unpack_tensors(cls: Type[X], tensors, to_jax: bool = False) -> X:
     """Rebuild dataclass ``cls`` from a repeated Tensor field by name."""
     known = {f.name for f in dataclasses.fields(cls)}
     by_name: Dict[str, np.ndarray] = {}
+    total = 0
     for t in tensors:
+        total += len(t.data)
         if t.name not in known:
             continue  # newer peer sent a field this side predates
         arr = np.frombuffer(t.data, dtype=np.dtype(t.dtype)).reshape(tuple(t.shape))
         by_name[t.name] = arr
+    metrics().counter_add(
+        "rpc_codec_bytes_total", total, labels={"direction": "unpack"}
+    )
     # fields with defaults may be absent (a peer one release behind can
     # omit a newly added field; its default is the documented fallback)
     missing = [

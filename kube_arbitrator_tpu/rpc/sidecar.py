@@ -20,8 +20,10 @@ import time
 from concurrent import futures
 from typing import Dict, Optional, Tuple
 
+from ..utils.metrics import metrics
+from ..utils.tracing import tracer
 from . import decision_pb2 as pb
-from .codec import decide_reply, unpack_tensors
+from .codec import CORR_ID_METADATA_KEY, decide_reply, unpack_tensors
 
 log = logging.getLogger(__name__)
 
@@ -47,9 +49,9 @@ class DecisionService:
         # work stays outside the critical section)
         self._lock = threading.Lock()
         self.cycles_served = 0
-        # conf YAML -> parsed (actions, tiers); jax caches the compiled
+        # conf YAML -> parsed SchedulerConfig; jax caches the compiled
         # program per (conf, shape-bucket) under its own jit cache
-        self._conf_cache: Dict[str, Tuple] = {}
+        self._conf_cache: Dict[str, object] = {}
 
     def _config(self, conf_yaml: str):
         with self._lock:
@@ -59,49 +61,63 @@ class DecisionService:
 
             # parse outside the lock (YAML load is slow); a racing
             # duplicate parse is idempotent and last-write-wins is fine
-            cfg = load_conf(conf_yaml) if conf_yaml.strip() else SchedulerConfig.default()
-            cached = (cfg.actions, cfg.tiers)
+            cached = load_conf(conf_yaml) if conf_yaml.strip() else SchedulerConfig.default()
             with self._lock:
                 self._conf_cache[conf_yaml] = cached
         return cached
 
     def Decide(self, request: "pb.SnapshotRequest", context) -> "pb.DecideReply":
         from ..cache.snapshot import SnapshotTensors
-        from ..ops.cycle import schedule_cycle
-        from ..platform import decision_route
+        from ..framework.decider import LocalDecider
 
-        actions, tiers = self._config(request.conf_yaml)
-        # Unpack to HOST numpy: the device the tensors belong on is the
-        # crossover's decision, and it needs task_status first.  Eagerly
-        # converting to jax here (the old to_jax=True) put the whole
-        # snapshot on the accelerator and then pulled it back for every
-        # cycle the policy routes to the CPU — paying the host->chip
-        # transfer the routing exists to avoid.  schedule_cycle moves the
-        # arrays onto the routed device itself.
-        st = unpack_tensors(SnapshotTensors, request.tensors)
-        # Same backend crossover as the in-process LocalDecider
-        # (platform.decision_route): small and EVICTIVE cycles run on the
-        # host CPU even when this sidecar owns an accelerator — without
-        # this an accelerator-hosted sidecar kept evictive cycles on the
-        # chip, the 2-4x-slower path the crossover policy exists to
-        # avoid, and sidecar vs in-process deployments made different
-        # decisions (ADVICE.md sidecar item).
-        ctx, _dev, native_ops = decision_route(
-            int(st.task_valid.shape[0]), actions, st.task_status
-        )
-        t0 = time.perf_counter()
-        with ctx:
-            dec = schedule_cycle(
-                st, tiers=tiers, actions=actions,
-                native_ops=native_ops,
+        cfg = self._config(request.conf_yaml)
+        # The client ships its cycle's trace correlation id as request
+        # metadata (rpc/codec.py CORR_ID_METADATA_KEY); re-activating it
+        # here stitches this handler's spans into the SAME trace the
+        # scheduler process opened — one remote cycle, one trace.
+        corr = ""
+        for k, v in context.invocation_metadata() or ():
+            if k == CORR_ID_METADATA_KEY:
+                corr = v
+        tr = tracer()
+        t_req = time.perf_counter()
+        with tr.activate(corr or None, component="sidecar"):
+            with tr.span("sidecar.decide", cycle=int(request.cycle)):
+                # Unpack to HOST numpy: the device the tensors belong on
+                # is the crossover's decision, and it needs task_status
+                # first.  Eagerly converting to jax here (the old
+                # to_jax=True) put the whole snapshot on the accelerator
+                # and then pulled it back for every cycle the policy
+                # routes to the CPU — paying the host->chip transfer the
+                # routing exists to avoid.  The decider moves the arrays
+                # onto the routed device itself.
+                with tr.span("unpack"):
+                    st = unpack_tensors(SnapshotTensors, request.tensors)
+                # LocalDecider applies the same backend crossover as the
+                # in-process path (platform.decision_route): small and
+                # EVICTIVE cycles run on the host CPU even when this
+                # sidecar owns an accelerator (ADVICE.md sidecar item) —
+                # and, with tracing on, the staged per-action runner so
+                # kernel stages land in the trace and the action-labeled
+                # histograms.  A fresh decider per request: handlers run
+                # concurrently and last_action_ms is per-decide state.
+                decider = LocalDecider()
+                dec, kernel_ms = decider.decide(st, cfg)
+                with tr.span("pack"):
+                    rep = decide_reply(dec, cycle=request.cycle, kernel_ms=kernel_ms)
+        m = metrics()
+        m.observe("rpc_decide_duration_seconds", time.perf_counter() - t_req)
+        for stage, ms in decider.last_action_ms.items():
+            m.observe(
+                "kernel_action_duration_seconds", ms / 1000,
+                labels={"action": stage},
             )
-            dec.task_node.block_until_ready()
-        kernel_ms = (time.perf_counter() - t0) * 1000
-        # block_until_ready above MUST stay outside this lock (KAT-LCK-002:
-        # a wedged device would stall every concurrent handler)
+        m.counter_add("rpc_cycles_served_total")
+        # the blocking decide above MUST stay outside this lock
+        # (KAT-LCK-002: a wedged device would stall every handler)
         with self._lock:
             self.cycles_served += 1
-        return decide_reply(dec, cycle=request.cycle, kernel_ms=kernel_ms)
+        return rep
 
     def Health(self, request: "pb.HealthRequest", context) -> "pb.HealthReply":
         import jax

@@ -6,13 +6,55 @@ phase timing histograms because proving the <1 s/100k-pod target requires
 them.  Names follow the kube-scheduler metric conventions
 (``*_duration_seconds`` histograms, ``*_total`` counters) so standard
 dashboards apply.
+
+Thread-safety: the registry is written from the scheduler loop, the gRPC
+sidecar's handler pool, leader electors, and read by the observability
+server's ``/metrics`` handler — every method takes the one registry lock,
+and only dict/float ops run under it (KAT-LCK discipline).
+
+``METRIC_HELP`` is the single table of ``# HELP`` text for every metric
+family the system emits; registries seed their help text from it so call
+sites never re-describe a family per cycle.
 """
 from __future__ import annotations
 
 import bisect
 import dataclasses
 import math
+import threading
 from typing import Dict, List, Optional, Tuple
+
+# One table for every family's # HELP text (kube-scheduler naming
+# conventions).  New families register here, not at the observation site.
+METRIC_HELP: Dict[str, str] = {
+    # scheduler cycle
+    "e2e_scheduling_duration_seconds": "Full cycle latency: snapshot through actuation.",
+    "cycle_phase_duration_seconds": "Per-phase cycle latency (snapshot/kernel/decode/close/actuate/transport).",
+    "kernel_action_duration_seconds": "Per-action decision-kernel wall time (staged runner; action label).",
+    "binds_total": "Committed bind intents.",
+    "evicts_total": "Committed evict intents.",
+    "pending_tasks": "Pending tasks observed at cycle start.",
+    "cycles_total": "Scheduling cycles completed.",
+    # decision-plane RPC (client + sidecar)
+    "rpc_decide_duration_seconds": "Sidecar Decide handler latency (unpack through reply pack).",
+    "rpc_decide_retries_total": "Client-side Decide retries after transient transport failures.",
+    "rpc_decide_failures_total": "Decide calls that exhausted retries or hit a non-retryable error.",
+    "rpc_codec_bytes_total": "Tensor bytes through the RPC codec (direction label: pack/unpack).",
+    "rpc_cycles_served_total": "Cycles served by the decision sidecar.",
+    # live cache
+    "cache_watch_events_total": "Apiserver list/watch events applied to the live cache (phase label).",
+    "cache_resync_depth": "errTasks resync queue depth at pump time.",
+    "cache_snapshot_staleness_seconds": "Age of the live-cache model at the latest sync (gap between pumps).",
+    # leader election
+    "leader_renew_duration_seconds": "Leader lease renew round-trip latency.",
+    "leader_transitions_total": "Leadership transitions observed by this elector (to label).",
+    "leader_is_leader": "1 when this elector currently holds the lease.",
+    # flight recorder
+    "flight_anomalies_total": "Anomalies noted by the flight recorder (kind label).",
+    "flight_dumps_total": "Flight-recorder dump files written.",
+    # observability server
+    "obs_requests_total": "Observability-plane HTTP requests served (path label).",
+}
 
 
 def _default_buckets() -> List[float]:
@@ -39,21 +81,33 @@ class Histogram:
         self.total += v
         self.n += 1
 
-    def quantile(self, q: float) -> float:
-        """Linear interpolation inside the target bucket (Prometheus
-        histogram_quantile)."""
+    def quantile_capped(self, q: float) -> Tuple[float, bool]:
+        """(estimate, capped): linear interpolation inside the target
+        bucket (Prometheus histogram_quantile).  When the rank lands in
+        the +Inf overflow bucket there is no finite upper bound to
+        interpolate toward — the estimate is the last finite bucket bound
+        and ``capped`` is True (never NaN): the true quantile is >= the
+        returned value.  Callers that surface the number should mark it
+        (e.g. ">= 65.5s") instead of reporting a silently capped p99."""
         if self.n == 0:
-            return math.nan
+            return math.nan, False
         rank = q * self.n
         cum = 0
         for i, c in enumerate(self.counts):
             if cum + c >= rank and c > 0:
+                if i >= len(self.buckets):
+                    return self.buckets[-1], True  # +Inf bucket: lower bound
                 lo = self.buckets[i - 1] if i > 0 else 0.0
-                hi = self.buckets[i] if i < len(self.buckets) else self.buckets[-1]
+                hi = self.buckets[i]
                 frac = (rank - cum) / c
-                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+                return lo + (hi - lo) * min(max(frac, 0.0), 1.0), False
             cum += c
-        return self.buckets[-1]
+        return self.buckets[-1], True
+
+    def quantile(self, q: float) -> float:
+        """Quantile estimate; see :meth:`quantile_capped` for the +Inf
+        overflow-bucket semantics (returns the last finite bound then)."""
+        return self.quantile_capped(q)[0]
 
     @property
     def mean(self) -> float:
@@ -62,38 +116,48 @@ class Histogram:
 
 class MetricsRegistry:
     """Counters, gauges, histograms with label support; renders the
-    Prometheus text exposition format."""
+    Prometheus text exposition format.  All methods are thread-safe."""
 
     def __init__(self, namespace: str = "kube_arbitrator_tpu"):
         self.namespace = namespace
+        self._lock = threading.Lock()
         self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
         self._gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
         self._hists: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], Histogram] = {}
-        self._help: Dict[str, str] = {}
+        # seeded from the shared family table; describe() overrides
+        self._help: Dict[str, str] = dict(METRIC_HELP)
 
     @staticmethod
     def _key(name: str, labels: Optional[Dict[str, str]]):
         return (name, tuple(sorted((labels or {}).items())))
 
     def describe(self, name: str, help_text: str) -> None:
-        self._help[name] = help_text
+        with self._lock:
+            self._help[name] = help_text
 
     def counter_add(self, name: str, v: float = 1.0, labels: Optional[Dict[str, str]] = None) -> None:
         k = self._key(name, labels)
-        self._counters[k] = self._counters.get(k, 0.0) + v
+        with self._lock:
+            self._counters[k] = self._counters.get(k, 0.0) + v
 
     def gauge_set(self, name: str, v: float, labels: Optional[Dict[str, str]] = None) -> None:
-        self._gauges[self._key(name, labels)] = v
+        with self._lock:
+            self._gauges[self._key(name, labels)] = v
 
     def observe(self, name: str, v: float, labels: Optional[Dict[str, str]] = None) -> None:
         k = self._key(name, labels)
-        h = self._hists.get(k)
-        if h is None:
-            h = self._hists[k] = Histogram()
-        h.observe(v)
+        with self._lock:
+            h = self._hists.get(k)
+            if h is None:
+                h = self._hists[k] = Histogram()
+            h.observe(v)
 
     def histogram(self, name: str, labels: Optional[Dict[str, str]] = None) -> Optional[Histogram]:
-        return self._hists.get(self._key(name, labels))
+        """The live histogram for one series (None when never observed).
+        The returned object keeps being mutated by concurrent observes;
+        snapshot its fields promptly if consistency matters."""
+        with self._lock:
+            return self._hists.get(self._key(name, labels))
 
     # ---- rendering ----
 
@@ -104,26 +168,59 @@ class MetricsRegistry:
             parts.append(extra)
         return "{" + ",".join(parts) + "}" if parts else ""
 
+    @staticmethod
+    def _fmt_value(v: float) -> str:
+        """Full-precision sample rendering.  %g's 6 significant digits
+        lose counter increments once values pass ~1e6 (the byte counters
+        get there in a handful of cycles), which quantizes rate() on the
+        scrape side; integral values render as exact integers, the rest
+        as Python's shortest round-tripping float repr."""
+        f = float(v)
+        if f.is_integer() and abs(f) < 2**53:
+            return str(int(f))
+        return repr(f)
+
     def render(self) -> str:
+        """Prometheus text exposition.  # HELP / # TYPE are emitted once
+        per family (the format forbids repeating them per labeled series);
+        series of one family are contiguous and label-sorted."""
         ns = self.namespace
+        with self._lock:
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+            # histograms deep-copied under the lock: rendering walks bucket
+            # lists that concurrent observes mutate
+            hists = [
+                (k, Histogram(list(h.buckets), list(h.counts), h.total, h.n))
+                for k, h in sorted(self._hists.items())
+            ]
+            help_text = dict(self._help)
         out: List[str] = []
-        for (name, labels), v in sorted(self._counters.items()):
+
+        def _head(name: str, kind: str) -> None:
             full = f"{ns}_{name}"
-            if name in self._help:
-                out.append(f"# HELP {full} {self._help[name]}")
-            out.append(f"# TYPE {full} counter")
-            out.append(f"{full}{self._fmt_labels(labels)} {v:g}")
-        for (name, labels), v in sorted(self._gauges.items()):
+            if name in help_text:
+                out.append(f"# HELP {full} {help_text[name]}")
+            out.append(f"# TYPE {full} {kind}")
+
+        seen = None
+        for (name, labels), v in counters:
+            if name != seen:
+                _head(name, "counter")
+                seen = name
+            out.append(f"{ns}_{name}{self._fmt_labels(labels)} {self._fmt_value(v)}")
+        seen = None
+        for (name, labels), v in gauges:
+            if name != seen:
+                _head(name, "gauge")
+                seen = name
+            out.append(f"{ns}_{name}{self._fmt_labels(labels)} {self._fmt_value(v)}")
+        seen = None
+        for (name, labels), h in hists:
             full = f"{ns}_{name}"
-            if name in self._help:
-                out.append(f"# HELP {full} {self._help[name]}")
-            out.append(f"# TYPE {full} gauge")
-            out.append(f"{full}{self._fmt_labels(labels)} {v:g}")
-        for (name, labels), h in sorted(self._hists.items()):
-            full = f"{ns}_{name}"
-            if name in self._help:
-                out.append(f"# HELP {full} {self._help[name]}")
-            out.append(f"# TYPE {full} histogram")
+            if name != seen:
+                _head(name, "histogram")
+                seen = name
             cum = 0
             for i, b in enumerate(h.buckets):
                 cum += h.counts[i]
@@ -134,14 +231,15 @@ class MetricsRegistry:
                 out.append(f"{full}_bucket{self._fmt_labels(labels, le)} {cum}")
             le_inf = 'le="+Inf"'
             out.append(f"{full}_bucket{self._fmt_labels(labels, le_inf)} {h.n}")
-            out.append(f"{full}_sum{self._fmt_labels(labels)} {h.total:g}")
+            out.append(f"{full}_sum{self._fmt_labels(labels)} {self._fmt_value(h.total)}")
             out.append(f"{full}_count{self._fmt_labels(labels)} {h.n}")
         return "\n".join(out) + ("\n" if out else "")
 
     def reset(self) -> None:
-        self._counters.clear()
-        self._gauges.clear()
-        self._hists.clear()
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
 
 
 _registry: Optional[MetricsRegistry] = None

@@ -1,0 +1,272 @@
+"""Structured span tracing for the scheduling cycle.
+
+SURVEY §5: the reference ships leveled glog lines and nothing else — when
+a cycle misbehaves the only evidence is whatever happened to be printed.
+This module gives every scheduling cycle a **correlation id** and a tree
+of timed spans (snapshot → transfer → kernel per action → decode → close
+→ actuate), stitched across the RPC sidecar boundary: the
+:class:`rpc.client.RemoteDecider` ships the id as gRPC request metadata
+and the sidecar's handler re-activates it, so one remote-decider cycle is
+ONE trace even though two processes produced it.
+
+Design constraints, in order:
+
+* **Cheap when off.**  The tracer defaults to disabled; ``span()`` is a
+  no-op null context then (one attribute read per call site).
+* **Thread-correct.**  The active correlation id is thread-local (the
+  sidecar's gRPC handler pool serves concurrent Decide calls for
+  different cycles); the completed-span store is a dict guarded by one
+  lock, and only dict/list ops ever run under it (KAT-LCK discipline).
+* **Bounded.**  Completed traces live in an insertion-ordered dict capped
+  at ``max_traces`` — the flight recorder persists anything worth keeping
+  longer.
+* **Standard export.**  :meth:`Tracer.export_chrome` renders one trace as
+  Chrome-trace/Perfetto JSON (``chrome://tracing`` / ui.perfetto.dev),
+  complementing the whole-process ``jax.profiler`` hook the scheduler
+  already has (``--profile-dir``) with per-cycle, per-component spans.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+import time
+import uuid
+from typing import Dict, Iterator, List, Optional
+
+
+@dataclasses.dataclass
+class Span:
+    """One completed, timed region of a cycle."""
+
+    name: str
+    corr_id: str
+    component: str          # which plane produced it: scheduler | sidecar
+    ts: float               # wall-clock start (time.time seconds)
+    dur_s: float            # duration (perf_counter delta)
+    args: Dict[str, object] = dataclasses.field(default_factory=dict)
+    depth: int = 0          # nesting depth within its component/thread
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+class _NullSpan:
+    """The disabled-tracer span: absorbs the context protocol for free."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Tracer:
+    """Correlation-id span tracer with a bounded completed-trace store."""
+
+    def __init__(self, max_traces: int = 256, enabled: bool = False):
+        self.max_traces = max_traces
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        # corr id -> completed spans, insertion-ordered for eviction
+        self._traces: Dict[str, List[Span]] = {}
+        self._tls = threading.local()
+
+    # ---- enablement / identity ----
+
+    def enable(self, on: bool = True) -> None:
+        self.enabled = on
+
+    def reset(self) -> None:
+        with self._lock:
+            self._traces.clear()
+
+    @staticmethod
+    def new_corr_id(seq: Optional[int] = None) -> str:
+        """A fresh correlation id; ``seq`` embeds the cycle ordinal so ids
+        sort and read chronologically in dumps."""
+        tail = uuid.uuid4().hex[:8]
+        return f"c{seq:06d}-{tail}" if seq is not None else f"c-{tail}"
+
+    def current_corr_id(self) -> Optional[str]:
+        return getattr(self._tls, "corr", None)
+
+    def current_component(self) -> str:
+        return getattr(self._tls, "component", "scheduler")
+
+    # ---- activation (per-thread) ----
+
+    @contextlib.contextmanager
+    def activate(self, corr_id: Optional[str], component: Optional[str] = None):
+        """Bind ``corr_id`` (and optionally a component name) to this
+        thread for the duration — every ``span()`` inside attaches to it.
+        ``corr_id=None`` is a no-op passthrough so call sites need no
+        enabled-check of their own."""
+        if corr_id is None:
+            yield None
+            return
+        prev_corr = getattr(self._tls, "corr", None)
+        prev_comp = getattr(self._tls, "component", None)
+        self._tls.corr = corr_id
+        if component is not None:
+            self._tls.component = component
+        try:
+            yield corr_id
+        finally:
+            self._tls.corr = prev_corr
+            if component is not None:
+                self._tls.component = prev_comp
+
+    # ---- recording ----
+
+    def span(self, name: str, **args):
+        """Context manager timing one region under the thread's active
+        correlation id.  No active id or disabled tracer -> no-op."""
+        if not self.enabled or getattr(self._tls, "corr", None) is None:
+            return _NULL_SPAN
+        return _LiveSpan(self, name, args)
+
+    def record_span(
+        self,
+        name: str,
+        ts: float,
+        dur_s: float,
+        corr_id: Optional[str] = None,
+        component: Optional[str] = None,
+        depth: Optional[int] = None,
+        **args,
+    ) -> None:
+        """Record an externally-timed span (e.g. per-action kernel stage
+        timings measured by the staged cycle runner)."""
+        if not self.enabled:
+            return
+        corr = corr_id if corr_id is not None else getattr(self._tls, "corr", None)
+        if corr is None:
+            return
+        span = Span(
+            name=name,
+            corr_id=corr,
+            component=component or self.current_component(),
+            ts=ts,
+            dur_s=dur_s,
+            args=dict(args),
+            depth=depth if depth is not None else len(getattr(self._tls, "stack", ())),
+        )
+        self._store(span)
+
+    def _store(self, span: Span) -> None:
+        with self._lock:
+            bucket = self._traces.get(span.corr_id)
+            if bucket is None:
+                bucket = self._traces[span.corr_id] = []
+                while len(self._traces) > self.max_traces:
+                    # evict oldest corr id (insertion order)
+                    self._traces.pop(next(iter(self._traces)))
+            bucket.append(span)
+
+    # ---- retrieval / export ----
+
+    def trace_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._traces)
+
+    def spans(self, corr_id: str) -> List[Span]:
+        with self._lock:
+            return list(self._traces.get(corr_id, ()))
+
+    def export_chrome(self, corr_id: str) -> Dict[str, object]:
+        """One trace as Chrome-trace JSON (the Perfetto legacy format):
+        complete ('X') events with microsecond timestamps, one virtual
+        thread per component, correlation id in every event's args."""
+        spans = self.spans(corr_id)
+        tids: Dict[str, int] = {}
+        events: List[Dict[str, object]] = []
+        for s in spans:
+            tid = tids.setdefault(s.component, len(tids) + 1)
+            events.append(
+                {
+                    "name": s.name,
+                    "ph": "X",
+                    "cat": "cycle",
+                    "ts": s.ts * 1e6,
+                    "dur": s.dur_s * 1e6,
+                    "pid": 1,
+                    "tid": tid,
+                    "args": {"corr_id": s.corr_id, **s.args},
+                }
+            )
+        for component, tid in tids.items():
+            events.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": 1,
+                    "tid": tid,
+                    "args": {"name": component},
+                }
+            )
+        return {"displayTimeUnit": "ms", "traceEvents": events}
+
+
+class _LiveSpan:
+    """An open span: measures wall + perf_counter, stores on exit."""
+
+    __slots__ = ("_tracer", "_name", "_args", "_ts", "_t0", "_depth")
+
+    def __init__(self, tracer: Tracer, name: str, args: Dict[str, object]):
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+
+    def __enter__(self) -> "_LiveSpan":
+        tls = self._tracer._tls
+        stack = getattr(tls, "stack", None)
+        if stack is None:
+            stack = tls.stack = []
+        self._depth = len(stack)
+        stack.append(self._name)
+        self._ts = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def note(self, **args) -> None:
+        """Attach key/values discovered mid-span (e.g. bind counts)."""
+        self._args.update(args)
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        dur = time.perf_counter() - self._t0
+        tls = self._tracer._tls
+        stack = getattr(tls, "stack", None)
+        if stack:
+            stack.pop()
+        if exc_type is not None:
+            self._args.setdefault("error", f"{exc_type.__name__}: {exc}")
+        corr = getattr(tls, "corr", None)
+        if corr is not None:
+            self._tracer._store(
+                Span(
+                    name=self._name,
+                    corr_id=corr,
+                    component=self._tracer.current_component(),
+                    ts=self._ts,
+                    dur_s=dur,
+                    args=self._args,
+                    depth=self._depth,
+                )
+            )
+        return False
+
+
+_tracer: Optional[Tracer] = None
+
+
+def tracer() -> Tracer:
+    """Process-wide tracer (disabled until something enables it — the CLI
+    does when any observability flag is set)."""
+    global _tracer
+    if _tracer is None:
+        _tracer = Tracer()
+    return _tracer

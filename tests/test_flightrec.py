@@ -1,0 +1,129 @@
+"""Flight recorder: bounded ring, anomaly dumps, and the scheduler
+wiring that guarantees the last dump entry is the failing cycle."""
+import json
+import os
+
+import pytest
+
+from kube_arbitrator_tpu.cache.sim import generate_cluster
+from kube_arbitrator_tpu.framework import Scheduler
+from kube_arbitrator_tpu.framework.leader import LeaderLost
+from kube_arbitrator_tpu.utils.flightrec import CycleRecord, FlightRecorder
+
+GB = 1024**3
+
+
+def _rec(seq, **kw):
+    return CycleRecord(seq=seq, corr_id=f"c-{seq}", ts=1000.0 + seq, **kw)
+
+
+def test_ring_is_bounded_oldest_first():
+    fr = FlightRecorder(capacity=3)
+    for i in range(7):
+        fr.record(_rec(i))
+    entries = fr.entries()
+    assert [e["seq"] for e in entries] == [4, 5, 6]
+    assert fr.last().seq == 6
+
+
+def test_anomaly_without_dump_dir_is_memory_only():
+    fr = FlightRecorder(capacity=2)
+    fr.record(_rec(1))
+    assert fr.anomaly("slo_breach", "test") is None
+
+
+def test_anomaly_dump_contains_ring_and_kind(tmp_path):
+    fr = FlightRecorder(capacity=4, dump_dir=str(tmp_path))
+    for i in range(6):
+        fr.record(_rec(i, stats={"cycle_ms": float(i)}))
+    path = fr.anomaly("slo_breach", detail="cycle 5 took too long")
+    assert path is not None and os.path.exists(path)
+    dump = json.load(open(path))
+    assert dump["kind"] == "slo_breach"
+    assert dump["detail"] == "cycle 5 took too long"
+    assert [c["seq"] for c in dump["cycles"]] == [2, 3, 4, 5]
+    assert dump["cycles"][-1]["stats"]["cycle_ms"] == 5.0
+    # a second anomaly gets its own numbered file
+    path2 = fr.anomaly("leader_lost")
+    assert path2 != path and os.path.exists(path2)
+
+
+class _StaleElector:
+    """Elector double: leader until the post-decision fence checks the
+    lease — the wedged-device scenario the actuation fence guards."""
+
+    identity = "stale-leader"
+    is_leader = True
+
+    def renew(self):
+        return True
+
+    def lease_fresh(self):
+        return False
+
+
+def test_scheduler_leader_lost_dumps_failing_cycle(tmp_path):
+    """Acceptance: an induced LeaderLost writes a flight dump whose LAST
+    entry is the failing cycle (its error recorded, its seq matching)."""
+    sim = generate_cluster(num_nodes=8, num_jobs=2, tasks_per_job=3,
+                           num_queues=2, seed=2)
+    fr = FlightRecorder(capacity=8, dump_dir=str(tmp_path))
+    sched = Scheduler(sim, elector=_StaleElector(), flight=fr)
+    with pytest.raises(LeaderLost):
+        sched.run(max_cycles=2, until_idle=False)
+    dumps = sorted(os.listdir(tmp_path))
+    assert len(dumps) == 1 and "leader_lost" in dumps[0]
+    dump = json.load(open(tmp_path / dumps[0]))
+    assert dump["kind"] == "leader_lost"
+    last = dump["cycles"][-1]
+    assert last["seq"] == 1  # the first (and only) cycle is the failing one
+    assert "LeaderLost" in last["error"]
+    assert "lease stale" in last["error"]
+
+
+def test_scheduler_slo_breach_dumps_matching_cycle(tmp_path):
+    """Acceptance: a cycle over the SLO dumps the ring; the last entry is
+    the breaching cycle, digests coherent with the scheduler's stats."""
+    sim = generate_cluster(num_nodes=8, num_jobs=2, tasks_per_job=3,
+                           num_queues=2, seed=3)
+    fr = FlightRecorder(capacity=8, dump_dir=str(tmp_path))
+    # every real cycle takes > 1 ns: each of the 2 cycles breaches
+    sched = Scheduler(sim, flight=fr, cycle_slo_ms=1e-6)
+    sched.run(max_cycles=2, until_idle=False)
+    dumps = sorted(os.listdir(tmp_path))
+    assert len(dumps) == 2 and all("slo_breach" in d for d in dumps)
+    dump = json.load(open(tmp_path / dumps[-1]))
+    last = dump["cycles"][-1]
+    assert last["seq"] == 2
+    assert last["error"] is None
+    assert last["stats"]["cycle_ms"] == sched.history[-1].cycle_ms
+    assert last["digests"]["binds"] == sched.history[-1].binds
+    assert set(last["digests"]["pending_per_job"]) == {"0", "1-9", "10-99", ">=100"}
+
+
+def test_scheduler_dtype_contract_violation_dumps(tmp_path):
+    """A decider returning drifted dtypes trips the decision contract
+    assert; the flight recorder files it under dtype_contract."""
+    import numpy as np
+
+    from kube_arbitrator_tpu.framework.decider import LocalDecider
+
+    class _DriftingDecider(LocalDecider):
+        def decide(self, st, config):
+            dec, ms = super().decide(st, config)
+            import dataclasses
+
+            return dataclasses.replace(
+                dec, task_node=np.asarray(dec.task_node, dtype=np.int64)
+            ), ms
+
+    sim = generate_cluster(num_nodes=8, num_jobs=2, tasks_per_job=3,
+                           num_queues=2, seed=4)
+    fr = FlightRecorder(capacity=4, dump_dir=str(tmp_path))
+    sched = Scheduler(sim, decider=_DriftingDecider(), flight=fr)
+    with pytest.raises(TypeError, match="contract"):
+        sched.run_once()
+    dumps = os.listdir(tmp_path)
+    assert len(dumps) == 1 and "dtype_contract" in dumps[0]
+    dump = json.load(open(tmp_path / dumps[0]))
+    assert "task_node" in dump["cycles"][-1]["error"]

@@ -1,5 +1,6 @@
 """Observability: histograms, Prometheus rendering, scheduler phase timings."""
 import math
+import threading
 
 from kube_arbitrator_tpu.cache import SimCluster
 from kube_arbitrator_tpu.framework import Scheduler
@@ -17,6 +18,76 @@ def test_histogram_quantiles_and_mean():
     assert 0.001 <= h.quantile(0.5) <= 0.01
     assert h.quantile(0.99) >= 0.1
     assert not math.isnan(h.mean)
+
+
+def test_histogram_quantile_overflow_bucket_is_marked():
+    """Regression: a rank landing in the +Inf overflow bucket must not
+    silently cap the estimate — the value is the last finite bound (never
+    NaN) and ``quantile_capped`` flags it as a lower bound."""
+    h = Histogram()
+    top = h.buckets[-1]
+    for v in (0.001, 0.002):
+        h.observe(v)
+    for _ in range(8):
+        h.observe(top * 10)  # all land in the +Inf bucket
+    v99, capped = h.quantile_capped(0.99)
+    assert capped is True
+    assert v99 == top and not math.isnan(v99)
+    assert h.quantile(0.99) == top  # plain accessor agrees, NaN-free
+    # low quantiles that stay in finite buckets are uncapped
+    v10, capped10 = h.quantile_capped(0.1)
+    assert capped10 is False and v10 <= 0.002
+    # empty histogram: NaN estimate, not capped
+    v, c = Histogram().quantile_capped(0.5)
+    assert math.isnan(v) and c is False
+
+
+def test_render_keeps_full_precision_on_large_counters():
+    """Regression: %g rendering quantized counters past ~1e6 significant
+    digits, flattening rate() on high-magnitude families like
+    rpc_codec_bytes_total; integral values must render exactly."""
+    r = MetricsRegistry(namespace="kat")
+    r.counter_add("bytes_total", 12345678.0)
+    r.counter_add("bytes_total", 1.0)
+    r.gauge_set("staleness_seconds", 0.1234567890123)
+    text = r.render()
+    assert "kat_bytes_total 12345679\n" in text
+    assert "kat_staleness_seconds 0.1234567890123\n" in text
+
+
+def test_registry_is_thread_safe_under_concurrent_writes():
+    """The sidecar's handler threads and the scheduler loop write the one
+    registry concurrently while the obs server renders it (the KAT-LCK
+    failure mode): hammer all three op kinds from 8 threads and render
+    in the middle; totals must come out exact."""
+    r = MetricsRegistry(namespace="kat")
+    threads, per_thread = 8, 500
+    renders = []
+
+    def writer(i):
+        for k in range(per_thread):
+            r.counter_add("ops_total", 1, labels={"t": str(i % 2)})
+            r.observe("dur_seconds", 0.001 * (k % 50 + 1))
+            r.gauge_set("depth", float(k))
+            if k % 100 == 0:
+                renders.append(r.render())
+
+    ts = [threading.Thread(target=writer, args=(i,)) for i in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    text = r.render()
+    total = sum(
+        float(line.rsplit(" ", 1)[1])
+        for line in text.splitlines()
+        if line.startswith("kat_ops_total{")
+    )
+    assert total == threads * per_thread
+    h = r.histogram("dur_seconds")
+    assert h.n == threads * per_thread
+    assert sum(h.counts) == h.n
+    assert all(renders)  # every mid-write render produced parseable text
 
 
 def test_registry_render_prometheus_text():

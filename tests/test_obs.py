@@ -1,0 +1,286 @@
+"""The served observability plane + Prometheus text conformance.
+
+The conformance checker parses ``MetricsRegistry.render()`` line by line
+against the exposition-format rules scrapers actually enforce: HELP/TYPE
+emitted once per family and before its samples, cumulative ``le`` buckets
+monotone, ``_count`` equal to the +Inf bucket.  The e2e test runs real sim
+cycles (remote decider + leader elector, tracing on) with the obs server
+up and asserts every endpoint serves coherent values — the acceptance
+criteria for the observability plane.
+"""
+import json
+import re
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kube_arbitrator_tpu.obs import scheduler_status_fn, serve_obs
+from kube_arbitrator_tpu.utils.flightrec import FlightRecorder
+from kube_arbitrator_tpu.utils.metrics import METRIC_HELP, MetricsRegistry, metrics
+from kube_arbitrator_tpu.utils.tracing import tracer
+
+_SAMPLE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)(?P<labels>\{[^}]*\})? (?P<value>\S+)$"
+)
+
+
+def _strip_le(labels: str) -> str:
+    inner = labels.strip("{}")
+    parts = [p for p in inner.split(",") if p and not p.startswith("le=")]
+    return ",".join(sorted(parts))
+
+
+def check_promtext(text: str) -> None:
+    """Assert ``text`` is conformant Prometheus exposition format:
+    HELP before TYPE, TYPE once per family and before its samples,
+    families contiguous, histogram le buckets cumulative-monotone with
+    ``_count`` equal to the +Inf bucket per label set."""
+    typed = {}            # family -> declared type
+    current = None        # family of the block being read
+    closed = set()        # families whose block has ended
+    hist_buckets = {}     # (family, base labels) -> [cumulative counts]
+    hist_inf = {}         # (family, base labels) -> +Inf bucket value
+    hist_count = {}       # (family, base labels) -> _count value
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP"):
+            _, _, fam, _ = line.split(" ", 3)
+            assert fam not in typed, f"HELP for {fam} after its TYPE"
+            continue
+        if line.startswith("# TYPE"):
+            _, _, fam, kind = line.split(" ", 3)
+            assert fam not in typed, f"duplicate TYPE for {fam}"
+            assert fam not in closed, f"family {fam} split into two blocks"
+            assert kind in ("counter", "gauge", "histogram")
+            typed[fam] = kind
+            if current is not None:
+                closed.add(current)
+            current = fam
+            continue
+        m = _SAMPLE.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        name = m.group("name")
+        fam = name if name in typed else re.sub(r"_(bucket|sum|count)$", "", name)
+        assert fam in typed, f"sample {name} before any TYPE"
+        assert fam == current, f"sample {name} outside its family block"
+        value = float(m.group("value"))
+        if typed[fam] == "histogram":
+            labels = m.group("labels") or ""
+            key = (fam, _strip_le(labels))
+            if name.endswith("_bucket"):
+                if 'le="+Inf"' in labels:
+                    hist_inf[key] = value
+                else:
+                    hist_buckets.setdefault(key, []).append(value)
+            elif name.endswith("_count"):
+                hist_count[key] = value
+    for key, buckets in hist_buckets.items():
+        assert buckets == sorted(buckets), f"{key}: le buckets not monotone"
+        assert key in hist_inf, f"{key}: no +Inf bucket"
+        assert hist_inf[key] >= buckets[-1], f"{key}: +Inf below last bucket"
+    for key, count in hist_count.items():
+        assert hist_inf.get(key) == count, f"{key}: _count != +Inf bucket"
+
+
+def _le_values(text: str, fam: str, labels_filter: str = "") -> list:
+    out = []
+    for line in text.splitlines():
+        m = _SAMPLE.match(line) if line and not line.startswith("#") else None
+        if m and m.group("name") == f"{fam}_bucket":
+            labels = m.group("labels") or ""
+            if labels_filter and labels_filter not in labels:
+                continue
+            out.append(float(m.group("value")))
+    return out
+
+
+def test_promtext_conformance_synthetic():
+    r = MetricsRegistry(namespace="kat")
+    r.counter_add("binds_total", 3)
+    r.counter_add("watch_total", 1, labels={"phase": "list"})
+    r.counter_add("watch_total", 9, labels={"phase": "watch"})
+    r.gauge_set("pending_tasks", 7)
+    for v in (0.002, 0.004, 0.1, 50.0, 200.0):  # incl. +Inf overflow
+        r.observe("dur_seconds", v, labels={"phase": "kernel"})
+        r.observe("dur_seconds", v / 2, labels={"phase": "decode"})
+    text = r.render()
+    check_promtext(text)
+    # multi-label-set families emit TYPE exactly once
+    assert text.count("# TYPE kat_watch_total counter") == 1
+    assert text.count("# TYPE kat_dur_seconds histogram") == 1
+    kernel_buckets = _le_values(text, "kat_dur_seconds", 'phase="kernel"')
+    assert kernel_buckets == sorted(kernel_buckets)
+
+
+def test_metric_help_table_covers_scheduler_families():
+    """HELP text lives in ONE module-level table; the families the
+    scheduler loop emits every cycle must all be declared there."""
+    for fam in (
+        "e2e_scheduling_duration_seconds",
+        "cycle_phase_duration_seconds",
+        "kernel_action_duration_seconds",
+        "binds_total",
+        "evicts_total",
+        "pending_tasks",
+        "rpc_decide_duration_seconds",
+        "leader_renew_duration_seconds",
+    ):
+        assert fam in METRIC_HELP, fam
+    r = MetricsRegistry(namespace="kat")
+    r.counter_add("binds_total", 1)
+    assert "# HELP kat_binds_total" in r.render()
+
+
+def test_obs_unknown_paths_share_one_counter_series(tmp_path):
+    """Regression: a scanner probing random paths must not mint unbounded
+    obs_requests_total label series in the process-wide registry."""
+    reg = MetricsRegistry(namespace="kat")
+    server, _t, url = serve_obs(registry=reg)
+    try:
+        for p in ("/wp-admin", "/.env", "/id/1", "/id/2", "/metrics"):
+            try:
+                _get(url + p)
+            except urllib.error.HTTPError:
+                pass
+    finally:
+        server.shutdown()
+    text = reg.render()
+    assert 'kat_obs_requests_total{path="other"} 4' in text
+    assert "/wp-admin" not in text
+
+
+def test_leader_demotion_paths_update_telemetry(tmp_path):
+    """Regression: lease_fresh()'s actuation-fence demotion and
+    release() must flip leader_is_leader and count a standby transition
+    (renew() alone covered only one of the three demotion paths)."""
+    from kube_arbitrator_tpu.framework import LeaderElector
+
+    metrics().reset()
+    clock = [1000.0]
+    el = LeaderElector(lock_path=str(tmp_path / "l.lock"), identity="a",
+                       now_fn=lambda: clock[0])
+    assert el.try_acquire()
+    assert metrics()._gauges[("leader_is_leader", ())] == 1.0
+    clock[0] += el.renew_deadline_s + 1  # decide hung past the deadline
+    assert el.lease_fresh() is False
+    assert metrics()._gauges[("leader_is_leader", ())] == 0.0
+    trans = metrics()._counters[("leader_transitions_total", (("to", "standby"),))]
+    assert trans == 1.0
+    assert el.try_acquire()  # re-acquire, then voluntary release
+    el.release()
+    assert metrics()._gauges[("leader_is_leader", ())] == 0.0
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        body = resp.read()
+        return resp.status, body
+
+
+@pytest.fixture
+def obs_e2e(tmp_path):
+    """3 sim cycles with the full plane: tracing on, file-lease leader,
+    remote decider (in-process sidecar), flight recorder, obs server."""
+    pytest.importorskip("grpc")
+    from kube_arbitrator_tpu.cache.sim import generate_cluster
+    from kube_arbitrator_tpu.framework import LeaderElector, Scheduler
+    from kube_arbitrator_tpu.rpc import DecisionService, RemoteDecider, serve
+
+    metrics().reset()
+    tr = tracer()
+    tr.reset()
+    tr.enable()
+    grpc_server, port = serve("127.0.0.1:0", service=DecisionService())
+    sim = generate_cluster(num_nodes=16, num_jobs=4, tasks_per_job=4,
+                           num_queues=2, seed=9)
+    # generous lease timing: a cold first cycle compiles the staged
+    # kernels and must not trip the actuation fence on a slow CI box
+    elector = LeaderElector(lock_path=str(tmp_path / "leader.lock"),
+                            identity="obs-test", lease_duration_s=300.0,
+                            renew_deadline_s=120.0, retry_period_s=5.0)
+    flight = FlightRecorder(capacity=16, dump_dir=str(tmp_path / "flight"))
+    sched = Scheduler(
+        sim, elector=elector, flight=flight,
+        decider=RemoteDecider(f"127.0.0.1:{port}"),
+    )
+    sched.run(max_cycles=3, until_idle=False)
+    server, thread, url = serve_obs(
+        flight=flight, status_fn=scheduler_status_fn(sched)
+    )
+    try:
+        yield sched, url
+    finally:
+        server.shutdown()
+        sched.decider.close()
+        grpc_server.stop(grace=None)
+        elector.release()
+        tr.enable(False)
+        tr.reset()
+
+
+def test_obs_plane_end_to_end(obs_e2e):
+    """Acceptance: /metrics serves conformant Prometheus text including
+    the new RPC / leader / per-action families; health + debug endpoints
+    answer with values coherent with the scheduler's own state."""
+    sched, url = obs_e2e
+
+    status, body = _get(url + "/metrics")
+    assert status == 200
+    text = body.decode()
+    check_promtext(text)
+    ns = "kube_arbitrator_tpu"
+    for fam in (
+        f"{ns}_rpc_decide_duration_seconds",
+        f"{ns}_leader_renew_duration_seconds",
+        f"{ns}_rpc_codec_bytes_total",
+        f"{ns}_e2e_scheduling_duration_seconds",
+    ):
+        assert f"# TYPE {fam}" in text, fam
+    # action-labeled kernel histograms (staged runner, sidecar side)
+    assert re.search(
+        rf'{ns}_kernel_action_duration_seconds_count{{action="allocate"}} 3\b',
+        text,
+    )
+    # counters agree with the scheduler's own history
+    binds = sum(s.binds for s in sched.history)
+    assert f"{ns}_binds_total {binds:g}" in text
+    assert f"{ns}_cycles_total 3" in text
+    assert f"{ns}_rpc_cycles_served_total 3" in text
+    assert f"{ns}_leader_is_leader 1" in text
+
+    status, body = _get(url + "/healthz")
+    health = json.loads(body)
+    assert status == 200 and health["ok"] and health["device_count"] >= 1
+    assert health["leader"] is True and health["cycles"] == 3
+
+    status, body = _get(url + "/readyz")
+    assert status == 200 and json.loads(body)["ready"] is True
+
+    status, body = _get(url + "/debug/cycles")
+    cycles = json.loads(body)["cycles"]
+    assert [c["seq"] for c in cycles] == [1, 2, 3]
+    assert all(c["error"] is None for c in cycles)
+    assert sum(c["digests"]["binds"] for c in cycles) == binds
+    # every recorded cycle carries its spans and a correlation id
+    assert all(c["corr_id"] and c["spans"] for c in cycles)
+
+    corr = cycles[-1]["corr_id"]
+    status, body = _get(url + f"/debug/trace/{corr}")
+    assert status == 200
+    trace = json.loads(body)
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert {"cycle", "snapshot", "sidecar.decide"} <= names
+    comps = {
+        e["args"]["name"] for e in trace["traceEvents"] if e["ph"] == "M"
+    }
+    assert comps == {"scheduler", "sidecar"}
+
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _get(url + "/debug/trace/nope")
+    assert err.value.code == 404
+
+    # the index route lists the endpoint catalog
+    status, body = _get(url + "/")
+    assert status == 200 and "/metrics" in json.loads(body)["endpoints"]

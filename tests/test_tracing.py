@@ -1,0 +1,127 @@
+"""Span tracing: nesting, correlation ids, bounded store, Chrome export,
+and the stitched scheduler<->sidecar trace across the RPC boundary."""
+import threading
+import time
+
+import pytest
+
+from kube_arbitrator_tpu.utils.tracing import Tracer, tracer
+
+
+def test_disabled_tracer_is_noop():
+    tr = Tracer()
+    with tr.activate("c-x"):
+        with tr.span("a"):
+            pass
+    assert tr.trace_ids() == []
+
+
+def test_span_requires_active_corr_id():
+    tr = Tracer(enabled=True)
+    with tr.span("orphan"):
+        pass  # no activate() -> nothing recorded
+    assert tr.trace_ids() == []
+
+
+def test_spans_nest_and_export_chrome():
+    tr = Tracer(enabled=True)
+    with tr.activate("c-1"):
+        with tr.span("cycle", seq=1):
+            with tr.span("snapshot"):
+                time.sleep(0.001)
+            with tr.span("decide"):
+                pass
+    spans = {s.name: s for s in tr.spans("c-1")}
+    assert set(spans) == {"cycle", "snapshot", "decide"}
+    assert spans["cycle"].depth == 0
+    assert spans["snapshot"].depth == 1
+    assert spans["cycle"].dur_s >= spans["snapshot"].dur_s > 0
+    assert spans["cycle"].args["seq"] == 1
+    chrome = tr.export_chrome("c-1")
+    events = chrome["traceEvents"]
+    xs = [e for e in events if e["ph"] == "X"]
+    assert len(xs) == 3
+    for e in xs:
+        assert e["args"]["corr_id"] == "c-1"
+        assert e["ts"] > 0 and e["dur"] >= 0
+    # component metadata event names the virtual thread
+    assert any(e["ph"] == "M" and e["args"]["name"] == "scheduler" for e in events)
+
+
+def test_span_records_error_on_exception():
+    tr = Tracer(enabled=True)
+    with pytest.raises(RuntimeError):
+        with tr.activate("c-err"):
+            with tr.span("cycle"):
+                raise RuntimeError("boom")
+    (span,) = tr.spans("c-err")
+    assert "RuntimeError: boom" in span.args["error"]
+
+
+def test_trace_store_is_bounded():
+    tr = Tracer(enabled=True, max_traces=4)
+    for i in range(10):
+        with tr.activate(f"c-{i}"):
+            with tr.span("cycle"):
+                pass
+    ids = tr.trace_ids()
+    assert len(ids) == 4
+    assert ids == [f"c-{i}" for i in range(6, 10)]  # oldest evicted
+
+
+def test_activation_is_thread_local():
+    tr = Tracer(enabled=True)
+    seen = []
+
+    def worker(corr):
+        with tr.activate(corr, component=corr):
+            with tr.span("w"):
+                time.sleep(0.002)
+            seen.append(tr.current_corr_id())
+
+    threads = [threading.Thread(target=worker, args=(f"t-{i}",)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(seen) == [f"t-{i}" for i in range(4)]
+    for i in range(4):
+        (span,) = tr.spans(f"t-{i}")
+        assert span.component == f"t-{i}"
+
+
+def test_remote_decider_cycle_stitches_one_trace():
+    """Acceptance: a remote-decider cycle is ONE trace — a single
+    correlation id spans both the scheduler's client-side spans and the
+    sidecar's handler spans (the id rides the gRPC request metadata)."""
+    pytest.importorskip("grpc")
+    from kube_arbitrator_tpu.cache.sim import generate_cluster
+    from kube_arbitrator_tpu.framework import Scheduler
+    from kube_arbitrator_tpu.rpc import DecisionService, RemoteDecider, serve
+
+    tr = tracer()
+    tr.reset()
+    tr.enable()
+    server, port = serve("127.0.0.1:0", service=DecisionService())
+    try:
+        sim = generate_cluster(
+            num_nodes=16, num_jobs=3, tasks_per_job=4, num_queues=2, seed=5
+        )
+        sched = Scheduler(sim, decider=RemoteDecider(f"127.0.0.1:{port}"))
+        sched.run(max_cycles=2, until_idle=False)
+        ids = tr.trace_ids()
+        assert len(ids) == 2  # one trace per cycle
+        for corr in ids:
+            spans = tr.spans(corr)
+            assert {s.corr_id for s in spans} == {corr}
+            by_comp = {s.component for s in spans}
+            assert by_comp == {"scheduler", "sidecar"}
+            names = {s.name for s in spans}
+            # client-side, handler-side, and kernel-stage spans all stitch
+            assert {"cycle", "rpc.call", "sidecar.decide", "unpack"} <= names
+            assert any(n.startswith("kernel.") for n in names)
+        sched.decider.close()
+    finally:
+        server.stop(grace=None)
+        tr.enable(False)
+        tr.reset()
