@@ -75,9 +75,15 @@ def _time_cycle(schedule_cycle, instances, actions, reps=3):
     - The timed region ends at a forced device→host transfer of the
       bind mask (np.asarray), which production decoding pays anyway —
       a premature async unblock cannot fake a row through it.
+    - PROVENANCE (ADVICE r5): reps run on *different* instances, so the
+      rate must pair one rep's time with THAT rep's own placement count
+      — dividing the seed-42 instance's binds by the median of other
+      instances' times mixed provenance.  The caller gets per-rep times
+      AND per-rep binds plus the index of the median rep, and computes
+      value = rep_binds[median] / times[median].
 
-    Returns (median seconds, per-rep ms list, decisions of the FIRST
-    instance — the canonical seed the parity suite pins).
+    Returns (times_s list, rep_binds list, median rep index, decisions of
+    the FIRST instance — the canonical seed the parity suite pins).
     """
     import jax
 
@@ -90,7 +96,7 @@ def _time_cycle(schedule_cycle, instances, actions, reps=3):
     jax.block_until_ready(dec0)  # compile + first-exec stall absorber
     dec0 = schedule_cycle(instances[0], actions=actions)
     np.asarray(dec0.bind_mask)  # settle exec: forces full pipeline once
-    times = []
+    times, rep_binds = [], []
     for i in range(reps):
         if len(instances) > 1:
             t = instances[(i % (len(instances) - 1)) + 1]
@@ -105,15 +111,17 @@ def _time_cycle(schedule_cycle, instances, actions, reps=3):
         jax.block_until_ready(t)
         t0 = time.perf_counter()
         dec = schedule_cycle(t, actions=actions)
-        np.asarray(dec.bind_mask)  # honest end: decisions reach the host
+        mask = np.asarray(dec.bind_mask)  # honest end: decisions reach the host
         times.append(time.perf_counter() - t0)
+        rep_binds.append(int(mask.sum()))
     # wildly inconsistent reps are a measurement smell — surface them
     # instead of silently medianing (the flag also rides the row dict via
     # the rep_ms list the caller records)
     if max(times) > 10 * max(min(times), 1e-9):
         print(f"# inconsistent reps for {actions}: "
               f"{[round(t * 1000, 1) for t in times]} ms", file=sys.stderr)
-    return float(np.median(times)), [round(t * 1000, 1) for t in times], dec0
+    med_idx = int(np.argsort(times)[len(times) // 2])
+    return times, rep_binds, med_idx, dec0
 
 
 def _cluster(num_tasks, num_nodes, num_queues, running_fraction, seed=42):
@@ -127,7 +135,7 @@ def _cluster(num_tasks, num_nodes, num_queues, running_fraction, seed=42):
         seed=seed,
         running_fraction=running_fraction,
     )
-    return build_snapshot(sim.cluster)
+    return sim, build_snapshot(sim.cluster)
 
 
 def _instances(num_tasks, num_nodes, num_queues, running_fraction, want=3):
@@ -138,16 +146,20 @@ def _instances(num_tasks, num_nodes, num_queues, running_fraction, want=3):
     snapshot would recompile inside the timed region, so it is skipped;
     if no variant matches (tiny configs near a bucket boundary), the
     timer falls back to value-copies of the canonical instance.
+
+    Returns (tensor instance list, canonical SimCluster, canonical
+    Snapshot) — the sim/snapshot feed the host-path phase probes.
     """
     import jax.tree_util as jtu
 
-    canon = _cluster(num_tasks, num_nodes, num_queues, running_fraction)
+    sim, canon = _cluster(num_tasks, num_nodes, num_queues, running_fraction)
     flat0, treedef0 = jtu.tree_flatten(canon.tensors)
     shapes0 = [getattr(a, "shape", None) for a in flat0]
     out = [canon.tensors]
     seed = 43
     while len(out) < want + 1 and seed < 43 + 2 * want + 4:
-        t = _cluster(num_tasks, num_nodes, num_queues, running_fraction, seed=seed).tensors
+        _, snap = _cluster(num_tasks, num_nodes, num_queues, running_fraction, seed=seed)
+        t = snap.tensors
         flat, treedef = jtu.tree_flatten(t)
         if treedef == treedef0 and [getattr(a, "shape", None) for a in flat] == shapes0:
             out.append(t)
@@ -155,7 +167,74 @@ def _instances(num_tasks, num_nodes, num_queues, running_fraction, want=3):
             print(f"# variant seed {seed} bucketed to different shapes; skipped",
                   file=sys.stderr)
         seed += 1
-    return out
+    return out, sim, canon
+
+
+def _phase_probe(sim, dec0, reps):
+    """Host-path phase costs per rep: full snapshot rebuild, pack device
+    upload, decision decode.  Measured on the canonical instance — host
+    phases have no device-memoization hazard (the distinct-content rule
+    exists for the accelerator tunnel), and decode pairs the canonical
+    decisions with a snapshot rebuilt from the same canonical cluster
+    (identical content) for honest provenance.
+    Returns a list of {"snapshot_ms", "upload_ms", "decode_ms"} dicts the
+    caller zips with the kernel reps into the row's ``rep_phases``."""
+    import jax
+
+    from kube_arbitrator_tpu.cache import build_snapshot
+    from kube_arbitrator_tpu.cache.decode import decode_decisions
+
+    phases = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        snap = build_snapshot(sim.cluster)
+        t1 = time.perf_counter()
+        st_dev = jax.device_put(snap.tensors)
+        jax.block_until_ready(st_dev)
+        t2 = time.perf_counter()
+        decode_decisions(snap, dec0)
+        t3 = time.perf_counter()
+        phases.append({
+            "snapshot_ms": round((t1 - t0) * 1000, 1),
+            "upload_ms": round((t2 - t1) * 1000, 1),
+            "decode_ms": round((t3 - t2) * 1000, 1),
+        })
+    return phases
+
+
+def _arena_probe(sim, canon_snap, dec0):
+    """Steady-state incremental-snapshot cost (cache/arena.py): apply the
+    canonical cycle's own binds/evicts to the sim (exactly cycle 2's
+    churn), then time the arena's delta pack.  verify() asserts the delta
+    pack byte-identical to a full rebuild OUTSIDE the timed region, so
+    the number can't come from a wrong pack.  MUTATES ``sim`` — callers
+    run it last."""
+    from kube_arbitrator_tpu.cache.arena import SnapshotArena
+    from kube_arbitrator_tpu.cache.decode import decode_decisions
+
+    arena = SnapshotArena(sim, verify_every=0)
+    arena.snapshot()  # seed pack (adopts the full build)
+    binds, evicts = decode_decisions(canon_snap, dec0)
+    sim.apply_binds(binds)
+    sim.apply_evicts(evicts)
+    t0 = time.perf_counter()
+    arena.snapshot()
+    delta_ms = (time.perf_counter() - t0) * 1000
+    arena.verify()  # byte-identity gate, untimed
+    # provenance: a structural fallback here means the timed pack was a
+    # FULL rebuild, not the delta path — label it so the trajectory can
+    # never mistake a rebuild time for the steady-state number
+    reason = arena.last_rebuild_reason
+    row = {
+        "snapshot_delta_ms": round(delta_ms, 1),
+        "delta_rows": int(arena.last_delta_rows),
+        "delta_binds": len(binds),
+        "delta_evicts": len(evicts),
+    }
+    if reason is not None:
+        row["rebuild_reason"] = reason
+        row["note"] = "structural fallback: timed pack was a full rebuild"
+    return row
 
 
 def main() -> None:
@@ -327,24 +406,52 @@ def _measure_main() -> None:
         ]
         from kube_arbitrator_tpu.platform import decision_device
 
+        run_phases = os.environ.get("BENCH_PHASES", "1") != "0"
         for metric, T, N, Q, frac, actions in ladder:
             try:
-                inst = _instances(T, N, Q, frac)
-                cycle_s, rep_ms, dec = _time_cycle(schedule_cycle, inst, actions)
-                placed = int(np.asarray(dec.bind_mask).sum())
+                inst, sim, canon = _instances(T, N, Q, frac)
+                times, rep_binds, med, dec = _time_cycle(
+                    schedule_cycle, inst, actions
+                )
+                cycle_s, placed = times[med], rep_binds[med]
+                rep_ms = [round(t * 1000, 1) for t in times]
                 evicted = int(np.asarray(dec.evict_mask).sum())
+                phases, arena = [], None
+                if run_phases:
+                    # host-path phases on the unmutated canonical sim
+                    # first; the arena probe applies the cycle's intents
+                    # (it measures cycle 2's steady-state pack) last
+                    phases = _phase_probe(sim, dec, reps=len(times))
+                    try:
+                        arena = _arena_probe(sim, canon, dec)
+                    except Exception as e:
+                        arena = {"error": str(e)[:200]}
                 row = {
                     "metric": metric,
                     "value": round(placed / cycle_s, 1) if cycle_s > 0 else 0.0,
                     "unit": "pods/s",
                     "cycle_ms": round(cycle_s * 1000, 1),
                     "rep_ms": rep_ms,
+                    "rep_binds": rep_binds,
                     "distinct_instances": len(inst) - 1,
                     "binds": placed,
+                    "binds_seed42": int(np.asarray(dec.bind_mask).sum()),
                     "evicts": evicted,
+                    # ADVICE r5: value pairs the MEDIAN rep's own placement
+                    # count with that same rep's time (reps run distinct
+                    # instances; mixing the seed-42 binds with another
+                    # instance's time was mixed provenance).  evicts /
+                    # binds_seed42 describe the canonical instance.
+                    "provenance": "value = median rep's own binds / its time",
+                    "rep_phases": [
+                        dict(p, kernel_ms=rep_ms[i])
+                        for i, p in enumerate(phases)
+                    ],
                     "native_ops": use_native,
                     "cadence_contract_s": 1.0,
                 }
+                if arena is not None:
+                    row["arena"] = arena
                 ladder_rows.append(row)
                 _emit(row, stream=sys.stderr)
                 _spill(row)
@@ -363,17 +470,21 @@ def _measure_main() -> None:
                         if policy_native else schedule_cycle
                     )
                     with jax.default_device(dev):
-                        p_s, p_rep, p_dec = _time_cycle(cpu_cycle, inst, actions)
-                    p_placed = int(np.asarray(p_dec.bind_mask).sum())
+                        p_times, p_binds, p_med, p_dec = _time_cycle(
+                            cpu_cycle, inst, actions
+                        )
+                    p_s, p_placed = p_times[p_med], p_binds[p_med]
                     prow = {
                         "metric": metric + "/policy",
                         "value": round(p_placed / p_s, 1) if p_s > 0 else 0.0,
                         "unit": "pods/s",
                         "cycle_ms": round(p_s * 1000, 1),
-                        "rep_ms": p_rep,
+                        "rep_ms": [round(t * 1000, 1) for t in p_times],
+                        "rep_binds": p_binds,
                         "distinct_instances": len(inst) - 1,
                         "binds": p_placed,
                         "evicts": int(np.asarray(p_dec.evict_mask).sum()),
+                        "provenance": "value = median rep's own binds / its time",
                         "native_ops": policy_native,
                         "backend": str(dev),
                         "note": "backend the crossover policy selects in production",
@@ -398,12 +509,14 @@ def _measure_primary(schedule_cycle, num_tasks, num_nodes, oracle_cap_s):
     from kube_arbitrator_tpu.cache import generate_cluster
     from kube_arbitrator_tpu.oracle import SequentialScheduler
 
-    inst = _instances(num_tasks, num_nodes, 8, 0.0, want=5)
+    inst, _sim, _canon = _instances(num_tasks, num_nodes, 8, 0.0, want=5)
     snap_tensors = inst[0]
-    cycle_s, rep_ms, dec = _time_cycle(
+    times, rep_binds, med, dec = _time_cycle(
         schedule_cycle, inst, ("allocate", "backfill"), reps=5
     )
-    n_placed = int(np.asarray(dec.bind_mask).sum())
+    # median rep's own time paired with its own placement count (the
+    # same provenance rule the ladder rows follow — ADVICE r5)
+    cycle_s, n_placed = times[med], rep_binds[med]
     pods_per_sec = n_placed / cycle_s if cycle_s > 0 else 0.0
 
     native_rate = faithful_rate = None
@@ -467,6 +580,9 @@ def _measure_primary(schedule_cycle, num_tasks, num_nodes, oracle_cap_s):
         "metric": f"pods_scheduled_per_sec@{num_tasks}x{num_nodes}",
         "value": round(pods_per_sec, 1),
         "unit": "pods/s",
+        "rep_ms": [round(t * 1000, 1) for t in times],
+        "rep_binds": rep_binds,
+        "provenance": "value = median rep's own binds / its time",
         "vs_baseline": round(vs_baseline, 2),
         "baseline": "seq_native_loop" if native_rate else "python_oracle",
         "vs_baseline_faithful": (

@@ -67,9 +67,34 @@ EOF
   fi
 fi
 
+# ARENA_EQUIV=1: the incremental snapshot plane's equivalence lane — run
+# the randomized mutation-stream byte-identity suite + the arena soak,
+# then re-lint the arena producer chain under the dtype/lock families
+# (its delta path must satisfy the same SNAPSHOT contract KAT-CTR-007
+# checks inside the default lint gate above).
+rc_arena=0
+if [ "${ARENA_EQUIV:-0}" = "1" ]; then
+  env JAX_PLATFORMS=cpu python -m pytest -q \
+    tests/test_arena.py \
+    tests/test_soak.py::test_arena_soak_50_cycles_matches_full_rebuild \
+    || rc_arena=$?
+  python -m kube_arbitrator_tpu.analysis --rules KAT-LCK,KAT-DTY \
+    kube_arbitrator_tpu/cache/arena.py \
+    kube_arbitrator_tpu/cache/sim.py \
+    kube_arbitrator_tpu/cache/live.py \
+    kube_arbitrator_tpu/rpc/codec.py \
+    kube_arbitrator_tpu/rpc/sidecar.py || rc_arena=$?
+  if [ "${rc_arena}" -ne 0 ]; then
+    echo "arena equivalence job: FAILED (exit ${rc_arena})" >&2
+  else
+    echo "arena equivalence job: ok"
+  fi
+fi
+
 if [ "${LINT_ONLY:-0}" = "1" ]; then
   if [ "${rc_lint}" -ne 0 ]; then exit "${rc_lint}"; fi
-  exit "${rc_obs}"
+  if [ "${rc_obs}" -ne 0 ]; then exit "${rc_obs}"; fi
+  exit "${rc_arena}"
 fi
 
 rc_test=0
@@ -82,4 +107,5 @@ fi
 
 if [ "${rc_lint}" -ne 0 ]; then exit "${rc_lint}"; fi
 if [ "${rc_obs}" -ne 0 ]; then exit "${rc_obs}"; fi
+if [ "${rc_arena}" -ne 0 ]; then exit "${rc_arena}"; fi
 exit "${rc_test}"

@@ -41,6 +41,10 @@ Sub-ids:
   or dtypes disagree with what the next pipeline stage consumes.
 - ``KAT-CTR-006``: the fused ``schedule_cycle`` decisions disagree with
   the actuation-side contract (``framework/session.py`` decodes them).
+- ``KAT-CTR-007``: the incremental snapshot producer (``cache/arena.py``
+  delta path) emits a pack violating the same SNAPSHOT schema the full
+  rebuild is held to — checked on a real mini-cluster after a bind delta,
+  so the row-refresh/group-recompute path is what's evaluated.
 
 The harness takes the schemas as parameters so the regression tests can
 seed one mutated dtype and assert the checker reports exactly the
@@ -310,6 +314,49 @@ def snapshot_struct(
     return SnapshotTensors(**kw)
 
 
+def _mini_cluster():
+    """The shared producer-check fixture: one node, a gang job with a
+    pending task, a second job with a running task (so the reclaim pack
+    has a victim candidate).  Both producer passes (build_snapshot and
+    the arena delta path) build from this same cluster."""
+    from ..api.types import TaskStatus
+    from ..cache.sim import SimCluster
+
+    sim = SimCluster()
+    sim.add_queue("default", weight=1)
+    sim.add_node("n1", cpu_milli=4000, memory=8 * 1024**3)
+    j = sim.add_job("j1", queue="default", min_available=1)
+    t1 = sim.add_task(j, 1000, 1024**3)
+    j2 = sim.add_job("j2", queue="default")
+    sim.add_task(j2, 500, 1024**3, status=TaskStatus.RUNNING, node="n1")
+    return sim, t1
+
+
+def _snapshot_axes(t) -> Dict[str, int]:
+    """Resolve the symbolic axes from a BUILT pack — shared by every
+    producer-side check so the axis identities can't drift between them."""
+    return {
+        "T": t.task_resreq.shape[0],
+        "N": t.node_idle.shape[0],
+        "G": t.group_job.shape[0],
+        "J": t.job_queue.shape[0],
+        "Q": t.queue_weight.shape[0],
+        "R": t.task_resreq.shape[1],
+        "W": t.task_ports.shape[1],
+        "CT": t.class_fit.shape[0],
+        "CN": t.class_fit.shape[1],
+        "K": t.node_dom.shape[0],
+        "TF": t.aff_key.shape[0],
+        "TA": t.anti_key.shape[0],
+        "D": t.aff_static.shape[1],
+        "CP": t.aff_match.shape[1],
+        "CS": t.symm_ok.shape[0],
+        "MA": t.group_aff_terms.shape[1],
+        "MB": t.group_anti_terms.shape[1],
+        "V": t.rv_idx.shape[0],
+    }
+
+
 # ---------------------------------------------------------------------------
 # the passes
 
@@ -349,18 +396,10 @@ def check_producer(
     themselves, so the check is about dtype and axis *identity*, not the
     padded sizes (which the sticky-bucket memo may vary)."""
     from ..cache import snapshot as snapmod
-    from ..cache.sim import SimCluster
 
     schema = schema or SNAPSHOT_SCHEMA
     path, line = _anchor(snapmod.build_snapshot)
-    sim = SimCluster()
-    sim.add_queue("default", weight=1)
-    sim.add_node("n1", cpu_milli=4000, memory=8 * 1024**3)
-    j = sim.add_job("j1", queue="default", min_available=1)
-    sim.add_task(j, 1000, 1024**3)
-    from ..api.types import TaskStatus
-    j2 = sim.add_job("j2", queue="default")
-    sim.add_task(j2, 500, 1024**3, status=TaskStatus.RUNNING, node="n1")
+    sim, _t1 = _mini_cluster()
     try:
         t = snapmod.build_snapshot(sim.cluster).tensors
     except Exception as err:
@@ -376,28 +415,8 @@ def check_producer(
             "legitimately changed)",
         )]
 
-    axes = {
-        "T": t.task_resreq.shape[0],
-        "N": t.node_idle.shape[0],
-        "G": t.group_job.shape[0],
-        "J": t.job_queue.shape[0],
-        "Q": t.queue_weight.shape[0],
-        "R": t.task_resreq.shape[1],
-        "W": t.task_ports.shape[1],
-        "CT": t.class_fit.shape[0],
-        "CN": t.class_fit.shape[1],
-        "K": t.node_dom.shape[0],
-        "TF": t.aff_key.shape[0],
-        "TA": t.anti_key.shape[0],
-        "D": t.aff_static.shape[1],
-        "CP": t.aff_match.shape[1],
-        "CS": t.symm_ok.shape[0],
-        "MA": t.group_aff_terms.shape[1],
-        "MB": t.group_anti_terms.shape[1],
-        "V": t.rv_idx.shape[0],
-    }
     return _check_fields(
-        t, schema, axes, "KAT-CTR-002", path, line,
+        t, schema, _snapshot_axes(t), "KAT-CTR-002", path, line,
         stage="snapshot producer (build_snapshot)",
         hint="the snapshot boundary must emit exactly the declared "
         "device dtypes — an np.float64/int64 here is silently downcast "
@@ -405,6 +424,55 @@ def check_producer(
         "decisions without an error (cast explicitly at the boundary "
         "like to_device_units, or fix the schema if the contract "
         "legitimately changed)",
+    )
+
+
+def check_arena_producer(
+    schema: Optional[Mapping[str, Tuple[Tuple[str, ...], str]]] = None,
+) -> List[Finding]:
+    """KAT-CTR-007: the arena's DELTA path is a second snapshot producer
+    and must satisfy the same schema as ``build_snapshot``.  Build a mini
+    cluster, seed the arena, apply a bind delta, and verify the
+    incrementally maintained pack field-for-field — dtype drift in the
+    row-refresh or vectorized group/reclaim recompute is caught here
+    before the byte-identity runtime twin ever runs."""
+    from ..cache import arena as arenamod
+    from ..cache.sim import BindIntent
+
+    schema = schema or SNAPSHOT_SCHEMA
+    path, line = _anchor(arenamod.SnapshotArena)
+    sim, t1 = _mini_cluster()
+    try:
+        ar = arenamod.SnapshotArena(sim, verify_every=0)
+        ar.snapshot()  # seed (full build)
+        sim.apply_binds([BindIntent(t1.uid, "n1")])
+        t = ar.snapshot().tensors  # the delta-path pack under test
+        if ar.last_rebuild_reason is not None:
+            return [Finding(
+                "KAT-CTR-007", "error", path, line,
+                "arena bind delta fell back to a full rebuild "
+                f"({ar.last_rebuild_reason}) on a minimal cluster — the "
+                "delta path is unreachable and this check is vacuous",
+                hint="a bind emits task_dirty/node_dirty only; something "
+                "in the emission or guard chain regressed",
+            )]
+    except Exception as err:
+        return [Finding(
+            "KAT-CTR-007", "error", path, line,
+            f"arena delta pack failed on a minimal cluster: "
+            f"{type(err).__name__}: {err}",
+            hint="the incremental producer no longer builds a clean pack — "
+            "fix cache/arena.py (or the schema, if the contract "
+            "legitimately changed)",
+        )]
+    return _check_fields(
+        t, schema, _snapshot_axes(t), "KAT-CTR-007", path, line,
+        stage="incremental snapshot producer (SnapshotArena delta path)",
+        hint="the arena's delta path must emit exactly the declared "
+        "device dtypes — a float64/int64 from a row refresh or the "
+        "vectorized group/reclaim recompute is silently downcast at the "
+        "jit boundary, and (worse) breaks the byte-identity contract "
+        "with build_snapshot",
     )
 
 
@@ -539,6 +607,7 @@ def check_contracts(
     regression tests assert the seeded stage (and only it) is reported."""
     findings = check_schema_fields()
     findings += check_producer(schema)
+    findings += check_arena_producer(schema)
     findings += check_kernels(schema, state_schema=state_schema)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings

@@ -12,8 +12,11 @@ from .sim import (
 from .snapshot import Snapshot, SnapshotIndex, SnapshotTensors, build_snapshot
 from .fakeapi import FakeApiServer, ApiError
 from .live import LiveCache
+from .arena import ArenaDivergence, SnapshotArena
 
 __all__ = [
+    "ArenaDivergence",
+    "SnapshotArena",
     "BindFailure",
     "BindIntent",
     "EvictIntent",

@@ -343,6 +343,11 @@ class LiveCache:
         self._claim_pods: Dict[Tuple[str, str], set] = {}
         self._pv_claims: Dict[str, set] = {}
         self._last_sync_ts: Optional[float] = None
+        # incremental snapshot plane (cache/arena.py SnapshotArena): when
+        # attached, watch handlers publish deltas — row-level dirt for
+        # in-place pod/node churn, structural events for set membership
+        # changes the arena cannot patch.  None = no arena.
+        self.delta_sink = None
 
     # ---- informer pump ----
 
@@ -499,6 +504,45 @@ class LiveCache:
                     del self._claim_pods[(ns, claim)]
 
     def _on_pod(self, etype: str, pod: dict) -> None:
+        """Pod handler + arena delta classification: an in-place update of
+        a pod we already model is row-level dirt (the arena refreshes the
+        task/node rows and its guards catch signature drift); a pod
+        entering or leaving the model — or switching between ours and
+        another scheduler's — changes set membership and is structural."""
+        sink = self.delta_sink
+        if sink is None:
+            return self._on_pod_inner(etype, pod)
+        md = pod.get("metadata", {})
+        uid = md.get("uid") or f"{md.get('namespace', 'default')}/{md['name']}"
+        old = self._task_by_uid.get(uid)
+        old_other = self._other_by_uid.get(uid)
+        prev = old if old is not None else old_other
+        old_node = prev.node_name if prev is not None else ""
+        old_job = old.job_uid if old is not None else None
+        n_nodes = len(self.cluster.nodes)
+        self._on_pod_inner(etype, pod)
+        if len(self.cluster.nodes) != n_nodes:
+            sink.structural("node_added")  # placeholder node materialized
+        new = self._task_by_uid.get(uid)
+        new_other = self._other_by_uid.get(uid)
+        if (old is None) != (new is None) or (old_other is None) != (new_other is None):
+            sink.structural("task_set")
+        elif new is not None:
+            if new.job_uid != old_job:
+                sink.structural("job_membership")
+            else:
+                sink.task_dirty(uid, old_node)
+                if new.node_name and new.node_name != old_node:
+                    sink.node_dirty(new.node_name)
+        elif new_other is not None:
+            # foreign pods surface only through node accounting and the
+            # per-pack others_used recompute — node dirt is enough
+            if old_node:
+                sink.node_dirty(old_node)
+            if new_other.node_name:
+                sink.node_dirty(new_other.node_name)
+
+    def _on_pod_inner(self, etype: str, pod: dict) -> None:
         md = pod.get("metadata", {})
         uid = md.get("uid") or f"{md.get('namespace', 'default')}/{md['name']}"
         # updatePod == deletePod + addPod (event_handlers.go:190-210)
@@ -596,6 +640,14 @@ class LiveCache:
     def _on_node(self, etype: str, node_obj: dict) -> None:
         name = node_obj["metadata"]["name"]
         old = self.cluster.nodes.get(name)
+        sink = self.delta_sink
+        if sink is not None:
+            if etype == DELETED or old is None:
+                sink.structural("node_set")
+            else:
+                # in-place update: the arena refreshes the node's rows and
+                # falls back itself if the property signature changed
+                sink.node_dirty(name)
         if etype == DELETED:
             if old is not None:
                 del self.cluster.nodes[name]
@@ -629,6 +681,10 @@ class LiveCache:
         if job is None:
             job = JobInfo(uid=job_uid, name=md["name"], namespace=ns)
             self.cluster.jobs[job_uid] = job
+            if self.delta_sink is not None:
+                self.delta_sink.structural("job_added")
+        # a modified PodGroup (minMember/queue/creation_ts) needs no delta:
+        # the arena recomputes the whole job plane every pack
         spec = pg.get("spec", {})
         job.name = md["name"]
         job.min_available = int(spec.get("minMember", 0))
@@ -649,6 +705,7 @@ class LiveCache:
         if options().namespace_as_queue:
             return  # namespaces back the queues instead (cache.go:290-306)
         name = q["metadata"]["name"]
+        self._emit_queue_set(name, etype)
         if etype == DELETED:
             self.cluster.queues.pop(name, None)
             return
@@ -656,10 +713,20 @@ class LiveCache:
             uid=name, name=name, weight=int(q.get("spec", {}).get("weight", 1))
         )
 
+    def _emit_queue_set(self, name: str, etype: str) -> None:
+        """Queue set-membership delta; weight-only updates need none (the
+        arena recomputes the queue plane every pack)."""
+        if self.delta_sink is None:
+            return
+        existed = name in self.cluster.queues
+        if (etype == DELETED) == existed:
+            self.delta_sink.structural("queue_set")
+
     def _on_namespace(self, etype: str, ns_obj: dict) -> None:
         if not options().namespace_as_queue:
             return
         name = ns_obj["metadata"]["name"]
+        self._emit_queue_set(name, etype)
         if etype == DELETED:
             self.cluster.queues.pop(name, None)
             return
@@ -681,6 +748,8 @@ class LiveCache:
         if job is None:
             job = JobInfo(uid=job_uid, namespace=ns)
             self.cluster.jobs[job_uid] = job
+            if self.delta_sink is not None:
+                self.delta_sink.structural("job_added")
         job.set_pdb(
             PDBInfo(
                 name=md["name"],
@@ -788,6 +857,8 @@ class LiveCache:
             if pod is None:
                 self._remove_task(uid)
                 self._pod_ref.pop(uid, None)
+                if self.delta_sink is not None:
+                    self.delta_sink.structural("task_set")
             else:
                 self._on_pod(MODIFIED, pod)
             repaired += 1
@@ -812,4 +883,6 @@ class LiveCache:
             del self.cluster.jobs[uid]
             collected.append(uid)
         self._deleted_jobs = keep
+        if collected and self.delta_sink is not None:
+            self.delta_sink.structural("job_removed")
         return collected

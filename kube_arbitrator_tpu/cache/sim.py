@@ -145,6 +145,20 @@ class SimCluster:
         self.resync_queue: List[str] = []
         # deferred job GC FIFO (cache.go:476-517): (job uid, deletion ts)
         self._deleted_jobs: List[Tuple[str, float]] = []
+        # incremental snapshot plane (cache/arena.py SnapshotArena): when
+        # attached, every mutation publishes a delta so the arena can
+        # refresh rows instead of rebuilding the pack.  None = no arena.
+        self.delta_sink = None
+
+    # ---- arena delta emission (no-ops when no arena is attached) ----
+
+    def _emit_structural(self, reason: str) -> None:
+        if self.delta_sink is not None:
+            self.delta_sink.structural(reason)
+
+    def _emit_task(self, uid: str, node_name: str = "") -> None:
+        if self.delta_sink is not None:
+            self.delta_sink.task_dirty(uid, node_name)
 
     def update_pod_condition(self, task_uid: str, message: str) -> None:
         """Record the PodScheduled=False condition (the fakeStatusUpdater
@@ -159,6 +173,7 @@ class SimCluster:
     def add_queue(self, name: str, weight: int = 1) -> QueueInfo:
         q = QueueInfo(uid=name, name=name, weight=weight)
         self.cluster.queues[name] = q
+        self._emit_structural("queue_added")
         return q
 
     def add_namespace(self, name: str, weight: int = 1) -> Optional[QueueInfo]:
@@ -184,6 +199,7 @@ class SimCluster:
             if not options().namespace_as_queue
             else "",
         )
+        self._emit_structural("pdb")
         return job
 
     def delete_pdb(self, name: str, namespace: str = "default") -> None:
@@ -192,6 +208,7 @@ class SimCluster:
         if job is None:
             raise KeyError(f"{namespace}/{name}")
         job.unset_pdb()
+        self._emit_structural("pdb")
 
     def add_node(
         self,
@@ -214,6 +231,7 @@ class SimCluster:
             unschedulable=unschedulable,
         )
         self.cluster.nodes[name] = n
+        self._emit_structural("node_added")
         return n
 
     def add_job(
@@ -240,6 +258,7 @@ class SimCluster:
             creation_ts=creation_ts,
         )
         self.cluster.jobs[name] = j
+        self._emit_structural("job_added")
         return j
 
     def delete_job(self, uid: str, now: Optional[float] = None) -> None:
@@ -274,6 +293,8 @@ class SimCluster:
             del self.cluster.jobs[uid]
             collected.append(uid)
         self._deleted_jobs = keep
+        if collected:
+            self._emit_structural("job_removed")
         return collected
 
     def add_task(
@@ -319,6 +340,7 @@ class SimCluster:
         if node:
             self.cluster.nodes[node].add_task(t)
         job.add_task(t)
+        self._emit_structural("task_added")
         return t
 
     def add_other_task(
@@ -335,6 +357,7 @@ class SimCluster:
         )
         self.cluster.others.append(t)
         self.cluster.nodes[node].add_task(t)
+        self._emit_structural("other_added")
         return t
 
     # ---- actuation ----
@@ -372,10 +395,14 @@ class SimCluster:
                     self.binder.bind(b.task_uid, b.node_name)
                 except BindFailure as err:
                     self._defer_resync(b.task_uid, "Bind", str(err))
+                    # no model change, but the emission is idempotent and
+                    # keeps the failure path indistinguishable to the arena
+                    self._emit_task(b.task_uid, b.node_name)
                     continue
                 task.status = TaskStatus.BOUND
                 task.node_name = b.node_name
                 node.add_task(task)
+                self._emit_task(b.task_uid, b.node_name)
 
     def apply_evicts(self, evicts: Sequence[EvictIntent]) -> None:
         """Evict: running task -> Releasing on its node (cache.go:369-405)."""
@@ -396,6 +423,7 @@ class SimCluster:
                 node.add_task(task)
             else:
                 task.status = TaskStatus.RELEASING
+            self._emit_task(e.task_uid, task.node_name)
             self.record_event("Evict", e.task_uid, "Evict")
 
     # ---- failure handling (errTasks resync, cache.go:519-547) ----
@@ -422,10 +450,12 @@ class SimCluster:
                 continue
             # op half-applied (should not happen in sim: accounting follows
             # the backend call) — restore the authoritative pending state
+            old_node = task.node_name
             if task.node_name and uid in self.cluster.nodes.get(task.node_name, NodeInfo("")).tasks:
                 self.cluster.nodes[task.node_name].remove_task(task)
             task.status = TaskStatus.PENDING
             task.node_name = ""
+            self._emit_task(uid, old_node)
             repaired += 1
         return repaired
 

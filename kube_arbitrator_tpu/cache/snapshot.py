@@ -449,6 +449,34 @@ def _ports_mask(ports, universe_pos: Dict[int, int]) -> np.ndarray:
     return mask
 
 
+def group_signature(
+    t: TaskInfo,
+    job_ordinal: int,
+    klass: int,
+    pa_class: int = 0,
+    aff_ids=(),
+    anti_ids=(),
+) -> Tuple:
+    """The interchangeability key of the allocate unit: tasks of one job
+    sharing this key are placed by *count* (see the task-group section of
+    :class:`SnapshotTensors`).  ONE definition, shared by
+    :func:`build_snapshot` and the incremental arena (cache/arena.py), so
+    the full-rebuild and delta paths can never disagree on what makes two
+    tasks interchangeable — a drift here would break the arena's
+    byte-identity contract, not just performance."""
+    return (
+        int(job_ordinal),
+        tuple(np.round(t.resreq, 6)),
+        int(klass),
+        t.host_ports,
+        t.priority,
+        t.best_effort,
+        int(pa_class),
+        tuple(sorted(set(aff_ids))),
+        tuple(sorted(set(anti_ids))),
+    )
+
+
 def trivial_pod_affinity(T: int, N: int) -> Dict[str, np.ndarray]:
     """The no-terms encoding: zero-sized term axes so the decision plane
     compiles the feature out, and a single pod-label class.  Used whenever
@@ -718,16 +746,13 @@ def build_snapshot(cluster: ClusterInfo) -> Snapshot:
     for t in tasks:
         if t.status != TaskStatus.PENDING:
             continue
-        key = (
+        key = group_signature(
+            t,
             job_of_task[t.uid],
-            tuple(np.round(t.resreq, 6)),
-            int(task_klass[t.ordinal]),
-            t.host_ports,
-            t.priority,
-            t.best_effort,
-            int(task_pa_class[t.ordinal]),
-            tuple(sorted(set(task_aff_ids.get(t.ordinal, ())))),
-            tuple(sorted(set(task_anti_ids.get(t.ordinal, ())))),
+            task_klass[t.ordinal],
+            task_pa_class[t.ordinal],
+            task_aff_ids.get(t.ordinal, ()),
+            task_anti_ids.get(t.ordinal, ()),
         )
         g = group_key_to_ord.setdefault(key, len(group_members))
         if g == len(group_members):

@@ -114,6 +114,22 @@ def build_parser() -> argparse.ArgumentParser:
         "FakeApiServer.dump_stream) through the live-cluster plane instead "
         "of the simulator",
     )
+    # incremental snapshot plane (cache/arena.py)
+    p.add_argument(
+        "--arena",
+        action="store_true",
+        help="maintain the snapshot pack incrementally (SnapshotArena): "
+        "delta row refresh + dirty-range device upload instead of a full "
+        "rebuild per cycle",
+    )
+    p.add_argument(
+        "--arena-verify-every",
+        type=int,
+        default=64,
+        metavar="N",
+        help="with --arena: every N-th cycle rebuild from scratch and "
+        "assert byte-identity against the arena (0 = never)",
+    )
     # snapshot trace record/replay (SURVEY §5: snapshot persistence)
     p.add_argument(
         "--record-trace",
@@ -273,6 +289,11 @@ def main(argv=None) -> int:
             lock_path=f"{opts.lock_object_namespace}/{opts.scheduler_name}.lock",
             identity=opts.scheduler_name,
         )
+    arena = None
+    if args.arena:
+        from .cache.arena import SnapshotArena
+
+        arena = SnapshotArena(sim, verify_every=args.arena_verify_every)
     try:
         sched = Scheduler(
             sim,
@@ -283,6 +304,7 @@ def main(argv=None) -> int:
             decider=decider,
             flight=flight,
             cycle_slo_ms=args.cycle_slo_ms or None,
+            arena=arena,
         )
     except (ValueError, OSError) as e:
         print(f"error: invalid scheduler conf: {e}", file=sys.stderr)

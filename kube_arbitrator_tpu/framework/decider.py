@@ -21,11 +21,17 @@ class LocalDecider:
     ``kernel_action_duration_seconds{action=...}`` histograms).  The
     fused program stays the fast path when observability is off."""
 
+    # arena cycles: the Session pre-places the pack on the routed device
+    # (dirty-range upload) because this decider consumes it in-process
+    wants_device_pack = True
+
     def __init__(self):
         # stage -> wall ms of the most recent decide (staged runs only)
         self.last_action_ms: Dict[str, float] = {}
 
-    def decide(self, st, config) -> Tuple[object, float]:
+    def decide(self, st, config, pack_meta=None) -> Tuple[object, float]:
+        # pack_meta is the arena's delta descriptor — a transport concern;
+        # the in-process path takes the resident device arrays instead
         from ..ops.cycle import schedule_cycle, schedule_cycle_staged
         from ..platform import decision_route
 

@@ -264,6 +264,47 @@ class _ElectorBase:
         self._note_transition(was)
         return False
 
+    def revalidate(self) -> bool:
+        """Storage-backed re-check for the actuation fence: re-read the
+        lock and push a fresh renew_ts iff the record STILL names us.
+
+        ``lease_fresh()`` is clock-only — a slow-but-healthy cycle that
+        lands in the (renew_deadline, lease_duration] window looks stale
+        to it even though no standby can have legally usurped yet (a
+        usurper needs a full unchanged lease_duration).  This consults
+        the source of truth instead: if the lease record is still ours
+        and the CAS write succeeds, leadership (and ``_last_renew_ok``)
+        is restored and the cycle may actuate; if another holder took
+        the lease — or storage can't confirm — the caller must discard
+        the cycle.  Unlike :meth:`renew` this deliberately ignores the
+        renew deadline: the deadline bounds how long a leader may coast
+        on BLIND grace, not how late a successful storage round-trip may
+        confirm leadership."""
+        was = self._is_leader
+        try:
+            return self._revalidate_inner()
+        finally:
+            self._note_transition(was)
+
+    def _revalidate_inner(self) -> bool:
+        with self._locked():
+            try:
+                token, cur = self._fetch()
+            except TransientLockError:
+                self._is_leader = False
+                return False  # cannot confirm against storage: stay demoted
+            now = self.now()
+            self._observe(cur, now)
+            if cur is None or cur.holder != self.identity:
+                self._is_leader = False
+                return False
+            if self._push(token, dataclasses.replace(cur, renew_ts=now)):
+                self._last_renew_ok = now
+                self._is_leader = True
+            else:
+                self._is_leader = False
+            return self._is_leader
+
     def acquire_blocking(self, timeout_s: Optional[float] = None) -> bool:
         """RunOrDie's acquisition loop: retry every retry_period until
         leadership (or timeout, for tests/CLI)."""

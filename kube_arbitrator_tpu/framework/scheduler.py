@@ -34,6 +34,7 @@ class CycleStats:
     close_ms: float = 0.0
     actuate_ms: float = 0.0
     transport_ms: float = 0.0
+    upload_ms: float = 0.0
 
 
 class Scheduler:
@@ -51,6 +52,7 @@ class Scheduler:
         trace_recorder=None,
         flight: Optional[FlightRecorder] = None,
         cycle_slo_ms: Optional[float] = None,
+        arena=None,
     ):
         # conf is re-loadable per Run like the reference (scheduler.go:66-78)
         self.sim = sim
@@ -70,6 +72,14 @@ class Scheduler:
         self.flight = flight
         # cycle-latency SLO in ms; a breach is a flight-recorder anomaly
         self.cycle_slo_ms = cycle_slo_ms
+        # incremental snapshot plane: True builds a SnapshotArena over the
+        # backend; a pre-built arena is also accepted.  None/False keeps
+        # the per-cycle full rebuild.
+        if arena is True:
+            from ..cache.arena import SnapshotArena
+
+            arena = SnapshotArena(sim)
+        self.arena = arena or None
         self.job_status: Dict[str, PodGroupStatus] = {}
         self.history: List[CycleStats] = []
         self.last_cycle_ts: Optional[float] = None  # /readyz freshness
@@ -175,7 +185,10 @@ class Scheduler:
         ]
         pending = sum(per_job_pending)
         self._last_pending_hist = self._pending_histogram(per_job_pending)
-        session = Session(self.sim.cluster, self.config, decider=self.decider)
+        session = Session(
+            self.sim.cluster, self.config, decider=self.decider,
+            arena=self.arena,
+        )
         result = session.run()
         if self.trace_recorder is not None:
             self.trace_recorder.record(result.snapshot.tensors)
@@ -185,16 +198,27 @@ class Scheduler:
         # minutes), during which a standby legitimately takes over — the
         # run() loop's renew() happens BEFORE the cycle, so without this
         # gate the unwedged ex-leader would still apply its stale
-        # binds/evicts once.  Discard the cycle instead (the reference has
-        # the same decide/actuate race; its safety net is the apiserver's
-        # optimistic concurrency on the bind subresource — ours is this
-        # RPC-free freshness check plus that same CAS on live backends).
+        # binds/evicts once.  The clock-only check can FALSE-POSITIVE on a
+        # slow-but-healthy cycle in the (renew_deadline, lease_duration]
+        # window (no standby can have usurped yet), so a stale-looking
+        # lease gets one storage-backed re-validation — the record still
+        # naming us + a successful CAS renew means actuation is safe.
+        # Only a failed re-validation discards the cycle (the reference
+        # has the same decide/actuate race; its safety net is the
+        # apiserver's optimistic concurrency on the bind subresource).
         if self.elector is not None and not self.elector.lease_fresh():
-            raise LeaderLost(
-                f"lease stale after decision phase; discarding cycle "
-                f"({len(result.binds)} binds, {len(result.evicts)} evicts "
-                f"not actuated) — holder {self.elector.identity}"
+            revalidate = getattr(self.elector, "revalidate", None)
+            ok = bool(revalidate()) if revalidate is not None else False
+            metrics().counter_add(
+                "leader_fence_revalidations_total",
+                labels={"outcome": "renewed" if ok else "lost"},
             )
+            if not ok:
+                raise LeaderLost(
+                    f"lease stale after decision phase; discarding cycle "
+                    f"({len(result.binds)} binds, {len(result.evicts)} evicts "
+                    f"not actuated) — holder {self.elector.identity}"
+                )
         with tr.span("actuate", binds=len(result.binds), evicts=len(result.evicts)):
             self.sim.apply_binds(result.binds)
             self.sim.apply_evicts(result.evicts)
@@ -235,6 +259,7 @@ class Scheduler:
             close_ms=result.close_ms,
             actuate_ms=(t2 - t1) * 1000,
             transport_ms=result.transport_ms,
+            upload_ms=result.upload_ms,
         )
         self.history.append(stats)
         self._record_metrics(stats, result.action_ms)
@@ -247,6 +272,7 @@ class Scheduler:
         m.observe("e2e_scheduling_duration_seconds", s.cycle_ms / 1000)
         for phase, ms in (
             ("snapshot", s.snapshot_ms),
+            ("upload", s.upload_ms),
             ("kernel", s.kernel_ms),
             ("decode", s.decode_ms),
             ("close", s.close_ms),

@@ -90,6 +90,9 @@ class CycleResult:
     close_ms: float = 0.0
     # decide-wall minus device time: ~0 in-process, RPC overhead remote
     transport_ms: float = 0.0
+    # host->device pack placement (arena cycles only; the non-arena path
+    # pays this inside the jit dispatch where it is not separable)
+    upload_ms: float = 0.0
     # stage -> wall ms from the staged per-action runner (tracing-enabled
     # local decides only; empty for fused or remote cycles)
     action_ms: Dict[str, float] = dataclasses.field(default_factory=dict)
@@ -99,17 +102,23 @@ class Session:
     """One scheduling cycle over a ClusterInfo.
 
     ``decider`` selects where the decision program runs: in-process
-    (default) or on a gRPC decision sidecar (rpc/client.RemoteDecider)."""
+    (default) or on a gRPC decision sidecar (rpc/client.RemoteDecider).
+    ``arena`` (cache/arena.SnapshotArena) switches the snapshot phase from
+    a full rebuild to incremental delta maintenance, with dirty-range
+    device upload for local deciders and epoch-keyed delta shipping for
+    remote ones."""
 
     def __init__(
         self,
         cluster: ClusterInfo,
         config: Optional[SchedulerConfig] = None,
         decider=None,
+        arena=None,
     ):
         self.cluster = cluster
         self.config = config or SchedulerConfig.default()
         self.decider = decider
+        self.arena = arena
         self.uid = str(uuid.uuid4())
 
     def run(self) -> CycleResult:
@@ -121,14 +130,30 @@ class Session:
             from .decider import LocalDecider
 
             decider = LocalDecider()
+        arena = self.arena
         t0 = time.perf_counter()
         with tr.span("snapshot"):
-            snap = build_snapshot(self.cluster)
+            snap = arena.snapshot() if arena is not None else build_snapshot(self.cluster)
         t1 = time.perf_counter()
+        st, pack_meta = snap.tensors, None
+        if arena is not None:
+            if getattr(decider, "wants_device_pack", True):
+                # dirty-range upload onto the routed device; the decider's
+                # own decision_route resolves to the same device, so the
+                # jit consumes the resident buffers without a transfer
+                with tr.span("upload"):
+                    st = arena.device_pack(self.config.actions)
+            else:
+                # remote decider: ship the delta, keyed by arena epoch
+                pack_meta = arena.pack_meta
+        t_up = time.perf_counter()
         # kernel_ms is device time in both modes (the sidecar measures its
         # own); remote transport overhead is the decide-wall minus it
         with tr.span("decide", tasks=int(snap.tensors.num_tasks)):
-            dec, kernel_ms = decider.decide(snap.tensors, self.config)
+            if pack_meta is not None:
+                dec, kernel_ms = decider.decide(st, self.config, pack_meta=pack_meta)
+            else:
+                dec, kernel_ms = decider.decide(st, self.config)
         t2 = time.perf_counter()
         # Decisions may have crossed an RPC codec (RemoteDecider): hold
         # them to the same declared contract the producer side asserts
@@ -153,7 +178,8 @@ class Session:
             kernel_ms=kernel_ms,
             decode_ms=(t3 - t2) * 1000,
             close_ms=(t4 - t3) * 1000,
-            transport_ms=max((t2 - t1) * 1000 - kernel_ms, 0.0),
+            transport_ms=max((t2 - t_up) * 1000 - kernel_ms, 0.0),
+            upload_ms=(t_up - t1) * 1000,
             action_ms=dict(getattr(decider, "last_action_ms", None) or {}),
         )
 

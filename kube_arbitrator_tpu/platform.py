@@ -67,6 +67,22 @@ def decision_device(num_tasks: int, evictive: bool = False):
     return cpus[0] if cpus else None
 
 
+def is_evictive(actions, task_status) -> bool:
+    """THE evictive-cycle classifier: reclaim/preempt in the action list
+    AND running victims present.  One definition shared by
+    ``decision_route`` and the arena's device pre-placement
+    (cache/arena.py) — a drifted copy would pre-place the pack on one
+    backend while the decider routes the kernel to the other, paying a
+    full cross-device transfer every cycle."""
+    import numpy as np
+
+    from .api.types import TaskStatus
+
+    return bool(set(actions) & {"reclaim", "preempt"}) and bool(
+        (np.asarray(task_status) == int(TaskStatus.RUNNING)).any()
+    )
+
+
 def decision_route(num_tasks: int, actions, task_status):
     """THE shared routing block for every ``schedule_cycle`` entry point
     (in-process decider, RPC sidecar, trace replay): classify the cycle
@@ -82,14 +98,8 @@ def decision_route(num_tasks: int, actions, task_status):
     import contextlib
 
     import jax
-    import numpy as np
 
-    from .api.types import TaskStatus
-
-    evictive = bool(set(actions) & {"reclaim", "preempt"}) and bool(
-        (np.asarray(task_status) == int(TaskStatus.RUNNING)).any()
-    )
-    dev = decision_device(num_tasks, evictive=evictive)
+    dev = decision_device(num_tasks, evictive=is_evictive(actions, task_status))
     ctx = jax.default_device(dev) if dev is not None else contextlib.nullcontext()
     return ctx, dev, resolve_native_ops(dev)
 

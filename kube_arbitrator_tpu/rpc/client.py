@@ -16,7 +16,13 @@ from ..cache.snapshot import SnapshotTensors
 from ..framework.decider import LocalDecider  # noqa: F401  (re-export; pb-free home)
 from ..utils.metrics import metrics
 from ..utils.tracing import tracer
-from .codec import CORR_ID_METADATA_KEY, snapshot_request, unpack_tensors
+from .codec import (
+    ARENA_BASE_METADATA_KEY,
+    ARENA_EPOCH_METADATA_KEY,
+    CORR_ID_METADATA_KEY,
+    snapshot_request,
+    unpack_tensors,
+)
 from .sidecar import CHANNEL_OPTIONS, SERVICE
 
 from . import decision_pb2 as pb
@@ -35,6 +41,10 @@ class RemoteDecider:
     # exceptions (bad conf, codec field mismatch) to UNKNOWN, and those are
     # deterministic — retrying only re-ships the snapshot to the same error.
     RETRYABLE = ("UNAVAILABLE", "DEADLINE_EXCEEDED")
+
+    # arena cycles: this decider ships bytes, so the Session hands it the
+    # host pack + PackMeta instead of pre-placing arrays on a device
+    wants_device_pack = False
 
     def __init__(
         self,
@@ -63,16 +73,26 @@ class RemoteDecider:
         self._cycle = 0
         self.last_kernel_ms = 0.0
         self.last_roundtrip_ms = 0.0
+        # arena pack-reuse: the epoch key of the pack the sidecar last
+        # acknowledged holding (None until a full pack lands)
+        self._resident_key = None
 
     def health(self, timeout_s: float = 10.0) -> "pb.HealthReply":
         return self._health(pb.HealthRequest(), timeout=timeout_s)
 
-    def decide(self, st: SnapshotTensors, config) -> Tuple[object, float]:
+    def decide(
+        self, st: SnapshotTensors, config, pack_meta=None
+    ) -> Tuple[object, float]:
         """Returns (CycleDecisions of host numpy arrays, sidecar device-time
         ms).  The decisions feed decode_decisions / close-side status
         exactly like the local path — those consume arrays via np.asarray.
         Round-trip time (serialize + network + device) is kept in
-        ``last_roundtrip_ms`` for the transport-overhead metric."""
+        ``last_roundtrip_ms`` for the transport-overhead metric.
+
+        With ``pack_meta`` (an arena cycle) the request ships ONLY the
+        fields that changed since the sidecar's resident pack, keyed by
+        arena epoch; a sidecar that lost the base (restart, another
+        client) aborts FAILED_PRECONDITION and the pack is re-sent whole."""
         import grpc
 
         from ..framework.conf import dump_conf
@@ -80,12 +100,28 @@ class RemoteDecider:
 
         tr = tracer()
         self._cycle += 1
-        with tr.span("rpc.encode"):
-            req = snapshot_request(st, dump_conf(config), self._cycle)
+        conf_yaml = dump_conf(config)
+        delta_base = (
+            pack_meta.base_key
+            if pack_meta is not None
+            and pack_meta.base_key is not None
+            and pack_meta.base_key == self._resident_key
+            else None
+        )
+        with tr.span("rpc.encode", delta=bool(delta_base)):
+            req = snapshot_request(
+                st, conf_yaml, self._cycle,
+                fields=pack_meta.changed_fields if delta_base else None,
+            )
         # the cycle's trace correlation id rides the request metadata so
         # the sidecar's spans stitch into the SAME trace (utils/tracing.py)
         corr = tr.current_corr_id()
-        md = ((CORR_ID_METADATA_KEY, corr),) if corr else None
+        md = [(CORR_ID_METADATA_KEY, corr)] if corr else []
+        if pack_meta is not None:
+            md.append((ARENA_EPOCH_METADATA_KEY, pack_meta.key))
+            if delta_base:
+                md.append((ARENA_BASE_METADATA_KEY, delta_base))
+        md = tuple(md) or None
         t0 = time.perf_counter()
         attempt = 0
         with tr.span("rpc.call", target=self.target) as call_span:
@@ -95,6 +131,17 @@ class RemoteDecider:
                     break
                 except grpc.RpcError as e:
                     code = e.code().name if e.code() is not None else "UNKNOWN"
+                    if code == "FAILED_PRECONDITION" and delta_base:
+                        # the sidecar no longer holds our base pack
+                        # (restart / evicted by another client): ship whole
+                        metrics().counter_add("rpc_pack_resend_total")
+                        delta_base = None
+                        self._resident_key = None
+                        req = snapshot_request(st, conf_yaml, self._cycle)
+                        md = tuple(
+                            kv for kv in md if kv[0] != ARENA_BASE_METADATA_KEY
+                        ) or None
+                        continue
                     attempt += 1
                     if code not in self.RETRYABLE or attempt > self.retries:
                         metrics().counter_add(
@@ -109,6 +156,8 @@ class RemoteDecider:
                 call_span.note(retries=attempt)
         self.last_roundtrip_ms = (time.perf_counter() - t0) * 1000
         self.last_kernel_ms = rep.kernel_ms
+        if pack_meta is not None:
+            self._resident_key = pack_meta.key
         with tr.span("rpc.decode"):
             dec = unpack_tensors(CycleDecisions, rep.tensors)
         return dec, rep.kernel_ms

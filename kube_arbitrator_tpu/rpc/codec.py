@@ -23,13 +23,23 @@ X = TypeVar("X")
 # scheduler <-> sidecar boundary (utils/tracing.py); lowercase per the
 # gRPC metadata-key rules.
 CORR_ID_METADATA_KEY = "kat-corr-id"
+# Arena pack-reuse protocol (cache/arena.py): the epoch key of the pack a
+# Decide request carries, and — for delta requests shipping only changed
+# fields — the epoch the delta patches.  A sidecar without the base pack
+# resident aborts FAILED_PRECONDITION and the client re-sends in full.
+ARENA_EPOCH_METADATA_KEY = "kat-arena-epoch"
+ARENA_BASE_METADATA_KEY = "kat-arena-base"
 
 
-def pack_tensors(obj, into) -> None:
-    """Serialize every dataclass field of ``obj`` into ``into`` (a repeated
-    Tensor proto field)."""
+def pack_tensors(obj, into, fields=None) -> None:
+    """Serialize dataclass fields of ``obj`` into ``into`` (a repeated
+    Tensor proto field).  ``fields`` restricts to a subset — the arena
+    delta path ships only fields that changed since the receiver's
+    resident pack."""
     total = 0
     for f in dataclasses.fields(obj):
+        if fields is not None and f.name not in fields:
+            continue
         arr = np.asarray(getattr(obj, f.name))
         # ascontiguousarray promotes 0-d to (1,); restore the true shape
         arr = np.ascontiguousarray(arr).reshape(arr.shape)
@@ -44,20 +54,31 @@ def pack_tensors(obj, into) -> None:
     )
 
 
-def unpack_tensors(cls: Type[X], tensors, to_jax: bool = False) -> X:
-    """Rebuild dataclass ``cls`` from a repeated Tensor field by name."""
+def unpack_fields(cls: Type[X], tensors) -> Dict[str, object]:
+    """Decode a repeated Tensor field into a name -> array dict (static
+    dataclass fields come back as python scalars).  The arena delta path
+    uses this to patch a resident pack with only the shipped fields."""
     known = {f.name for f in dataclasses.fields(cls)}
-    by_name: Dict[str, np.ndarray] = {}
+    static_names = {
+        f.name for f in dataclasses.fields(cls) if f.metadata.get("static")
+    }
+    by_name: Dict[str, object] = {}
     total = 0
     for t in tensors:
         total += len(t.data)
         if t.name not in known:
             continue  # newer peer sent a field this side predates
         arr = np.frombuffer(t.data, dtype=np.dtype(t.dtype)).reshape(tuple(t.shape))
-        by_name[t.name] = arr
+        by_name[t.name] = arr.item() if t.name in static_names else arr
     metrics().counter_add(
         "rpc_codec_bytes_total", total, labels={"direction": "unpack"}
     )
+    return by_name
+
+
+def unpack_tensors(cls: Type[X], tensors, to_jax: bool = False) -> X:
+    """Rebuild dataclass ``cls`` from a repeated Tensor field by name."""
+    by_name = unpack_fields(cls, tensors)
     # fields with defaults may be absent (a peer one release behind can
     # omit a newly added field; its default is the documented fallback)
     missing = [
@@ -69,17 +90,12 @@ def unpack_tensors(cls: Type[X], tensors, to_jax: bool = False) -> X:
     ]
     if missing:
         raise ValueError(f"{cls.__name__} wire payload missing fields: {missing}")
-    # static (pytree-meta) fields travel as 0-d arrays on the wire but
-    # must come back as hashable python scalars (e.g. rv_window sizes a
-    # dynamic-slice window at compile time)
-    static_names = {
-        f.name for f in dataclasses.fields(cls) if f.metadata.get("static")
-    }
-    for k in static_names & by_name.keys():
-        by_name[k] = by_name[k].item()
     if to_jax:
         import jax.numpy as jnp
 
+        static_names = {
+            f.name for f in dataclasses.fields(cls) if f.metadata.get("static")
+        }
         by_name = {
             k: v if k in static_names else jnp.asarray(v)
             for k, v in by_name.items()
@@ -87,9 +103,13 @@ def unpack_tensors(cls: Type[X], tensors, to_jax: bool = False) -> X:
     return cls(**by_name)
 
 
-def snapshot_request(tensors, conf_yaml: str, cycle: int) -> "pb.SnapshotRequest":
+def snapshot_request(
+    tensors, conf_yaml: str, cycle: int, fields=None
+) -> "pb.SnapshotRequest":
+    """``fields`` restricts the payload to changed fields (arena delta
+    shipping); the receiver patches its epoch-keyed resident pack."""
     req = pb.SnapshotRequest(cycle=cycle, conf_yaml=conf_yaml)
-    pack_tensors(tensors, req.tensors)
+    pack_tensors(tensors, req.tensors, fields=fields)
     return req
 
 

@@ -14,6 +14,7 @@ classes, so no grpc_tools stub generation is needed at build time.
 """
 from __future__ import annotations
 
+import dataclasses
 import logging
 import threading
 import time
@@ -23,7 +24,14 @@ from typing import Dict, Optional, Tuple
 from ..utils.metrics import metrics
 from ..utils.tracing import tracer
 from . import decision_pb2 as pb
-from .codec import CORR_ID_METADATA_KEY, decide_reply, unpack_tensors
+from .codec import (
+    ARENA_BASE_METADATA_KEY,
+    ARENA_EPOCH_METADATA_KEY,
+    CORR_ID_METADATA_KEY,
+    decide_reply,
+    unpack_fields,
+    unpack_tensors,
+)
 
 log = logging.getLogger(__name__)
 
@@ -52,6 +60,12 @@ class DecisionService:
         # conf YAML -> parsed SchedulerConfig; jax caches the compiled
         # program per (conf, shape-bucket) under its own jit cache
         self._conf_cache: Dict[str, object] = {}
+        # arena pack reuse (cache/arena.py protocol): the most recent
+        # epoch-keyed pack, so a delta Decide ships only changed fields
+        # and patches this resident copy.  One slot — competing clients
+        # simply evict each other back to full sends (still correct).
+        self._pack_key: Optional[str] = None
+        self._pack: Optional[object] = None
 
     def _config(self, conf_yaml: str):
         with self._lock:
@@ -67,7 +81,6 @@ class DecisionService:
         return cached
 
     def Decide(self, request: "pb.SnapshotRequest", context) -> "pb.DecideReply":
-        from ..cache.snapshot import SnapshotTensors
         from ..framework.decider import LocalDecider
 
         cfg = self._config(request.conf_yaml)
@@ -75,10 +88,14 @@ class DecisionService:
         # metadata (rpc/codec.py CORR_ID_METADATA_KEY); re-activating it
         # here stitches this handler's spans into the SAME trace the
         # scheduler process opened — one remote cycle, one trace.
-        corr = ""
+        corr = epoch_key = base_key = ""
         for k, v in context.invocation_metadata() or ():
             if k == CORR_ID_METADATA_KEY:
                 corr = v
+            elif k == ARENA_EPOCH_METADATA_KEY:
+                epoch_key = v
+            elif k == ARENA_BASE_METADATA_KEY:
+                base_key = v
         tr = tracer()
         t_req = time.perf_counter()
         with tr.activate(corr or None, component="sidecar"):
@@ -91,8 +108,11 @@ class DecisionService:
                 # routes to the CPU — paying the host->chip transfer the
                 # routing exists to avoid.  The decider moves the arrays
                 # onto the routed device itself.
-                with tr.span("unpack"):
-                    st = unpack_tensors(SnapshotTensors, request.tensors)
+                with tr.span("unpack", delta=bool(base_key)):
+                    st = self._unpack_request(request, base_key, context)
+                if epoch_key:
+                    with self._lock:
+                        self._pack_key, self._pack = epoch_key, st
                 # LocalDecider applies the same backend crossover as the
                 # in-process path (platform.decision_route): small and
                 # EVICTIVE cycles run on the host CPU even when this
@@ -118,6 +138,29 @@ class DecisionService:
         with self._lock:
             self.cycles_served += 1
         return rep
+
+    def _unpack_request(self, request, base_key: str, context):
+        """Full request -> fresh pack; delta request (base_key set) ->
+        patch the resident pack with the shipped fields.  A missing or
+        mismatched base aborts FAILED_PRECONDITION so the client re-sends
+        the pack in full (sidecar restarts / competing clients)."""
+        from ..cache.snapshot import SnapshotTensors
+
+        if not base_key:
+            return unpack_tensors(SnapshotTensors, request.tensors)
+        with self._lock:
+            cached = self._pack if self._pack_key == base_key else None
+        if cached is None:
+            import grpc
+
+            metrics().counter_add("rpc_pack_resend_total")
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"arena base pack {base_key} not resident; resend full",
+            )
+        metrics().counter_add("rpc_pack_reuse_total")
+        patch = unpack_fields(SnapshotTensors, request.tensors)
+        return dataclasses.replace(cached, **patch) if patch else cached
 
     def Health(self, request: "pb.HealthRequest", context) -> "pb.HealthReply":
         import jax

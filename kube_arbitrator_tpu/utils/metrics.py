@@ -29,14 +29,20 @@ from typing import Dict, List, Optional, Tuple
 METRIC_HELP: Dict[str, str] = {
     # scheduler cycle
     "e2e_scheduling_duration_seconds": "Full cycle latency: snapshot through actuation.",
-    "cycle_phase_duration_seconds": "Per-phase cycle latency (snapshot/kernel/decode/close/actuate/transport).",
+    "cycle_phase_duration_seconds": "Per-phase cycle latency (snapshot/upload/kernel/decode/close/actuate/transport).",
     "kernel_action_duration_seconds": "Per-action decision-kernel wall time (staged runner; action label).",
     "binds_total": "Committed bind intents.",
     "evicts_total": "Committed evict intents.",
     "pending_tasks": "Pending tasks observed at cycle start.",
     "cycles_total": "Scheduling cycles completed.",
+    # incremental snapshot plane (cache/arena.py)
+    "snapshot_delta_rows": "Rows the last arena pack refreshed (changed vs the previously shipped pack).",
+    "snapshot_full_rebuilds_total": "Arena full rebuilds (reason label: seed/verify/structural triggers).",
+    "device_upload_bytes_total": "Bytes shipped to the decision device (mode label: full/delta).",
     # decision-plane RPC (client + sidecar)
     "rpc_decide_duration_seconds": "Sidecar Decide handler latency (unpack through reply pack).",
+    "rpc_pack_reuse_total": "Decide calls served from the sidecar's epoch-keyed resident pack (delta patch).",
+    "rpc_pack_resend_total": "Arena delta Decides that fell back to a full pack resend (base not resident).",
     "rpc_decide_retries_total": "Client-side Decide retries after transient transport failures.",
     "rpc_decide_failures_total": "Decide calls that exhausted retries or hit a non-retryable error.",
     "rpc_codec_bytes_total": "Tensor bytes through the RPC codec (direction label: pack/unpack).",
@@ -47,6 +53,7 @@ METRIC_HELP: Dict[str, str] = {
     "cache_snapshot_staleness_seconds": "Age of the live-cache model at the latest sync (gap between pumps).",
     # leader election
     "leader_renew_duration_seconds": "Leader lease renew round-trip latency.",
+    "leader_fence_revalidations_total": "Actuation-fence storage re-validations of a stale-looking lease (outcome label: renewed/lost).",
     "leader_transitions_total": "Leadership transitions observed by this elector (to label).",
     "leader_is_leader": "1 when this elector currently holds the lease.",
     # flight recorder

@@ -1,0 +1,449 @@
+"""Incremental snapshot plane (cache/arena.py): the byte-identity
+contract under randomized mutation streams, the structural fallback
+triggers, the device-resident pack, and the RPC pack-reuse protocol.
+
+The load-bearing test is the randomized equivalence stream: after EVERY
+step of generated bind/evict/add/delete/resync sequences the arena's
+incremental pack must be byte-identical to a fresh ``build_snapshot`` —
+identical packs imply bit-identical decisions, which is the whole
+correctness argument for the delta path.
+"""
+import dataclasses
+import random
+
+import numpy as np
+import pytest
+
+from kube_arbitrator_tpu.api import TaskStatus
+from kube_arbitrator_tpu.cache import build_snapshot, generate_cluster
+from kube_arbitrator_tpu.cache.arena import (
+    ArenaDivergence,
+    SnapshotArena,
+    _pad_rows,
+    _scatter_copy,
+)
+from kube_arbitrator_tpu.cache.sim import BindIntent, EvictIntent, SimCluster
+from kube_arbitrator_tpu.cache.snapshot import SnapshotTensors
+
+
+def assert_packs_identical(a: SnapshotTensors, b: SnapshotTensors, ctx=""):
+    for f in dataclasses.fields(SnapshotTensors):
+        x, y = getattr(a, f.name), getattr(b, f.name)
+        if f.metadata.get("static"):
+            assert x == y, f"{ctx}: static {f.name}: {x} != {y}"
+            continue
+        xa, ya = np.asarray(x), np.asarray(y)
+        assert xa.dtype == ya.dtype and xa.shape == ya.shape, (
+            f"{ctx}: {f.name}: {xa.dtype}{xa.shape} != {ya.dtype}{ya.shape}"
+        )
+        assert np.array_equal(xa, ya), (
+            f"{ctx}: {f.name}: {int((xa != ya).sum())} cells differ"
+        )
+
+
+def tasks_by_status(sim, status):
+    return [
+        t for j in sim.cluster.jobs.values() for t in j.tasks.values()
+        if t.status == status
+    ]
+
+
+def feasible_bind(sim, rng):
+    """One (pending task, node with room) pair, or None."""
+    pend = tasks_by_status(sim, TaskStatus.PENDING)
+    if not pend:
+        return None
+    t = rng.choice(pend)
+    nodes = list(sim.cluster.nodes.values())
+    rng.shuffle(nodes)
+    for n in nodes:
+        if (n.idle - t.resreq >= -1e-6).all() and len(n.tasks) < n.max_tasks:
+            return BindIntent(t.uid, n.name)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the randomized mutation-stream equivalence test
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_mutation_stream_equivalence(seed):
+    """After every step of a random bind/evict/add/delete/resync stream,
+    the incremental pack == a fresh full rebuild, byte for byte."""
+    rng = random.Random(seed)
+    sim = generate_cluster(
+        num_nodes=12, num_jobs=6, tasks_per_job=6,
+        num_queues=2 + seed, seed=seed, running_fraction=0.4,
+    )
+    arena = SnapshotArena(sim, verify_every=0)
+
+    def step_bind():
+        b = feasible_bind(sim, rng)
+        if b is not None:
+            sim.apply_binds([b])
+
+    def step_bind_failure():
+        b = feasible_bind(sim, rng)
+        if b is not None:
+            sim.binder.fail_uids = {b.task_uid}
+            sim.apply_binds([b])          # diverts to the resync FIFO
+            sim.binder.fail_uids = set()
+            sim.process_resync()          # repairs; emits task deltas
+
+    def step_evict():
+        running = tasks_by_status(sim, TaskStatus.RUNNING)
+        if running:
+            sim.apply_evicts([EvictIntent(rng.choice(running).uid)])
+
+    def step_add_task():
+        job = rng.choice(list(sim.cluster.jobs.values()))
+        sim.add_task(job, 400, 512 * 1024**2, priority=rng.randrange(3))
+
+    def step_add_job():
+        name = f"rand-job-{rng.randrange(10**6)}"
+        j = sim.add_job(name, queue=rng.choice(list(sim.cluster.queues)))
+        sim.add_task(j, 200, 256 * 1024**2)
+
+    def step_delete_job():
+        # pick a job whose tasks are all terminal-or-pending; evict-free
+        # deletion path: mark deleted, then GC with delay elapsed
+        jobs = [
+            j for j in sim.cluster.jobs.values()
+            if all(t.status == TaskStatus.PENDING for t in j.tasks.values())
+        ]
+        if jobs:
+            j = rng.choice(jobs)
+            for t in j.tasks.values():
+                t.status = TaskStatus.SUCCEEDED
+            # direct status flip is not an emitted delta: tell the arena
+            for t in j.tasks.values():
+                arena.task_dirty(t.uid)
+            sim.delete_job(j.uid, now=0.0)
+            sim.collect_garbage(now=10.0)
+
+    def step_add_node():
+        sim.add_node(f"rand-node-{rng.randrange(10**6)}", cpu_milli=16000,
+                     memory=32 * 1024**3)
+
+    def step_cordon():
+        n = rng.choice(list(sim.cluster.nodes.values()))
+        n.unschedulable = not n.unschedulable
+        arena.node_dirty(n.name)  # node_updated delta
+
+    steps = [step_bind, step_bind, step_evict, step_add_task, step_cordon,
+             step_bind_failure, step_add_job, step_delete_job, step_add_node]
+    for i in range(60):
+        rng.choice(steps)()
+        snap = arena.snapshot()
+        fresh = build_snapshot(sim.cluster)
+        assert_packs_identical(
+            snap.tensors, fresh.tensors,
+            ctx=f"seed {seed} step {i} (rebuild={arena.last_rebuild_reason})",
+        )
+
+
+def test_periodic_verify_catches_unpublished_mutation():
+    """A backend mutation that never reaches the delta sink must be caught
+    by the every-Nth-pack epoch check, not silently served forever."""
+    sim = generate_cluster(num_nodes=8, num_jobs=3, tasks_per_job=4,
+                           num_queues=2, seed=9)
+    arena = SnapshotArena(sim, verify_every=2)
+    arena.snapshot()
+    # mutate behind the arena's back: no emission
+    t = tasks_by_status(sim, TaskStatus.PENDING)[0]
+    t.priority += 7
+    arena.snapshot()  # delta pack (stale, but nothing marked it dirty)
+    with pytest.raises(ArenaDivergence, match="task_priority"):
+        arena.snapshot()  # the verify pack
+    # the divergence poisons the arena into a rebuild: next pack is clean
+    snap = arena.snapshot()
+    assert arena.last_rebuild_reason == "divergence"
+    assert_packs_identical(snap.tensors, build_snapshot(sim.cluster).tensors)
+
+
+# ---------------------------------------------------------------------------
+# structural fallback triggers
+
+
+def _mini_sim():
+    sim = SimCluster()
+    sim.add_queue("default")
+    sim.add_node("n1", cpu_milli=8000, memory=16 * 1024**3)
+    j = sim.add_job("j1", queue="default")
+    sim.add_task(j, 1000, 1024**3)
+    sim.add_task(j, 1000, 1024**3, status=TaskStatus.RUNNING, node="n1")
+    return sim
+
+
+def test_structural_fallback_reasons():
+    sim = _mini_sim()
+    arena = SnapshotArena(sim, verify_every=0)
+    arena.snapshot()
+    assert arena.last_rebuild_reason == "seed"
+    j = sim.cluster.jobs["j1"]
+    sim.add_task(j, 500, 1024**3)  # emits structural("task_added")
+    arena.snapshot()
+    assert arena.last_rebuild_reason == "task_added"
+    # steady pack after: delta path again
+    arena.snapshot()
+    assert arena.last_rebuild_reason is None
+
+
+def test_signature_change_falls_back():
+    """A dirty task whose predicate signature changed cannot be row-
+    refreshed (class ids are first-occurrence-ordered) — full rebuild."""
+    sim = _mini_sim()
+    arena = SnapshotArena(sim, verify_every=0)
+    arena.snapshot()
+    t = tasks_by_status(sim, TaskStatus.PENDING)[0]
+    t.node_selector = {"accel": "tpu"}
+    arena.task_dirty(t.uid)
+    snap = arena.snapshot()
+    assert arena.last_rebuild_reason == "predicate_signature"
+    assert_packs_identical(snap.tensors, build_snapshot(sim.cluster).tensors)
+
+
+def test_port_universe_change_falls_back():
+    sim = _mini_sim()
+    arena = SnapshotArena(sim, verify_every=0)
+    arena.snapshot()
+    t = tasks_by_status(sim, TaskStatus.PENDING)[0]
+    t.host_ports = (8080,)
+    arena.task_dirty(t.uid)
+    snap = arena.snapshot()
+    assert arena.last_rebuild_reason == "port_universe"
+    assert_packs_identical(snap.tensors, build_snapshot(sim.cluster).tensors)
+
+
+def test_pod_affinity_always_rebuilds():
+    """Affinity encodings re-count 'existing pods per domain' on every
+    bind: a snapshot with any affinity term runs the full producer."""
+    from kube_arbitrator_tpu.api.info import PodAffinityTerm
+
+    sim = _mini_sim()
+    j = sim.cluster.jobs["j1"]
+    sim.add_task(
+        j, 100, 1024**2,
+        labels={"app": "web"},
+        affinity=[PodAffinityTerm(match_labels=(("app", "web"),), anti=True)],
+    )
+    arena = SnapshotArena(sim, verify_every=0)
+    arena.snapshot()
+    snap = arena.snapshot()
+    assert arena.last_rebuild_reason == "pod_affinity"
+    assert_packs_identical(snap.tensors, build_snapshot(sim.cluster).tensors)
+
+
+def test_set_drift_safety_net():
+    """Even a direct dict mutation with NO emission at all is caught by
+    the set-membership net before the delta path can serve a stale pack."""
+    sim = _mini_sim()
+    arena = SnapshotArena(sim, verify_every=0)
+    arena.snapshot()
+    from kube_arbitrator_tpu.api.info import QueueInfo
+
+    sim.cluster.queues["rogue"] = QueueInfo(uid="rogue", name="rogue")
+    snap = arena.snapshot()
+    assert arena.last_rebuild_reason == "set_drift"
+    assert_packs_identical(snap.tensors, build_snapshot(sim.cluster).tensors)
+
+
+# ---------------------------------------------------------------------------
+# epoch / PackMeta / device plane
+
+
+def test_epoch_advances_only_on_change():
+    sim = _mini_sim()
+    arena = SnapshotArena(sim, verify_every=0)
+    arena.snapshot()
+    e0 = arena.epoch
+    arena.snapshot()  # nothing changed
+    assert arena.epoch == e0
+    assert arena.pack_meta.changed_fields == ()
+    b = feasible_bind(sim, random.Random(0))
+    sim.apply_binds([b])
+    arena.snapshot()
+    assert arena.epoch == e0 + 1
+    assert "task_status" in arena.pack_meta.changed_fields
+    assert arena.pack_meta.base_key.endswith(f":{e0}")
+
+
+def test_verify_every_1_does_not_recurse():
+    """Regression: verify()'s drain guard re-entered snapshot() while the
+    consumed dirty sets were still populated — verify_every=1 (a legal
+    --arena-verify-every value) recursed unboundedly on the first delta."""
+    sim = _mini_sim()
+    arena = SnapshotArena(sim, verify_every=1)
+    arena.snapshot()
+    b = feasible_bind(sim, random.Random(3))
+    sim.apply_binds([b])
+    snap = arena.snapshot()  # delta + immediate epoch check
+    assert arena.last_rebuild_reason is None
+    assert_packs_identical(snap.tensors, build_snapshot(sim.cluster).tensors)
+
+
+def test_static_rv_window_change_rides_changed_fields():
+    """Regression: rv_window is a static (non-array) field that can move
+    on a pure delta cycle; it must appear in PackMeta.changed_fields or
+    the RPC delta path patches the rv_* arrays while the sidecar keeps a
+    stale compile-time window."""
+    sim = SimCluster()
+    sim.add_queue("default")
+    sim.add_node("n1", cpu_milli=200000, memory=400 * 1024**3, max_tasks=200)
+    j = sim.add_job("j1", queue="default")
+    for _ in range(40):
+        sim.add_task(j, 100, 1024**2, status=TaskStatus.RUNNING, node="n1")
+    arena = SnapshotArena(sim, verify_every=0)
+    w0 = arena.snapshot().tensors.rv_window
+    running = tasks_by_status(sim, TaskStatus.RUNNING)
+    sim.apply_evicts([EvictIntent(t.uid) for t in running[:20]])
+    snap = arena.snapshot()
+    assert arena.last_rebuild_reason is None
+    assert snap.tensors.rv_window != w0  # the bucket actually moved
+    assert "rv_window" in arena.pack_meta.changed_fields
+    assert_packs_identical(snap.tensors, build_snapshot(sim.cluster).tensors)
+    # and the codec can ship it: statics round-trip as python scalars
+    grpc_pb = pytest.importorskip("kube_arbitrator_tpu.rpc.decision_pb2")
+    from kube_arbitrator_tpu.rpc.codec import pack_tensors, unpack_fields
+
+    req = grpc_pb.SnapshotRequest()
+    pack_tensors(snap.tensors, req.tensors, fields=arena.pack_meta.changed_fields)
+    patch = unpack_fields(SnapshotTensors, req.tensors)
+    assert patch["rv_window"] == snap.tensors.rv_window
+    assert isinstance(patch["rv_window"], int)
+
+
+def test_device_pack_reuse_and_delta():
+    sim = generate_cluster(num_nodes=12, num_jobs=4, tasks_per_job=6,
+                           num_queues=2, seed=4)
+    arena = SnapshotArena(sim, verify_every=0)
+    s0 = arena.snapshot()
+    actions = ("allocate", "backfill")
+    arena.device_pack(actions)
+    assert arena._resident.last_mode == "full"
+    full_bytes = arena._resident.last_upload_bytes
+    arena.device_pack(actions)
+    assert arena._resident.last_mode == "reuse"
+    assert arena._resident.last_upload_bytes == 0
+    b = feasible_bind(sim, random.Random(1))
+    sim.apply_binds([b])
+    s1 = arena.snapshot()
+    st = arena.device_pack(actions)
+    assert arena._resident.last_mode == "delta"
+    assert 0 < arena._resident.last_upload_bytes < full_bytes
+    # the resident view must equal the host pack byte for byte
+    for f in dataclasses.fields(SnapshotTensors):
+        if f.metadata.get("static"):
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(getattr(st, f.name)),
+            np.asarray(getattr(s1.tensors, f.name)), err_msg=f.name,
+        )
+
+
+def test_scatter_row_padding_is_idempotent():
+    """_pad_rows repeats the last (index, row) pair to reach a stable
+    compile bucket; duplicate .at[i].set(v) with identical v must land
+    the same result as the unpadded scatter."""
+    buf = np.arange(40, dtype=np.float32).reshape(10, 4)
+    rows = np.array([2, 7], dtype=np.int32)
+    vals = np.full((2, 4), -1.0, dtype=np.float32)
+    idx_p, vals_p = _pad_rows(rows, vals)
+    assert len(idx_p) >= len(rows) and len(idx_p) == len(vals_p)
+    out = np.asarray(_scatter_copy(buf.copy(), idx_p, vals_p))
+    expect = buf.copy()
+    expect[rows] = vals
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_arena_decisions_match_full_rebuild_decisions():
+    """End to end: identical packs -> bit-identical decisions."""
+    from kube_arbitrator_tpu.framework import Scheduler
+
+    def mk():
+        return generate_cluster(num_nodes=16, num_jobs=6, tasks_per_job=8,
+                                num_queues=2, seed=21, running_fraction=0.3)
+
+    a = Scheduler(mk(), arena=True)
+    a.arena.verify_every = 3
+    b = Scheduler(mk())
+    for cyc in range(6):
+        ra, rb = a.run_once(), b.run_once()
+        assert sorted((x.task_uid, x.node_name) for x in ra.binds) == \
+            sorted((x.task_uid, x.node_name) for x in rb.binds), cyc
+        assert sorted(x.task_uid for x in ra.evicts) == \
+            sorted(x.task_uid for x in rb.evicts), cyc
+    assert a.history[-1].upload_ms >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# live-cache watch-plane deltas
+
+
+def test_live_cache_emits_row_deltas():
+    from kube_arbitrator_tpu.cache import FakeApiServer, LiveCache
+    from test_live_cache import make_node, make_pod, make_podgroup
+
+    api = FakeApiServer()
+    live = LiveCache(api)
+    for i in range(3):
+        api.create("nodes", make_node(f"n{i}", cpu="8", memory="16Gi"))
+    api.create("queues", {"metadata": {"name": "default"}, "spec": {"weight": 1}})
+    api.create("podgroups", make_podgroup("g1", min_member=1, queue="default"))
+    for i in range(4):
+        api.create("pods", make_pod(f"p{i}", group="g1", cpu="500m", memory="256Mi"))
+    live.sync()
+    arena = SnapshotArena(live, verify_every=0)
+    arena.snapshot()
+    assert arena.last_rebuild_reason == "seed"
+    # actuate a bind through the apiserver; the watch event is an
+    # in-place pod update -> row delta, NOT a structural rebuild
+    live.apply_binds([BindIntent(next(iter(live._pod_ref)), "n0")])
+    live.sync()
+    snap = arena.snapshot()
+    assert arena.last_rebuild_reason is None
+    assert_packs_identical(snap.tensors, build_snapshot(live.cluster).tensors)
+    # a NEW pod arriving is structural
+    api.create("pods", make_pod("p-late", group="g1", cpu="250m", memory="128Mi"))
+    live.sync()
+    snap = arena.snapshot()
+    assert arena.last_rebuild_reason == "task_set"
+    assert_packs_identical(snap.tensors, build_snapshot(live.cluster).tensors)
+
+
+# ---------------------------------------------------------------------------
+# RPC pack reuse (runs only when grpc is importable)
+
+
+def test_rpc_delta_shipping_and_resend():
+    pytest.importorskip("grpc")
+    from kube_arbitrator_tpu.rpc import DecisionService, RemoteDecider, serve
+
+    svc = DecisionService()
+    server, port = serve("127.0.0.1:0", service=svc)
+    try:
+        from kube_arbitrator_tpu.framework import Scheduler
+
+        def mk():
+            return generate_cluster(num_nodes=12, num_jobs=4, tasks_per_job=6,
+                                    num_queues=2, seed=13)
+
+        remote = Scheduler(mk(), decider=RemoteDecider(f"127.0.0.1:{port}"),
+                           arena=True)
+        local = Scheduler(mk())
+        for cyc in range(3):
+            rr, rl = remote.run_once(), local.run_once()
+            assert sorted((x.task_uid, x.node_name) for x in rr.binds) == \
+                sorted((x.task_uid, x.node_name) for x in rl.binds), cyc
+        # deltas actually rode the wire
+        assert remote.decider._resident_key is not None
+        # sidecar restart: wipe the resident pack -> FAILED_PRECONDITION
+        # -> transparent full resend, decisions unaffected
+        with svc._lock:
+            svc._pack_key = svc._pack = None
+        rr, rl = remote.run_once(), local.run_once()
+        assert sorted((x.task_uid, x.node_name) for x in rr.binds) == \
+            sorted((x.task_uid, x.node_name) for x in rl.binds)
+        remote.decider.close()
+    finally:
+        server.stop(grace=None)

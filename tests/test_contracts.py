@@ -93,6 +93,22 @@ def test_snapshot_build_asserts_pack_dtypes():
     assert snapmod.to_device_units(np.zeros(4)).dtype == snapmod.DEVICE_DTYPE
 
 
+def test_mutated_arena_producer_dtype_reports_exactly_that_field():
+    # the arena's delta path is a SECOND snapshot producer: declaring
+    # group_size as int64 must make the arena check flag exactly that
+    # field (the real delta path correctly emits int32)
+    seeded = contracts.mutated(contracts.SNAPSHOT_SCHEMA, "group_size", "int64")
+    findings = contracts.check_arena_producer(seeded)
+    assert len(findings) == 1
+    assert findings[0].rule == "KAT-CTR-007"
+    assert "group_size" in findings[0].message
+    assert "delta path" in findings[0].message or "SnapshotArena" in findings[0].message
+
+
+def test_arena_producer_clean_on_real_tree():
+    assert contracts.check_arena_producer() == []
+
+
 def test_producer_crash_becomes_a_finding_not_a_traceback(monkeypatch):
     # a build_snapshot that RAISES (e.g. its own pack-dtype guard firing)
     # must surface as a KAT-CTR-002 finding, not crash the analyzer and

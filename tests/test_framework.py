@@ -203,7 +203,7 @@ def test_bench_instances_share_compiled_shapes():
     bench = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bench)
 
-    inst = bench._instances(400, 40, 4, 0.3, want=2)
+    inst, _sim, _canon = bench._instances(400, 40, 4, 0.3, want=2)
     assert len(inst) >= 2, "no same-shaped variant instance found"
     flat0, tree0 = jtu.tree_flatten(inst[0])
     for variant in inst[1:]:

@@ -131,47 +131,90 @@ def test_timing_ordering_validated(tmp_path):
                       retry_period_s=0.0)
 
 
-def test_stale_lease_after_decision_discards_cycle(tmp_path):
-    """A decision phase that outlasts the renew deadline (wedged
-    accelerator tunnel) must NOT actuate its stale binds: the actuation
-    fence in Scheduler._run_once_inner discards the cycle with LeaderLost
-    before apply_binds, so a standby that took the lease mid-decision
-    never co-exists with a stale actuator."""
-    clock = FakeClock()
-    lock = tmp_path / "kb.lock"
-    leader = _elector(lock, "leader", clock)
-    assert leader.try_acquire()
-
+def _one_task_sim():
     sim = SimCluster()
     sim.add_queue("default")
     sim.add_node("n1", cpu_milli=4000, memory=8 * GB)
     job = sim.add_job("j1")
     sim.add_task(job, cpu_milli=500, memory=GB)
+    return sim
 
-    # simulate the decision program hanging past the renew deadline:
-    # advance the fake clock inside the decide path
+
+def _slow_decider(clock, dt, mid_decision=None):
+    """A decider whose decision phase 'takes' dt seconds on the fake
+    clock (the wedged-accelerator shape), optionally running a callback
+    mid-decision (e.g. a standby stealing the lease)."""
     from kube_arbitrator_tpu.framework.decider import LocalDecider
 
     class WedgedDecider(LocalDecider):
-        def decide(self, st, config):
+        def decide(self, st, config, pack_meta=None):
             out = super().decide(st, config)
-            clock.t += 1000.0  # decision "took" far past renew_deadline_s
+            clock.t += dt
+            if mid_decision is not None:
+                mid_decision()
             return out
 
-    sched = Scheduler(sim, elector=leader, decider=WedgedDecider())
+    return WedgedDecider()
+
+
+def test_slow_cycle_revalidates_against_storage_and_actuates(tmp_path):
+    """ADVICE r5 fence false-positive: a cycle slower than the renew
+    deadline looks stale to the clock-only lease_fresh(), but with NO
+    usurper the lease record still names this leader — the fence's
+    elector.revalidate() confirms against storage, renews, and the cycle
+    actuates instead of killing a healthy process."""
+    clock = FakeClock()
+    leader = _elector(tmp_path / "kb.lock", "leader", clock,
+                      lease_duration_s=15, renew_deadline_s=10)
+    assert leader.try_acquire()
+    sim = _one_task_sim()
+    # 12 s decision: past renew_deadline (10), inside lease_duration (15)
+    sched = Scheduler(sim, elector=leader, decider=_slow_decider(clock, 12.0))
+    sched.run(max_cycles=1)
+    assert len(sim.binder.binds) == 1, "slow-but-healthy cycle must actuate"
+    assert leader.is_leader  # re-validation restored leadership + renew_ts
+
+
+def test_usurped_lease_after_decision_discards_cycle(tmp_path):
+    """The fence's real target: a decision phase so long a standby
+    legally took the lease.  revalidate() sees the other holder and the
+    stale binds are discarded with LeaderLost before apply_binds."""
+    clock = FakeClock()
+    lock = tmp_path / "kb.lock"
+    leader = _elector(lock, "leader", clock)
+    standby = _elector(lock, "standby", clock)
+    assert leader.try_acquire()
+    sim = _one_task_sim()
+
+    def standby_takes_over():
+        # observer-local lease timing: the standby must watch the record
+        # unchanged for a full lease_duration before it may steal
+        assert not standby.try_acquire()
+        clock.t += 20.0
+        assert standby.try_acquire()
+
+    sched = Scheduler(
+        sim, elector=leader, decider=_slow_decider(clock, 20.0, standby_takes_over)
+    )
     with pytest.raises(LeaderLost, match="not actuated"):
         sched.run(max_cycles=1)
     assert sim.binder.binds == {}, "stale cycle must not actuate"
+    assert not leader.is_leader
 
-    # control: a fresh lease actuates normally
-    clock2 = FakeClock()
-    lock2 = tmp_path / "kb2.lock"
-    leader2 = _elector(lock2, "leader2", clock2)
-    assert leader2.try_acquire()
-    sim2 = SimCluster()
-    sim2.add_queue("default")
-    sim2.add_node("n1", cpu_milli=4000, memory=8 * GB)
-    j2 = sim2.add_job("j1")
-    sim2.add_task(j2, cpu_milli=500, memory=GB)
-    Scheduler(sim2, elector=leader2).run(max_cycles=1)
-    assert len(sim2.binder.binds) == 1
+
+def test_revalidate_fails_on_transient_storage_error(tmp_path, monkeypatch):
+    """Storage that cannot CONFIRM leadership must not let a stale cycle
+    actuate: revalidate() treats an unreadable lock as lost."""
+    from kube_arbitrator_tpu.framework.leader import TransientLockError
+
+    clock = FakeClock()
+    leader = _elector(tmp_path / "kb.lock", "leader", clock)
+    assert leader.try_acquire()
+    clock.t += 12.0  # past renew deadline
+
+    def boom():
+        raise TransientLockError("storage unreachable")
+
+    monkeypatch.setattr(leader, "_fetch", boom)
+    assert not leader.revalidate()
+    assert not leader.is_leader

@@ -101,6 +101,75 @@ def _assert_converged(api: FakeApiServer, live: LiveCache) -> int:
     return len(model_tasks)
 
 
+def test_arena_soak_50_cycles_matches_full_rebuild():
+    """Arena acceptance soak: >=50 cycles through Scheduler.run with the
+    incremental snapshot plane on, against a twin scheduler rebuilding
+    from scratch every cycle — bind/evict decisions must match cycle for
+    cycle.  Churn between cycles exercises both the delta path (binds,
+    evicts, resync repairs) and the structural fallbacks (gang arrivals,
+    job deletion + GC, cordon flaps), and verify_every=10 interleaves the
+    byte-identity epoch check five times across the run."""
+    from kube_arbitrator_tpu.cache.sim import generate_cluster
+
+    def mk():
+        return generate_cluster(num_nodes=24, num_jobs=10, tasks_per_job=8,
+                                num_queues=3, seed=29, running_fraction=0.3)
+
+    arena_sched = Scheduler(mk(), config=load_conf(FULL_CONF), arena=True)
+    arena_sched.arena.verify_every = 10
+    full_sched = Scheduler(mk(), config=load_conf(FULL_CONF))
+
+    def churn(sched, cycle):
+        """Deterministic mutation stream, identical for both backends."""
+        sim, r = sched.sim, random.Random(1000 + cycle)
+        if cycle % 7 == 3:
+            j = sim.add_job(f"soak-job-{cycle}",
+                            queue=f"queue-{r.randrange(3):03d}",
+                            min_available=2)
+            for _ in range(4):
+                sim.add_task(j, 500, 512 * 1024**2)
+        if cycle % 11 == 5:
+            victims = sorted(
+                j.uid for j in sim.cluster.jobs.values()
+                if j.uid.startswith("soak-job-")
+                and all(t.status == TaskStatus.PENDING for t in j.tasks.values())
+            )
+            if victims:
+                # GC only collects jobs whose tasks are all terminal:
+                # finish the tasks first (emitting the status flips),
+                # so the job_removed structural path actually fires
+                job = sim.cluster.jobs[victims[0]]
+                for t in job.tasks.values():
+                    t.status = TaskStatus.SUCCEEDED
+                    if getattr(sim, "delta_sink", None) is not None:
+                        sim.delta_sink.task_dirty(t.uid)
+                sim.delete_job(victims[0], now=0.0)
+                collected = sim.collect_garbage(now=10.0)
+                assert victims[0] in collected
+        if cycle % 5 == 2:
+            n = list(sim.cluster.nodes.values())[r.randrange(24)]
+            n.unschedulable = not n.unschedulable
+            if getattr(sim, "delta_sink", None) is not None:
+                sim.delta_sink.node_dirty(n.name)
+
+    rebuild_reasons = []
+    for cycle in range(50):
+        churn(arena_sched, cycle)
+        churn(full_sched, cycle)
+        ra = arena_sched.run_once()
+        rb = full_sched.run_once()
+        rebuild_reasons.append(arena_sched.arena.last_rebuild_reason)
+        assert sorted((b.task_uid, b.node_name) for b in ra.binds) == \
+            sorted((b.task_uid, b.node_name) for b in rb.binds), cycle
+        assert sorted(e.task_uid for e in ra.evicts) == \
+            sorted(e.task_uid for e in rb.evicts), cycle
+    assert len(arena_sched.history) == 50
+    # the delta path must carry the steady-state majority — a rebuild
+    # every cycle would be a degenerate (correct but pointless) arena
+    delta_cycles = sum(1 for r in rebuild_reasons if r is None)
+    assert delta_cycles >= 30, rebuild_reasons
+
+
 def test_live_plane_soak_50_cycles():
     rng = random.Random(17)
     api = FakeApiServer()
