@@ -91,10 +91,41 @@ if [ "${ARENA_EQUIV:-0}" = "1" ]; then
   fi
 fi
 
+# CHAOS=1: the deterministic chaos lane — a seed-matrix smoke over the
+# full loop (LiveCache + arena + leader + faulting apiserver on a
+# virtual clock), the runner exiting nonzero on any invariant breach,
+# plus one sensitivity run proving the breach detectors actually fire
+# when a safety mechanism (the arena byte-identity verifier) is off.
+rc_chaos=0
+if [ "${CHAOS:-0}" = "1" ]; then
+  for seed in 0 1 2 3 4 5 6 7; do
+    env JAX_PLATFORMS=cpu python -m kube_arbitrator_tpu.chaos \
+      --seed "${seed}" --cycles 10 --profile smoke --out-dir /tmp \
+      || rc_chaos=$?
+  done
+  # sensitivity canary: this MUST breach — exit code exactly 1.  A clean
+  # exit means the invariant checkers have gone blind; any OTHER nonzero
+  # (usage error, crash) means the proof never ran — both are failures.
+  env JAX_PLATFORMS=cpu python -m kube_arbitrator_tpu.chaos \
+    --seed 2 --cycles 6 --profile arena --disable arena-verify \
+    --out-dir /tmp >/dev/null
+  rc_canary=$?
+  if [ "${rc_canary}" -ne 1 ]; then
+    echo "chaos sensitivity canary did not breach (exit ${rc_canary})" >&2
+    rc_chaos=1
+  fi
+  if [ "${rc_chaos}" -ne 0 ]; then
+    echo "chaos smoke job: FAILED (exit ${rc_chaos})" >&2
+  else
+    echo "chaos smoke job: ok (8-seed matrix + sensitivity canary)"
+  fi
+fi
+
 if [ "${LINT_ONLY:-0}" = "1" ]; then
   if [ "${rc_lint}" -ne 0 ]; then exit "${rc_lint}"; fi
   if [ "${rc_obs}" -ne 0 ]; then exit "${rc_obs}"; fi
-  exit "${rc_arena}"
+  if [ "${rc_arena}" -ne 0 ]; then exit "${rc_arena}"; fi
+  exit "${rc_chaos}"
 fi
 
 rc_test=0
@@ -108,4 +139,5 @@ fi
 if [ "${rc_lint}" -ne 0 ]; then exit "${rc_lint}"; fi
 if [ "${rc_obs}" -ne 0 ]; then exit "${rc_obs}"; fi
 if [ "${rc_arena}" -ne 0 ]; then exit "${rc_arena}"; fi
+if [ "${rc_chaos}" -ne 0 ]; then exit "${rc_chaos}"; fi
 exit "${rc_test}"

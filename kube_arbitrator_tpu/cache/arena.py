@@ -243,7 +243,6 @@ class SnapshotArena:
 
     def __init__(self, backend, verify_every: int = 64):
         self.backend = backend
-        self.cluster = backend.cluster
         self.verify_every = verify_every
         backend.delta_sink = self
         self.uid = uuid.uuid4().hex[:8]
@@ -279,6 +278,14 @@ class SnapshotArena:
         self._universe: List[int] = []
         self._aff_trivial = True
         self._resident = _DeviceResident()
+
+    @property
+    def cluster(self):
+        """The backend's live model, resolved per access: ``LiveCache``
+        swaps its ``ClusterInfo`` wholesale on a 410-Gone relist, and a
+        captured reference would leave the arena rebuilding from the
+        dropped model forever."""
+        return self.backend.cluster
 
     # ---- the delta sink surface (backends call these) ----
 
@@ -443,6 +450,33 @@ class SnapshotArena:
             labels={"mode": self._resident.last_mode},
         )
         return st
+
+    # ---- chaos seam (chaos/faults.py) ----
+
+    def pick_clean_node_row(self, hint: int) -> Optional[int]:
+        """First node ordinal at/after ``hint`` (wrapping) with no dirty
+        refresh queued — a corruption target the next delta pack will NOT
+        immediately overwrite from the live object.  None before the
+        first pack or when every node is dirty."""
+        n = len(self._node_names)
+        if n == 0:
+            return None
+        for off in range(n):
+            cand = (int(hint) + off) % n
+            if self._node_names[cand] not in self._dirty_nodes:
+                return cand
+        return None
+
+    def corrupt(self, field: str, row: int, values) -> None:
+        """CHAOS SEAM — emulate a lost delta: overwrite one working-arena
+        row WITHOUT publishing anything to the sink, exactly the damage a
+        backend mutation path that forgot to emit its delta would cause.
+        The every-Nth-pack byte-identity :meth:`verify` exists to catch
+        this bug class; the chaos plane injects it to prove the verifier
+        fires (and that, with the verifier disabled, the cluster-level
+        invariant checkers catch the downstream damage instead).  Never
+        called outside chaos/tests."""
+        self._w[field][row] = values
 
     # ---- internals ----
 

@@ -51,6 +51,17 @@ class ApiError(RuntimeError):
         self.status = status
 
 
+class GoneError(ApiError):
+    """410 Gone: the requested resourceVersion predates the event-log
+    compaction horizon.  A real apiserver keeps a bounded etcd watch
+    window and answers a too-old watch with 410; client-go informers
+    respond with a full relist + re-watch.  :class:`cache.live.LiveCache`
+    does the same (``_reset_model`` + LIST)."""
+
+    def __init__(self, message: str):
+        super().__init__(message, status=410)
+
+
 def _key(obj: dict) -> Tuple[str, str]:
     md = obj.get("metadata", {})
     return md.get("namespace", ""), md["name"]
@@ -65,6 +76,9 @@ class FakeApiServer:
         self._rv = 0
         # (rv, resource, type, object-copy)
         self.event_log: List[Tuple[int, str, str, dict]] = []
+        # watch-window compaction horizon: events with rv <= this are gone
+        # from the log; a watch from below it gets a 410 GoneError
+        self._compacted_rv = 0
         # failure injection: uids whose bind/delete/status calls raise
         self.fail_bind_uids: set = set()
         self.fail_delete_uids: set = set()
@@ -142,9 +156,28 @@ class FakeApiServer:
         """LIST: (items, resourceVersion to watch from)."""
         return [copy.deepcopy(o) for o in self._store[resource].values()], self._rv
 
+    def compact(self, upto_rv: Optional[int] = None) -> int:
+        """Drop event-log entries with rv <= ``upto_rv`` (default: the
+        current head — the whole log), like etcd compaction shrinking the
+        apiserver's watch window.  Clients watching from below the new
+        horizon get a :class:`GoneError` and must relist."""
+        upto = self._rv if upto_rv is None else int(upto_rv)
+        self.event_log = [e for e in self.event_log if e[0] > upto]
+        self._compacted_rv = max(self._compacted_rv, upto)
+        return self._compacted_rv
+
+    def _check_window(self, since_rv: int) -> None:
+        if since_rv < self._compacted_rv:
+            raise GoneError(
+                f"watch from resourceVersion {since_rv} is too old: "
+                f"compacted up to {self._compacted_rv}; relist required"
+            )
+
     def watch(self, resource: str, since_rv: int) -> List[Tuple[int, str, dict]]:
         """Pull the (rv, type, object) events for ``resource`` after
-        ``since_rv`` — one informer pump's worth."""
+        ``since_rv`` — one informer pump's worth.  Raises
+        :class:`GoneError` when ``since_rv`` predates compaction."""
+        self._check_window(since_rv)
         return [
             (rv, etype, copy.deepcopy(obj))
             for rv, r, etype, obj in self.event_log
@@ -154,7 +187,9 @@ class FakeApiServer:
     def watch_all(self, since_rv: int) -> List[Tuple[int, str, str, dict]]:
         """All resources' events after ``since_rv`` in global rv order — a
         single-threaded stand-in for concurrent per-resource informers that
-        preserves causal order (a pod's bind never precedes its node)."""
+        preserves causal order (a pod's bind never precedes its node).
+        Raises :class:`GoneError` when ``since_rv`` predates compaction."""
+        self._check_window(since_rv)
         return [
             (rv, r, etype, copy.deepcopy(obj))
             for rv, r, etype, obj in self.event_log
@@ -179,14 +214,19 @@ class FakeApiServer:
             pod.setdefault("status", {})["phase"] = "Running"
             self._bump("pods", MODIFIED, pod)
 
-    def evict_pod(self, namespace: str, name: str) -> None:
-        """DELETE pod (DefaultEvictor, cache.go:106-123)."""
+    def evict_pod(
+        self, namespace: str, name: str, expect_rv: Optional[str] = None
+    ) -> None:
+        """DELETE pod (DefaultEvictor, cache.go:106-123).  ``expect_rv``
+        makes it a compare-and-delete: an evictor deciding from a stale
+        snapshot (the pod was bound/updated since) gets a 409 instead of
+        silently killing a pod in a state it never observed."""
         pod = self._store["pods"].get((namespace, name))
         if pod is None:
             raise ApiError(f"pod {namespace}/{name} not found", status=404)
         if pod.get("metadata", {}).get("uid") in self.fail_delete_uids:
             raise ApiError(f"evict {namespace}/{name} injected failure")
-        self.delete("pods", namespace, name)
+        self.delete("pods", namespace, name, expect_rv=expect_rv)
 
     def update_pod_condition(self, namespace: str, name: str, condition: dict) -> None:
         """PATCH a pod status condition (StatusUpdater.UpdatePodCondition,

@@ -209,7 +209,9 @@ class _Handler(BaseHTTPRequestHandler):
             ns, resource, name = self._object_ref(rest)
             expect = query.get("expectResourceVersion", [None])[0]
             if resource == "pods":
-                api.evict_pod(ns, name)
+                # compare-and-delete precondition rides through to the
+                # evictor: a stale-snapshot evict must 409, not apply
+                api.evict_pod(ns, name, expect_rv=expect)
             else:
                 api.delete(resource, ns, name, expect_rv=expect)
             return 200, {"status": "Success"}
@@ -361,8 +363,13 @@ class HttpApiClient:
             {"target": {"kind": "Node", "name": node_name}},
         )
 
-    def evict_pod(self, namespace: str, name: str) -> None:
-        self._call("DELETE", f"/api/v1/namespaces/{namespace}/pods/{name}")
+    def evict_pod(
+        self, namespace: str, name: str, expect_rv: Optional[str] = None
+    ) -> None:
+        path = f"/api/v1/namespaces/{namespace}/pods/{name}"
+        if expect_rv is not None:
+            path += f"?expectResourceVersion={expect_rv}"
+        self._call("DELETE", path)
 
     def update_pod_condition(self, namespace: str, name: str, condition: dict) -> None:
         self._call(

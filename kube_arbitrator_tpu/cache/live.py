@@ -314,8 +314,11 @@ class LiveCache:
     Drop-in backend for :class:`framework.Scheduler` (same duck-typed
     surface as :class:`SimCluster`)."""
 
-    def __init__(self, api: FakeApiServer):
+    def __init__(self, api: FakeApiServer, now_fn=None):
         self.api = api
+        # injectable clock (chaos plane / tests run on a virtual clock so
+        # GC delays and staleness gauges are deterministic)
+        self._now = now_fn or _time.time
         self.cluster = ClusterInfo()
         self.events: List[Event] = []
         self.resync_queue: List[str] = []
@@ -360,12 +363,37 @@ class LiveCache:
                    "persistentvolumes", "persistentvolumeclaims",
                    "podgroups", "pdbs", "pods")
 
+    def _reset_model(self) -> None:
+        """410-Gone recovery: the watch window was compacted past our
+        resourceVersion, so incremental catch-up is impossible — drop the
+        whole model and relist from scratch (client-go's informer relist).
+        Actuation refs rebuild during the LIST; the errTasks resync FIFO
+        survives (its uids re-resolve against the fresh refs, and uids
+        whose pods vanished are skipped like any deleted pod)."""
+        self.cluster = ClusterInfo()
+        self._watch_rv = 0
+        self._listed = False
+        self._pod_ref.clear()
+        self._pg_ref.clear()
+        self._deleted_jobs = []
+        self._task_by_uid.clear()
+        self._other_by_uid.clear()
+        self._pvs.clear()
+        self._pvcs.clear()
+        self._scs.clear()
+        self._raw_pod.clear()
+        self._claim_pods.clear()
+        self._pv_claims.clear()
+        if self.delta_sink is not None:
+            # the arena's ordinal maps all point into the dropped model
+            self.delta_sink.structural("relist")
+
     def sync(self) -> int:
         """One pump: initial LIST then incremental WATCH; returns events
         applied (WaitForCacheSync + handler goroutines, cache.go:311-351,
         single-threaded)."""
         m = metrics()
-        now = _time.time()
+        now = self._now()
         # model age at pump time: the gap since the previous pump is how
         # stale the snapshot the NEXT cycle builds from could have been
         if self._last_sync_ts is not None:
@@ -393,7 +421,20 @@ class LiveCache:
             self._listed = True
             m.counter_add("cache_watch_events_total", n, labels={"phase": "list"})
             return n
-        for rv, resource, etype, obj in self.api.watch_all(self._watch_rv):
+        try:
+            events = self.api.watch_all(self._watch_rv)
+        except ApiError as err:
+            # the watch window was compacted past us: relist (the informer
+            # response to 410).  Matched by status, not type: the HTTP
+            # backend re-raises the server's GoneError as a plain
+            # ApiError(status=410) after the wire crossing.  The
+            # recursive call takes the LIST branch.
+            if err.status != 410:
+                raise
+            m.counter_add("cache_relists_total")
+            self._reset_model()
+            return self.sync()
+        for rv, resource, etype, obj in events:
             self._dispatch(resource, etype, obj)
             self._watch_rv = rv
             n += 1
@@ -675,7 +716,7 @@ class LiveCache:
         job_uid = f"{ns}/{md['name']}"
         if etype == DELETED:
             self._pg_ref.pop(job_uid, None)
-            self._deleted_jobs.append((job_uid, _time.time()))
+            self._deleted_jobs.append((job_uid, self._now()))
             return
         job = self.cluster.jobs.get(job_uid)
         if job is None:
@@ -867,7 +908,7 @@ class LiveCache:
     def collect_garbage(self, now: Optional[float] = None, delay_s: float = 5.0) -> List[str]:
         """Deferred job GC (cache.go:476-517): a deleted PodGroup's job is
         removed once its delay elapsed and no live tasks remain."""
-        now = now if now is not None else _time.time()
+        now = now if now is not None else self._now()
         keep: List[Tuple[str, float]] = []
         collected: List[str] = []
         terminal = {TaskStatus.SUCCEEDED, TaskStatus.FAILED, TaskStatus.UNKNOWN}

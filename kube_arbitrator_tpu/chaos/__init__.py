@@ -1,0 +1,47 @@
+"""Deterministic chaos plane: seeded fault injection + invariant checking.
+
+Deterministic-simulation testing (DST) for the whole scheduling loop:
+``FakeApiServer`` → ``LiveCache`` (+ optional ``SnapshotArena``) → decider
+→ commit/bind, driven on a virtual clock under a **seeded fault plan**,
+with cluster-level invariants checked after every cycle.  The reference
+scheduler leans on the apiserver to absorb faults (errTasks resync, 409
+on bind); this plane proves the TPU-side rebuild provides the same safety
+properties itself — the way heterogeneity-aware schedulers validate
+policies in simulation before deployment (Gavel, Tesserae).
+
+Modules:
+
+* :mod:`clock` — the virtual clock every timed component runs on.
+* :mod:`plan` — seeded fault-plan generation, profiles, repro files.
+* :mod:`faults` — the injector + the explicit seams (a faulting
+  apiserver subclass, a retrying decider wrapper, lease usurpation, arena
+  delta corruption).  No monkeypatching: every fault enters through a
+  constructor-injected object or a documented seam.
+* :mod:`invariants` — the cluster-level safety checkers.
+* :mod:`runner` — builds the world, drives cycles, reports; the
+  ``python -m kube_arbitrator_tpu.chaos`` entry point.
+* :mod:`shrink` — minimizes a failing plan (horizon prefix + ddmin-lite
+  fault-subset search).
+"""
+from .clock import VirtualClock
+from .faults import ChaosApiServer, ChaosDecider, FaultInjector
+from .invariants import Breach, InvariantChecker
+from .plan import PROFILES, ChaosProfile, FaultPlan, FaultSpec
+from .runner import ChaosReport, run_chaos
+from .shrink import shrink
+
+__all__ = [
+    "VirtualClock",
+    "ChaosApiServer",
+    "ChaosDecider",
+    "FaultInjector",
+    "Breach",
+    "InvariantChecker",
+    "PROFILES",
+    "ChaosProfile",
+    "FaultPlan",
+    "FaultSpec",
+    "ChaosReport",
+    "run_chaos",
+    "shrink",
+]

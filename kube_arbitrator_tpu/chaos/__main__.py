@@ -1,0 +1,5 @@
+"""``python -m kube_arbitrator_tpu.chaos`` — the chaos runner CLI."""
+from .runner import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,31 @@
+"""The virtual clock chaos runs march on.
+
+Every timed component in the loop takes an injectable clock already
+(``ApiLeaderElector(now_fn=...)``, ``LiveCache(now_fn=...)``,
+``RemoteDecider(sleep_fn=...)``, ``_ElectorBase.sleep``); the chaos
+runner hands them all this one, so a run consumes zero wall-clock time on
+sleeps/leases and — critically — is bit-reproducible: lease expiry,
+backoff schedules and GC delays depend only on the plan, never on host
+scheduling jitter.
+"""
+from __future__ import annotations
+
+
+class VirtualClock:
+    """Monotonic simulated time.  ``sleep`` advances instead of blocking."""
+
+    def __init__(self, start: float = 1_000_000.0):
+        self._t = float(start)
+
+    def now(self) -> float:
+        return self._t
+
+    def advance(self, seconds: float) -> float:
+        if seconds < 0:
+            raise ValueError(f"cannot advance time backwards ({seconds})")
+        self._t += float(seconds)
+        return self._t
+
+    # drop-in for time.sleep in injectable-sleep seams
+    def sleep(self, seconds: float) -> None:
+        self.advance(seconds)

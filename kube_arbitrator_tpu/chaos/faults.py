@@ -1,0 +1,300 @@
+"""The fault injector + the explicit seams faults enter through.
+
+Nothing here monkeypatches: every fault arrives through an object the
+world was CONSTRUCTED with (a faulting apiserver subclass, a decider
+wrapper, the elector's lease storage, the arena's documented corruption
+seam).  The injector is the single source of truth for what fired when —
+its log lands in the repro file, so a replay re-arms the identical
+faults.
+
+Seam map (fault kind -> seam):
+
+* ``api_conflict``/``api_timeout``/``api_latency`` —
+  :class:`ChaosApiServer`, a :class:`FakeApiServer` subclass whose
+  actuation verbs consult the injector before/after delegating.
+* ``watch_*`` — the same subclass's ``watch_all`` (duplicate / reorder /
+  truncate the batch; compact the log so the next behind watch gets 410).
+* ``rpc_fail``/``rpc_deadline`` — :class:`ChaosDecider`, the in-process
+  twin of ``RemoteDecider``'s retry loop (same
+  :func:`utils.backoff.backoff_delay_s` schedule) failing on command.
+* ``lease_steal`` — the Session/Scheduler ``phase_hook``: at the chosen
+  phase boundary a standby usurps the ConfigMap resourcelock
+  (:func:`framework.leader.usurp_lease`) and the virtual clock jumps past
+  the renew deadline, so the actuation fence must discard the cycle.
+* ``arena_corrupt`` — :meth:`cache.arena.SnapshotArena.corrupt`, the
+  lost-delta emulation the byte-identity verifier exists to catch.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..cache.fakeapi import ApiError, FakeApiServer
+from ..framework.leader import usurp_lease
+from ..utils.backoff import backoff_delay_s
+from ..utils.metrics import metrics
+from .clock import VirtualClock
+from .plan import FaultPlan, FaultSpec
+
+
+class DecideDeadline(RuntimeError):
+    """Chaos-injected decide retry exhaustion — kills the cycle with a
+    retryable error (the scheduler loop's classification keeps going)."""
+
+    retryable = True
+
+
+class FaultInjector:
+    """Arms the current cycle's faults; seams ask :meth:`take` for them.
+
+    A spec is consumed at most once (the first matching seam call), so a
+    "bind conflict" faults exactly one bind no matter how many the cycle
+    commits — keeping injected damage proportional to the plan, not the
+    decision volume."""
+
+    def __init__(self, plan: FaultPlan, clock: VirtualClock):
+        self.plan = plan
+        self.clock = clock
+        self.cycle = -1
+        self._armed: List[FaultSpec] = []
+        # every fault actually delivered, in delivery order (repro file)
+        self.injected: List[dict] = []
+
+    def begin_cycle(self, cycle: int) -> None:
+        self.cycle = cycle
+        self._armed = list(self.plan.for_cycle(cycle))
+
+    def disarm(self) -> None:
+        """End-of-cycle: pending faults are dropped (their seam never ran
+        this cycle — e.g. an evict fault in a cycle with no evicts)."""
+        self._armed = []
+
+    def peek(self, kind: str, site: Optional[str] = None) -> Optional[FaultSpec]:
+        """The first armed spec matching ``kind`` (and ``site``, when the
+        spec names one), WITHOUT consuming it — for seams that must run
+        no-op guards before committing to delivery."""
+        for spec in self._armed:
+            if spec.kind != kind:
+                continue
+            want = spec.param("site")
+            if want is not None and site is not None and want != site:
+                continue
+            return spec
+        return None
+
+    def consume(self, spec: FaultSpec) -> None:
+        """Mark a peeked spec DELIVERED: removed from the armed set,
+        recorded in the injected log, counted in the metric.  Only
+        actually-delivered faults may land here — the repro file's
+        ``injected`` list is the ground truth a debugger replays."""
+        self._armed.remove(spec)
+        self.injected.append(
+            {"cycle": self.cycle, "kind": spec.kind, "params": dict(spec.params)}
+        )
+        metrics().counter_add(
+            "chaos_faults_injected_total", labels={"kind": spec.kind}
+        )
+
+    def take(self, kind: str, site: Optional[str] = None) -> Optional[FaultSpec]:
+        """Consume and return the first armed spec matching ``kind``/
+        ``site``; None when nothing matches."""
+        spec = self.peek(kind, site)
+        if spec is not None:
+            self.consume(spec)
+        return spec
+
+    def injected_kinds(self, cycle: Optional[int] = None) -> List[str]:
+        return [
+            rec["kind"]
+            for rec in self.injected
+            if cycle is None or rec["cycle"] == cycle
+        ]
+
+
+class ChaosApiServer(FakeApiServer):
+    """FakeApiServer whose actuation verbs and watch stream fault on
+    command.  Conflict faults reject WITHOUT applying; timeout faults
+    APPLY then raise 504 — the ambiguous-outcome case the errTasks resync
+    must repair (the caller cannot tell a lost request from a lost reply);
+    latency faults consume virtual time then apply normally."""
+
+    def __init__(self, injector: FaultInjector, clock: VirtualClock):
+        super().__init__()
+        self._injector = injector
+        self._clock = clock
+
+    def _fault_before(self, site: str) -> Optional[FaultSpec]:
+        """Latency + conflict before the verb runs; returns the armed
+        timeout spec (if any) for the caller to honor AFTER applying."""
+        lat = self._injector.take("api_latency", site=site)
+        if lat is not None:
+            self._clock.advance(float(lat.param("ms", 100)) / 1000.0)
+        if self._injector.take("api_conflict", site=site) is not None:
+            raise ApiError(f"chaos: injected conflict on {site}", status=409)
+        return self._injector.take("api_timeout", site=site)
+
+    def bind_pod(self, namespace: str, name: str, node_name: str) -> None:
+        timeout = self._fault_before("bind")
+        super().bind_pod(namespace, name, node_name)
+        if timeout is not None:
+            raise ApiError(
+                f"chaos: bind {namespace}/{name} timed out after apply",
+                status=504,
+            )
+
+    def evict_pod(self, namespace, name, expect_rv=None) -> None:
+        timeout = self._fault_before("evict")
+        super().evict_pod(namespace, name, expect_rv=expect_rv)
+        if timeout is not None:
+            raise ApiError(
+                f"chaos: evict {namespace}/{name} timed out after apply",
+                status=504,
+            )
+
+    def update_podgroup_status(self, namespace: str, name: str, status: dict) -> dict:
+        timeout = self._fault_before("pg_status")
+        out = super().update_podgroup_status(namespace, name, status)
+        if timeout is not None:
+            raise ApiError("chaos: status PUT timed out after apply", status=504)
+        return out
+
+    def update_pod_condition(self, namespace: str, name: str, condition: dict) -> None:
+        timeout = self._fault_before("pod_condition")
+        super().update_pod_condition(namespace, name, condition)
+        if timeout is not None:
+            raise ApiError("chaos: condition PATCH timed out after apply", status=504)
+
+    def watch_all(self, since_rv: int):
+        if self._injector.take("watch_compact") is not None:
+            # etcd compaction to the head: a watcher with pending events
+            # is now behind the window; super() answers it with 410 Gone
+            self.compact()
+        events = super().watch_all(since_rv)
+        if len(events) >= 1:
+            # take() only once the fault can actually land: a consumed
+            # spec is recorded as DELIVERED in the repro's injected log
+            if len(events) > 1 and self._injector.take("watch_truncate") is not None:
+                # delayed delivery: this pump sees a prefix; the informer
+                # rv bookkeeping redelivers the rest next pump
+                events = events[: (len(events) + 1) // 2]
+            spec = self._injector.take("watch_dup")
+            if spec is not None:
+                i = int(spec.param("index", 0)) % len(events)
+                events = events[: i + 1] + [events[i]] + events[i + 1:]
+            if len(events) >= 2:
+                spec = self._injector.take("watch_reorder")
+                if spec is not None:
+                    j = int(spec.param("index", 0)) % (len(events) - 1)
+                    events[j], events[j + 1] = events[j + 1], events[j]
+        return events
+
+
+class ChaosDecider:
+    """Decider wrapper that fails decide attempts on command, with the
+    SAME capped-exponential deterministic-jitter retry schedule as
+    ``RemoteDecider`` — run on the virtual clock, so retries consume
+    simulated time only.  ``rpc_fail`` specs fail N attempts then let the
+    inner decider run; ``rpc_deadline`` exhausts every retry and raises
+    :class:`DecideDeadline` (a retryable cycle error)."""
+
+    def __init__(
+        self,
+        inner,
+        injector: FaultInjector,
+        clock: VirtualClock,
+        retries: int = 3,
+        backoff_s: float = 0.05,
+        backoff_cap_s: float = 1.0,
+        jitter_seed: int = 0,
+    ):
+        self.inner = inner
+        self.injector = injector
+        self.clock = clock
+        self.retries = retries
+        self.backoff_s = backoff_s
+        self.backoff_cap_s = backoff_cap_s
+        self.jitter_seed = jitter_seed
+
+    @property
+    def wants_device_pack(self) -> bool:
+        return getattr(self.inner, "wants_device_pack", True)
+
+    @property
+    def last_action_ms(self) -> Dict[str, float]:
+        return getattr(self.inner, "last_action_ms", None) or {}
+
+    def decide(self, st, config, pack_meta=None):
+        fail_budget = 0
+        spec = self.injector.take("rpc_fail")
+        if spec is not None:
+            fail_budget = min(int(spec.param("attempts", 1)), self.retries)
+        if self.injector.take("rpc_deadline") is not None:
+            fail_budget = self.retries + 1
+        attempt = 0
+        while attempt < fail_budget:
+            attempt += 1
+            if attempt > self.retries:
+                raise DecideDeadline(
+                    f"chaos: decide deadline after {self.retries} retries"
+                )
+            self.clock.sleep(
+                backoff_delay_s(
+                    attempt, self.backoff_s, self.backoff_cap_s, self.jitter_seed
+                )
+            )
+        if pack_meta is not None:
+            return self.inner.decide(st, config, pack_meta=pack_meta)
+        return self.inner.decide(st, config)
+
+
+def make_phase_hook(injector: FaultInjector, clock: VirtualClock, elector):
+    """The ``lease_steal`` seam: at the armed phase boundary a standby
+    usurps the resourcelock and the clock jumps past the renew deadline.
+    The leader's decision program is still mid-flight — only the
+    actuation fence (``lease_fresh`` + ``revalidate`` against the now
+    foreign record) stands between its stale binds and the cluster."""
+
+    def hook(phase: str) -> None:
+        spec = injector.take("lease_steal", site=phase)
+        if spec is None:
+            return
+        usurp_lease(
+            elector.api,
+            holder=f"chaos-standby-c{spec.cycle}",
+            now=clock.now(),
+            namespace=elector.namespace,
+            name=elector.name,
+            lease_duration_s=elector.lease_duration_s,
+        )
+        clock.advance(elector.renew_deadline_s + 1.0)
+
+    return hook
+
+
+def apply_arena_corruption(arena, injector: FaultInjector) -> Optional[int]:
+    """The ``arena_corrupt`` seam, applied at cycle start: overwrite one
+    node's idle row in the working arena with inflated capacity (its
+    allocatable row scaled up) WITHOUT a delta emission — the exact
+    damage of a backend mutation path that forgot to publish.  Picks a
+    row no dirty refresh is queued for, so the corruption survives into
+    the next pack.  Returns the corrupted row (None: no-op — no armed
+    spec, or the arena has no pack yet)."""
+    if arena is None:
+        return None
+    spec = injector.peek("arena_corrupt")
+    if spec is None:
+        return None
+    # all no-op guards BEFORE consume(): only a corruption that actually
+    # lands may appear in the repro's injected log
+    field = str(spec.param("field", "node_idle"))
+    if field not in arena._w:  # no pack built yet: nothing to corrupt
+        return None
+    row = arena.pick_clean_node_row(int(spec.param("row", 0)))
+    if row is None:
+        return None
+    injector.consume(spec)
+    scale = float(spec.param("scale", 8.0))
+    alloc = np.asarray(arena._w["node_alloc"][row])
+    arena.corrupt(field, row, (alloc * np.float32(scale)).astype(alloc.dtype))
+    return row

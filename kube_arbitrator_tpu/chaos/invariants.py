@@ -1,0 +1,234 @@
+"""Cluster-level safety invariants, checked after every chaos cycle.
+
+All checks read the **apiserver as the source of truth** (its object
+store and event log), not the scheduler's own model — a scheduler bug
+that corrupts both its model and its decisions identically would fool a
+model-side check, but cannot fool resource arithmetic over the objects it
+actually wrote.  The one model-side check (cache consistency) compares
+the model AGAINST the store, which is exactly the no-lost-no-duplicated
+property a resync must preserve.
+
+Invariants:
+
+* ``no_overcommit`` — per node, the resource sum of its non-terminal
+  bound pods never exceeds allocatable.
+* ``no_double_bind`` — a pod, once bound, is never re-bound to a
+  different node (k8s bindings are immutable).
+* ``no_bind_and_evict`` — no pod is bound and evicted within one cycle
+  (contradictory decisions from one snapshot).
+* ``single_actuator`` — a cycle fenced out by the leader fence writes
+  NOTHING: zero events in the apiserver log for that cycle.
+* ``cache_consistency`` — after a settled sync, the live-cache model
+  holds exactly the apiserver's responsible pods: none lost, none
+  duplicated, statuses and placements agreeing (THE property a forced
+  410 relist must preserve).
+* ``gang_atomicity`` — end-of-run (after the fault-free drain): every
+  gang is either uncommitted or committed to at least ``minMember`` —
+  no partially committed group survived a faulted commit.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Tuple
+
+from ..api import resource as res
+from ..cache.fakeapi import DELETED
+from ..cache.live import GROUP_ANNOTATION, node_to_info, pod_resreq, pod_status
+from ..options import options
+from ..utils.metrics import metrics
+
+# relative resource slack for the overcommit check: decisions travel
+# through f32 device units; exact host-side sums must not flag rounding
+_REL_EPS = 1e-6
+
+
+@dataclasses.dataclass(frozen=True)
+class Breach:
+    invariant: str
+    cycle: int
+    detail: str
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _pod_uid(obj: dict) -> str:
+    md = obj.get("metadata", {})
+    return md.get("uid") or f"{md.get('namespace', 'default')}/{md.get('name', '?')}"
+
+
+class InvariantChecker:
+    """Stateful across a run: tracks which pod is bound where (from the
+    event stream) so re-binds are caught even after later churn."""
+
+    def __init__(self):
+        self._bound: Dict[str, str] = {}  # pod uid -> node it bound to
+
+    def _breach(self, out: List[Breach], invariant: str, cycle: int, detail: str) -> None:
+        out.append(Breach(invariant=invariant, cycle=cycle, detail=detail))
+        metrics().counter_add(
+            "chaos_invariant_breaches_total", labels={"invariant": invariant}
+        )
+
+    # ---- per-cycle ----
+
+    def after_cycle(
+        self, api, cache, cycle: int, events: List[Tuple], fenced: bool
+    ) -> List[Breach]:
+        """``events`` is the apiserver event-log slice this cycle
+        produced; ``fenced`` marks a cycle the leader fence discarded."""
+        out: List[Breach] = []
+        if fenced and events:
+            self._breach(
+                out, "single_actuator", cycle,
+                f"fenced-out leader wrote {len(events)} events "
+                f"(first: {events[0][1]}/{events[0][2]})",
+            )
+        bound_now, evicted_now = set(), set()
+        for _rv, resource, etype, obj in events:
+            if resource != "pods":
+                continue
+            uid = _pod_uid(obj)
+            if etype == DELETED:
+                evicted_now.add(uid)
+                self._bound.pop(uid, None)
+                continue
+            node = obj.get("spec", {}).get("nodeName", "")
+            if not node:
+                continue
+            prev = self._bound.get(uid)
+            if prev is None:
+                self._bound[uid] = node
+                bound_now.add(uid)
+            elif prev != node:
+                self._breach(
+                    out, "no_double_bind", cycle,
+                    f"pod {uid} re-bound {prev} -> {node}",
+                )
+        for uid in sorted(bound_now & evicted_now):
+            self._breach(
+                out, "no_bind_and_evict", cycle,
+                f"pod {uid} bound and evicted in one cycle",
+            )
+        out += self.check_overcommit(api, cycle)
+        out += self.check_cache_consistency(api, cache, cycle)
+        return out
+
+    def check_overcommit(self, api, cycle: int) -> List[Breach]:
+        out: List[Breach] = []
+        pods, _ = api.list("pods")
+        used: Dict[str, object] = {}
+        for pod in pods:
+            node = pod.get("spec", {}).get("nodeName", "")
+            phase = pod.get("status", {}).get("phase", "Pending")
+            if not node or phase in ("Succeeded", "Failed"):
+                continue
+            r = pod_resreq(pod)
+            used[node] = r if node not in used else used[node] + r
+        nodes, _ = api.list("nodes")
+        for node in nodes:
+            info = node_to_info(node)
+            u = used.get(info.name)
+            if u is None:
+                continue
+            # cpu/mem/gpu axes only: the attach axis is resolved by the
+            # volume binder at actuation, not by the apiserver objects
+            for axis, label in ((res.CPU, "cpu"), (res.MEMORY, "memory"), (res.GPU, "gpu")):
+                cap = float(info.allocatable[axis])
+                got = float(u[axis])
+                if got > cap * (1 + _REL_EPS) + _REL_EPS:
+                    self._breach(
+                        out, "no_overcommit", cycle,
+                        f"node {info.name} over-committed on {label}: "
+                        f"{got:g} > allocatable {cap:g}",
+                    )
+        return out
+
+    def check_cache_consistency(self, api, cache, cycle: int) -> List[Breach]:
+        """Model == store, exactly — call only after a settled sync."""
+        out: List[Breach] = []
+        ours = options().scheduler_name
+        api_tasks: Dict[str, Tuple[str, object]] = {}
+        for pod in api.list("pods")[0]:
+            if pod.get("spec", {}).get("schedulerName", "") != ours:
+                continue
+            api_tasks[_pod_uid(pod)] = (
+                pod.get("spec", {}).get("nodeName", ""), pod_status(pod)
+            )
+        model: Dict[str, Tuple[str, object]] = {}
+        for job in cache.cluster.jobs.values():
+            for uid, t in job.tasks.items():
+                if uid in model:
+                    self._breach(
+                        out, "cache_consistency", cycle,
+                        f"task {uid} appears in two jobs",
+                    )
+                model[uid] = (t.node_name, t.status)
+        for uid in sorted(api_tasks.keys() - model.keys()):
+            self._breach(
+                out, "cache_consistency", cycle,
+                f"task {uid} lost: in apiserver, missing from model",
+            )
+        for uid in sorted(model.keys() - api_tasks.keys()):
+            self._breach(
+                out, "cache_consistency", cycle,
+                f"task {uid} ghosted: in model, missing from apiserver",
+            )
+        for uid in sorted(api_tasks.keys() & model.keys()):
+            want_node, want_status = api_tasks[uid]
+            got_node, got_status = model[uid]
+            if want_node != got_node or want_status != got_status:
+                self._breach(
+                    out, "cache_consistency", cycle,
+                    f"task {uid} diverged: model ({got_node or '-'}, "
+                    f"{got_status.name}) != apiserver ({want_node or '-'}, "
+                    f"{want_status.name})",
+                )
+        seen_others = set()
+        for t in cache.cluster.others:
+            if t.uid in seen_others:
+                self._breach(
+                    out, "cache_consistency", cycle,
+                    f"foreign task {t.uid} duplicated in others",
+                )
+            seen_others.add(t.uid)
+            if t.uid in api_tasks:
+                self._breach(
+                    out, "cache_consistency", cycle,
+                    f"our pod {t.uid} misfiled as a foreign task",
+                )
+        return out
+
+    # ---- end-of-run (after the fault-free drain) ----
+
+    def final(self, api, cache, cycle: int) -> List[Breach]:
+        out: List[Breach] = []
+        ours = options().scheduler_name
+        committed: Dict[Tuple[str, str], int] = {}
+        for pod in api.list("pods")[0]:
+            if pod.get("spec", {}).get("schedulerName", "") != ours:
+                continue
+            md = pod.get("metadata", {})
+            group = md.get("annotations", {}).get(GROUP_ANNOTATION)
+            if not group:
+                continue
+            key = (md.get("namespace", "default"), group)
+            committed.setdefault(key, 0)
+            phase = pod.get("status", {}).get("phase", "Pending")
+            if pod.get("spec", {}).get("nodeName") and phase in ("Pending", "Running"):
+                committed[key] += 1
+        for pg in api.list("podgroups")[0]:
+            md = pg.get("metadata", {})
+            mm = int(pg.get("spec", {}).get("minMember", 0))
+            if mm <= 0:
+                continue
+            got = committed.get((md.get("namespace", "default"), md["name"]), 0)
+            if 0 < got < mm:
+                self._breach(
+                    out, "gang_atomicity", cycle,
+                    f"gang {md['name']} partially committed after drain: "
+                    f"{got}/{mm} members placed",
+                )
+        out += self.check_overcommit(api, cycle)
+        out += self.check_cache_consistency(api, cache, cycle)
+        return out

@@ -1,0 +1,257 @@
+"""Seeded fault plans, chaos profiles, and the repro-file format.
+
+A **plan** is the complete, explicit list of faults a run will inject:
+``FaultSpec(cycle, kind, params)``.  Plans are *generated* from
+``(seed, profile, cycles)`` by a ``random.Random(seed)`` walk in a fixed
+iteration order, so the same triple always yields the same plan — and a
+failing run's repro file carries the plan verbatim, so a replay injects
+bit-identical faults even if generation logic later changes.
+
+Fault kinds (each lands at one explicit seam, see :mod:`faults`):
+
+==================  =====================================================
+``api_conflict``    409 on an actuation verb (site: bind/evict/pg_status/
+                    pod_condition); nothing applied.
+``api_timeout``     the verb APPLIES server-side, then the client sees a
+                    504 — the ambiguous-outcome case errTasks resync must
+                    repair (site: bind/evict).
+``api_latency``     the verb consumes virtual time before applying.
+``watch_dup``       one event of the pump's batch is delivered twice.
+``watch_reorder``   two adjacent events of the batch swap places.
+``watch_truncate``  the pump returns only a prefix of the batch (delayed
+                    delivery; the rest arrives next pump).
+``watch_compact``   the event log is compacted to the head: a behind
+                    watcher gets 410 Gone and must relist.
+``rpc_fail``        N decide attempts fail transiently, then succeed
+                    (recovered inside the cycle's retry loop).
+``rpc_deadline``    every decide attempt fails: retry exhaustion kills
+                    the cycle with a retryable error.
+``lease_steal``     at a phase boundary (site: snapshot/upload/kernel/
+                    decode/commit) a standby usurps the lease and the
+                    clock jumps past the renew deadline — the actuation
+                    fence must discard the cycle.
+``arena_corrupt``   one working-arena row is overwritten without a delta
+                    emission (a lost-delta bug): the byte-identity
+                    verifier must catch it.
+==================  =====================================================
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+from typing import Dict, List, Tuple
+
+API_SITES = ("bind", "evict", "pg_status", "pod_condition")
+LEASE_PHASES = ("snapshot", "kernel", "decode", "commit")
+LEASE_PHASES_ARENA = ("snapshot", "upload", "kernel", "decode", "commit")
+
+# generation iterates kinds in THIS order (determinism depends on it)
+FAULT_KINDS = (
+    "api_conflict",
+    "api_timeout",
+    "api_latency",
+    "watch_dup",
+    "watch_reorder",
+    "watch_truncate",
+    "watch_compact",
+    "rpc_fail",
+    "rpc_deadline",
+    "lease_steal",
+    "arena_corrupt",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One fault: fires in ``cycle`` at the seam ``kind`` names."""
+
+    cycle: int
+    kind: str
+    params: Tuple[Tuple[str, object], ...] = ()
+
+    def param(self, key: str, default=None):
+        for k, v in self.params:
+            if k == key:
+                return v
+        return default
+
+    def to_dict(self) -> dict:
+        return {"cycle": self.cycle, "kind": self.kind, "params": dict(self.params)}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultSpec":
+        return cls(
+            cycle=int(d["cycle"]),
+            kind=str(d["kind"]),
+            params=tuple(sorted((str(k), v) for k, v in (d.get("params") or {}).items())),
+        )
+
+
+def _spec(cycle: int, kind: str, **params) -> FaultSpec:
+    return FaultSpec(
+        cycle=cycle, kind=kind, params=tuple(sorted(params.items()))
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosProfile:
+    """World shape + per-cycle fault rates for plan generation."""
+
+    name: str
+    nodes: int = 8
+    jobs: int = 6
+    tasks_per_job: int = 4
+    queues: int = 2
+    gang_fraction: float = 0.5
+    # demand multiple of cluster capacity; >1 keeps a pending backlog so
+    # every cycle has decisions to corrupt/fence/retry
+    oversubscribe: float = 1.5
+    arena: bool = True
+    verify_every: int = 2
+    drain_cycles: int = 4
+    # fault kind -> per-cycle injection probability
+    rates: Tuple[Tuple[str, float], ...] = ()
+
+    def rate(self, kind: str) -> float:
+        for k, v in self.rates:
+            if k == kind:
+                return v
+        return 0.0
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["rates"] = dict(self.rates)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ChaosProfile":
+        d = dict(d)
+        rates = d.pop("rates", {}) or {}
+        unknown = set(rates) - set(FAULT_KINDS)
+        if unknown:
+            raise ValueError(f"unknown fault kinds in profile: {sorted(unknown)}")
+        return cls(
+            rates=tuple(sorted((str(k), float(v)) for k, v in rates.items())),
+            **d,
+        )
+
+    @classmethod
+    def from_file(cls, path: str) -> "ChaosProfile":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+
+_MIXED_RATES = (
+    ("api_conflict", 0.30),
+    ("api_timeout", 0.20),
+    ("api_latency", 0.20),
+    ("watch_dup", 0.25),
+    ("watch_reorder", 0.20),
+    ("watch_truncate", 0.20),
+    ("watch_compact", 0.15),
+    ("rpc_fail", 0.20),
+    ("rpc_deadline", 0.10),
+    ("lease_steal", 0.10),
+    ("arena_corrupt", 0.0),
+)
+
+PROFILES: Dict[str, ChaosProfile] = {
+    # clean control runs (determinism baseline, CI canary)
+    "none": ChaosProfile(name="none", rates=()),
+    # the CI smoke shape: small world, every fault class plausible
+    "smoke": ChaosProfile(name="smoke", rates=_MIXED_RATES),
+    "default": ChaosProfile(
+        name="default", nodes=12, jobs=10, tasks_per_job=5, queues=3,
+        rates=_MIXED_RATES,
+    ),
+    "heavy": ChaosProfile(
+        name="heavy", nodes=16, jobs=14, tasks_per_job=6, queues=4,
+        oversubscribe=2.0, verify_every=1,
+        rates=tuple((k, min(1.0, v * 2)) for k, v in _MIXED_RATES),
+    ),
+    # the lost-delta bug class: corruption every few cycles, verifier hot
+    "arena": ChaosProfile(
+        name="arena", verify_every=1,
+        rates=(("arena_corrupt", 0.5),),
+    ),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    seed: int
+    specs: Tuple[FaultSpec, ...] = ()
+
+    def for_cycle(self, cycle: int) -> List[FaultSpec]:
+        return [s for s in self.specs if s.cycle == cycle]
+
+    def truncated(self, horizon: int) -> "FaultPlan":
+        return FaultPlan(
+            seed=self.seed,
+            specs=tuple(s for s in self.specs if s.cycle < horizon),
+        )
+
+    def without(self, spec: FaultSpec) -> "FaultPlan":
+        out, removed = [], False
+        for s in self.specs:
+            if not removed and s == spec:
+                removed = True
+                continue
+            out.append(s)
+        return FaultPlan(seed=self.seed, specs=tuple(out))
+
+    def to_dict(self) -> dict:
+        return {"seed": self.seed, "specs": [s.to_dict() for s in self.specs]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultPlan":
+        return cls(
+            seed=int(d.get("seed", 0)),
+            specs=tuple(FaultSpec.from_dict(s) for s in d.get("specs", ())),
+        )
+
+    @classmethod
+    def generate(
+        cls, seed: int, cycles: int, profile: ChaosProfile
+    ) -> "FaultPlan":
+        """The seeded walk: per cycle, per kind (in ``FAULT_KINDS`` order),
+        one Bernoulli draw at the profile's rate, then the kind's params.
+        Every draw happens in a fixed order so the plan is a pure function
+        of (seed, cycles, profile)."""
+        # string seeds hash via sha512 (process-stable); tuple seeds fall
+        # back to hash(), which PYTHONHASHSEED randomizes per process
+        rng = random.Random(f"kat-chaos-plan:{seed}")
+        phases = LEASE_PHASES_ARENA if profile.arena else LEASE_PHASES
+        specs: List[FaultSpec] = []
+        for cycle in range(cycles):
+            for kind in FAULT_KINDS:
+                if rng.random() >= profile.rate(kind):
+                    continue
+                if kind == "api_conflict":
+                    specs.append(_spec(cycle, kind, site=rng.choice(API_SITES)))
+                elif kind == "api_timeout":
+                    specs.append(_spec(cycle, kind, site=rng.choice(("bind", "evict"))))
+                elif kind == "api_latency":
+                    specs.append(_spec(
+                        cycle, kind, site=rng.choice(API_SITES),
+                        ms=rng.choice((50, 200, 1000)),
+                    ))
+                elif kind in ("watch_dup", "watch_reorder"):
+                    specs.append(_spec(cycle, kind, index=rng.randrange(64)))
+                elif kind in ("watch_truncate", "watch_compact"):
+                    specs.append(_spec(cycle, kind))
+                elif kind == "rpc_fail":
+                    specs.append(_spec(cycle, kind, attempts=rng.randint(1, 2)))
+                elif kind == "rpc_deadline":
+                    specs.append(_spec(cycle, kind))
+                elif kind == "lease_steal":
+                    specs.append(_spec(cycle, kind, site=rng.choice(phases)))
+                elif kind == "arena_corrupt" and profile.arena and cycle >= 2:
+                    # cycle >= 2: the arena needs a first pack to corrupt
+                    specs.append(_spec(
+                        cycle, kind, field="node_idle",
+                        row=rng.randrange(max(1, profile.nodes)),
+                        scale=8.0,
+                    ))
+        return cls(seed=seed, specs=tuple(specs))

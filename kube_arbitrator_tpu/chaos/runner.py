@@ -1,0 +1,433 @@
+"""The chaos runner: build the world, drive faulted cycles, check, report.
+
+The world is the REAL production loop, not a mock of it: a
+:class:`ChaosApiServer` (a FakeApiServer that faults on command) feeds a
+:class:`LiveCache` through list/watch; an optional :class:`SnapshotArena`
+maintains the pack incrementally; an :class:`ApiLeaderElector` holds a
+ConfigMap resourcelock in the same apiserver; decisions run through
+:class:`LocalDecider` wrapped in the retrying :class:`ChaosDecider`; and
+actuation POSTs back through the apiserver.  Everything timed marches on
+one :class:`VirtualClock`, so a run is a pure function of
+``(seed, profile, plan, disabled)`` — two runs produce byte-identical
+repro files and per-cycle decision digests.
+
+Every run ends with ``drain_cycles`` fault-free cycles so transient
+repair paths (errTasks resync, gang completion) get their chance before
+the end-of-run invariants (gang atomicity) are asserted.
+
+``python -m kube_arbitrator_tpu.chaos --seed 3 --cycles 20
+--profile default`` exits nonzero on any invariant breach and writes a
+repro file (seed + profile + fault plan + digests) that ``--replay``
+re-executes bit-identically and ``--shrink`` minimizes.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import hashlib
+import json
+import os
+import random
+import sys
+from typing import List, Optional, Sequence, Tuple
+
+from ..cache.arena import ArenaDivergence, SnapshotArena
+from ..cache.live import GROUP_ANNOTATION, LiveCache
+from ..framework.decider import LocalDecider
+from ..framework.leader import ApiLeaderElector, LeaderLost
+from ..framework.scheduler import Scheduler, classify_cycle_error
+from ..options import options
+from ..utils.metrics import metrics
+from .clock import VirtualClock
+from .faults import (
+    ChaosApiServer,
+    ChaosDecider,
+    FaultInjector,
+    apply_arena_corruption,
+    make_phase_hook,
+)
+from .invariants import Breach, InvariantChecker
+from .plan import PROFILES, ChaosProfile, FaultPlan
+
+REPRO_VERSION = 1
+
+# sensitivity knobs --disable accepts: each turns OFF one safety
+# mechanism so a test can prove the invariant checkers catch the damage
+# the mechanism normally prevents (chaos that only passes clean runs
+# proves nothing)
+DISABLE_CHOICES = ("arena-verify",)
+
+
+def seed_world(api, profile: ChaosProfile, seed: int) -> None:
+    """Populate the apiserver with a seeded synthetic cluster: queues,
+    nodes, gang/non-gang PodGroups, and Pending pods annotated into their
+    groups.  CPU is the binding axis; ``profile.oversubscribe`` sizes
+    total demand past capacity so a pending backlog persists and every
+    cycle has real decisions to fault."""
+    # a STRING seed: process-stable (sha512), unlike tuple seeds which
+    # fall back to PYTHONHASHSEED-randomized hash()
+    rng = random.Random(f"kat-chaos-world:{seed}")
+    ours = options().scheduler_name
+    for q in range(profile.queues):
+        api.create(
+            "queues",
+            {"metadata": {"name": f"q{q}"}, "spec": {"weight": 1 + q % 3}},
+        )
+    node_cpu_m = 8000
+    for n in range(profile.nodes):
+        api.create(
+            "nodes",
+            {
+                "metadata": {"name": f"node-{n:03d}"},
+                "status": {
+                    "allocatable": {
+                        "cpu": f"{node_cpu_m}m",
+                        "memory": "32Gi",
+                        "pods": 110,
+                    }
+                },
+            },
+        )
+    total_tasks = max(1, profile.jobs * profile.tasks_per_job)
+    base_cpu_m = profile.nodes * node_cpu_m * profile.oversubscribe / total_tasks
+    for j in range(profile.jobs):
+        name = f"job-{j:03d}"
+        gang = rng.random() < profile.gang_fraction
+        mm = profile.tasks_per_job // 2 + 1 if gang else 0
+        api.create(
+            "podgroups",
+            {
+                "metadata": {
+                    "namespace": "default",
+                    "name": name,
+                    "creationTimestamp": float(j),
+                },
+                "spec": {"minMember": mm, "queue": f"q{j % profile.queues}"},
+            },
+        )
+        for t in range(profile.tasks_per_job):
+            cpu_m = max(100, int(base_cpu_m * rng.choice((0.5, 1.0, 1.5)) / 50) * 50)
+            api.create(
+                "pods",
+                {
+                    "metadata": {
+                        "namespace": "default",
+                        "name": f"{name}-{t:02d}",
+                        "uid": f"u{j:03d}-{t:02d}",
+                        "annotations": {GROUP_ANNOTATION: name},
+                    },
+                    "spec": {
+                        "schedulerName": ours,
+                        "priority": rng.choice((0, 1, 2)),
+                        "containers": [
+                            {
+                                "name": "main",
+                                "resources": {
+                                    "requests": {
+                                        "cpu": f"{cpu_m}m",
+                                        "memory": "1Gi",
+                                    }
+                                },
+                            }
+                        ],
+                    },
+                    "status": {"phase": "Pending"},
+                },
+            )
+
+
+def _digest(cycle: int, outcome: str, events: Sequence[Tuple]) -> str:
+    """Per-cycle decision digest: the cycle's outcome + every apiserver
+    event it produced.  Virtual time only — byte-stable across runs."""
+    payload = json.dumps([cycle, outcome, list(events)], sort_keys=True, default=str)
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+@dataclasses.dataclass
+class ChaosReport:
+    seed: int
+    profile: ChaosProfile
+    cycles: int
+    disabled: Tuple[str, ...]
+    plan: FaultPlan
+    injected: List[dict]
+    outcomes: List[str]
+    digests: List[str]
+    detections: List[dict]
+    breaches: List[Breach]
+
+    @property
+    def ok(self) -> bool:
+        return not self.breaches
+
+    def to_dict(self) -> dict:
+        return {
+            "version": REPRO_VERSION,
+            "seed": self.seed,
+            "profile": self.profile.to_dict(),
+            "cycles": self.cycles,
+            "disabled": sorted(self.disabled),
+            "plan": self.plan.to_dict(),
+            "injected": self.injected,
+            "outcomes": self.outcomes,
+            "digests": self.digests,
+            "detections": self.detections,
+            "breaches": [b.to_dict() for b in self.breaches],
+        }
+
+    def repro_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True, indent=2) + "\n"
+
+    def write(self, path: str) -> str:
+        with open(path, "w") as f:
+            f.write(self.repro_json())
+        return path
+
+
+def run_chaos(
+    seed: int = 0,
+    cycles: int = 12,
+    profile=None,
+    disabled: Sequence[str] = (),
+    plan: Optional[FaultPlan] = None,
+    out_dir: Optional[str] = None,
+) -> ChaosReport:
+    """One deterministic chaos run; see the module docstring.  ``plan``
+    overrides generation (replay/shrink); ``out_dir`` (if set) receives a
+    repro file when any invariant breaches."""
+    prof = profile if isinstance(profile, ChaosProfile) else PROFILES[profile or "smoke"]
+    disabled = tuple(sorted(set(disabled)))
+    unknown = set(disabled) - set(DISABLE_CHOICES)
+    if unknown:
+        raise ValueError(f"unknown --disable choices: {sorted(unknown)}")
+    if plan is None:
+        plan = FaultPlan.generate(seed, cycles, prof)
+    clock = VirtualClock()
+    injector = FaultInjector(plan, clock)
+    api = ChaosApiServer(injector, clock)
+    seed_world(api, prof, seed)
+    cache = LiveCache(api, now_fn=clock.now)
+    arena = None
+    if prof.arena:
+        verify_every = 0 if "arena-verify" in disabled else prof.verify_every
+        arena = SnapshotArena(cache, verify_every=verify_every)
+    elector = ApiLeaderElector(
+        api, identity="chaos-leader",
+        lease_duration_s=15.0, renew_deadline_s=10.0, retry_period_s=2.0,
+        now_fn=clock.now,
+    )
+    elector.sleep = clock.sleep
+    decider = ChaosDecider(LocalDecider(), injector, clock, jitter_seed=seed)
+    sched = Scheduler(
+        cache,
+        elector=elector,
+        decider=decider,
+        arena=arena,
+        phase_hook=make_phase_hook(injector, clock, elector),
+    )
+    if not elector.acquire_blocking(timeout_s=120.0):
+        raise RuntimeError("chaos: initial leader acquisition failed")
+    checker = InvariantChecker()
+    outcomes: List[str] = []
+    digests: List[str] = []
+    detections: List[dict] = []
+    breaches: List[Breach] = []
+
+    def detect(cycle: int, kind: str, **extra) -> None:
+        detections.append({"cycle": cycle, "kind": kind, **extra})
+        metrics().counter_add("chaos_detections_total", labels={"kind": kind})
+
+    total = cycles + prof.drain_cycles
+    for cycle in range(total):
+        injector.begin_cycle(cycle)
+        if cycle >= cycles:
+            injector.disarm()  # the fault-free drain window
+        else:
+            apply_arena_corruption(arena, injector)
+        clock.advance(1.0)  # cycle cadence
+        rv0 = api._rv
+        fenced = False
+        outcome = "ok"
+        if not elector.renew():
+            # post-fence recovery: acquire_blocking's retry loop runs on
+            # the elector's injected sleep (the virtual clock), waiting
+            # out the usurper's never-renewed lease in simulated time
+            if not elector.acquire_blocking(timeout_s=240.0):
+                raise RuntimeError(
+                    "chaos: could not re-acquire leadership after fence"
+                )
+        try:
+            sched.run_once()
+        except LeaderLost:
+            fenced = True
+            outcome = "fenced"
+            detect(cycle, "leader_fence")
+        except ArenaDivergence:
+            outcome = "arena_divergence"
+            detect(cycle, "arena_divergence")
+        except Exception as err:
+            kind = classify_cycle_error(err)
+            if kind == "retryable":
+                outcome = f"retryable:{type(err).__name__}"
+                detect(cycle, "retryable_error", error=type(err).__name__)
+            else:
+                # an unclassified fatal escaping the loop IS a finding
+                outcome = f"fatal:{type(err).__name__}"
+                breaches.append(Breach(
+                    invariant="no_unhandled_fatal", cycle=cycle,
+                    detail=f"{type(err).__name__}: {err}",
+                ))
+                metrics().counter_add(
+                    "chaos_invariant_breaches_total",
+                    labels={"invariant": "no_unhandled_fatal"},
+                )
+        injector.disarm()
+        cache.sync()  # settle: deliver every pending event before checking
+        events = [e for e in api.event_log if e[0] > rv0]
+        breaches += checker.after_cycle(api, cache, cycle, events, fenced=fenced)
+        outcomes.append(outcome)
+        digests.append(_digest(cycle, outcome, events))
+    breaches += checker.final(api, cache, total)
+    report = ChaosReport(
+        seed=seed, profile=prof, cycles=cycles, disabled=disabled, plan=plan,
+        injected=list(injector.injected), outcomes=outcomes, digests=digests,
+        detections=detections, breaches=breaches,
+    )
+    if out_dir and report.breaches:
+        report.write(
+            os.path.join(out_dir, f"chaos-repro-{prof.name}-{seed}.json")
+        )
+    return report
+
+
+def _print_summary(report: ChaosReport, as_json: bool, repro_path: Optional[str]) -> None:
+    if as_json:
+        d = report.to_dict()
+        d["ok"] = report.ok
+        print(json.dumps(d, sort_keys=True))
+        return
+    print(
+        f"chaos: seed={report.seed} profile={report.profile.name} "
+        f"cycles={report.cycles}+{report.profile.drain_cycles} drain | "
+        f"{len(report.injected)} faults injected, "
+        f"{len(report.detections)} detections, "
+        f"{len(report.breaches)} invariant breaches"
+    )
+    for rec in report.detections:
+        print(f"  detected  c{rec['cycle']:>3} {rec['kind']}")
+    for b in report.breaches:
+        print(f"  BREACH    c{b.cycle:>3} {b.invariant}: {b.detail}")
+    if repro_path:
+        print(f"  repro written: {repro_path}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m kube_arbitrator_tpu.chaos",
+        description="deterministic chaos runner: seeded fault injection + "
+        "invariant checking over the full scheduling loop",
+    )
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--cycles", type=int, default=12)
+    p.add_argument(
+        "--profile", default="smoke",
+        help=f"profile name ({', '.join(sorted(PROFILES))}) or a JSON profile file",
+    )
+    p.add_argument("--replay", default="", help="repro file to replay bit-identically")
+    p.add_argument(
+        "--shrink", action="store_true",
+        help="with --replay: minimize the failing plan (horizon + fault subset)",
+    )
+    p.add_argument(
+        "--disable", default="",
+        help=f"CSV of safety mechanisms to disable for sensitivity proofs "
+        f"({', '.join(DISABLE_CHOICES)})",
+    )
+    p.add_argument("--out-dir", default=".", help="failure repro files land here")
+    p.add_argument("--json", action="store_true", help="machine-readable summary")
+    args = p.parse_args(argv)
+    disabled = {x.strip() for x in args.disable.split(",") if x.strip()}
+    if disabled - set(DISABLE_CHOICES):
+        print(
+            f"error: unknown --disable {sorted(disabled - set(DISABLE_CHOICES))}",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.replay:
+        try:
+            with open(args.replay) as f:
+                rec = json.load(f)
+            prof = ChaosProfile.from_dict(rec["profile"])
+            plan = FaultPlan.from_dict(rec["plan"])
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            print(f"error: invalid repro file {args.replay}: {e}", file=sys.stderr)
+            return 2
+        recorded_disabled = set(rec.get("disabled", ()))
+        extra_disabled = disabled - recorded_disabled
+        disabled |= recorded_disabled
+        seed, cycles = int(rec["seed"]), int(rec["cycles"])
+        if args.shrink:
+            from .shrink import shrink
+
+            report, min_plan, min_cycles = shrink(
+                seed, prof, cycles, plan, disabled
+            )
+            path = os.path.join(
+                args.out_dir, f"chaos-repro-{prof.name}-{seed}-min.json"
+            )
+            report.write(path)
+            print(
+                f"shrunk: {len(plan.specs)} -> {len(min_plan.specs)} faults, "
+                f"{cycles} -> {min_cycles} cycles; minimized repro: {path}"
+            )
+            _print_summary(report, args.json, path)
+            return 0 if report.breaches else 1  # a vanished failure is the error
+        report = run_chaos(
+            seed=seed, cycles=cycles, profile=prof, plan=plan, disabled=disabled
+        )
+        _print_summary(report, args.json, None)
+        if extra_disabled:
+            # the user changed the configuration: digests legitimately
+            # diverge, so a mismatch is NOT nondeterminism evidence
+            print(
+                f"note: --disable {sorted(extra_disabled)} not in the "
+                "recorded run; skipping the digest determinism check",
+                file=sys.stderr,
+            )
+        else:
+            recorded = rec.get("digests")
+            if recorded and recorded != report.digests:
+                print(
+                    "error: replay digests diverged from the recorded run — "
+                    "nondeterminism in the loop", file=sys.stderr,
+                )
+                return 3
+        return 1 if report.breaches else 0
+
+    if args.profile.endswith(".json"):
+        try:
+            prof = ChaosProfile.from_file(args.profile)
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            # TypeError included: cls(**d) with a typo'd profile key must
+            # be a usage error (exit 2), not a traceback that exits 1
+            print(f"error: invalid profile {args.profile}: {e}", file=sys.stderr)
+            return 2
+    elif args.profile in PROFILES:
+        prof = PROFILES[args.profile]
+    else:
+        print(
+            f"error: unknown profile {args.profile} "
+            f"(have: {', '.join(sorted(PROFILES))})", file=sys.stderr,
+        )
+        return 2
+    report = run_chaos(
+        seed=args.seed, cycles=args.cycles, profile=prof,
+        disabled=disabled, out_dir=args.out_dir,
+    )
+    repro = (
+        os.path.join(args.out_dir, f"chaos-repro-{prof.name}-{args.seed}.json")
+        if report.breaches else None
+    )
+    _print_summary(report, args.json, repro)
+    return 1 if report.breaches else 0

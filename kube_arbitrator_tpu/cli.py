@@ -102,6 +102,24 @@ def build_parser() -> argparse.ArgumentParser:
         help="host:port of a decision sidecar; cycles run there instead of in-process",
     )
     p.add_argument(
+        "--rpc-retries",
+        type=int,
+        default=3,
+        help="transient decide-RPC failures retried per cycle (default 3)",
+    )
+    p.add_argument(
+        "--rpc-backoff-s",
+        type=float,
+        default=1.0,
+        help="base of the capped-exponential decide-retry backoff (default 1.0)",
+    )
+    p.add_argument(
+        "--rpc-backoff-cap-s",
+        type=float,
+        default=30.0,
+        help="ceiling of the decide-retry backoff (default 30.0)",
+    )
+    p.add_argument(
         "--sidecar",
         metavar="BIND",
         default="",
@@ -266,7 +284,14 @@ def main(argv=None) -> int:
         try:
             from .rpc.client import RemoteDecider
 
-            decider = RemoteDecider(args.decision_endpoint)
+            # jitter_seed defaults to the pid inside RemoteDecider, so
+            # replicas de-synchronize their retry schedules
+            decider = RemoteDecider(
+                args.decision_endpoint,
+                retries=args.rpc_retries,
+                retry_backoff_s=args.rpc_backoff_s,
+                retry_backoff_cap_s=args.rpc_backoff_cap_s,
+            )
             health = decider.health()
         except ImportError as e:
             print(f"error: decision endpoint needs grpcio: {e}", file=sys.stderr)

@@ -84,6 +84,10 @@ class _ElectorBase:
     renew_deadline_s: float
     retry_period_s: float
     now: Callable[[], float]
+    # injectable sleep for acquire_blocking's retry loop: the chaos plane
+    # and tests substitute a virtual clock's sleep so standby contention
+    # consumes simulated, not wall, time
+    sleep: Callable[[float], None] = staticmethod(time.sleep)
     _is_leader: bool = False
     _observed_key = None      # (holder, renew_ts) of the last seen record
     _observed_at: float = 0.0  # our clock when that record FIRST appeared
@@ -314,7 +318,7 @@ class _ElectorBase:
                 return True
             if timeout_s is not None and self.now() - start >= timeout_s:
                 return False
-            time.sleep(self.retry_period_s)
+            self.sleep(self.retry_period_s)
 
 
 class LeaderElector(_ElectorBase):
@@ -453,3 +457,45 @@ class ApiLeaderElector(_ElectorBase):
             self.api.delete("configmaps", self.namespace, self.name, expect_rv=rv)
         except ApiError:
             pass  # already gone or re-acquired by a standby — both fine
+
+
+def usurp_lease(
+    api,
+    holder: str,
+    now: float,
+    namespace: str = "kube-system",
+    name: str = LOCK_CONFIGMAP,
+    lease_duration_s: float = 15.0,
+) -> LeaseRecord:
+    """CHAOS SEAM — overwrite the ConfigMap resourcelock with a record
+    naming ``holder``, emulating a standby that legally acquired after the
+    leader's lease expired on ITS observation clock.  The wedged ex-leader
+    must then be stopped by the actuation fence (``lease_fresh`` +
+    ``revalidate``): the record no longer names it, so ``revalidate``
+    fails and the cycle's binds/evicts are discarded — the single-actuator
+    invariant the chaos plane checks.  Never called outside chaos/tests."""
+    rec = LeaseRecord(
+        holder=holder, acquired_ts=now, renew_ts=now,
+        lease_duration_s=lease_duration_s,
+    )
+    obj = api.get("configmaps", namespace, name)
+    if obj is None:
+        api.create(
+            "configmaps",
+            {
+                "metadata": {
+                    "namespace": namespace,
+                    "name": name,
+                    "annotations": {LEASE_ANNOTATION: rec.to_json()},
+                }
+            },
+        )
+    else:
+        obj.setdefault("metadata", {}).setdefault("annotations", {})[
+            LEASE_ANNOTATION
+        ] = rec.to_json()
+        api.update(
+            "configmaps", obj,
+            expect_rv=obj.get("metadata", {}).get("resourceVersion"),
+        )
+    return rec

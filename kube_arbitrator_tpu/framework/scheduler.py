@@ -18,8 +18,50 @@ from ..utils.flightrec import CycleRecord, FlightRecorder
 from ..utils.metrics import metrics
 from ..utils.tracing import tracer
 from .conf import SchedulerConfig, load_conf_file
-from .leader import LeaderElector, LeaderLost
+from .leader import LeaderElector, LeaderLost, TransientLockError
 from .session import CycleResult, PodGroupStatus, Session
+
+# gRPC status codes a cycle-level retry can help with; everything else a
+# transport raises is deterministic (bad conf, codec drift) and fatal
+_RETRYABLE_RPC_CODES = ("UNAVAILABLE", "DEADLINE_EXCEEDED")
+
+
+def classify_cycle_error(err: BaseException) -> str:
+    """``"fatal"`` | ``"retryable"`` for an exception that killed a cycle.
+
+    Retryable errors are environmental — the next cycle runs against a
+    world that may have healed (apiserver conflict/timeout, RPC deadline,
+    lease-storage blip); the loop keeps scheduling.  Fatal errors are
+    evidence the SCHEDULER's own state or contracts broke (arena
+    divergence, dtype contract violations, invariant breaches, lost
+    leadership) — retrying would actuate decisions computed from corrupt
+    state, so they re-raise after the flight-recorder dump.  Exceptions
+    may self-classify via a boolean ``retryable`` attribute (the chaos
+    plane's injected faults do); unknown errors default to fatal, the
+    conservative route."""
+    if isinstance(err, LeaderLost):
+        return "fatal"
+    retryable = getattr(err, "retryable", None)
+    if retryable is not None:
+        return "retryable" if retryable else "fatal"
+    from ..cache.arena import ArenaDivergence
+
+    if isinstance(err, (ArenaDivergence, AssertionError)):
+        return "fatal"
+    if isinstance(err, TypeError) and "contract" in str(err):
+        return "fatal"
+    from ..cache.fakeapi import ApiError
+
+    if isinstance(err, (ApiError, TransientLockError, TimeoutError, ConnectionError)):
+        return "retryable"
+    if type(err).__module__.partition(".")[0] == "grpc":
+        code = getattr(err, "code", None)
+        try:
+            name = code().name if callable(code) else ""
+        except Exception:
+            name = ""
+        return "retryable" if name in _RETRYABLE_RPC_CODES else "fatal"
+    return "fatal"
 
 
 @dataclasses.dataclass
@@ -53,6 +95,8 @@ class Scheduler:
         flight: Optional[FlightRecorder] = None,
         cycle_slo_ms: Optional[float] = None,
         arena=None,
+        phase_hook=None,
+        max_cycle_retries: int = 8,
     ):
         # conf is re-loadable per Run like the reference (scheduler.go:66-78)
         self.sim = sim
@@ -80,6 +124,15 @@ class Scheduler:
 
             arena = SnapshotArena(sim)
         self.arena = arena or None
+        # chaos seam: called with the phase name at each cycle phase
+        # boundary (snapshot/upload/kernel/decode in Session, commit here
+        # just before the actuation fence); None costs nothing
+        self.phase_hook = phase_hook
+        # run(): consecutive RETRYABLE cycle errors tolerated before the
+        # loop escalates (a persistently failing environment is not
+        # something spinning forever will fix)
+        self.max_cycle_retries = max_cycle_retries
+        self._consecutive_cycle_errors = 0
         self.job_status: Dict[str, PodGroupStatus] = {}
         self.history: List[CycleStats] = []
         self.last_cycle_ts: Optional[float] = None  # /readyz freshness
@@ -139,8 +192,12 @@ class Scheduler:
         failure dump IS the failing cycle."""
         if self.flight is None:
             return
+        from ..cache.arena import ArenaDivergence
+
         if isinstance(err, LeaderLost):
             kind = "leader_lost"
+        elif isinstance(err, ArenaDivergence):
+            kind = "arena_divergence"
         elif isinstance(err, TypeError) and "contract" in str(err):
             kind = "dtype_contract"
         else:  # RPC deadline/retry exhaustion and any other cycle killer
@@ -187,7 +244,7 @@ class Scheduler:
         self._last_pending_hist = self._pending_histogram(per_job_pending)
         session = Session(
             self.sim.cluster, self.config, decider=self.decider,
-            arena=self.arena,
+            arena=self.arena, phase_hook=self.phase_hook,
         )
         result = session.run()
         if self.trace_recorder is not None:
@@ -206,6 +263,8 @@ class Scheduler:
         # Only a failed re-validation discards the cycle (the reference
         # has the same decide/actuate race; its safety net is the
         # apiserver's optimistic concurrency on the bind subresource).
+        if self.phase_hook is not None:
+            self.phase_hook("commit")
         if self.elector is not None and not self.elector.lease_fresh():
             revalidate = getattr(self.elector, "revalidate", None)
             ok = bool(revalidate()) if revalidate is not None else False
@@ -296,9 +355,19 @@ class Scheduler:
     def run(self, max_cycles: int = 0, until_idle: bool = True) -> int:
         """Run cycles at the configured cadence (in sim: back-to-back).
         Stops after max_cycles (0 = unlimited) or when a cycle makes no
-        progress and nothing is pending."""
+        progress and nothing is pending.
+
+        Cycle errors are classified (:func:`classify_cycle_error`):
+        retryable ones (RPC deadline, apiserver conflict, lease-storage
+        blip) are swallowed — the failed cycle counts, the loop moves on —
+        up to ``max_cycle_retries`` CONSECUTIVE failures; fatal ones
+        (arena divergence, contract/invariant violations, lost
+        leadership) re-raise after run_once's flight-recorder dump."""
         if not until_idle and not max_cycles:
             raise ValueError("until_idle=False requires max_cycles > 0")
+        # a fresh run() gets the full retry budget: a supervisor that
+        # caught the escalation and resumed must not instantly re-raise
+        self._consecutive_cycle_errors = 0
         # only the leader schedules; acquisition blocks like RunOrDie
         # (server.go:102-125) and a lost lease is fatal (:119-121)
         if self.elector is not None and not self.elector.is_leader:
@@ -314,7 +383,25 @@ class Scheduler:
                 raise LeaderLost(
                     f"leader lease lost by {self.elector.identity}"
                 )
-            result = self.run_once()
+            try:
+                result = self.run_once()
+            except LeaderLost:
+                raise  # leadership is gone; only a supervisor re-acquires
+            except Exception as err:
+                kind = classify_cycle_error(err)
+                metrics().counter_add(
+                    "cycle_errors_total", labels={"class": kind}
+                )
+                if kind == "fatal":
+                    raise
+                self._consecutive_cycle_errors += 1
+                if self._consecutive_cycle_errors > self.max_cycle_retries:
+                    raise
+                cycles += 1
+                if max_cycles and cycles >= max_cycles:
+                    return cycles
+                continue
+            self._consecutive_cycle_errors = 0
             cycles += 1
             if max_cycles and cycles >= max_cycles:
                 return cycles

@@ -106,7 +106,11 @@ class Session:
     ``arena`` (cache/arena.SnapshotArena) switches the snapshot phase from
     a full rebuild to incremental delta maintenance, with dirty-range
     device upload for local deciders and epoch-keyed delta shipping for
-    remote ones."""
+    remote ones.  ``phase_hook`` is called with the phase name after each
+    completed phase (snapshot/upload/kernel/decode) — the explicit seam
+    the chaos plane uses to inject mid-cycle faults (e.g. a leader-lease
+    usurpation between kernel and commit) without monkeypatching; None
+    costs nothing."""
 
     def __init__(
         self,
@@ -114,11 +118,13 @@ class Session:
         config: Optional[SchedulerConfig] = None,
         decider=None,
         arena=None,
+        phase_hook=None,
     ):
         self.cluster = cluster
         self.config = config or SchedulerConfig.default()
         self.decider = decider
         self.arena = arena
+        self.phase_hook = phase_hook
         self.uid = str(uuid.uuid4())
 
     def run(self) -> CycleResult:
@@ -131,10 +137,13 @@ class Session:
 
             decider = LocalDecider()
         arena = self.arena
+        hook = self.phase_hook
         t0 = time.perf_counter()
         with tr.span("snapshot"):
             snap = arena.snapshot() if arena is not None else build_snapshot(self.cluster)
         t1 = time.perf_counter()
+        if hook is not None:
+            hook("snapshot")
         st, pack_meta = snap.tensors, None
         if arena is not None:
             if getattr(decider, "wants_device_pack", True):
@@ -146,6 +155,8 @@ class Session:
             else:
                 # remote decider: ship the delta, keyed by arena epoch
                 pack_meta = arena.pack_meta
+            if hook is not None:
+                hook("upload")
         t_up = time.perf_counter()
         # kernel_ms is device time in both modes (the sidecar measures its
         # own); remote transport overhead is the decide-wall minus it
@@ -155,6 +166,8 @@ class Session:
             else:
                 dec, kernel_ms = decider.decide(st, self.config)
         t2 = time.perf_counter()
+        if hook is not None:
+            hook("kernel")
         # Decisions may have crossed an RPC codec (RemoteDecider): hold
         # them to the same declared contract the producer side asserts
         # (cache/snapshot.py _assert_pack_dtypes) before decoding them
@@ -164,6 +177,8 @@ class Session:
         with tr.span("decode"):
             binds, evicts = decode_decisions(snap, dec)
         t3 = time.perf_counter()
+        if hook is not None:
+            hook("decode")
         with tr.span("close"):
             job_status = self._close(snap, dec)
         t4 = time.perf_counter()

@@ -9,8 +9,9 @@ cluster state and talks to the apiserver for everything.
 """
 from __future__ import annotations
 
+import os
 import time
-from typing import Tuple
+from typing import Callable, Optional, Tuple
 
 from ..cache.snapshot import SnapshotTensors
 from ..framework.decider import LocalDecider  # noqa: F401  (re-export; pb-free home)
@@ -26,6 +27,7 @@ from .codec import (
 from .sidecar import CHANNEL_OPTIONS, SERVICE
 
 from . import decision_pb2 as pb
+from ..utils.backoff import backoff_delay_s  # noqa: F401  (re-export: retry policy home)
 
 
 class RemoteDecider:
@@ -52,6 +54,9 @@ class RemoteDecider:
         timeout_s: float = 300.0,
         retries: int = 3,
         retry_backoff_s: float = 1.0,
+        retry_backoff_cap_s: float = 30.0,
+        jitter_seed: Optional[int] = None,
+        sleep_fn: Callable[[float], None] = time.sleep,
     ):
         import grpc
 
@@ -59,6 +64,14 @@ class RemoteDecider:
         self.timeout_s = timeout_s
         self.retries = retries
         self.retry_backoff_s = retry_backoff_s
+        self.retry_backoff_cap_s = retry_backoff_cap_s
+        # per-process default: N replicas retrying against one recovering
+        # sidecar must NOT share a backoff schedule (the point of the
+        # jitter); an explicit seed pins the schedule for replay/tests
+        self.jitter_seed = jitter_seed if jitter_seed is not None else os.getpid()
+        # injectable sleep (chaos plane / tests pass a virtual clock's
+        # sleep so retry schedules consume simulated, not wall, time)
+        self.sleep_fn = sleep_fn
         self._channel = grpc.insecure_channel(target, options=CHANNEL_OPTIONS)
         self._decide = self._channel.unary_unary(
             f"/{SERVICE}/Decide",
@@ -151,7 +164,12 @@ class RemoteDecider:
                     metrics().counter_add(
                         "rpc_decide_retries_total", labels={"code": code}
                     )
-                    time.sleep(self.retry_backoff_s * attempt)
+                    self.sleep_fn(
+                        backoff_delay_s(
+                            attempt, self.retry_backoff_s,
+                            self.retry_backoff_cap_s, self.jitter_seed,
+                        )
+                    )
             if attempt and hasattr(call_span, "note"):
                 call_span.note(retries=attempt)
         self.last_roundtrip_ms = (time.perf_counter() - t0) * 1000

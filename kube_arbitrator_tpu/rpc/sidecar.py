@@ -49,13 +49,17 @@ CHANNEL_OPTIONS = [
 class DecisionService:
     """Implements DecisionPlane against the local jax backend."""
 
-    def __init__(self):
+    def __init__(self, decider_factory=None):
         # grpc.server runs handlers on a ThreadPoolExecutor, so Decide and
         # Health race: the counter and the conf cache are shared state and
         # every access takes _lock (KAT-LCK discipline: the lock guards
         # ONLY dict/int ops — the blocking schedule_cycle/block_until_ready
         # work stays outside the critical section)
         self._lock = threading.Lock()
+        # injectable decide seam: the chaos plane / tests substitute a
+        # fault-wrapped decider so the client's retry path runs against a
+        # REAL gRPC server failing on schedule (None = LocalDecider)
+        self._decider_factory = decider_factory
         self.cycles_served = 0
         # conf YAML -> parsed SchedulerConfig; jax caches the compiled
         # program per (conf, shape-bucket) under its own jit cache
@@ -121,7 +125,11 @@ class DecisionService:
                 # kernel stages land in the trace and the action-labeled
                 # histograms.  A fresh decider per request: handlers run
                 # concurrently and last_action_ms is per-decide state.
-                decider = LocalDecider()
+                decider = (
+                    self._decider_factory()
+                    if self._decider_factory is not None
+                    else LocalDecider()
+                )
                 dec, kernel_ms = decider.decide(st, cfg)
                 with tr.span("pack"):
                     rep = decide_reply(dec, cycle=request.cycle, kernel_ms=kernel_ms)

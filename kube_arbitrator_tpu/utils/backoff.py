@@ -1,0 +1,30 @@
+"""Capped exponential backoff with deterministic jitter.
+
+One implementation for every retry loop in the system: the decide-RPC
+client (``rpc/client.py``), the chaos plane's in-process decider wrapper
+(``chaos/faults.py``), and anything else that must wait-and-retry.  Kept
+free of rpc/grpc imports so retry policy is usable (and testable) without
+the transport stack.
+"""
+from __future__ import annotations
+
+import random
+
+
+def backoff_delay_s(
+    attempt: int, base_s: float, cap_s: float, jitter_seed: int = 0
+) -> float:
+    """Delay before retry ``attempt`` (1-based): ``min(cap, base *
+    2**(attempt-1))`` scaled into ``[0.5d, d]`` by a fraction drawn from a
+    seed keyed on (jitter_seed, attempt).  Jitter de-synchronizes a fleet
+    of clients hammering one recovering server (the thundering-herd fix a
+    linear ``base * attempt`` sleep lacks), while the seeding keeps every
+    schedule bit-reproducible — the chaos plane replays failures under a
+    virtual clock and must see identical delays run over run."""
+    if attempt < 1:
+        return 0.0
+    d = min(cap_s, base_s * (2.0 ** (attempt - 1)))
+    # a STRING seed: random.Random hashes str seeds via sha512, stable
+    # across processes (tuple seeds use PYTHONHASHSEED-randomized hash())
+    frac = random.Random(f"kat-backoff:{jitter_seed}:{attempt}").random()
+    return d * (0.5 + 0.5 * frac)

@@ -35,6 +35,7 @@ METRIC_HELP: Dict[str, str] = {
     "evicts_total": "Committed evict intents.",
     "pending_tasks": "Pending tasks observed at cycle start.",
     "cycles_total": "Scheduling cycles completed.",
+    "cycle_errors_total": "Cycles that died with an error (class label: retryable/fatal).",
     # incremental snapshot plane (cache/arena.py)
     "snapshot_delta_rows": "Rows the last arena pack refreshed (changed vs the previously shipped pack).",
     "snapshot_full_rebuilds_total": "Arena full rebuilds (reason label: seed/verify/structural triggers).",
@@ -51,6 +52,11 @@ METRIC_HELP: Dict[str, str] = {
     "cache_watch_events_total": "Apiserver list/watch events applied to the live cache (phase label).",
     "cache_resync_depth": "errTasks resync queue depth at pump time.",
     "cache_snapshot_staleness_seconds": "Age of the live-cache model at the latest sync (gap between pumps).",
+    "cache_relists_total": "Full relists forced by a 410-Gone compacted watch window.",
+    # chaos plane (kube_arbitrator_tpu/chaos)
+    "chaos_faults_injected_total": "Faults injected by the chaos plane (kind label).",
+    "chaos_invariant_breaches_total": "Cluster-level invariant breaches the chaos plane detected (invariant label).",
+    "chaos_detections_total": "Injected faults the system itself detected and contained (kind label).",
     # leader election
     "leader_renew_duration_seconds": "Leader lease renew round-trip latency.",
     "leader_fence_revalidations_total": "Actuation-fence storage re-validations of a stale-looking lease (outcome label: renewed/lost).",

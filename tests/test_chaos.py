@@ -1,0 +1,188 @@
+"""Chaos plane: determinism, fault tolerance, sensitivity, shrinking.
+
+The two acceptance proofs live here:
+
+* **determinism** — same seed + profile => byte-identical repro file and
+  identical per-cycle decision digests across two runs;
+* **sensitivity** — with the arena byte-identity verifier deliberately
+  disabled under a seeded corruption plan, the invariant checkers report
+  the breach (and with it enabled, the verifier itself catches the fault
+  first) — the plane detects real bugs, not just clean runs.
+"""
+import json
+
+import pytest
+
+from kube_arbitrator_tpu.chaos import (
+    PROFILES,
+    FaultPlan,
+    VirtualClock,
+    run_chaos,
+    shrink,
+)
+from kube_arbitrator_tpu.chaos.plan import ChaosProfile, _spec
+from kube_arbitrator_tpu.chaos.runner import main as chaos_main
+
+
+def test_virtual_clock_sleep_advances_without_blocking():
+    clk = VirtualClock(start=100.0)
+    assert clk.now() == 100.0
+    clk.sleep(5.0)
+    clk.advance(2.5)
+    assert clk.now() == 107.5
+    with pytest.raises(ValueError):
+        clk.advance(-1.0)
+
+
+def test_fault_plan_is_pure_function_of_seed():
+    prof = PROFILES["smoke"]
+    a = FaultPlan.generate(7, 20, prof)
+    b = FaultPlan.generate(7, 20, prof)
+    assert a == b
+    assert a.specs, "smoke profile at 20 cycles should draw some faults"
+    assert FaultPlan.generate(8, 20, prof) != a
+    # JSON round-trip is lossless (the repro file carries the plan)
+    assert FaultPlan.from_dict(json.loads(json.dumps(a.to_dict()))) == a
+
+
+def test_clean_profile_run_is_breach_free():
+    rep = run_chaos(seed=0, cycles=3, profile="none")
+    assert rep.ok
+    assert rep.injected == []
+    assert all(o == "ok" for o in rep.outcomes)
+
+
+def test_determinism_same_seed_byte_identical_repro_and_digests():
+    """Acceptance: two runs of the same (seed, profile) produce the same
+    per-cycle decision digests and a byte-identical repro file."""
+    a = run_chaos(seed=3, cycles=6, profile="smoke")
+    b = run_chaos(seed=3, cycles=6, profile="smoke")
+    assert a.digests == b.digests
+    assert a.repro_json() == b.repro_json()
+    # and a different seed actually changes the run (the digests are not
+    # a constant)
+    c = run_chaos(seed=4, cycles=6, profile="smoke")
+    assert c.digests != a.digests
+
+
+def test_faulted_run_holds_all_invariants():
+    """The full fault mix (apiserver conflicts/timeouts, watch chaos,
+    RPC deadlines, lease steals) injected against the real loop: every
+    cluster-level invariant must hold — the system absorbs what it
+    claims to absorb."""
+    rep = run_chaos(seed=1, cycles=10, profile="smoke")
+    assert rep.breaches == []
+    assert len(rep.injected) > 0, "plan drew no faults; test proves nothing"
+
+
+def test_lease_steal_is_fenced_and_actuates_nothing():
+    """A lease usurped at the kernel/commit boundary: the actuation fence
+    must discard the cycle (LeaderLost), and the single-actuator
+    invariant must see ZERO apiserver writes from the fenced cycle."""
+    prof = PROFILES["smoke"]
+    plan = FaultPlan(seed=0, specs=(
+        _spec(1, "lease_steal", site="kernel"),
+        _spec(3, "lease_steal", site="commit"),
+    ))
+    rep = run_chaos(seed=0, cycles=5, profile=prof, plan=plan)
+    assert rep.breaches == []
+    fenced = [i for i, o in enumerate(rep.outcomes) if o == "fenced"]
+    assert fenced == [1, 3]
+    assert {d["kind"] for d in rep.detections} == {"leader_fence"}
+
+
+def test_watch_compaction_forces_relist_without_losing_tasks():
+    """410-Gone mid-run: the cache relists and the no-lost-no-duplicated
+    consistency invariant (checked every cycle) must hold."""
+    prof = PROFILES["smoke"]
+    plan = FaultPlan(seed=0, specs=(
+        _spec(1, "watch_compact"),
+        _spec(2, "watch_dup", index=3),
+        _spec(3, "watch_compact"),
+    ))
+    rep = run_chaos(seed=2, cycles=6, profile=prof, plan=plan)
+    assert rep.breaches == []
+    assert "watch_compact" in [r["kind"] for r in rep.injected]
+
+
+def test_sensitivity_verifier_catches_arena_corruption():
+    """With the byte-identity verifier ON, injected arena corruption is
+    detected as ArenaDivergence before any damaged decision actuates —
+    no invariant breaches."""
+    rep = run_chaos(seed=2, cycles=6, profile="arena")
+    assert rep.breaches == []
+    kinds = {d["kind"] for d in rep.detections}
+    assert "arena_divergence" in kinds
+
+
+def test_sensitivity_disabled_verifier_breaches_invariants():
+    """Acceptance: verifier OFF, same corruption plan — the damage flows
+    into decisions and the no-overcommit invariant checker reports it.
+    Proves the chaos plane detects real bugs, not just clean runs."""
+    rep = run_chaos(
+        seed=2, cycles=6, profile="arena", disabled=("arena-verify",)
+    )
+    assert not rep.ok
+    assert {b.invariant for b in rep.breaches} == {"no_overcommit"}
+    assert "arena_corrupt" in [r["kind"] for r in rep.injected]
+
+
+def test_shrink_minimizes_to_the_causal_fault():
+    """Shrinking a failing (verifier-off corruption) run must keep the
+    failure while dropping the decoy faults and shortening the horizon."""
+    prof = PROFILES["arena"]
+    plan = FaultPlan(seed=2, specs=(
+        _spec(1, "watch_dup", index=0),
+        _spec(2, "arena_corrupt", field="node_idle", row=3, scale=8.0),
+        _spec(3, "rpc_fail", attempts=1),
+        _spec(4, "watch_truncate"),
+    ))
+    base = run_chaos(
+        seed=2, cycles=6, profile=prof, plan=plan, disabled=("arena-verify",)
+    )
+    assert not base.ok
+    report, min_plan, min_cycles = shrink(
+        2, prof, 6, plan, disabled=("arena-verify",)
+    )
+    assert not report.ok, "shrink lost the failure"
+    assert len(min_plan.specs) == 1
+    assert min_plan.specs[0].kind == "arena_corrupt"
+    assert min_cycles <= 6
+
+
+def test_repro_file_replays_bit_identically(tmp_path):
+    """The repro a failing run writes replays to the same digests and
+    breaches when fed back through the runner (the --replay path)."""
+    rep = run_chaos(
+        seed=2, cycles=5, profile="arena", disabled=("arena-verify",),
+        out_dir=str(tmp_path),
+    )
+    assert not rep.ok
+    path = tmp_path / "chaos-repro-arena-2.json"
+    rec = json.loads(path.read_text())
+    replay = run_chaos(
+        seed=rec["seed"],
+        cycles=rec["cycles"],
+        profile=ChaosProfile.from_dict(rec["profile"]),
+        plan=FaultPlan.from_dict(rec["plan"]),
+        disabled=tuple(rec["disabled"]),
+    )
+    assert replay.digests == rec["digests"]
+    assert [b.to_dict() for b in replay.breaches] == rec["breaches"]
+
+
+def test_runner_cli_exit_codes(tmp_path):
+    assert chaos_main(["--profile", "none", "--cycles", "2"]) == 0
+    assert chaos_main(["--profile", "fnord"]) == 2
+    assert chaos_main(["--disable", "gravity"]) == 2
+    # breach => 1 + repro file in --out-dir
+    rc = chaos_main([
+        "--profile", "arena", "--cycles", "5", "--seed", "2",
+        "--disable", "arena-verify", "--out-dir", str(tmp_path),
+    ])
+    assert rc == 1
+    assert (tmp_path / "chaos-repro-arena-2.json").exists()
+    # replay of that repro reproduces (exit 1, not the digest-mismatch 3)
+    assert chaos_main(
+        ["--replay", str(tmp_path / "chaos-repro-arena-2.json")]
+    ) == 1

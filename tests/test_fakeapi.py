@@ -1,0 +1,170 @@
+"""Direct FakeApiServer actuation-conflict coverage + the 410-Gone
+compaction / LiveCache relist path.
+
+The bind/evict conflict semantics were previously exercised only
+indirectly (through scheduler runs); these pin them down at the verb
+level: bind to a deleted pod (404), double-bind (409), evict with a
+stale resourceVersion after a bind raced in (409).
+"""
+import pytest
+
+from kube_arbitrator_tpu.cache.fakeapi import (
+    ApiError,
+    FakeApiServer,
+    GoneError,
+)
+from kube_arbitrator_tpu.cache.live import LiveCache
+from kube_arbitrator_tpu.options import options
+
+
+def _pod(name, uid=None, node=None, scheduler=None):
+    p = {
+        "metadata": {"namespace": "default", "name": name, "uid": uid or name},
+        "spec": {
+            "schedulerName": scheduler or options().scheduler_name,
+            "containers": [
+                {"name": "c", "resources": {"requests": {"cpu": "500m"}}}
+            ],
+        },
+        "status": {"phase": "Pending"},
+    }
+    if node:
+        p["spec"]["nodeName"] = node
+    return p
+
+
+def _node(name):
+    return {
+        "metadata": {"name": name},
+        "status": {"allocatable": {"cpu": "4", "memory": "8Gi", "pods": 110}},
+    }
+
+
+def test_bind_to_deleted_pod_is_404():
+    api = FakeApiServer()
+    api.create("pods", _pod("p1"))
+    api.delete("pods", "default", "p1")
+    with pytest.raises(ApiError) as ei:
+        api.bind_pod("default", "p1", "n1")
+    assert ei.value.status == 404
+
+
+def test_double_bind_is_409_and_first_binding_sticks():
+    api = FakeApiServer()
+    api.create("nodes", _node("n1"))
+    api.create("pods", _pod("p1"))
+    api.bind_pod("default", "p1", "n1")
+    with pytest.raises(ApiError) as ei:
+        api.bind_pod("default", "p1", "n2")
+    assert ei.value.status == 409
+    assert api.get("pods", "default", "p1")["spec"]["nodeName"] == "n1"
+
+
+def test_evict_after_bind_with_stale_rv_is_409():
+    """An evictor holding the pre-bind resourceVersion must get a 409 —
+    its decision predates the bind, and a compare-and-delete refuses to
+    kill a pod in a state the evictor never observed."""
+    api = FakeApiServer()
+    api.create("pods", _pod("p1"))
+    stale_rv = api.get("pods", "default", "p1")["metadata"]["resourceVersion"]
+    api.bind_pod("default", "p1", "n1")  # bumps the rv
+    with pytest.raises(ApiError) as ei:
+        api.evict_pod("default", "p1", expect_rv=stale_rv)
+    assert ei.value.status == 409
+    assert api.get("pods", "default", "p1") is not None  # still alive
+    # with the CURRENT rv the evict goes through
+    rv = api.get("pods", "default", "p1")["metadata"]["resourceVersion"]
+    api.evict_pod("default", "p1", expect_rv=rv)
+    assert api.get("pods", "default", "p1") is None
+
+
+def test_injected_bind_failure_is_non_409():
+    api = FakeApiServer()
+    api.create("pods", _pod("p1", uid="u1"))
+    api.fail_bind_uids.add("u1")
+    with pytest.raises(ApiError) as ei:
+        api.bind_pod("default", "p1", "n1")
+    assert ei.value.status == 422
+
+
+def test_watch_from_compacted_rv_raises_gone():
+    api = FakeApiServer()
+    api.create("pods", _pod("p1"))
+    api.create("pods", _pod("p2"))
+    horizon = api.compact()
+    assert horizon > 0
+    with pytest.raises(GoneError):
+        api.watch_all(0)
+    with pytest.raises(GoneError):
+        api.watch("pods", 0)
+    # a caught-up client (since_rv at/after the horizon) is unaffected
+    assert api.watch_all(horizon) == []
+    api.create("pods", _pod("p3"))
+    assert [e[3]["metadata"]["name"] for e in api.watch_all(horizon)] == ["p3"]
+
+
+def test_live_cache_relists_after_gone_without_losing_or_duplicating():
+    """Regression for the 410 recovery: events are mutated while the
+    cache is behind a compacted window — after the forced relist the
+    model must hold EXACTLY the apiserver's pods (none lost to the
+    compaction gap, none duplicated by the re-ingest), including a
+    deletion the dropped events carried."""
+    api = FakeApiServer()
+    api.create("nodes", _node("n1"))
+    for i in range(4):
+        api.create("pods", _pod(f"p{i}", uid=f"u{i}"))
+    cache = LiveCache(api)
+    cache.sync()
+    assert sum(len(j.tasks) for j in cache.cluster.jobs.values()) == 4
+    # mutations the cache never sees as events: a bind, a delete, an add
+    api.bind_pod("default", "p0", "n1")
+    api.delete("pods", "default", "p1")
+    api.create("pods", _pod("p4", uid="u4"))
+    api.compact()  # the watch window closes over all of it
+    n = cache.sync()  # 410 -> relist
+    assert n > 0
+    model = {
+        uid: t for j in cache.cluster.jobs.values() for uid, t in j.tasks.items()
+    }
+    api_uids = {
+        p["metadata"]["uid"]
+        for p in api.list("pods")[0]
+        if p["spec"].get("schedulerName") == options().scheduler_name
+    }
+    assert set(model) == api_uids == {"u0", "u2", "u3", "u4"}
+    # the bound pod came back bound (status from the fresh LIST)
+    assert model["u0"].node_name == "n1"
+    # no duplicate foreign tasks either
+    assert len({t.uid for t in cache.cluster.others}) == len(cache.cluster.others)
+    # and the watch plane keeps working after the relist
+    api.create("pods", _pod("p5", uid="u5"))
+    cache.sync()
+    assert "u5" in {
+        uid for j in cache.cluster.jobs.values() for uid in j.tasks
+    }
+
+
+def test_live_cache_relist_emits_structural_to_delta_sink():
+    class Sink:
+        def __init__(self):
+            self.reasons = []
+
+        def structural(self, reason):
+            self.reasons.append(reason)
+
+        def task_dirty(self, uid, node_name=""):
+            pass
+
+        def node_dirty(self, name):
+            pass
+
+    api = FakeApiServer()
+    api.create("nodes", _node("n1"))
+    api.create("pods", _pod("p0"))
+    cache = LiveCache(api)
+    cache.sync()
+    cache.delta_sink = Sink()
+    api.create("pods", _pod("p1"))
+    api.compact()
+    cache.sync()
+    assert "relist" in cache.delta_sink.reasons

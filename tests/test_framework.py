@@ -246,3 +246,96 @@ def test_backend_probe_kills_wedged_child():
     except FileNotFoundError:
         return  # no procps on this host; the timing assert above stands
     assert out.returncode != 0, "wedged probe child leaked"
+
+
+# ---- cycle-error classification (chaos-plane satellite) ----
+
+
+class _FailingDecider:
+    """Decider that raises a scripted exception for the first N cycles,
+    then delegates to the real in-process path."""
+
+    wants_device_pack = True
+
+    def __init__(self, err, times):
+        self.err = err
+        self.times = times
+        self.calls = 0
+
+    def decide(self, st, config, pack_meta=None):
+        self.calls += 1
+        if self.calls <= self.times:
+            raise self.err
+        from kube_arbitrator_tpu.framework.decider import LocalDecider
+
+        return LocalDecider().decide(st, config)
+
+
+def test_classify_cycle_error_routes():
+    from kube_arbitrator_tpu.cache.arena import ArenaDivergence
+    from kube_arbitrator_tpu.cache.fakeapi import ApiError
+    from kube_arbitrator_tpu.framework.leader import LeaderLost, TransientLockError
+    from kube_arbitrator_tpu.framework.scheduler import classify_cycle_error
+
+    assert classify_cycle_error(ArenaDivergence("drift")) == "fatal"
+    assert classify_cycle_error(LeaderLost("gone")) == "fatal"
+    assert classify_cycle_error(TypeError("decision contract violation")) == "fatal"
+    assert classify_cycle_error(AssertionError("invariant")) == "fatal"
+    assert classify_cycle_error(RuntimeError("unknown")) == "fatal"
+    assert classify_cycle_error(ApiError("conflict", status=409)) == "retryable"
+    assert classify_cycle_error(TransientLockError("blip")) == "retryable"
+    assert classify_cycle_error(TimeoutError("deadline")) == "retryable"
+
+    class SelfDescribed(RuntimeError):
+        retryable = True
+
+    assert classify_cycle_error(SelfDescribed()) == "retryable"
+
+
+def test_run_swallows_retryable_cycle_errors_and_continues():
+    class Transient(RuntimeError):
+        retryable = True
+
+    sim = generate_cluster(num_nodes=16, num_jobs=3, tasks_per_job=4, num_queues=2, seed=5)
+    decider = _FailingDecider(Transient("decide blip"), times=2)
+    sched = Scheduler(sim, decider=decider)
+    cycles = sched.run(max_cycles=6, until_idle=False)
+    assert cycles == 6
+    # the two failed cycles count but bind nothing; later cycles recover
+    assert sum(s.binds for s in sched.history) > 0
+
+
+def test_run_reraises_fatal_cycle_errors():
+    from kube_arbitrator_tpu.cache.arena import ArenaDivergence
+
+    sim = generate_cluster(num_nodes=16, num_jobs=3, tasks_per_job=4, num_queues=2, seed=5)
+    sched = Scheduler(sim, decider=_FailingDecider(ArenaDivergence("drift"), times=99))
+    with pytest.raises(ArenaDivergence):
+        sched.run(max_cycles=6, until_idle=False)
+
+
+def test_run_escalates_after_max_consecutive_retryable_errors():
+    class Transient(RuntimeError):
+        retryable = True
+
+    sim = generate_cluster(num_nodes=16, num_jobs=3, tasks_per_job=4, num_queues=2, seed=5)
+    sched = Scheduler(
+        sim, decider=_FailingDecider(Transient("forever"), times=99),
+        max_cycle_retries=3,
+    )
+    with pytest.raises(Transient):
+        sched.run(max_cycles=50, until_idle=False)
+
+
+def test_phase_hook_fires_at_every_boundary():
+    phases = []
+    sim = generate_cluster(num_nodes=16, num_jobs=3, tasks_per_job=4, num_queues=2, seed=5)
+    sched = Scheduler(sim, phase_hook=phases.append)
+    sched.run_once()
+    assert phases == ["snapshot", "kernel", "decode", "commit"]
+    # with an arena the upload boundary appears too
+    phases2 = []
+    sim2 = generate_cluster(num_nodes=16, num_jobs=3, tasks_per_job=4, num_queues=2, seed=5)
+    sched2 = Scheduler(sim2, arena=True, phase_hook=phases2.append)
+    sched2.run_once()
+    assert phases2 == ["snapshot", "upload", "kernel", "decode", "commit"]

@@ -506,3 +506,40 @@ def test_late_pv_event_retranslates_pod():
         assert task.volume_zone == "z9"
     finally:
         server.shutdown()
+
+
+def test_http_evict_with_stale_rv_is_409(http_api):
+    """The evict compare-and-delete precondition must survive the HTTP
+    crossing: a stale expectResourceVersion DELETE on a pod gets 409."""
+    api, client = http_api
+    client.create("pods", make_pod("p1", group="g"))
+    stale_rv = client.get("pods", "default", "p1")["metadata"]["resourceVersion"]
+    client.bind_pod("default", "p1", "n1")  # bumps the rv server-side
+    with pytest.raises(ApiError) as ei:
+        client.evict_pod("default", "p1", expect_rv=stale_rv)
+    assert ei.value.status == 409
+    assert client.get("pods", "default", "p1") is not None
+    client.evict_pod("default", "p1")  # unconditional still works
+    assert client.get("pods", "default", "p1") is None
+
+
+def test_http_410_compaction_forces_relist(http_api):
+    """A compacted watch window over the WIRE arrives as a plain
+    ApiError(status=410), not the GoneError class — the live cache must
+    still relist and converge on the store."""
+    api, client = http_api
+    client.create("nodes", make_node("n0"))
+    for i in range(3):
+        client.create("pods", make_pod(f"p{i}", group="g"))
+    cache = LiveCache(client)
+    cache.sync()
+    # churn the cache never sees as events, then close the window
+    api.bind_pod("default", "p0", "n0")
+    api.delete("pods", "default", "p1")
+    api.compact()
+    cache.sync()  # wire 410 -> relist
+    model = {
+        uid: t for j in cache.cluster.jobs.values() for uid, t in j.tasks.items()
+    }
+    assert set(model) == {"uid-default-p0", "uid-default-p2"}
+    assert model["uid-default-p0"].node_name == "n0"

@@ -96,3 +96,60 @@ def test_remote_full_actions_with_preemption(sidecar):
     sched.run(max_cycles=4)
     assert sum(s.binds for s in sched.history) > 0
     sched.decider.close()
+
+
+# ---- retry backoff (chaos-plane satellite) ----
+
+
+def test_backoff_is_capped_exponential_with_bounded_jitter():
+    from kube_arbitrator_tpu.utils.backoff import backoff_delay_s
+
+    base, cap = 1.0, 30.0
+    for attempt in range(1, 12):
+        raw = min(cap, base * 2 ** (attempt - 1))
+        d = backoff_delay_s(attempt, base, cap, jitter_seed=0)
+        assert raw * 0.5 <= d <= raw, (attempt, d)
+    # capped: late attempts stop growing
+    assert backoff_delay_s(20, base, cap) <= cap
+    assert backoff_delay_s(0, base, cap) == 0.0
+
+
+def test_backoff_jitter_is_deterministic_and_seed_keyed():
+    from kube_arbitrator_tpu.utils.backoff import backoff_delay_s
+
+    a = [backoff_delay_s(i, 1.0, 30.0, jitter_seed=7) for i in range(1, 6)]
+    b = [backoff_delay_s(i, 1.0, 30.0, jitter_seed=7) for i in range(1, 6)]
+    c = [backoff_delay_s(i, 1.0, 30.0, jitter_seed=8) for i in range(1, 6)]
+    assert a == b
+    assert a != c  # different clients de-synchronize
+
+
+def test_remote_decider_retry_uses_injected_sleep_and_schedule():
+    """Retries against a dead endpoint must sleep through the injected
+    hook (never wall-clock) with exactly the deterministic backoff
+    schedule."""
+    import grpc
+
+    from kube_arbitrator_tpu.cache import build_snapshot
+    from kube_arbitrator_tpu.framework.conf import SchedulerConfig
+    from kube_arbitrator_tpu.utils.backoff import backoff_delay_s
+
+    slept = []
+    d = RemoteDecider(
+        "127.0.0.1:1",  # nothing listens: UNAVAILABLE
+        timeout_s=5.0,
+        retries=2,
+        retry_backoff_s=0.25,
+        retry_backoff_cap_s=2.0,
+        jitter_seed=42,
+        sleep_fn=slept.append,
+    )
+    sim = generate_cluster(num_nodes=8, num_jobs=2, tasks_per_job=3, num_queues=1, seed=0)
+    st = build_snapshot(sim.cluster).tensors
+    with pytest.raises(grpc.RpcError):
+        d.decide(st, SchedulerConfig.default())
+    assert slept == [
+        backoff_delay_s(1, 0.25, 2.0, 42),
+        backoff_delay_s(2, 0.25, 2.0, 42),
+    ]
+    d.close()
