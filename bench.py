@@ -29,6 +29,15 @@ recorded):
 Env overrides: BENCH_TASKS / BENCH_NODES / BENCH_ORACLE_CAP_S change the
 primary config; BENCH_LADDER=0 skips the stderr ladder.
 
+BENCH_PIPELINE=1 switches to the pipelined-cadence mode instead (the
+BENCH_r06 artifact): per rung, the same churn-driven multi-cycle world
+runs once through the sequential Scheduler loop and once through the
+pipelined executor, recording effective cycle period (commit-to-commit),
+per-stage occupancy, and the revalidation discard rate — the
+sum(stages) -> max(stage) comparison.  BENCH_PIPE_RUNGS ("TxN,TxN"),
+BENCH_PIPE_CYCLES, and BENCH_PIPE_CHURN (fraction of running tasks
+completed per cycle) shape it.
+
 Wedge containment: the measurement loop runs in a CHILD process that
 streams every completed row to a spill file; the parent enforces
 BENCH_TIMEOUT_S (default 2700 s) and, if the child hangs (the axon TPU
@@ -238,9 +247,218 @@ def _arena_probe(sim, canon_snap, dec0):
 
 
 def main() -> None:
+    # the parent/child wedge containment wraps EVERY mode, the pipeline
+    # cadence mode included: a wedged accelerator mid-leg must still
+    # yield the contract line from the spilled rows within BENCH_TIMEOUT_S
     if os.environ.get("BENCH_SUBPROC", "1") != "0" and os.environ.get("BENCH_CHILD") != "1":
         sys.exit(_parent_main())
+    if os.environ.get("BENCH_PIPELINE") == "1":
+        sys.exit(_pipeline_main())
     _measure_main()
+
+
+# ---------------------------------------------------------------------------
+# pipelined-vs-sequential cadence mode (BENCH_PIPELINE=1)
+
+
+def _pipe_churn(sim, cycle, frac):
+    """External heavy churn between cycles: complete a seeded fraction of
+    RUNNING tasks (node accounting updated, row-level deltas emitted) —
+    the watch-driven mutation stream the speculation window must absorb,
+    and the capacity release that keeps the pending backlog draining."""
+    import random
+
+    from kube_arbitrator_tpu.api.types import TaskStatus
+
+    rng = random.Random(f"kat-pipe-churn:{cycle}")
+    running = [
+        t
+        for j in sim.cluster.jobs.values()
+        for t in j.tasks.values()
+        if t.status == TaskStatus.RUNNING
+    ]
+    if not running:
+        return 0
+    k = min(len(running), max(1, int(len(running) * frac)))
+    for t in rng.sample(running, k):
+        node = sim.cluster.nodes.get(t.node_name)
+        if node is not None and t.uid in node.tasks:
+            node.remove_task(t)
+        t.status = TaskStatus.SUCCEEDED
+        if sim.delta_sink is not None:
+            sim.delta_sink.task_dirty(t.uid, t.node_name)
+    return k
+
+
+def _pipe_leg(mode, T, N, cycles, churn_frac, conf, queues, node_milli, warm=2):
+    """One measured leg over a fresh seeded world; returns the row dict.
+    Every leg runs the identical churn stream.  ``mode``:
+
+    - ``"sequential"`` — the plain Session loop, full snapshot rebuild
+      per cycle: kube-batch's strictly sequential sum(stages) posture
+      (the baseline the pipeline plane is measured against).
+    - ``"arena"`` — sequential with the incremental snapshot plane (PR 4)
+      on: sum(stages) with delta packs.  The strictest baseline.
+    - ``"pipelined"`` — the overlapped executor (arena on).
+
+    ``node_milli`` sizes node capacity: the default (16 cores vs the
+    ladder's 32) keeps the world oversubscribed so a pending backlog
+    persists through the run — the heavy-traffic serving posture the
+    cadence claim is about — instead of the backlog draining
+    mid-measurement and the decide stage collapsing to a trivial
+    kernel."""
+    from kube_arbitrator_tpu.cache.sim import generate_cluster
+    from kube_arbitrator_tpu.framework import Scheduler
+
+    sim = generate_cluster(
+        num_nodes=N, num_jobs=max(1, T // 100), tasks_per_job=100,
+        num_queues=queues, seed=42, running_fraction=0.5,
+        node_cpu_milli=node_milli, node_memory=node_milli * 4 * 1024**2,
+        node_gpu_milli=node_milli // 4,
+    )
+    sched = Scheduler(sim, config=conf, arena=(mode != "sequential"))
+    executor = None
+    if mode == "pipelined":
+        from kube_arbitrator_tpu.pipeline import PipelinedExecutor
+
+        executor = PipelinedExecutor(sched)
+    periods, stage_sums, churned = [], [], 0
+    try:
+        for c in range(warm + cycles):
+            churned += _pipe_churn(sim, c, churn_frac)
+            t0 = time.perf_counter()
+            if executor is not None:
+                out = executor.step()
+                period_ms = out.period_ms
+            else:
+                sched.run_once()
+                period_ms = (time.perf_counter() - t0) * 1000
+            if c < warm:
+                continue  # compile + pipeline fill
+            periods.append(period_ms)
+            s = sched.history[-1]
+            stage_sums.append(
+                s.snapshot_ms + s.upload_ms + s.kernel_ms + s.decode_ms
+                + s.close_ms + s.actuate_ms
+            )
+        row = {
+            "mode": mode,
+            "period_ms": round(float(np.median(periods)), 1),
+            "period_ms_reps": [round(p, 1) for p in periods],
+            "stage_sum_ms": round(float(np.median(stage_sums)), 1),
+            "binds": sum(s.binds for s in sched.history),
+            "evicts": sum(s.evicts for s in sched.history),
+            "churned": churned,
+        }
+        if executor is not None:
+            total = sum(executor.discard_totals.values())
+            decisions = row["binds"] + row["evicts"] + total
+            row["occupancy"] = {
+                k: round(v, 3) for k, v in executor.occupancy().items()
+            }
+            row["discards"] = dict(executor.discard_totals)
+            row["discard_rate"] = round(total / decisions, 4) if decisions else 0.0
+            row["backpressure_events"] = executor.backpressure_events
+        return row
+    finally:
+        if executor is not None:
+            executor.close()
+
+
+def _pipeline_main() -> int:
+    """The cadence artifact: sequential sum(stages) vs pipelined
+    max(stage) per rung; one stdout JSON line, rung rows on stderr."""
+    # On a CPU-only host XLA's eigen pool spreads the kernel across every
+    # core, so an "overlapped" decide just cannibalizes the ingest
+    # thread's cores and the comparison measures contention, not the
+    # pipeline.  Pin XLA to one intra-op thread for BOTH legs (identical
+    # config, fair comparison): that models the production posture the
+    # plane targets — the decision program on an accelerator (or a
+    # sidecar) that does not steal host cores.  BENCH_PIPE_XLA_SINGLE=0
+    # restores the default pool (the right choice on accelerator hosts,
+    # where the kernel never touches host cores anyway).
+    if os.environ.get("BENCH_PIPE_XLA_SINGLE", "1") == "1":
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_cpu_multi_thread_eigen=false intra_op_parallelism_threads=1"
+        ).strip()
+    from kube_arbitrator_tpu.platform import enable_persistent_cache, ensure_jax_backend
+
+    ensure_jax_backend()
+    enable_persistent_cache()
+    from kube_arbitrator_tpu.framework.conf import load_conf
+
+    # Default action set is the north-star allocate+backfill: that is the
+    # regime where host-side pack maintenance (snapshot/upload/decode/
+    # close) rivals the kernel and overlap collapses sum->max.  The full
+    # evictive list (BENCH_PIPE_ACTIONS=full) is decide-bound — its row
+    # honestly reports occupancy{decide}~1 and no cadence win; crushing
+    # that kernel is ROADMAP item 1, not this plane's job.
+    actions = (
+        '"reclaim, allocate, backfill, preempt"'
+        if os.environ.get("BENCH_PIPE_ACTIONS", "") == "full"
+        else '"allocate, backfill"'
+    )
+    conf = load_conf(
+        f"actions: {actions}\n"
+        "tiers:\n"
+        "- plugins:\n  - name: priority\n  - name: gang\n"
+        "- plugins:\n  - name: drf\n  - name: predicates\n  - name: proportion\n"
+    )
+    rungs = []
+    for part in os.environ.get("BENCH_PIPE_RUNGS", "5000x500,50000x5000").split(","):
+        t, n = part.strip().lower().split("x")
+        rungs.append((int(t), int(n)))
+    cycles = int(os.environ.get("BENCH_PIPE_CYCLES", 8))
+    churn_frac = float(os.environ.get("BENCH_PIPE_CHURN", 0.04))
+    # default 512 namespace-queues (the ladder's q512 shape): the
+    # per-queue water-fill makes decide comparable to host-side pack
+    # maintenance, which is the regime the overlap is for
+    queues = int(os.environ.get("BENCH_PIPE_QUEUES", 512))
+    node_milli = int(os.environ.get("BENCH_PIPE_NODE_MILLI", 16000))
+    rows = []
+    for T, N in rungs:
+        seq = _pipe_leg("sequential", T, N, cycles, churn_frac, conf, queues, node_milli)
+        arena = _pipe_leg("arena", T, N, cycles, churn_frac, conf, queues, node_milli)
+        pipe = _pipe_leg("pipelined", T, N, cycles, churn_frac, conf, queues, node_milli)
+        pp = pipe["period_ms"] or 1.0
+        row = {
+            "metric": f"pipeline_cadence_q{queues}@{T}x{N}",
+            # the headline: pipelined effective period vs the strictly
+            # sequential Session loop's sum(stages) (full rebuild per
+            # cycle — the kube-batch posture the plane replaces)
+            "value": round(seq["stage_sum_ms"] / pp, 2),
+            "unit": "x",
+            # the strictest comparison: sequential WITH the incremental
+            # arena already on — what overlap alone buys on this host.
+            # On a 2-core CPU box the freeze->decide->commit data chain
+            # bounds this near 1; accelerator hosts (decide off the host
+            # CPU) are the posture the plane targets.
+            "speedup_vs_arena_stage_sum": round(arena["stage_sum_ms"] / pp, 2),
+            "speedup_vs_arena_wall": round(arena["period_ms"] / pp, 2),
+            "cycles": cycles,
+            "churn_frac": churn_frac,
+            "sequential_full_rebuild": seq,
+            "sequential_arena": arena,
+            "pipelined": pipe,
+            "provenance": "median cycle period of each leg on identical churn streams",
+        }
+        rows.append(row)
+        _emit(row, stream=sys.stderr)
+        _spill(row)  # wedge insurance: completed rungs survive a SIGKILL
+    summary = {
+        "metric": "pipeline_cadence",
+        "value": rows[-1]["value"] if rows else None,
+        "unit": "x",
+        "note": "pipelined effective period vs strictly-sequential sum(stages), last rung",
+        "rungs": rows,
+        "devices": _device_desc(),
+    }
+    _emit(summary)
+    # the parent wrapper (when active) reprints the contract line from
+    # the spill, so a wedge after this point still yields it
+    _spill({"primary": summary, "final": True})
+    return 0
 
 
 def _parent_main() -> int:

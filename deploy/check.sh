@@ -121,11 +121,54 @@ if [ "${CHAOS:-0}" = "1" ]; then
   fi
 fi
 
+# PIPE_SMOKE=1: the pipelined cycle plane — a 20-cycle pipelined run over
+# a churning sim through the real run_pipelined loop, the decision-
+# equivalence + revalidation-gate suite, the chaos pipeline profile, and
+# kat-lint KAT-LCK/KAT-DTY over the threaded modules (the executor's
+# worker + the stage-split scheduler surface).
+rc_pipe=0
+if [ "${PIPE_SMOKE:-0}" = "1" ]; then
+  env JAX_PLATFORMS=cpu python - <<'EOF' || rc_pipe=$?
+from kube_arbitrator_tpu.cache.sim import generate_cluster
+from kube_arbitrator_tpu.framework import Scheduler
+
+sim = generate_cluster(num_nodes=16, num_jobs=8, tasks_per_job=6,
+                       num_queues=2, seed=3, running_fraction=0.3)
+sched = Scheduler(sim, arena=True)
+cycles = sched.run_pipelined(max_cycles=20, until_idle=False)
+assert cycles == 20, cycles
+binds = sum(s.binds for s in sched.history)
+assert binds > 0, "pipelined run placed nothing"
+print(f"pipe smoke: {cycles} pipelined cycles, {binds} binds")
+EOF
+  env JAX_PLATFORMS=cpu python -m pytest -q tests/test_pipeline.py || rc_pipe=$?
+  # 8-seed chaos matrix through the speculation window: watch mangling /
+  # lease steals landing while frozen epochs are in flight must leave
+  # every invariant intact (exit nonzero on any breach)
+  for seed in 0 1 2 3 4 5 6 7; do
+    env JAX_PLATFORMS=cpu python -m kube_arbitrator_tpu.chaos \
+      --seed "${seed}" --cycles 8 --profile pipeline --out-dir /tmp \
+      || rc_pipe=$?
+  done
+  python -m kube_arbitrator_tpu.analysis --rules KAT-LCK,KAT-DTY \
+    kube_arbitrator_tpu/pipeline/executor.py \
+    kube_arbitrator_tpu/pipeline/journal.py \
+    kube_arbitrator_tpu/pipeline/revalidate.py \
+    kube_arbitrator_tpu/framework/scheduler.py \
+    kube_arbitrator_tpu/framework/session.py || rc_pipe=$?
+  if [ "${rc_pipe}" -ne 0 ]; then
+    echo "pipe smoke job: FAILED (exit ${rc_pipe})" >&2
+  else
+    echo "pipe smoke job: ok (20-cycle run + equivalence suite + kat-lint)"
+  fi
+fi
+
 if [ "${LINT_ONLY:-0}" = "1" ]; then
   if [ "${rc_lint}" -ne 0 ]; then exit "${rc_lint}"; fi
   if [ "${rc_obs}" -ne 0 ]; then exit "${rc_obs}"; fi
   if [ "${rc_arena}" -ne 0 ]; then exit "${rc_arena}"; fi
-  exit "${rc_chaos}"
+  if [ "${rc_chaos}" -ne 0 ]; then exit "${rc_chaos}"; fi
+  exit "${rc_pipe}"
 fi
 
 rc_test=0
@@ -140,4 +183,5 @@ if [ "${rc_lint}" -ne 0 ]; then exit "${rc_lint}"; fi
 if [ "${rc_obs}" -ne 0 ]; then exit "${rc_obs}"; fi
 if [ "${rc_arena}" -ne 0 ]; then exit "${rc_arena}"; fi
 if [ "${rc_chaos}" -ne 0 ]; then exit "${rc_chaos}"; fi
+if [ "${rc_pipe}" -ne 0 ]; then exit "${rc_pipe}"; fi
 exit "${rc_test}"

@@ -49,6 +49,19 @@ binds/evicts plus arrivals, so this module keeps the pack ALIVE:
 Metrics: ``snapshot_delta_rows`` (gauge, rows refreshed by the last
 pack), ``snapshot_full_rebuilds_total{reason=...}``,
 ``device_upload_bytes_total{mode=full|delta}``.
+
+**The double buffer** (the pipelined cycle plane builds on it): the
+working arenas ``_w`` are the INGEST buffer — mutated in place as deltas
+drain — while ``_shipped`` holds the FROZEN buffer, the fresh copies the
+last :meth:`snapshot` handed to consumers.  ``snapshot()`` IS the
+freeze/swap: it drains pending dirt into ``_w``, copies into a new
+``_shipped``, and advances the epoch — so a decision program can run on
+a frozen pack while the next epoch ingests underneath it.  When a
+:class:`pipeline.journal.DeltaJournal` is attached (``arena.journal``),
+every delta-sink call is ALSO teed into it unconditionally (even while
+the arena is already structurally dirty): the journal is the record of
+what changed inside the current speculation window, which the pipelined
+executor's commit gate checks speculative decisions against.
 """
 from __future__ import annotations
 
@@ -247,6 +260,11 @@ class SnapshotArena:
         backend.delta_sink = self
         self.uid = uuid.uuid4().hex[:8]
         self.epoch = 0
+        # speculation-window tee (pipeline plane): when attached, every
+        # sink call below is mirrored into the journal BEFORE the arena's
+        # own guards — the commit gate needs deltas even when the arena
+        # is already marked structural.  None costs one attribute read.
+        self.journal = None
         self.pack_meta: Optional[PackMeta] = None
         self.last_rebuild_reason: Optional[str] = None
         self.last_delta_rows = 0
@@ -295,18 +313,24 @@ class SnapshotArena:
         :meth:`structural` — but the pack-time guards catch a mis-filed
         one and fall back, so a conservative extra call here is always
         safe."""
+        if self.journal is not None:
+            self.journal.task_dirty(uid, node_name)
         if self._structural is None:
             self._dirty_tasks.add(uid)
             if node_name:
                 self._dirty_nodes.add(node_name)
 
     def node_dirty(self, name: str) -> None:
+        if self.journal is not None:
+            self.journal.node_dirty(name)
         if self._structural is None:
             self._dirty_nodes.add(name)
 
     def structural(self, reason: str) -> None:
         """Set membership or an equivalence-class universe changed; the
         next pack rebuilds from scratch.  First reason wins (metrics)."""
+        if self.journal is not None:
+            self.journal.structural_event(reason)
         if self._structural is None:
             self._structural = reason
             self._dirty_tasks.clear()

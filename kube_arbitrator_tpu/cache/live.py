@@ -351,6 +351,10 @@ class LiveCache:
         # in-place pod/node churn, structural events for set membership
         # changes the arena cannot patch.  None = no arena.
         self.delta_sink = None
+        # delta delivery callback: called with the applied event count
+        # after every sync() that applied any — the hook idle waiters and
+        # the pipelined executor's ingest observability ride on.
+        self.on_events = None
 
     # ---- informer pump ----
 
@@ -420,6 +424,8 @@ class LiveCache:
             self._watch_rv = max(self._watch_rv, first_rv or 0)
             self._listed = True
             m.counter_add("cache_watch_events_total", n, labels={"phase": "list"})
+            if n and self.on_events is not None:
+                self.on_events(n)
             return n
         try:
             events = self.api.watch_all(self._watch_rv)
@@ -439,7 +445,34 @@ class LiveCache:
             self._watch_rv = rv
             n += 1
         m.counter_add("cache_watch_events_total", n, labels={"phase": "watch"})
+        if n and self.on_events is not None:
+            self.on_events(n)
         return n
+
+    def event_waiter(
+        self,
+        timeout_s: float = 30.0,
+        poll_s: float = 0.5,
+        sleep_fn=None,
+    ):
+        """Build a ``Scheduler.wait_for_event`` seam fed by watch
+        delivery: the returned callable pumps :meth:`sync` until the
+        apiserver delivers at least one event (True — keep scheduling)
+        or ``timeout_s`` of model time elapses (False — exit the loop).
+        ``sleep_fn`` is injectable (chaos/tests hand a virtual clock's
+        sleep); the watches being pull-based, waiting IS polling."""
+        sleep = sleep_fn or _time.sleep
+
+        def wait() -> bool:
+            deadline = self._now() + timeout_s
+            while True:
+                if self.sync() > 0:
+                    return True
+                if self._now() >= deadline:
+                    return False
+                sleep(poll_s)
+
+        return wait
 
     def _dispatch(self, resource: str, etype: str, obj: dict) -> None:
         handler = {

@@ -106,11 +106,15 @@ class FakeVolumeBinder:
     # wired by SimCluster so the re-checks can read live cluster state
     sim: Optional["SimCluster"] = None
 
-    def allocate_volumes(self, task_uid: str, node_name: str) -> None:
+    def allocate_volumes(self, task_uid: str, node_name: str, task=None) -> None:
         if task_uid in self.fail_allocate_uids:
             raise BindFailure(f"volume allocate {task_uid} failed")
         if self.sim is not None:
-            task = self.sim.cluster.task_by_uid(task_uid)
+            # the caller (apply_binds) already resolved the task from its
+            # batch index; task_by_uid is an O(jobs) scan per call and at
+            # bench scale the per-bind scans dominated actuation
+            if task is None:
+                task = self.sim.cluster.task_by_uid(task_uid)
             node = self.sim.cluster.nodes.get(node_name)
             if task is not None and node is not None:
                 zone = node.labels.get(ZONE_LABEL, "")
@@ -372,6 +376,8 @@ class SimCluster:
         accounting), then per task BindVolumes + Bind (session.go:295-316).
         Backend failures divert the task to the resync FIFO instead of
         raising (cache.go:437-444)."""
+        if not binds:
+            return  # skip the O(cluster) index build on idle cycles
         index = self._task_index()
         by_job: Dict[str, List[BindIntent]] = {}
         for b in binds:
@@ -382,7 +388,9 @@ class SimCluster:
         for job_uid, job_binds in by_job.items():
             try:
                 for b in job_binds:
-                    self.volume_binder.allocate_volumes(b.task_uid, b.node_name)
+                    self.volume_binder.allocate_volumes(
+                        b.task_uid, b.node_name, task=index[b.task_uid]
+                    )
             except BindFailure as err:
                 for b in job_binds:
                     self._defer_resync(b.task_uid, "AllocateVolumes", str(err))
@@ -406,6 +414,8 @@ class SimCluster:
 
     def apply_evicts(self, evicts: Sequence[EvictIntent]) -> None:
         """Evict: running task -> Releasing on its node (cache.go:369-405)."""
+        if not evicts:
+            return
         index = self._task_index()
         for e in evicts:
             task = index.get(e.task_uid)

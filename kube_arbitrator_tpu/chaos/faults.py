@@ -112,6 +112,14 @@ class FaultInjector:
         ]
 
 
+def _event_obj_key(event) -> tuple:
+    """Identity of the object a watch event is about: (resource, ns,
+    name) — the granularity a real watch orders monotonically."""
+    _rv, resource, _etype, obj = event
+    md = obj.get("metadata", {})
+    return (resource, md.get("namespace", ""), md.get("name", ""))
+
+
 class ChaosApiServer(FakeApiServer):
     """FakeApiServer whose actuation verbs and watch stream fault on
     command.  Conflict faults reject WITHOUT applying; timeout faults
@@ -183,10 +191,23 @@ class ChaosApiServer(FakeApiServer):
                 i = int(spec.param("index", 0)) % len(events)
                 events = events[: i + 1] + [events[i]] + events[i + 1:]
             if len(events) >= 2:
-                spec = self._injector.take("watch_reorder")
+                spec = self._injector.peek("watch_reorder")
                 if spec is not None:
-                    j = int(spec.param("index", 0)) % (len(events) - 1)
-                    events[j], events[j + 1] = events[j + 1], events[j]
+                    # Reorder models the CROSS-informer race (independent
+                    # per-resource watch goroutines drain out of global
+                    # order); a real watch stream never inverts one
+                    # object's own event order — per-object rv is
+                    # monotone — so only a different-object adjacent pair
+                    # may swap.  Scan from the seeded index; a batch of
+                    # same-object runs only leaves the fault un-delivered
+                    # (peek/consume: no-op faults never enter the repro).
+                    j0 = int(spec.param("index", 0)) % (len(events) - 1)
+                    for off in range(len(events) - 1):
+                        j = (j0 + off) % (len(events) - 1)
+                        if _event_obj_key(events[j]) != _event_obj_key(events[j + 1]):
+                            self._injector.consume(spec)
+                            events[j], events[j + 1] = events[j + 1], events[j]
+                            break
         return events
 
 

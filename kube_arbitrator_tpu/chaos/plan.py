@@ -110,6 +110,11 @@ class ChaosProfile:
     arena: bool = True
     verify_every: int = 2
     drain_cycles: int = 4
+    # run the loop through the pipelined executor (deterministic mode):
+    # faults land inside the speculation window — watch mangling arrives
+    # while a frozen epoch's decide is in flight, so the commit gate's
+    # revalidate-or-discard (not just the arena) carries correctness
+    pipeline: bool = False
     # fault kind -> per-cycle injection probability
     rates: Tuple[Tuple[str, float], ...] = ()
 
@@ -174,6 +179,25 @@ PROFILES: Dict[str, ChaosProfile] = {
     "arena": ChaosProfile(
         name="arena", verify_every=1,
         rates=(("arena_corrupt", 0.5),),
+    ),
+    # the speculation window: pipelined executor + watch mangling landing
+    # mid-decide, plus lease steals exercising the fence inside the
+    # overlapped commit path (runner drives PipelinedExecutor.step)
+    "pipeline": ChaosProfile(
+        name="pipeline", nodes=10, jobs=8, tasks_per_job=5, queues=2,
+        oversubscribe=1.6, pipeline=True,
+        rates=(
+            ("api_conflict", 0.25),
+            ("api_timeout", 0.20),
+            ("api_latency", 0.20),
+            ("watch_dup", 0.35),
+            ("watch_reorder", 0.30),
+            ("watch_truncate", 0.30),
+            ("watch_compact", 0.15),
+            ("rpc_fail", 0.15),
+            ("rpc_deadline", 0.05),
+            ("lease_steal", 0.15),
+        ),
     ),
 }
 

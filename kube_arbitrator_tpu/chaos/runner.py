@@ -227,6 +227,16 @@ def run_chaos(
     )
     if not elector.acquire_blocking(timeout_s=120.0):
         raise RuntimeError("chaos: initial leader acquisition failed")
+    executor = None
+    if prof.pipeline:
+        # the speculation-window testbed: cycles run through the
+        # pipelined executor in DETERMINISTIC mode (exactly one ingest
+        # pump per decide window, before the worker starts), so the event
+        # stream — and the digests below — stay a pure function of the
+        # plan while watch faults land inside the in-flight window
+        from ..pipeline import PipelinedExecutor
+
+        executor = PipelinedExecutor(sched, deterministic=True)
     checker = InvariantChecker()
     outcomes: List[str] = []
     digests: List[str] = []
@@ -238,6 +248,34 @@ def run_chaos(
         metrics().counter_add("chaos_detections_total", labels={"kind": kind})
 
     total = cycles + prof.drain_cycles
+    try:
+        _run_cycles(
+            total, cycles, injector, arena, clock, api, elector, sched,
+            executor, cache, checker, detect, outcomes, digests, breaches,
+        )
+    finally:
+        if executor is not None:
+            # the final in-flight epoch is speculative and never commits;
+            # close on EVERY path (an escaped fatal must not leak the
+            # decide worker or leave the journal teed into the arena)
+            executor.close()
+    breaches += checker.final(api, cache, total)
+    report = ChaosReport(
+        seed=seed, profile=prof, cycles=cycles, disabled=disabled, plan=plan,
+        injected=list(injector.injected), outcomes=outcomes, digests=digests,
+        detections=detections, breaches=breaches,
+    )
+    if out_dir and report.breaches:
+        report.write(
+            os.path.join(out_dir, f"chaos-repro-{prof.name}-{seed}.json")
+        )
+    return report
+
+
+def _run_cycles(
+    total, cycles, injector, arena, clock, api, elector, sched, executor,
+    cache, checker, detect, outcomes, digests, breaches,
+) -> None:
     for cycle in range(total):
         injector.begin_cycle(cycle)
         if cycle >= cycles:
@@ -257,7 +295,10 @@ def run_chaos(
                     "chaos: could not re-acquire leadership after fence"
                 )
         try:
-            sched.run_once()
+            if executor is not None:
+                executor.step()
+            else:
+                sched.run_once()
         except LeaderLost:
             fenced = True
             outcome = "fenced"
@@ -287,17 +328,6 @@ def run_chaos(
         breaches += checker.after_cycle(api, cache, cycle, events, fenced=fenced)
         outcomes.append(outcome)
         digests.append(_digest(cycle, outcome, events))
-    breaches += checker.final(api, cache, total)
-    report = ChaosReport(
-        seed=seed, profile=prof, cycles=cycles, disabled=disabled, plan=plan,
-        injected=list(injector.injected), outcomes=outcomes, digests=digests,
-        detections=detections, breaches=breaches,
-    )
-    if out_dir and report.breaches:
-        report.write(
-            os.path.join(out_dir, f"chaos-repro-{prof.name}-{seed}.json")
-        )
-    return report
 
 
 def _print_summary(report: ChaosReport, as_json: bool, repro_path: Optional[str]) -> None:

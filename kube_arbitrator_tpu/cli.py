@@ -132,6 +132,23 @@ def build_parser() -> argparse.ArgumentParser:
         "FakeApiServer.dump_stream) through the live-cluster plane instead "
         "of the simulator",
     )
+    # pipelined cycle plane (kube_arbitrator_tpu/pipeline)
+    p.add_argument(
+        "--pipeline",
+        action="store_true",
+        help="run cycles as an overlapped pipeline: the decision program "
+        "for one epoch runs on a worker thread while the next epoch "
+        "ingests watch deltas, with commit-time revalidation dropping "
+        "decisions that conflict with mid-flight changes (implies --arena)",
+    )
+    p.add_argument(
+        "--pipeline-ingest-cap",
+        type=int,
+        default=64,
+        metavar="N",
+        help="with --pipeline: watch pumps allowed per in-flight decide "
+        "before ingest blocks (backpressure; default 64)",
+    )
     # incremental snapshot plane (cache/arena.py)
     p.add_argument(
         "--arena",
@@ -315,7 +332,7 @@ def main(argv=None) -> int:
             identity=opts.scheduler_name,
         )
     arena = None
-    if args.arena:
+    if args.arena or args.pipeline:
         from .cache.arena import SnapshotArena
 
         arena = SnapshotArena(sim, verify_every=args.arena_verify_every)
@@ -352,7 +369,13 @@ def main(argv=None) -> int:
 
     obs_server = _serve_obs(status_fn=scheduler_status_fn(sched))
     try:
-        cycles = sched.run(max_cycles=args.cycles)
+        if args.pipeline:
+            cycles = sched.run_pipelined(
+                max_cycles=args.cycles,
+                max_ingest_per_wait=args.pipeline_ingest_cap,
+            )
+        else:
+            cycles = sched.run(max_cycles=args.cycles)
     finally:
         if obs_server is not None:
             obs_server.shutdown()

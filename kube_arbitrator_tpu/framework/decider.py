@@ -48,7 +48,6 @@ class LocalDecider:
             int(st.task_valid.shape[0]), config.actions, st.task_status
         )
         tr = tracer()
-        self.last_action_ms = {}
         t0 = time.perf_counter()
         if tr.enabled and tr.current_corr_id() is not None:
             with ctx:
@@ -56,10 +55,18 @@ class LocalDecider:
                     st, tiers=config.tiers, actions=config.actions,
                     native_ops=native_ops,
                 )
+            # built locally, published in ONE reference assignment: a
+            # concurrent reader (another loop sharing this decider — e.g.
+            # a pipelined executor's in-flight worker next to a
+            # sequential loop on the cached default) sees either the
+            # previous complete dict or this one, never a dict mid-fill
+            action_ms = {}
             for stage, ts, ms in stages:
-                self.last_action_ms[stage] = ms
+                action_ms[stage] = ms
                 tr.record_span(f"kernel.{stage}", ts, ms / 1000)
+            self.last_action_ms = action_ms
             return dec, (time.perf_counter() - t0) * 1000
+        self.last_action_ms = {}
         with ctx:
             dec = schedule_cycle(
                 st, tiers=config.tiers, actions=config.actions,

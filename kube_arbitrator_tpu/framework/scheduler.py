@@ -97,6 +97,7 @@ class Scheduler:
         arena=None,
         phase_hook=None,
         max_cycle_retries: int = 8,
+        wait_for_event=None,
     ):
         # conf is re-loadable per Run like the reference (scheduler.go:66-78)
         self.sim = sim
@@ -107,7 +108,16 @@ class Scheduler:
         # SURVEY §5: JAX profiler hook — when set, cycles run under
         # jax.profiler.trace and emit a TensorBoard-readable trace
         self.profile_dir = profile_dir
-        # None = in-process; a rpc.RemoteDecider runs cycles on a sidecar
+        # None = in-process; a rpc.RemoteDecider runs cycles on a sidecar.
+        # The default is materialized PER SCHEDULER (not the module-level
+        # cached default): two loops in one process (a pipelined executor
+        # whose in-flight decide outlives step() next to a sequential
+        # loop) must not share one decider's timing scratch.  Back-to-
+        # back cycles of THIS loop still reuse one routing/jit identity.
+        if decider is None:
+            from .decider import LocalDecider
+
+            decider = LocalDecider()
         self.decider = decider
         # cache.persist.TraceRecorder: records every cycle's snapshot
         self.trace_recorder = trace_recorder
@@ -132,6 +142,11 @@ class Scheduler:
         # loop escalates (a persistently failing environment is not
         # something spinning forever will fix)
         self.max_cycle_retries = max_cycle_retries
+        # until_idle seam: a no-progress cycle calls this instead of
+        # exiting; True = an event arrived, keep scheduling, False =
+        # timed out, exit.  LiveCache.event_waiter() builds one fed by
+        # watch delivery; None keeps the sim behavior (stop when idle).
+        self.wait_for_event = wait_for_event
         self._consecutive_cycle_errors = 0
         self.job_status: Dict[str, PodGroupStatus] = {}
         self.history: List[CycleStats] = []
@@ -160,31 +175,39 @@ class Scheduler:
                 self._flight_failure(corr or "", cycle_ts, err)
                 raise
         self.last_cycle_ts = time.time()
-        stats = self.history[-1]
-        if self.flight is not None:
-            self.flight.record(
-                CycleRecord(
-                    seq=self._cycle_seq,
-                    corr_id=corr or "",
-                    ts=cycle_ts,
-                    stats=dataclasses.asdict(stats),
-                    digests={
-                        "binds": stats.binds,
-                        "evicts": stats.evicts,
-                        "pending_before": stats.pending_before,
-                        "pending_per_job": dict(self._last_pending_hist),
-                        "action_ms": dict(result.action_ms),
-                    },
-                    spans=[s.to_dict() for s in tr.spans(corr)] if corr else [],
-                )
-            )
-            if self.cycle_slo_ms is not None and stats.cycle_ms > self.cycle_slo_ms:
-                self.flight.anomaly(
-                    "slo_breach",
-                    detail=f"cycle {self._cycle_seq} took {stats.cycle_ms:.1f} ms "
-                    f"(SLO {self.cycle_slo_ms:g} ms)",
-                )
+        self._flight_success(self._cycle_seq, corr, cycle_ts, self.history[-1], result)
         return result
+
+    def _flight_success(
+        self, seq: int, corr: Optional[str], cycle_ts: float,
+        stats: CycleStats, result: CycleResult,
+    ) -> None:
+        """Record a completed cycle in the flight ring (+ the SLO-breach
+        anomaly check) — shared by run_once and the pipelined executor."""
+        if self.flight is None:
+            return
+        self.flight.record(
+            CycleRecord(
+                seq=seq,
+                corr_id=corr or "",
+                ts=cycle_ts,
+                stats=dataclasses.asdict(stats),
+                digests={
+                    "binds": stats.binds,
+                    "evicts": stats.evicts,
+                    "pending_before": stats.pending_before,
+                    "pending_per_job": dict(self._last_pending_hist),
+                    "action_ms": dict(result.action_ms),
+                },
+                spans=[s.to_dict() for s in tracer().spans(corr)] if corr else [],
+            )
+        )
+        if self.cycle_slo_ms is not None and stats.cycle_ms > self.cycle_slo_ms:
+            self.flight.anomaly(
+                "slo_breach",
+                detail=f"cycle {seq} took {stats.cycle_ms:.1f} ms "
+                f"(SLO {self.cycle_slo_ms:g} ms)",
+            )
 
     def _flight_failure(self, corr: str, cycle_ts: float, err: BaseException) -> None:
         """A cycle died: append the failing cycle to the ring (its spans
@@ -229,40 +252,59 @@ class Scheduler:
                 hist[">=100"] += 1
         return hist
 
-    def _run_once_inner(self) -> CycleResult:
-        tr = tracer()
-        t0 = time.perf_counter()
-        # steady-state maintenance that runs as goroutines in the reference:
-        # errTasks resync (cache.go:519-547) and deferred job GC (:476-517)
-        with tr.span("resync"):
+    def _pre_cycle(self, census: bool = True) -> Optional[int]:
+        """Cycle-start maintenance + pending census; returns the pending
+        count (None when ``census=False``).  Runs as goroutines in the
+        reference: errTasks resync (cache.go:519-547) and deferred job GC
+        (:476-517).  Arena cycles skip the live-object census — an
+        O(tasks) walk, ~25 ms at the 50k rung — and derive the same
+        numbers from the pack via :meth:`_pending_from_snapshot`."""
+        with tracer().span("resync"):
             self.sim.process_resync()
             self.sim.collect_garbage()
+        if not census:
+            return None
         per_job_pending = [
             len(j.pending_tasks()) for j in self.sim.cluster.jobs.values()
         ]
-        pending = sum(per_job_pending)
         self._last_pending_hist = self._pending_histogram(per_job_pending)
-        session = Session(
-            self.sim.cluster, self.config, decider=self.decider,
-            arena=self.arena, phase_hook=self.phase_hook,
+        return sum(per_job_pending)
+
+    def _pending_from_snapshot(self, snap) -> int:
+        """Pending census from the freshly built pack (vectorized twin of
+        the live-object walk; the pack holds the same state the cycle
+        decides from).  Also refreshes the flight recorder's per-job
+        pending histogram."""
+        import numpy as np
+
+        from ..api.types import TaskStatus
+
+        n_real = len(snap.index.tasks)
+        ts = np.asarray(snap.tensors.task_status)[:n_real]
+        tj = np.asarray(snap.tensors.task_job)[:n_real]
+        pending_rows = ts == int(TaskStatus.PENDING)
+        per_job = np.bincount(
+            tj[pending_rows], minlength=len(snap.index.jobs)
         )
-        result = session.run()
-        if self.trace_recorder is not None:
-            self.trace_recorder.record(result.snapshot.tensors)
-        t1 = time.perf_counter()
-        # Actuation fence: the decision program can hang past the lease
-        # deadline (observed: wedged accelerator tunnel stalls a cycle for
-        # minutes), during which a standby legitimately takes over — the
-        # run() loop's renew() happens BEFORE the cycle, so without this
-        # gate the unwedged ex-leader would still apply its stale
-        # binds/evicts once.  The clock-only check can FALSE-POSITIVE on a
-        # slow-but-healthy cycle in the (renew_deadline, lease_duration]
-        # window (no standby can have usurped yet), so a stale-looking
-        # lease gets one storage-backed re-validation — the record still
-        # naming us + a successful CAS renew means actuation is safe.
-        # Only a failed re-validation discards the cycle (the reference
-        # has the same decide/actuate race; its safety net is the
-        # apiserver's optimistic concurrency on the bind subresource).
+        self._last_pending_hist = self._pending_histogram(
+            [int(x) for x in per_job]
+        )
+        return int(pending_rows.sum())
+
+    def _commit_fence(self, n_binds: int, n_evicts: int) -> None:
+        """Actuation fence: the decision program can hang past the lease
+        deadline (observed: wedged accelerator tunnel stalls a cycle for
+        minutes), during which a standby legitimately takes over — the
+        run() loop's renew() happens BEFORE the cycle, so without this
+        gate the unwedged ex-leader would still apply its stale
+        binds/evicts once.  The clock-only check can FALSE-POSITIVE on a
+        slow-but-healthy cycle in the (renew_deadline, lease_duration]
+        window (no standby can have usurped yet), so a stale-looking
+        lease gets one storage-backed re-validation — the record still
+        naming us + a successful CAS renew means actuation is safe.
+        Only a failed re-validation discards the cycle (the reference
+        has the same decide/actuate race; its safety net is the
+        apiserver's optimistic concurrency on the bind subresource)."""
         if self.phase_hook is not None:
             self.phase_hook("commit")
         if self.elector is not None and not self.elector.lease_fresh():
@@ -275,12 +317,21 @@ class Scheduler:
             if not ok:
                 raise LeaderLost(
                     f"lease stale after decision phase; discarding cycle "
-                    f"({len(result.binds)} binds, {len(result.evicts)} evicts "
+                    f"({n_binds} binds, {n_evicts} evicts "
                     f"not actuated) — holder {self.elector.identity}"
                 )
-        with tr.span("actuate", binds=len(result.binds), evicts=len(result.evicts)):
-            self.sim.apply_binds(result.binds)
-            self.sim.apply_evicts(result.evicts)
+
+    def _actuate(self, binds, evicts) -> None:
+        with tracer().span("actuate", binds=len(binds), evicts=len(evicts)):
+            self.sim.apply_binds(binds)
+            self.sim.apply_evicts(evicts)
+
+    def _write_back(self, result: CycleResult, task_conditions=None) -> None:
+        """Close-side status/condition/event write-back (the reference's
+        closeSession -> cache.UpdateJobStatus path).  ``task_conditions``
+        accepts a precomputed explain_pending_tasks result — a pure
+        function of (snapshot, decisions) the pipelined executor derives
+        on its decide worker so the ingest thread doesn't stall on it."""
         self.job_status.update(result.job_status)  # cache.UpdateJobStatus equivalent
         # live backends PUT the PodGroup status back to the apiserver
         # (closeSession -> cache.UpdateJobStatus, session.go:130-144)
@@ -291,11 +342,13 @@ class Scheduler:
         # computed only when the backend consumes them, so the close path
         # of condition-less runs (bench, raw kernels) stays bounded
         if hasattr(self.sim, "update_pod_condition"):
-            from ..ops.diagnostics import explain_pending_tasks
+            if task_conditions is None:
+                from ..ops.diagnostics import explain_pending_tasks
 
-            result.task_conditions = explain_pending_tasks(
-                result.snapshot, result.decisions
-            )
+                task_conditions = explain_pending_tasks(
+                    result.snapshot, result.decisions
+                )
+            result.task_conditions = task_conditions
             for uid, msg in result.task_conditions.items():
                 self.sim.update_pod_condition(uid, msg)
         # user-facing Unschedulable events (cache.go:637-662 parity),
@@ -306,6 +359,23 @@ class Scheduler:
                 if self._last_event_msg.get(key) != cond.message:
                     self._last_event_msg[key] = cond.message
                     self.sim.record_event("Unschedulable", uid, cond.reason, cond.message)
+
+    def _run_once_inner(self) -> CycleResult:
+        t0 = time.perf_counter()
+        pending = self._pre_cycle(census=self.arena is None)
+        session = Session(
+            self.sim.cluster, self.config, decider=self.decider,
+            arena=self.arena, phase_hook=self.phase_hook,
+        )
+        result = session.run()
+        if pending is None:  # arena cycle: census from the pack instead
+            pending = self._pending_from_snapshot(result.snapshot)
+        if self.trace_recorder is not None:
+            self.trace_recorder.record(result.snapshot.tensors)
+        t1 = time.perf_counter()
+        self._commit_fence(len(result.binds), len(result.evicts))
+        self._actuate(result.binds, result.evicts)
+        self._write_back(result)
         t2 = time.perf_counter()
         stats = CycleStats(
             cycle_ms=(t2 - t0) * 1000,
@@ -352,20 +422,15 @@ class Scheduler:
         m.counter_add("evicts_total", s.evicts)
         m.gauge_set("pending_tasks", s.pending_before)
 
-    def run(self, max_cycles: int = 0, until_idle: bool = True) -> int:
-        """Run cycles at the configured cadence (in sim: back-to-back).
-        Stops after max_cycles (0 = unlimited) or when a cycle makes no
-        progress and nothing is pending.
-
-        Cycle errors are classified (:func:`classify_cycle_error`):
-        retryable ones (RPC deadline, apiserver conflict, lease-storage
-        blip) are swallowed — the failed cycle counts, the loop moves on —
-        up to ``max_cycle_retries`` CONSECUTIVE failures; fatal ones
-        (arena divergence, contract/invariant violations, lost
-        leadership) re-raise after run_once's flight-recorder dump."""
+    def _run_loop(self, step_fn, max_cycles: int, until_idle: bool) -> int:
+        """The shared cycle loop behind :meth:`run` and
+        :meth:`run_pipelined` — leader gating, error classification and
+        the consecutive-retry budget, cycle counting, and the idle wait
+        seam are ONE implementation; only the step callable differs.
+        ``step_fn()`` returns anything with ``binds``/``evicts``."""
         if not until_idle and not max_cycles:
             raise ValueError("until_idle=False requires max_cycles > 0")
-        # a fresh run() gets the full retry budget: a supervisor that
+        # a fresh run gets the full retry budget: a supervisor that
         # caught the escalation and resumed must not instantly re-raise
         self._consecutive_cycle_errors = 0
         # only the leader schedules; acquisition blocks like RunOrDie
@@ -384,7 +449,7 @@ class Scheduler:
                     f"leader lease lost by {self.elector.identity}"
                 )
             try:
-                result = self.run_once()
+                result = step_fn()
             except LeaderLost:
                 raise  # leadership is gone; only a supervisor re-acquires
             except Exception as err:
@@ -406,6 +471,49 @@ class Scheduler:
             if max_cycles and cycles >= max_cycles:
                 return cycles
             if until_idle and not result.binds and not result.evicts:
-                # no progress; in a live cluster we'd wait for the next
-                # informer event — in sim, stop instead of spinning
-                return cycles
+                # no progress: with a wait seam (live loops — fed by
+                # LiveCache watch delivery) block for the next event; a
+                # timeout (False) or no seam (sim) stops instead of
+                # spinning
+                if self.wait_for_event is None or not self.wait_for_event():
+                    return cycles
+
+    def run(self, max_cycles: int = 0, until_idle: bool = True) -> int:
+        """Run cycles at the configured cadence (in sim: back-to-back).
+        Stops after max_cycles (0 = unlimited) or when a cycle makes no
+        progress and nothing is pending.
+
+        Cycle errors are classified (:func:`classify_cycle_error`):
+        retryable ones (RPC deadline, apiserver conflict, lease-storage
+        blip) are swallowed — the failed cycle counts, the loop moves on —
+        up to ``max_cycle_retries`` CONSECUTIVE failures; fatal ones
+        (arena divergence, contract/invariant violations, lost
+        leadership) re-raise after run_once's flight-recorder dump."""
+        return self._run_loop(self.run_once, max_cycles, until_idle)
+
+    def run_pipelined(
+        self,
+        max_cycles: int = 0,
+        until_idle: bool = True,
+        deterministic: bool = False,
+        max_ingest_per_wait: int = 64,
+    ) -> int:
+        """The overlapped counterpart of :meth:`run`: cycles execute
+        through the pipelined executor (kube_arbitrator_tpu/pipeline) —
+        the decision program for epoch E runs on a worker thread while
+        this thread ingests watch deltas, commits epoch E-1 through the
+        revalidate-or-discard gate, and freezes epoch E+1.  Same leader
+        gating, retry classification, and idle semantics as :meth:`run`
+        (one shared loop); ``deterministic=True`` pins ingest to one pump
+        per decide window (chaos/replay mode)."""
+        from ..pipeline import PipelinedExecutor
+
+        executor = PipelinedExecutor(
+            self,
+            deterministic=deterministic,
+            max_ingest_per_wait=max_ingest_per_wait,
+        )
+        try:
+            return self._run_loop(executor.step, max_cycles, until_idle)
+        finally:
+            executor.close()

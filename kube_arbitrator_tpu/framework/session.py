@@ -30,6 +30,22 @@ from ..ops.diagnostics import HostView, explain_job
 MAX_EXPLAINED_JOBS = 100
 from .conf import SchedulerConfig
 
+# The process-wide default decider: Sessions constructed without one all
+# share this LocalDecider, so back-to-back cycles keep one routing/jit
+# identity instead of re-resolving per cycle.  Decide calls are
+# sequential per scheduling loop (the pipelined executor's single worker
+# included), so the shared ``last_action_ms`` scratch is race-free.
+_default_decider = None
+
+
+def default_decider():
+    global _default_decider
+    if _default_decider is None:
+        from .decider import LocalDecider
+
+        _default_decider = LocalDecider()
+    return _default_decider
+
 
 def _assert_decision_dtypes(dec: CycleDecisions) -> None:
     """Decisions-side twin of cache/snapshot.py's pack assert: every
@@ -127,60 +143,103 @@ class Session:
         self.phase_hook = phase_hook
         self.uid = str(uuid.uuid4())
 
-    def run(self) -> CycleResult:
+    def _decider(self):
+        return self.decider if self.decider is not None else default_decider()
+
+    # ---- the cycle stages ----
+    #
+    # run() composes them sequentially; the pipelined executor
+    # (kube_arbitrator_tpu/pipeline/executor.py) runs snapshot/upload on
+    # the ingest thread and decide/decode on its worker, so each stage
+    # must be self-contained: span + phase hook inside, timing by caller.
+
+    def snapshot_phase(self) -> Snapshot:
         from ..utils.tracing import tracer
 
-        tr = tracer()
-        decider = self.decider
-        if decider is None:
-            from .decider import LocalDecider
+        with tracer().span("snapshot"):
+            snap = (
+                self.arena.snapshot()
+                if self.arena is not None
+                else build_snapshot(self.cluster)
+            )
+        if self.phase_hook is not None:
+            self.phase_hook("snapshot")
+        return snap
 
-            decider = LocalDecider()
+    def upload_phase(self, snap: Snapshot):
+        """Place the pack where the decider consumes it: (tensors,
+        pack_meta).  Arena + local decider: dirty-range device upload;
+        arena + remote: the epoch-keyed delta descriptor; no arena: the
+        host tensors as built."""
+        from ..utils.tracing import tracer
+
         arena = self.arena
-        hook = self.phase_hook
-        t0 = time.perf_counter()
-        with tr.span("snapshot"):
-            snap = arena.snapshot() if arena is not None else build_snapshot(self.cluster)
-        t1 = time.perf_counter()
-        if hook is not None:
-            hook("snapshot")
         st, pack_meta = snap.tensors, None
         if arena is not None:
-            if getattr(decider, "wants_device_pack", True):
+            if getattr(self._decider(), "wants_device_pack", True):
                 # dirty-range upload onto the routed device; the decider's
                 # own decision_route resolves to the same device, so the
                 # jit consumes the resident buffers without a transfer
-                with tr.span("upload"):
+                with tracer().span("upload"):
                     st = arena.device_pack(self.config.actions)
             else:
                 # remote decider: ship the delta, keyed by arena epoch
                 pack_meta = arena.pack_meta
-            if hook is not None:
-                hook("upload")
-        t_up = time.perf_counter()
-        # kernel_ms is device time in both modes (the sidecar measures its
-        # own); remote transport overhead is the decide-wall minus it
-        with tr.span("decide", tasks=int(snap.tensors.num_tasks)):
+            if self.phase_hook is not None:
+                self.phase_hook("upload")
+        return st, pack_meta
+
+    def decide_phase(self, snap: Snapshot, st, pack_meta):
+        """Run the decision program; returns (decisions, kernel_ms,
+        transport_ms).  kernel_ms is device time in both modes (the
+        sidecar measures its own); transport is the decide-wall minus it
+        (~0 in-process, RPC overhead remote)."""
+        from ..utils.tracing import tracer
+
+        decider = self._decider()
+        t0 = time.perf_counter()
+        with tracer().span("decide", tasks=int(snap.tensors.num_tasks)):
             if pack_meta is not None:
                 dec, kernel_ms = decider.decide(st, self.config, pack_meta=pack_meta)
             else:
                 dec, kernel_ms = decider.decide(st, self.config)
-        t2 = time.perf_counter()
-        if hook is not None:
-            hook("kernel")
+        wall_ms = (time.perf_counter() - t0) * 1000
+        if self.phase_hook is not None:
+            self.phase_hook("kernel")
         # Decisions may have crossed an RPC codec (RemoteDecider): hold
         # them to the same declared contract the producer side asserts
         # (cache/snapshot.py _assert_pack_dtypes) before decoding them
         # into binds/evicts — a drifted dtype here corrupts actuation
         # host-side without raising.
         _assert_decision_dtypes(dec)
-        with tr.span("decode"):
+        return dec, kernel_ms, max(wall_ms - kernel_ms, 0.0)
+
+    def decode_phase(self, snap: Snapshot, dec: CycleDecisions):
+        from ..utils.tracing import tracer
+
+        with tracer().span("decode"):
             binds, evicts = decode_decisions(snap, dec)
+        if self.phase_hook is not None:
+            self.phase_hook("decode")
+        return binds, evicts
+
+    def close_phase(self, snap: Snapshot, dec: CycleDecisions) -> Dict[str, PodGroupStatus]:
+        from ..utils.tracing import tracer
+
+        with tracer().span("close"):
+            return self._close(snap, dec)
+
+    def run(self) -> CycleResult:
+        t0 = time.perf_counter()
+        snap = self.snapshot_phase()
+        t1 = time.perf_counter()
+        st, pack_meta = self.upload_phase(snap)
+        t_up = time.perf_counter()
+        dec, kernel_ms, transport_ms = self.decide_phase(snap, st, pack_meta)
+        t2 = time.perf_counter()
+        binds, evicts = self.decode_phase(snap, dec)
         t3 = time.perf_counter()
-        if hook is not None:
-            hook("decode")
-        with tr.span("close"):
-            job_status = self._close(snap, dec)
+        job_status = self.close_phase(snap, dec)
         t4 = time.perf_counter()
         return CycleResult(
             session_uid=self.uid,
@@ -193,9 +252,11 @@ class Session:
             kernel_ms=kernel_ms,
             decode_ms=(t3 - t2) * 1000,
             close_ms=(t4 - t3) * 1000,
-            transport_ms=max((t2 - t_up) * 1000 - kernel_ms, 0.0),
+            transport_ms=transport_ms,
             upload_ms=(t_up - t1) * 1000,
-            action_ms=dict(getattr(decider, "last_action_ms", None) or {}),
+            action_ms=dict(
+                getattr(self._decider(), "last_action_ms", None) or {}
+            ),
         )
 
     # ---- CloseSession ----
@@ -207,6 +268,30 @@ class Session:
         now = time.time()
         host = None
         explained = 0
+        # Per-job SESSION-status counts, vectorized: one bincount per
+        # status class over the real task rows replaces the per-task
+        # python loop (50k TaskStatus() constructions ≈ 100 ms/cycle at
+        # the 50k rung; this is ~1 ms).  Row o's job IS task_job[o], so
+        # the grouped counts equal the per-job ordinal-walk exactly.
+        n_real = len(snap.index.tasks)
+        n_jobs = len(snap.index.jobs)
+        ts = task_status[:n_real]
+        tj = np.asarray(snap.tensors.task_job)[:n_real]
+
+        def _cnt(mask: np.ndarray) -> np.ndarray:
+            return np.bincount(tj[mask], minlength=n_jobs)
+
+        zeros = np.zeros(n_jobs, dtype=np.int64)
+        if n_real:
+            n_running = _cnt(ts == int(TaskStatus.RUNNING))
+            n_succeeded = _cnt(ts == int(TaskStatus.SUCCEEDED))
+            n_failed = _cnt(ts == int(TaskStatus.FAILED))
+            alloc_vals = np.array(
+                [int(s) for s in TaskStatus if is_allocated_status(s)]
+            )
+            n_allocated = _cnt(np.isin(ts, alloc_vals))
+        else:
+            n_running = n_succeeded = n_failed = n_allocated = zeros
         for job in snap.index.jobs:
             unsched_cond = None
             if not job_ready[job.ordinal] and job.min_available > 0:
@@ -230,37 +315,43 @@ class Session:
                     message=msg,
                     last_transition=now,
                 )
-            statuses[job.uid] = self._job_status(job, unsched_cond, task_status)
+            statuses[job.uid] = self._job_status(
+                job,
+                unsched_cond,
+                running=int(n_running[job.ordinal]),
+                allocated=int(n_allocated[job.ordinal]),
+                succeeded=int(n_succeeded[job.ordinal]),
+                failed=int(n_failed[job.ordinal]),
+            )
         return statuses
 
     def _job_status(
         self,
         job: JobInfo,
         unsched: Optional[PodGroupCondition],
-        task_status: np.ndarray,
+        running: int,
+        allocated: int,
+        succeeded: int,
+        failed: int,
     ) -> PodGroupStatus:
         """session.go:159-197 jobStatus semantics (incl. the strict '>'
         on minMember).  Counts come from the SESSION-side statuses
         (``dec.task_status``): the reference's jobStatus reads the
         session's TaskStatusIndex, which includes this cycle's Allocated/
         Pipelined transitions (ssn.Allocate's UpdateTaskStatus) — not the
-        pre-actuation cache state."""
+        pre-actuation cache state.  ``_close`` computes them vectorized."""
         st = PodGroupStatus()
-        ords = [t.ordinal for t in job.tasks.values() if t.ordinal >= 0]
-        sts = [TaskStatus(int(task_status[o])) for o in ords]
-        n_running = sum(1 for x in sts if x == TaskStatus.RUNNING)
         if unsched is not None:
             st.conditions.append(unsched)
-        if n_running != 0 and unsched is not None:
+        if running != 0 and unsched is not None:
             st.phase = PodGroupPhase.UNKNOWN
         else:
-            allocated = sum(1 for x in sts if is_allocated_status(x))
             st.phase = (
                 PodGroupPhase.RUNNING
                 if allocated > job.min_available
                 else PodGroupPhase.PENDING
             )
-        st.running = n_running
-        st.succeeded = sum(1 for x in sts if x == TaskStatus.SUCCEEDED)
-        st.failed = sum(1 for x in sts if x == TaskStatus.FAILED)
+        st.running = running
+        st.succeeded = succeeded
+        st.failed = failed
         return st

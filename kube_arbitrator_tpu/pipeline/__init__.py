@@ -1,0 +1,29 @@
+"""Pipelined cycle plane: double-buffered arenas, speculative decide,
+commit-time revalidation.
+
+kube-batch's session is strictly sequential — snapshot, kernel, decode,
+commit, repeat — so effective cadence is sum(stages).  This package runs
+the stages as an overlapped pipeline over the incremental snapshot arena
+(cache/arena.py): epoch E ingests watch deltas on the cache thread while
+the decision program runs on the frozen epoch E-1, and every speculative
+decision passes a revalidate-or-discard gate against the deltas that
+arrived mid-flight before it actuates.  Cadence drops toward max(stage).
+
+Entry points: ``Scheduler.run_pipelined`` (framework/scheduler.py), the
+``--pipeline`` CLI flag, ``BENCH_PIPELINE=1 python bench.py`` for the
+cadence comparison, and the chaos ``pipeline`` profile for fault
+injection inside the speculation window.
+"""
+from .executor import PIPELINE_STAGES, PipelinedExecutor, StepOutcome
+from .journal import DeltaJournal
+from .revalidate import DISCARD_REASONS, Discard, revalidate_decisions
+
+__all__ = [
+    "PIPELINE_STAGES",
+    "PipelinedExecutor",
+    "StepOutcome",
+    "DeltaJournal",
+    "DISCARD_REASONS",
+    "Discard",
+    "revalidate_decisions",
+]

@@ -87,7 +87,12 @@ class RemoteDecider:
         self.last_kernel_ms = 0.0
         self.last_roundtrip_ms = 0.0
         # arena pack-reuse: the epoch key of the pack the sidecar last
-        # acknowledged holding (None until a full pack lands)
+        # acknowledged holding (None until a full pack lands).  NOTE on
+        # pipelined use: the pipelined executor calls decide() from its
+        # single worker thread while the ingest thread patches the next
+        # arena epoch — one decide in flight at a time, which is what the
+        # _cycle ordering and this delta-base handshake assume.  The
+        # channel itself is thread-safe.
         self._resident_key = None
 
     def health(self, timeout_s: float = 10.0) -> "pb.HealthReply":

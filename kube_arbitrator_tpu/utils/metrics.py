@@ -53,6 +53,12 @@ METRIC_HELP: Dict[str, str] = {
     "cache_resync_depth": "errTasks resync queue depth at pump time.",
     "cache_snapshot_staleness_seconds": "Age of the live-cache model at the latest sync (gap between pumps).",
     "cache_relists_total": "Full relists forced by a 410-Gone compacted watch window.",
+    # pipelined cycle plane (kube_arbitrator_tpu/pipeline)
+    "pipeline_cycle_period_seconds": "Commit-to-commit effective cycle period of the pipelined executor.",
+    "pipeline_stage_busy_seconds": "Per-step busy time of each pipeline stage (stage label: ingest/freeze/decide/revalidate/actuate/close).",
+    "pipeline_stage_occupancy": "Fraction of the last effective cycle period each stage was busy (stage label).",
+    "pipeline_discards_total": "Speculative decisions dropped by commit-time revalidation (reason label).",
+    "pipeline_backpressure_total": "Decide-wait windows where ingest hit its pump cap and blocked (ingest outran decide).",
     # chaos plane (kube_arbitrator_tpu/chaos)
     "chaos_faults_injected_total": "Faults injected by the chaos plane (kind label).",
     "chaos_invariant_breaches_total": "Cluster-level invariant breaches the chaos plane detected (invariant label).",

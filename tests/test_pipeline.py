@@ -1,0 +1,514 @@
+"""Pipelined cycle plane: equivalence, revalidation, seams, chaos.
+
+The decision-equivalence soak is the plane's acceptance bar: on a
+quiescent delta stream a pipelined run must produce bit-identical
+bind/evict streams to a sequential one, cycle for cycle — overlap buys
+cadence, never different decisions.  The revalidation suite drives every
+discard reason through the commit gate; the executor tests exercise the
+mid-window churn path (the crash a naive pipelined commit would hit),
+backpressure, and the journal tee; the chaos test proves the core
+invariants hold when faults land inside the speculation window.
+"""
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kube_arbitrator_tpu.api.types import TaskStatus
+from kube_arbitrator_tpu.cache.sim import BindIntent, EvictIntent, generate_cluster
+from kube_arbitrator_tpu.framework import Scheduler
+from kube_arbitrator_tpu.framework.conf import load_conf
+from kube_arbitrator_tpu.framework.session import Session, default_decider
+from kube_arbitrator_tpu.options import reset_options
+from kube_arbitrator_tpu.pipeline import (
+    DeltaJournal,
+    PipelinedExecutor,
+    revalidate_decisions,
+)
+from kube_arbitrator_tpu.utils.metrics import metrics
+
+FULL_CONF = (
+    'actions: "reclaim, allocate, backfill, preempt"\n'
+    "tiers:\n"
+    "- plugins:\n"
+    "  - name: priority\n"
+    "  - name: gang\n"
+    "- plugins:\n"
+    "  - name: drf\n"
+    "  - name: predicates\n"
+    "  - name: proportion\n"
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    reset_options()
+    metrics().reset()
+    yield
+    reset_options()
+    metrics().reset()
+
+
+def _mk(seed=7, running=0.4, nodes=12, jobs=8, tpj=5):
+    return generate_cluster(
+        num_nodes=nodes, num_jobs=jobs, tasks_per_job=tpj,
+        num_queues=3, seed=seed, running_fraction=running,
+    )
+
+
+# ---------------------------------------------------------------------------
+# decision equivalence
+
+
+def test_quiescent_equivalence_soak():
+    """Sequential vs pipelined on identical worlds with no external
+    churn: every cycle's bind/evict stream must match exactly (the
+    speculation window is empty, so the gate passes everything and the
+    frozen epochs see exactly the states the sequential loop sees)."""
+    seq = Scheduler(_mk(), config=load_conf(FULL_CONF), arena=True)
+    pipe = Scheduler(_mk(), config=load_conf(FULL_CONF), arena=True)
+    ex = PipelinedExecutor(pipe)
+    try:
+        for cycle in range(15):
+            r = seq.run_once()
+            out = ex.step()
+            assert sorted((b.task_uid, b.node_name) for b in r.binds) == \
+                sorted((b.task_uid, b.node_name) for b in out.binds), cycle
+            assert sorted(e.task_uid for e in r.evicts) == \
+                sorted(e.task_uid for e in out.evicts), cycle
+            assert not out.discards, cycle
+    finally:
+        ex.close()
+    assert len(pipe.history) == 15
+
+
+def test_run_pipelined_until_idle_matches_sequential_totals():
+    seq = Scheduler(_mk(seed=11, running=0.0), arena=True)
+    pipe = Scheduler(_mk(seed=11, running=0.0), arena=True)
+    n_seq = seq.run(max_cycles=6)
+    n_pipe = pipe.run_pipelined(max_cycles=6)
+    assert n_seq == n_pipe
+    assert sum(s.binds for s in seq.history) == sum(s.binds for s in pipe.history)
+
+
+# ---------------------------------------------------------------------------
+# the revalidation gate
+
+
+def _gate_world():
+    sim = _mk(seed=3, running=0.5, nodes=4, jobs=3, tpj=4)
+    index = {
+        uid: t for j in sim.cluster.jobs.values() for uid, t in j.tasks.items()
+    }
+    pending = [t for t in index.values() if t.status == TaskStatus.PENDING]
+    running = [t for t in index.values() if t.status == TaskStatus.RUNNING]
+    assert pending and running
+    return sim, pending, running
+
+
+def test_gate_empty_journal_is_a_no_op():
+    sim, pending, running = _gate_world()
+    binds = [BindIntent(task_uid=pending[0].uid, node_name="node-00000")]
+    evicts = [EvictIntent(task_uid=running[0].uid)]
+    kept_b, kept_e, discards = revalidate_decisions(
+        sim.cluster, binds, evicts, DeltaJournal()
+    )
+    assert kept_b == binds and kept_e == evicts and not discards
+
+
+def test_gate_task_gone():
+    sim, pending, _ = _gate_world()
+    victim = pending[0]
+    j = DeltaJournal()
+    j.task_dirty(victim.uid)
+    sim.cluster.jobs[victim.job_uid].tasks.pop(victim.uid)
+    kept_b, _, discards = revalidate_decisions(
+        sim.cluster,
+        [BindIntent(task_uid=victim.uid, node_name="node-00000")], [], j,
+    )
+    assert not kept_b
+    assert [d.reason for d in discards] == ["task_gone"]
+
+
+def test_gate_already_bound():
+    sim, pending, _ = _gate_world()
+    t = pending[0]
+    t.status = TaskStatus.BOUND
+    t.node_name = "node-00001"
+    j = DeltaJournal()
+    j.task_dirty(t.uid)
+    kept_b, _, discards = revalidate_decisions(
+        sim.cluster, [BindIntent(task_uid=t.uid, node_name="node-00000")], [], j,
+    )
+    assert not kept_b and discards[0].reason == "already_bound"
+
+
+def test_gate_node_gone_and_unsched():
+    sim, pending, _ = _gate_world()
+    a, b = pending[0], pending[1]
+    sim.cluster.nodes.pop("node-00000")
+    sim.cluster.nodes["node-00001"].unschedulable = True
+    j = DeltaJournal()
+    j.node_dirty("node-00000")
+    j.node_dirty("node-00001")
+    kept_b, _, discards = revalidate_decisions(
+        sim.cluster,
+        [
+            BindIntent(task_uid=a.uid, node_name="node-00000"),
+            BindIntent(task_uid=b.uid, node_name="node-00001"),
+        ],
+        [], j,
+    )
+    assert not kept_b
+    assert sorted(d.reason for d in discards) == ["node_gone", "node_unsched"]
+
+
+def test_gate_capacity_shrunk_counts_accepted_binds():
+    """Two binds onto one shrunken node: headroom for one — the second
+    must see the first's tentative usage and discard."""
+    sim, pending, _ = _gate_world()
+    # same-job tasks share one request profile, so 1.5x one request is
+    # headroom for exactly one of the two
+    by_job = {}
+    for t in pending:
+        by_job.setdefault(t.job_uid, []).append(t)
+    a, b = next(ts for ts in by_job.values() if len(ts) >= 2)[:2]
+    node = sim.cluster.nodes["node-00002"]
+    node.idle = np.asarray(a.resreq) * 1.5
+    node.releasing = np.zeros_like(node.idle)
+    j = DeltaJournal()
+    j.node_dirty(node.name)
+    kept_b, _, discards = revalidate_decisions(
+        sim.cluster,
+        [
+            BindIntent(task_uid=a.uid, node_name=node.name),
+            BindIntent(task_uid=b.uid, node_name=node.name),
+        ],
+        [], j,
+    )
+    assert len(kept_b) == 1 and kept_b[0].task_uid == a.uid
+    assert discards[0].reason == "capacity_shrunk"
+
+
+def test_gate_not_evictable_and_structural_checks_everything():
+    sim, pending, running = _gate_world()
+    v = running[0]
+    v.status = TaskStatus.RELEASING
+    j = DeltaJournal()
+    j.structural_event("relist")  # no per-row dirt: the structural flip
+    _, kept_e, discards = revalidate_decisions(
+        sim.cluster, [], [EvictIntent(task_uid=v.uid)], j,
+    )
+    assert not kept_e and discards[0].reason == "not_evictable"
+
+
+# ---------------------------------------------------------------------------
+# the executor: mid-window churn, journal, backpressure
+
+
+def test_mid_window_task_delete_discards_instead_of_crashing():
+    """A pod deleted while its bind decision is in flight: the sequential
+    actuation path would KeyError; the gate drops the bind with
+    ``task_gone`` and the loop keeps going."""
+    sim = _mk(seed=5, running=0.0, nodes=6, jobs=4, tpj=4)
+    sched = Scheduler(sim, arena=True)
+    deleted = []
+
+    def ingest():
+        # runs inside the speculation window (while a decide is in
+        # flight): delete one pending task the frozen epoch can see
+        if not deleted:
+            for j in sim.cluster.jobs.values():
+                for uid, t in list(j.tasks.items()):
+                    if t.status == TaskStatus.PENDING:
+                        j.tasks.pop(uid)
+                        sim.delta_sink.structural("task_set")
+                        deleted.append(uid)
+                        return 1
+        return 0
+
+    ex = PipelinedExecutor(sched, deterministic=True, ingest_fn=ingest)
+    try:
+        out = ex.step()
+    finally:
+        ex.close()
+    assert deleted
+    reasons = {d.reason for d in out.discards}
+    dropped = {d.task_uid for d in out.discards}
+    assert deleted[0] in dropped and "task_gone" in reasons
+    assert all(b.task_uid != deleted[0] for b in out.binds)
+    # the counter moved
+    text = metrics().render()
+    assert 'pipeline_discards_total{reason="task_gone"}' in text
+
+
+def test_mid_window_cordon_discards_binds_to_that_node():
+    sim = _mk(seed=9, running=0.0, nodes=5, jobs=4, tpj=4)
+    sched = Scheduler(sim, arena=True)
+    cordoned = []
+
+    def ingest():
+        if not cordoned:
+            node = next(iter(sim.cluster.nodes.values()))
+            node.unschedulable = True
+            sim.delta_sink.node_dirty(node.name)
+            cordoned.append(node.name)
+            return 1
+        return 0
+
+    ex = PipelinedExecutor(sched, deterministic=True, ingest_fn=ingest)
+    try:
+        out = ex.step()
+    finally:
+        ex.close()
+    assert cordoned
+    assert all(b.node_name != cordoned[0] for b in out.binds)
+    # every decision the frozen epoch aimed at the cordoned node is gone
+    for d in out.discards:
+        assert d.reason in ("node_unsched", "capacity_shrunk")
+
+
+def test_journal_tee_records_even_when_arena_structural():
+    sim = _mk(seed=1, running=0.0, nodes=4, jobs=2, tpj=3)
+    from kube_arbitrator_tpu.cache.arena import SnapshotArena
+
+    arena = SnapshotArena(sim)
+    j = DeltaJournal()
+    arena.journal = j
+    # arena is structurally dirty from seeding; the journal still records
+    assert arena._structural is not None
+    sim.delta_sink.task_dirty("t1", "n1")
+    sim.delta_sink.node_dirty("n2")
+    assert "t1" in j.dirty_tasks and {"n1", "n2"} <= j.dirty_nodes
+    j.reset()
+    assert j.empty
+    arena.structural("test_reason")
+    assert j.structural == ["test_reason"]
+
+
+def test_backpressure_counter_fires_when_ingest_outruns_decide():
+    sim = _mk(seed=2, running=0.0, nodes=4, jobs=2, tpj=3)
+
+    class SlowDecider:
+        wants_device_pack = True
+        last_action_ms = {}
+
+        def __init__(self):
+            self.inner = default_decider()
+
+        def decide(self, st, config, pack_meta=None):
+            time.sleep(0.15)
+            return self.inner.decide(st, config)
+
+    sched = Scheduler(sim, arena=True, decider=SlowDecider())
+    pumps = []
+
+    def ingest():
+        pumps.append(1)
+        return 1  # always "events pending": ingest outruns decide
+
+    ex = PipelinedExecutor(
+        sched, max_ingest_per_wait=3, wait_poll_s=0.001, ingest_fn=ingest
+    )
+    try:
+        ex.step()
+        ex.step()
+    finally:
+        ex.close()
+    assert ex.backpressure_events >= 1
+    assert "pipeline_backpressure_total" in metrics().render()
+
+
+def test_occupancy_and_period_metrics_recorded():
+    sim = _mk(seed=4, running=0.0, nodes=6, jobs=3, tpj=4)
+    sched = Scheduler(sim, arena=True)
+    ex = PipelinedExecutor(sched)
+    try:
+        ex.step()
+        ex.step()
+    finally:
+        ex.close()
+    text = metrics().render()
+    assert "pipeline_cycle_period_seconds" in text
+    assert 'pipeline_stage_busy_seconds_bucket{stage="decide"' in text
+    assert 'pipeline_stage_occupancy{stage="decide"}' in text
+    occ = ex.occupancy()
+    assert set(occ) == {"ingest", "freeze", "decide", "revalidate", "actuate", "close"}
+
+
+def test_decide_runs_off_the_ingest_thread():
+    sim = _mk(seed=6, running=0.0, nodes=4, jobs=2, tpj=3)
+    seen = []
+
+    class Spy:
+        wants_device_pack = True
+        last_action_ms = {}
+
+        def __init__(self):
+            self.inner = default_decider()
+
+        def decide(self, st, config, pack_meta=None):
+            seen.append(threading.current_thread().name)
+            return self.inner.decide(st, config)
+
+    sched = Scheduler(sim, arena=True, decider=Spy())
+    ex = PipelinedExecutor(sched)
+    try:
+        ex.step()
+    finally:
+        ex.close()
+    assert seen and all(n.startswith("kat-pipe-decide") for n in seen)
+
+
+# ---------------------------------------------------------------------------
+# satellites: cached default decider, idle wait seam
+
+
+def test_default_decider_is_cached_across_sessions():
+    assert default_decider() is default_decider()
+    s1 = Session(_mk(seed=1).cluster)
+    s2 = Session(_mk(seed=1).cluster)
+    assert s1._decider() is s2._decider()
+    # an explicit decider still wins
+    marker = object()
+    assert Session(_mk(seed=1).cluster, decider=marker)._decider() is marker
+
+
+def test_until_idle_wait_seam_blocks_then_times_out():
+    sim = _mk(seed=13, running=0.0, nodes=6, jobs=2, tpj=3)
+    calls = []
+
+    def waiter():
+        calls.append(1)
+        if len(calls) == 1:
+            # "an event arrived": inject fresh work, keep scheduling
+            job = sim.add_job("late-job", queue="queue-000")
+            sim.add_task(job, 500, 512 * 1024**2)
+            return True
+        return False  # timed out: exit
+
+    sched = Scheduler(sim, arena=True, wait_for_event=waiter)
+    sched.run(max_cycles=40)
+    assert len(calls) == 2  # one wakeup with work, one timeout
+    # the injected late task was actually placed after the wakeup
+    late = [t for j in sim.cluster.jobs.values() if j.uid == "late-job"
+            for t in j.tasks.values()]
+    assert late and late[0].node_name
+
+
+def test_live_cache_event_waiter():
+    from kube_arbitrator_tpu.cache.fakeapi import FakeApiServer
+    from kube_arbitrator_tpu.cache.live import LiveCache
+
+    api = FakeApiServer()
+    api.create("nodes", {"metadata": {"name": "n0"},
+                         "status": {"allocatable": {"cpu": "4", "memory": "8Gi"}}})
+    clock = [0.0]
+    live = LiveCache(api, now_fn=lambda: clock[0])
+    live.sync()  # initial LIST
+
+    created = []
+
+    def sleep(s):
+        clock[0] += s
+        if not created:  # an event shows up during the first wait
+            api.create("queues", {"metadata": {"name": "q1"}, "spec": {"weight": 1}})
+            created.append(1)
+
+    wait = live.event_waiter(timeout_s=5.0, poll_s=1.0, sleep_fn=sleep)
+    assert wait() is True         # the created queue's event woke it
+    assert wait() is False        # nothing else arrives: timeout
+    assert clock[0] >= 5.0
+
+
+def test_on_events_callback_fires():
+    from kube_arbitrator_tpu.cache.fakeapi import FakeApiServer
+    from kube_arbitrator_tpu.cache.live import LiveCache
+
+    api = FakeApiServer()
+    api.create("nodes", {"metadata": {"name": "n0"},
+                         "status": {"allocatable": {"cpu": "4", "memory": "8Gi"}}})
+    live = LiveCache(api)
+    got = []
+    live.on_events = got.append
+    live.sync()
+    assert got and got[0] >= 1
+
+
+# ---------------------------------------------------------------------------
+# chaos: faults inside the speculation window
+
+
+@pytest.mark.parametrize("seed", [0, 3])
+def test_chaos_pipeline_profile_invariants_hold(seed):
+    """Watch mangling / lease steals / rpc faults landing while frozen
+    epochs are in flight: no_double_bind and no_overcommit (and the rest
+    of the invariant set) must hold, and the run must be deterministic."""
+    from kube_arbitrator_tpu.chaos.plan import PROFILES
+    from kube_arbitrator_tpu.chaos.runner import run_chaos
+
+    prof = PROFILES["pipeline"]
+    r1 = run_chaos(seed=seed, cycles=8, profile=prof)
+    assert not r1.breaches, [b.to_dict() for b in r1.breaches]
+    r2 = run_chaos(seed=seed, cycles=8, profile=prof)
+    assert r1.digests == r2.digests  # pure function of the plan
+    assert r1.repro_json() == r2.repro_json()
+
+
+def test_chaos_watch_reorder_never_inverts_one_objects_events():
+    """The reorder fault models the cross-informer race; a real watch
+    never reorders one object against itself (per-object rv is
+    monotone), so the seam must skip same-object adjacent pairs."""
+    from kube_arbitrator_tpu.chaos.clock import VirtualClock
+    from kube_arbitrator_tpu.chaos.faults import ChaosApiServer, FaultInjector
+    from kube_arbitrator_tpu.chaos.plan import FaultPlan, FaultSpec
+
+    plan = FaultPlan(seed=0, specs=(
+        FaultSpec(cycle=0, kind="watch_reorder", params=(("index", 0),)),
+    ))
+    clock = VirtualClock()
+    inj = FaultInjector(plan, clock)
+    api = ChaosApiServer(inj, clock)
+    api.create("pods", {"metadata": {"namespace": "d", "name": "p1", "uid": "u1"},
+                        "spec": {}, "status": {"phase": "Pending"}})
+    rv0 = api._rv
+    # two adjacent events for the SAME pod, then one for another object
+    api.update_pod_condition("d", "p1", {"type": "PodScheduled", "status": "False"})
+    api.update_pod_condition("d", "p1", {"type": "PodScheduled", "status": "False"})
+    api.create("queues", {"metadata": {"name": "q9"}, "spec": {"weight": 1}})
+    inj.begin_cycle(0)
+    events = api.watch_all(rv0)
+    p1_rvs = [ev[0] for ev in events
+              if ev[1] == "pods" and ev[3]["metadata"]["name"] == "p1"]
+    assert p1_rvs == sorted(p1_rvs), "same-object order inverted"
+    assert inj.injected, "the fault should have landed on a cross-object pair"
+
+
+def test_freeze_failure_after_commit_keeps_epoch_bookkeeping():
+    """A failed pre-submit freeze (e.g. ArenaDivergence on the epoch
+    check) must not erase the already-committed epoch's evidence: its
+    stats land in history/metrics before the freeze error surfaces as
+    the next cycle's failure."""
+    from kube_arbitrator_tpu.cache.arena import ArenaDivergence
+
+    sim = _mk(seed=8, running=0.0, nodes=5, jobs=3, tpj=3)
+    sched = Scheduler(sim, arena=True)
+    ex = PipelinedExecutor(sched)
+    try:
+        ex.step()  # fill + commit epoch 1
+        n_hist = len(sched.history)
+        # poison the arena: the NEXT pre-submit freeze's verify trips
+        sched.arena.verify_every = 1
+        sched.arena._packs_since_verify = 1
+        sched.arena.corrupt("node_idle", 0, sched.arena._w["node_idle"][0] * 7)
+        with pytest.raises(ArenaDivergence):
+            ex.step()
+        # the committed epoch's stats were recorded despite the raise
+        assert len(sched.history) == n_hist + 1
+        # and the executor recovers: the poisoned arena rebuilds
+        out = ex.step()
+        assert out.stats is sched.history[-1]
+    finally:
+        ex.close()

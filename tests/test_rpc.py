@@ -153,3 +153,23 @@ def test_remote_decider_retry_uses_injected_sleep_and_schedule():
         backoff_delay_s(2, 0.25, 2.0, 42),
     ]
     d.close()
+
+
+def test_pipelined_remote_matches_sequential_remote(sidecar):
+    """Overlap through the wire: run_pipelined with a RemoteDecider (the
+    epoch-keyed delta protocol under the frozen-pack discipline) places
+    exactly what the sequential remote loop places."""
+    sim_a = generate_cluster(num_nodes=24, num_jobs=5, tasks_per_job=6, num_queues=2, seed=17)
+    sim_b = generate_cluster(num_nodes=24, num_jobs=5, tasks_per_job=6, num_queues=2, seed=17)
+    seq = Scheduler(sim_a, decider=RemoteDecider(sidecar), arena=True)
+    pipe = Scheduler(sim_b, decider=RemoteDecider(sidecar), arena=True)
+    try:
+        seq.run(max_cycles=4)
+        pipe.run_pipelined(max_cycles=4)
+    finally:
+        seq.decider.close()
+        pipe.decider.close()
+    bound_a = {t.uid: t.node_name for j in sim_a.cluster.jobs.values() for t in j.tasks.values()}
+    bound_b = {t.uid: t.node_name for j in sim_b.cluster.jobs.values() for t in j.tasks.values()}
+    assert bound_a == bound_b
+    assert sum(s.binds for s in seq.history) == sum(s.binds for s in pipe.history) > 0
