@@ -65,6 +65,42 @@ def _emit(obj, stream=sys.stdout):
     print(json.dumps(obj), file=stream, flush=True)
 
 
+class _RetraceCounter:
+    """Counts XLA backend compiles inside an armed window via
+    jax.monitoring — the attribution channel for rep-spread regressions:
+    a steady-state rep that recompiles (shape drift, cache miss, sticky-
+    bucket change) is a RETRACE artifact, not kernel time, and BENCH_r05's
+    380-858 ms q512 spread conflated the two.  Registered once per
+    process; armed only around the timed region."""
+
+    _installed = None
+
+    def __init__(self):
+        self.count = 0
+        self.armed = False
+        if _RetraceCounter._installed is None:
+            import jax.monitoring
+
+            def _on(event, duration, **kw):
+                inst = _RetraceCounter._installed
+                if inst is not None and inst.armed and event.endswith(
+                    "backend_compile_duration"
+                ):
+                    inst.count += 1
+
+            jax.monitoring.register_event_duration_secs_listener(_on)
+        _RetraceCounter._installed = self
+
+    def __enter__(self):
+        _RetraceCounter._installed = self
+        self.armed = True
+        return self
+
+    def __exit__(self, *exc):
+        self.armed = False
+        return False
+
+
 def _time_cycle(schedule_cycle, instances, actions, reps=3):
     """Time the cycle over DISTINCT-content instances of the same workload.
 
@@ -92,7 +128,12 @@ def _time_cycle(schedule_cycle, instances, actions, reps=3):
       value = rep_binds[median] / times[median].
 
     Returns (times_s list, rep_binds list, median rep index, decisions of
-    the FIRST instance — the canonical seed the parity suite pins).
+    the FIRST instance — the canonical seed the parity suite pins, and a
+    meta dict: ``warmup_ms`` = [compile+first-exec, settle] recorded
+    SEPARATELY from the steady-state reps, and ``retraces`` = XLA
+    backend compiles observed INSIDE the timed region — a nonzero count
+    marks the rep list as retrace-contaminated rather than steady-state
+    spread).
     """
     import jax
 
@@ -101,28 +142,33 @@ def _time_cycle(schedule_cycle, instances, actions, reps=3):
             lambda a: a.copy() if hasattr(a, "copy") else a, t
         )
 
+    w0 = time.perf_counter()
     dec0 = schedule_cycle(fresh(instances[0]), actions=actions)
     jax.block_until_ready(dec0)  # compile + first-exec stall absorber
+    w1 = time.perf_counter()
     dec0 = schedule_cycle(instances[0], actions=actions)
     np.asarray(dec0.bind_mask)  # settle exec: forces full pipeline once
+    w2 = time.perf_counter()
+    warmup_ms = [round((w1 - w0) * 1000, 1), round((w2 - w1) * 1000, 1)]
     times, rep_binds = [], []
-    for i in range(reps):
-        if len(instances) > 1:
-            t = instances[(i % (len(instances) - 1)) + 1]
-            if i >= len(instances) - 1:
-                # more reps than variants: a reused instance was already
-                # executed once, so re-materialize its buffers (fresh
-                # copy) — weaker than never-executed content, but never
-                # the same buffers (the round-4 memoization trigger)
-                t = fresh(t)
-        else:
-            t = fresh(instances[0])
-        jax.block_until_ready(t)
-        t0 = time.perf_counter()
-        dec = schedule_cycle(t, actions=actions)
-        mask = np.asarray(dec.bind_mask)  # honest end: decisions reach the host
-        times.append(time.perf_counter() - t0)
-        rep_binds.append(int(mask.sum()))
+    with _RetraceCounter() as rt:
+        for i in range(reps):
+            if len(instances) > 1:
+                t = instances[(i % (len(instances) - 1)) + 1]
+                if i >= len(instances) - 1:
+                    # more reps than variants: a reused instance was already
+                    # executed once, so re-materialize its buffers (fresh
+                    # copy) — weaker than never-executed content, but never
+                    # the same buffers (the round-4 memoization trigger)
+                    t = fresh(t)
+            else:
+                t = fresh(instances[0])
+            jax.block_until_ready(t)
+            t0 = time.perf_counter()
+            dec = schedule_cycle(t, actions=actions)
+            mask = np.asarray(dec.bind_mask)  # honest end: decisions reach the host
+            times.append(time.perf_counter() - t0)
+            rep_binds.append(int(mask.sum()))
     # wildly inconsistent reps are a measurement smell — surface them
     # instead of silently medianing (the flag also rides the row dict via
     # the rep_ms list the caller records)
@@ -130,7 +176,8 @@ def _time_cycle(schedule_cycle, instances, actions, reps=3):
         print(f"# inconsistent reps for {actions}: "
               f"{[round(t * 1000, 1) for t in times]} ms", file=sys.stderr)
     med_idx = int(np.argsort(times)[len(times) // 2])
-    return times, rep_binds, med_idx, dec0
+    meta = {"warmup_ms": warmup_ms, "retraces": rt.count}
+    return times, rep_binds, med_idx, dec0, meta
 
 
 def _cluster(num_tasks, num_nodes, num_queues, running_fraction, seed=42):
@@ -628,7 +675,7 @@ def _measure_main() -> None:
         for metric, T, N, Q, frac, actions in ladder:
             try:
                 inst, sim, canon = _instances(T, N, Q, frac)
-                times, rep_binds, med, dec = _time_cycle(
+                times, rep_binds, med, dec, meta = _time_cycle(
                     schedule_cycle, inst, actions
                 )
                 cycle_s, placed = times[med], rep_binds[med]
@@ -649,8 +696,15 @@ def _measure_main() -> None:
                     "value": round(placed / cycle_s, 1) if cycle_s > 0 else 0.0,
                     "unit": "pods/s",
                     "cycle_ms": round(cycle_s * 1000, 1),
+                    "cycle_ms_p10": round(float(np.percentile(times, 10)) * 1000, 1),
+                    "cycle_ms_p90": round(float(np.percentile(times, 90)) * 1000, 1),
                     "rep_ms": rep_ms,
                     "rep_binds": rep_binds,
+                    # compile+first-exec and settle, SEPARATE from the
+                    # steady-state reps; retraces > 0 marks the rep list
+                    # as retrace-contaminated (spread attribution)
+                    "warmup_ms": meta["warmup_ms"],
+                    "retraces": meta["retraces"],
                     "distinct_instances": len(inst) - 1,
                     "binds": placed,
                     "binds_seed42": int(np.asarray(dec.bind_mask).sum()),
@@ -688,7 +742,7 @@ def _measure_main() -> None:
                         if policy_native else schedule_cycle
                     )
                     with jax.default_device(dev):
-                        p_times, p_binds, p_med, p_dec = _time_cycle(
+                        p_times, p_binds, p_med, p_dec, p_meta = _time_cycle(
                             cpu_cycle, inst, actions
                         )
                     p_s, p_placed = p_times[p_med], p_binds[p_med]
@@ -697,8 +751,12 @@ def _measure_main() -> None:
                         "value": round(p_placed / p_s, 1) if p_s > 0 else 0.0,
                         "unit": "pods/s",
                         "cycle_ms": round(p_s * 1000, 1),
+                        "cycle_ms_p10": round(float(np.percentile(p_times, 10)) * 1000, 1),
+                        "cycle_ms_p90": round(float(np.percentile(p_times, 90)) * 1000, 1),
                         "rep_ms": [round(t * 1000, 1) for t in p_times],
                         "rep_binds": p_binds,
+                        "warmup_ms": p_meta["warmup_ms"],
+                        "retraces": p_meta["retraces"],
                         "distinct_instances": len(inst) - 1,
                         "binds": p_placed,
                         "evicts": int(np.asarray(p_dec.evict_mask).sum()),
@@ -729,7 +787,7 @@ def _measure_primary(schedule_cycle, num_tasks, num_nodes, oracle_cap_s):
 
     inst, _sim, _canon = _instances(num_tasks, num_nodes, 8, 0.0, want=5)
     snap_tensors = inst[0]
-    times, rep_binds, med, dec = _time_cycle(
+    times, rep_binds, med, dec, meta = _time_cycle(
         schedule_cycle, inst, ("allocate", "backfill"), reps=5
     )
     # median rep's own time paired with its own placement count (the
@@ -799,6 +857,10 @@ def _measure_primary(schedule_cycle, num_tasks, num_nodes, oracle_cap_s):
         "value": round(pods_per_sec, 1),
         "unit": "pods/s",
         "rep_ms": [round(t * 1000, 1) for t in times],
+        "cycle_ms_p10": round(float(np.percentile(times, 10)) * 1000, 1),
+        "cycle_ms_p90": round(float(np.percentile(times, 90)) * 1000, 1),
+        "warmup_ms": meta["warmup_ms"],
+        "retraces": meta["retraces"],
         "rep_binds": rep_binds,
         "provenance": "value = median rep's own binds / its time",
         "vs_baseline": round(vs_baseline, 2),

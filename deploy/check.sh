@@ -163,11 +163,63 @@ EOF
   fi
 fi
 
+# PERF_SMOKE=1: the batched-turn kernel lane — the sequential-vs-batched
+# decision-equality soak (3 seeds x q in {8, 64, 512} x every action,
+# bit-for-bit streams + round counts), the traced turn-bound assertion
+# (a q512 world with k claimant queues pays k gate-admitted turns per
+# preempt round, not 512), and kat-lint over the batched modules + the
+# native FFI bindings.
+rc_perf=0
+if [ "${PERF_SMOKE:-0}" = "1" ]; then
+  env JAX_PLATFORMS=cpu python -m pytest -q tests/test_batched_turns.py \
+    || rc_perf=$?
+  # rounds-x-turns smoke on a live preempt run: the batched engine must
+  # finish the q512 contention world in a handful of rounds and leave
+  # decisions identical to the sequential engine (redundant with the
+  # suite above, but cheap and self-contained for local bisecting)
+  env JAX_PLATFORMS=cpu python - <<'EOF' || rc_perf=$?
+import numpy as np
+from kube_arbitrator_tpu.cache import build_snapshot, generate_cluster
+from tests.test_batched_turns import _open
+
+sim = generate_cluster(num_nodes=48, num_jobs=576, tasks_per_job=4,
+                       num_queues=512, seed=7, node_cpu_milli=4000,
+                       node_memory=8 * 1024**3, running_fraction=0.5)
+st = build_snapshot(sim.cluster).tensors
+tiers, sess, state = _open(st)
+import jax
+import numpy as np
+from kube_arbitrator_tpu.ops.preempt import preempt_action
+run = lambda tb: jax.jit(
+    lambda st, se, s: preempt_action(st, se, s, tiers, turn_batch=tb)
+)(st, sess, state)
+out, ref = run(True), run(False)
+rounds = int(out.rounds)
+assert rounds < 64, f"preempt rounds blew the traced bound: {rounds}"
+assert rounds == int(ref.rounds), (rounds, int(ref.rounds))
+for f in ("task_status", "task_node", "node_releasing", "node_num_tasks"):
+    a, b = np.asarray(getattr(out, f)), np.asarray(getattr(ref, f))
+    assert (a == b).all(), f"batched vs sequential diverged on {f}"
+print(f"perf smoke: q512 preempt converged in {rounds} rounds, "
+      "batched == sequential")
+EOF
+  python -m kube_arbitrator_tpu.analysis --rules KAT-LCK,KAT-DTY \
+    kube_arbitrator_tpu/ops/preempt.py \
+    kube_arbitrator_tpu/ops/allocate.py \
+    kube_arbitrator_tpu/ops/native/segsum.py || rc_perf=$?
+  if [ "${rc_perf}" -ne 0 ]; then
+    echo "perf smoke job: FAILED (exit ${rc_perf})" >&2
+  else
+    echo "perf smoke job: ok (parity soak + turn bound + kat-lint)"
+  fi
+fi
+
 if [ "${LINT_ONLY:-0}" = "1" ]; then
   if [ "${rc_lint}" -ne 0 ]; then exit "${rc_lint}"; fi
   if [ "${rc_obs}" -ne 0 ]; then exit "${rc_obs}"; fi
   if [ "${rc_arena}" -ne 0 ]; then exit "${rc_arena}"; fi
   if [ "${rc_chaos}" -ne 0 ]; then exit "${rc_chaos}"; fi
+  if [ "${rc_perf}" -ne 0 ]; then exit "${rc_perf}"; fi
   exit "${rc_pipe}"
 fi
 
@@ -184,4 +236,5 @@ if [ "${rc_obs}" -ne 0 ]; then exit "${rc_obs}"; fi
 if [ "${rc_arena}" -ne 0 ]; then exit "${rc_arena}"; fi
 if [ "${rc_chaos}" -ne 0 ]; then exit "${rc_chaos}"; fi
 if [ "${rc_pipe}" -ne 0 ]; then exit "${rc_pipe}"; fi
+if [ "${rc_perf}" -ne 0 ]; then exit "${rc_perf}"; fi
 exit "${rc_test}"

@@ -45,6 +45,13 @@ Sub-ids:
   delta path) emits a pack violating the same SNAPSHOT schema the full
   rebuild is held to — checked on a real mini-cluster after a bind delta,
   so the row-refresh/group-recompute path is what's evaluated.
+- ``KAT-CTR-008``: the batched turn kernel's selection stage
+  (``ops/allocate.select_turns`` — one vmapped program selecting every
+  queue's claimant job/group/budget, consumed by allocate's
+  ``_round_batched`` slot loop AND preempt's ``_rounds_batched``) fails
+  abstract evaluation or returns per-queue tensors drifting from the
+  declared :data:`TURN_SCHEMA` — both eviction paths read these, so a
+  silent drift here corrupts two kernels at once.
 
 The harness takes the schemas as parameters so the regression tests can
 seed one mutated dtype and assert the checker reports exactly the
@@ -190,6 +197,20 @@ SESSION_SCHEMA: Dict[str, Tuple[Tuple[str, ...], str]] = {
     "job_sched_valid": (("J",), "bool"),
     "min_avail": (("J",), "int32"),
     "drf_level": (("J",), "float32"),
+}
+
+#: The batched turn-selection contract (KAT-CTR-008): per-queue
+#: (claimant job, group, has_grp, per-task resreq, fairness budget) in
+#: select_turns' return order.  The queue-ids axis is symbolic Q here;
+#: production callers pass perm prefixes (preempt's TURN_PANEL) or chunk
+#: slices (allocate's TURN_CHUNK) — the kernel is shape-polymorphic over
+#: the batch width, which is exactly what this pass verifies.
+TURN_SCHEMA: Dict[str, Tuple[Tuple[str, ...], str]] = {
+    "j_sel": (("Q",), "int32"),
+    "g_sel": (("Q",), "int32"),
+    "has_grp": (("Q",), "bool"),
+    "req": (("Q", "R"), "float32"),
+    "budget": (("Q",), "int32"),
 }
 
 #: What framework/session.py's actuation decode consumes.
@@ -573,6 +594,78 @@ def check_kernels(
     return findings
 
 
+def check_batched_turns(
+    schema: Optional[Mapping[str, Tuple[Tuple[str, ...], str]]] = None,
+    axes: Optional[Mapping[str, int]] = None,
+    turn_schema: Optional[Mapping[str, Tuple[Tuple[str, ...], str]]] = None,
+) -> List[Finding]:
+    """KAT-CTR-008: abstract-evaluate the batched turn-selection kernel
+    (``select_turns``) for both budget modes against the declared
+    snapshot/state/session contracts, and verify its per-queue outputs
+    against :data:`TURN_SCHEMA`.  Seeding a mutated ``turn_schema``
+    must make this pass report the drifted field (regression-tested)."""
+    import jax
+    import numpy as np
+
+    from ..ops import allocate as alc
+    from ..ops.ordering import DEFAULT_TIERS
+
+    axes = axes or DEFAULT_AXES
+    turn_schema = turn_schema or TURN_SCHEMA
+    findings: List[Finding] = []
+    path, line = _anchor(alc.select_turns)
+    st = snapshot_struct(schema, axes)
+    state = _state_struct(STATE_SCHEMA, axes)
+    sess = _session_struct(axes)
+    Q = axes["Q"]
+    q_ids = jax.ShapeDtypeStruct((Q,), np.dtype("int32"))
+    q_ok = jax.ShapeDtypeStruct((Q,), np.dtype("bool"))
+    names = tuple(turn_schema)  # declaration order == return order
+
+    with jax.default_device(jax.devices("cpu")[0]):
+        for mode in ("allocate", "preempt"):
+
+            def run(st, sess, state, qi, qo, _mode=mode):
+                shared = alc._selection_shared(
+                    st, sess, state, DEFAULT_TIERS,
+                    None if _mode == "preempt" else False,
+                )
+                return alc.select_turns(
+                    st, sess, state, DEFAULT_TIERS, 4096, _mode, shared, qi, qo
+                )
+
+            try:
+                out = jax.eval_shape(run, st, sess, state, q_ids, q_ok)
+            except Exception as err:
+                findings.append(Finding(
+                    "KAT-CTR-008", "error", path, line,
+                    f"batched turn selection (mode={mode}) failed abstract "
+                    f"evaluation: {type(err).__name__}: {err}",
+                    hint="select_turns no longer composes over the declared "
+                    "snapshot/state contract; allocate's _round_batched and "
+                    "preempt's _rounds_batched both consume it",
+                ))
+                continue
+            for name, val in zip(names, out):
+                sym_shape, dtype = turn_schema[name]
+                want_shape = _concrete_shape(sym_shape, axes)
+                got_shape = tuple(getattr(val, "shape", ()))
+                got_dtype = str(getattr(val, "dtype", type(val).__name__))
+                if got_shape != want_shape or got_dtype != dtype:
+                    findings.append(Finding(
+                        "KAT-CTR-008", "error", path, line,
+                        f"batched turn selection (mode={mode}): `{name}` is "
+                        f"{_describe(val)}, contract says "
+                        f"{dtype}[{','.join(map(str, want_shape))}] "
+                        f"(shape symbols {sym_shape})",
+                        hint="the batched slot loops index these per-queue; "
+                        "a drifted dtype/shape corrupts allocate AND preempt "
+                        "rounds at once — fix select_turns or the schema if "
+                        "the contract legitimately changed",
+                    ))
+    return findings
+
+
 def _state_struct(state_schema, axes):
     import jax
     import numpy as np
@@ -600,14 +693,17 @@ def _session_struct(axes):
 def check_contracts(
     schema: Optional[Mapping[str, Tuple[Tuple[str, ...], str]]] = None,
     state_schema: Optional[Mapping[str, Tuple[Tuple[str, ...], str]]] = None,
+    turn_schema: Optional[Mapping[str, Tuple[Tuple[str, ...], str]]] = None,
 ) -> List[Finding]:
     """The full contract pass: field-set, producer, then consumers.
 
-    Passing a mutated ``schema``/``state_schema`` seeds a violation; the
-    regression tests assert the seeded stage (and only it) is reported."""
+    Passing a mutated ``schema``/``state_schema``/``turn_schema`` seeds a
+    violation; the regression tests assert the seeded stage (and only it)
+    is reported."""
     findings = check_schema_fields()
     findings += check_producer(schema)
     findings += check_arena_producer(schema)
     findings += check_kernels(schema, state_schema=state_schema)
+    findings += check_batched_turns(schema, turn_schema=turn_schema)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings

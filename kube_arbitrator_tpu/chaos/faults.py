@@ -245,6 +245,10 @@ class ChaosDecider:
     def last_action_ms(self) -> Dict[str, float]:
         return getattr(self.inner, "last_action_ms", None) or {}
 
+    @property
+    def last_action_rounds(self) -> Dict[str, int]:
+        return getattr(self.inner, "last_action_rounds", None) or {}
+
     def decide(self, st, config, pack_meta=None):
         fail_budget = 0
         spec = self.injector.take("rpc_fail")

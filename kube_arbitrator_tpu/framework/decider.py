@@ -28,6 +28,9 @@ class LocalDecider:
     def __init__(self):
         # stage -> wall ms of the most recent decide (staged runs only)
         self.last_action_ms: Dict[str, float] = {}
+        # action -> round count of the most recent decide (staged runs
+        # only) — feeds kernel_rounds_total{action}
+        self.last_action_rounds: Dict[str, int] = {}
 
     def decide(self, st, config, pack_meta=None) -> Tuple[object, float]:
         # pack_meta is the arena's delta descriptor — a transport concern;
@@ -61,12 +64,17 @@ class LocalDecider:
             # sequential loop on the cached default) sees either the
             # previous complete dict or this one, never a dict mid-fill
             action_ms = {}
-            for stage, ts, ms in stages:
+            action_rounds = {}
+            for stage, ts, ms, rounds in stages:
                 action_ms[stage] = ms
+                if rounds is not None:
+                    action_rounds[stage] = rounds
                 tr.record_span(f"kernel.{stage}", ts, ms / 1000)
             self.last_action_ms = action_ms
+            self.last_action_rounds = action_rounds
             return dec, (time.perf_counter() - t0) * 1000
         self.last_action_ms = {}
+        self.last_action_rounds = {}
         with ctx:
             dec = schedule_cycle(
                 st, tiers=config.tiers, actions=config.actions,

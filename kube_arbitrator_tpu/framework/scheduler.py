@@ -391,10 +391,15 @@ class Scheduler:
             upload_ms=result.upload_ms,
         )
         self.history.append(stats)
-        self._record_metrics(stats, result.action_ms)
+        self._record_metrics(stats, result.action_ms, result.action_rounds)
         return result
 
-    def _record_metrics(self, s: CycleStats, action_ms: Dict[str, float]) -> None:
+    def _record_metrics(
+        self,
+        s: CycleStats,
+        action_ms: Dict[str, float],
+        action_rounds: Dict[str, int] = None,
+    ) -> None:
         # HELP text lives in utils/metrics.METRIC_HELP (one table for
         # every family), not in per-cycle describe() calls
         m = metrics()
@@ -416,6 +421,10 @@ class Scheduler:
             m.observe(
                 "kernel_action_duration_seconds", ms / 1000,
                 labels={"action": stage},
+            )
+        for action, rounds in (action_rounds or {}).items():
+            m.counter_add(
+                "kernel_rounds_total", rounds, labels={"action": action}
             )
         m.counter_add("cycles_total")
         m.counter_add("binds_total", s.binds)

@@ -112,6 +112,9 @@ class CycleResult:
     # stage -> wall ms from the staged per-action runner (tracing-enabled
     # local decides only; empty for fused or remote cycles)
     action_ms: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # action -> round count from the same staged runner (evictive round
+    # loops; feeds kernel_rounds_total{action})
+    action_rounds: Dict[str, int] = dataclasses.field(default_factory=dict)
 
 
 class Session:
@@ -256,6 +259,9 @@ class Session:
             upload_ms=(t_up - t1) * 1000,
             action_ms=dict(
                 getattr(self._decider(), "last_action_ms", None) or {}
+            ),
+            action_rounds=dict(
+                getattr(self._decider(), "last_action_rounds", None) or {}
             ),
         )
 

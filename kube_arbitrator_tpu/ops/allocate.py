@@ -154,6 +154,26 @@ def _status_in(status: jax.Array, members) -> jax.Array:
     return m
 
 
+# The ONE turn-budget policy switch.  Every action that batches queue
+# turns must name its clamp behavior here — the batched turn kernel
+# (preempt's _rounds_batched / allocate's _round_batched) reuses the
+# sequential selection verbatim, so a silently divergent per-action clamp
+# would corrupt both paths at once:
+#
+# * "allocate" — proportion's check-before-pop overused stop applies
+#   (allocate.go:71-74 + proportion.go:188-193): the batch stops at the
+#   queue's first yet-uncrossed deserved boundary.
+# * "preempt"  — NO queue clamp: preempt has no overused gate at all
+#   (preempt.go pops queues unconditionally), so only the gang/drf/
+#   equilibrium terms bound the turn.
+#
+# Reclaim does NOT take a budget: its claims are single-task by
+# construction (reclaim.go:94-105 pops one task per job per cycle) and
+# its overused gate is applied at the queue POP (proportion.go:188-193 via
+# ``q_over`` in the reclaim kernels), not as a batch clamp.
+TURN_BUDGET_MODES = ("allocate", "preempt")
+
+
 def turn_budget(
     st: SnapshotTensors,
     sess: SessionCtx,
@@ -166,15 +186,18 @@ def turn_budget(
     jmask: jax.Array,   # bool[J] contender mask (this queue's eligible jobs)
     state: AllocState,
     s_max: int,
-    queue_clamp: bool = True,
+    mode: str = "allocate",
 ) -> jax.Array:
     """How many tasks the sequential loop would grant job ``j`` before the
     ordering switches away from it — shared by allocate (idle placement)
-    and preempt/reclaim (victim claims), whose reference loops pop one
-    task at a time through the same JobOrderFn/Overused machinery.
+    and preempt (victim claims), whose reference loops pop one task at a
+    time through the same JobOrderFn/Overused machinery.
 
-    ``queue_clamp`` applies proportion's check-before-pop overused stop;
-    preempt has no overused gate (preempt.go) so it passes False."""
+    ``mode`` (one of :data:`TURN_BUDGET_MODES`) names the action's queue
+    clamp behavior — see the table above the constant."""
+    if mode not in TURN_BUDGET_MODES:
+        raise ValueError(f"turn_budget mode {mode!r}; one of {TURN_BUDGET_MODES}")
+    queue_clamp = mode == "allocate"
     J = st.num_jobs
     b_gang = jnp.where(
         job_ready[j],
@@ -327,10 +350,23 @@ def _selection_shared(st, sess, state, tiers, best_effort_pass):
     return grp_remaining, grp_elig, job_has_pending, job_ready, job_share, jkeys, gkeys
 
 
-def _select_turn(st, sess, state, tiers, s_max, best_effort_pass, shared, q, q_ok):
-    """One queue turn's selection — the single definition both the
-    immediate path (``_process_queue``) and the batched round use, so the
-    bit-exactness of the two paths cannot drift."""
+#: Turn-selection modes: how _select_turn shapes the fairness budget.
+#: "allocate"/"backfill" are allocate_action's two passes; "preempt"/
+#: "preempt_intra" are the eviction phases (no overused clamp — see
+#: TURN_BUDGET_MODES).  Preempt's statement-budget override
+#: (tasks-to-ready for a not-ready preemptor) is applied by the caller
+#: (ops/preempt._phase_budget): it needs the claimant's readiness, which
+#: selection alone does not expose.
+SELECT_MODES = ("allocate", "backfill", "preempt", "preempt_intra")
+
+
+def _select_turn(st, sess, state, tiers, s_max, mode, shared, q, q_ok):
+    """One queue turn's selection — the single definition the immediate
+    path (``_process_queue``), allocate's batched round, and preempt's
+    sequential AND batched turns all use, so the bit-exactness of the
+    paths cannot drift."""
+    if mode not in SELECT_MODES:
+        raise ValueError(f"_select_turn mode {mode!r}; one of {SELECT_MODES}")
     (grp_remaining, grp_elig, job_has_pending, job_ready, job_share,
      jkeys, gkeys) = shared
     jmask = (st.job_queue == q) & job_has_pending & st.job_valid & q_ok
@@ -345,15 +381,31 @@ def _select_turn(st, sess, state, tiers, s_max, best_effort_pass, shared, q, q_o
     req = st.group_resreq[g]  # [R]
 
     # ---- fairness budget B ----
-    if best_effort_pass:
+    if mode == "backfill":
         budget = jnp.int32(s_max)
     else:
         budget = turn_budget(
-            st, sess, tiers, j, q, req, job_share, job_ready, jmask, state, s_max
+            st, sess, tiers, j, q, req, job_share, job_ready, jmask, state,
+            s_max, mode="preempt" if mode.startswith("preempt") else "allocate",
         )
     budget = jnp.clip(budget, 0, s_max)
     budget = jnp.where(has_grp, jnp.minimum(budget, grp_remaining[g]), 0)
     return j, g, has_grp, req, budget
+
+
+def select_turns(st, sess, state, tiers, s_max, mode, shared, q_ids, q_ok):
+    """Batched (vmapped) turn selection — the batched turn kernel's
+    selection stage: every queue's (claimant job, group, budget) in one
+    fused program, from the SAME ``_select_turn`` definition the
+    sequential loops run.  Valid for a whole round because a turn's
+    selection reads only rows its own queue owns (see _round_batched /
+    _rounds_batched docstrings).  Returns [Qs]-batched
+    (j, g, has_grp, req, budget)."""
+
+    def sel(q, ok):
+        return _select_turn(st, sess, state, tiers, s_max, mode, shared, q, ok)
+
+    return jax.vmap(sel)(q_ids, q_ok)
 
 
 def _process_queue(
@@ -384,7 +436,8 @@ def _process_queue(
     # trip bound in _round)
     shared = _selection_shared(st, sess, state, tiers, best_effort_pass)
     j, g, has_grp, req, budget = _select_turn(
-        st, sess, state, tiers, s_max, best_effort_pass, shared, q, q_ok
+        st, sess, state, tiers, s_max,
+        "backfill" if best_effort_pass else "allocate", shared, q, q_ok,
     )
 
     # ---- static feasibility on nodes (predicates minus resources) ----
@@ -556,10 +609,7 @@ def _round_batched(
         for p in tier.plugins
     )
 
-    def select(q, qok):
-        return _select_turn(
-            st, sess, state, tiers, s_max, best_effort_pass, shared, q, qok
-        )
+    sel_mode = "backfill" if best_effort_pass else "allocate"
 
     def chunk_body(c, carry):
         (node_idle, node_releasing, node_ports, node_num_tasks,
@@ -568,8 +618,9 @@ def _round_batched(
 
         idx = c * S + jnp.arange(S)
         q_idx = perm[jnp.clip(idx, 0, Q - 1)]
-        j_sel, g_sel, has_grp, req_s, budget_s = jax.vmap(select)(
-            q_idx, q_served[q_idx] & (idx < trip)
+        j_sel, g_sel, has_grp, req_s, budget_s = select_turns(
+            st, sess, state, tiers, s_max, sel_mode, shared,
+            q_idx, q_served[q_idx] & (idx < trip),
         )
 
         if preds_on:
@@ -844,7 +895,10 @@ def _decode_deferred(
     return dataclasses.replace(state, task_status=task_status, task_node=task_node)
 
 
-@partial(jax.jit, static_argnames=("tiers", "s_max", "max_rounds", "best_effort_pass"))
+@partial(
+    jax.jit,
+    static_argnames=("tiers", "s_max", "max_rounds", "best_effort_pass", "turn_batch"),
+)
 def allocate_action(
     st: SnapshotTensors,
     sess: SessionCtx,
@@ -854,9 +908,21 @@ def allocate_action(
     max_rounds: int = 100_000,
     best_effort_pass: bool = False,
     native_ops: bool = False,  # ACTION_KERNELS uniformity; inert here
+    turn_batch=None,
 ) -> AllocState:
-    """Run rounds until a full round places nothing (queues drained)."""
-    defer = _use_deferred_decode(st, tiers)
+    """Run rounds until a full round places nothing (queues drained).
+
+    ``turn_batch``: None (default) auto-picks the batched round
+    (``_round_batched`` — deferred decode + batched selection) when
+    legal (:func:`_use_deferred_decode`); False forces the immediate
+    sequential turn loop (the parity suite's reference); True asserts
+    the batched path is legal and takes it."""
+    defer = _use_deferred_decode(st, tiers) if turn_batch is None else turn_batch
+    if turn_batch and not _use_deferred_decode(st, tiers):
+        raise ValueError(
+            "turn_batch=True but the deferred/batched round is not legal "
+            "for this snapshot/tiers (node order, pod affinity, or cell cap)"
+        )
 
     def cond(carry):
         s = carry[0] if defer else carry

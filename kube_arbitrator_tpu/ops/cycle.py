@@ -302,21 +302,29 @@ def schedule_cycle_staged(
     PER STAGE (open → each action → commit) with a device sync between
     stages, so each action's wall time is honestly measurable.
 
-    Returns ``(CycleDecisions, [(stage, wall_ts, dur_ms), ...])`` where
-    stage is ``open_session`` / each action name / ``commit``.  Used by
-    the deciders only when tracing is enabled: the fused program stays
-    the fast path (stage boundaries forfeit cross-action fusion and pay a
-    dispatch + sync per stage)."""
+    Returns ``(CycleDecisions, [(stage, wall_ts, dur_ms, rounds), ...])``
+    where stage is ``open_session`` / each action name / ``commit`` and
+    ``rounds`` is the action's round count (``AllocState.rounds`` after
+    the stage — every action kernel resets it at entry; preempt's two
+    phases accumulate into one counter) or None for the non-action
+    stages.  The scheduler turns rounds into the
+    ``kernel_rounds_total{action=...}`` counters, attributing WHERE the
+    evictive round loops spend their turns.  Used by the deciders only
+    when tracing is enabled: the fused program stays the fast path
+    (stage boundaries forfeit cross-action fusion and pay a dispatch +
+    sync per stage)."""
     import time
 
     timings = []
 
-    def _timed(stage, fn, *args, **kw):
+    def _timed(stage, fn, *args, rounds_of=None, **kw):
         ts = time.time()
         t0 = time.perf_counter()
         out = fn(*args, **kw)
         jax.block_until_ready(out)
-        timings.append((stage, ts, (time.perf_counter() - t0) * 1000))
+        ms = (time.perf_counter() - t0) * 1000
+        rounds = int(rounds_of(out).rounds) if rounds_of is not None else None
+        timings.append((stage, ts, ms, rounds))
         return out
 
     sess, state = _timed("open_session", _open_session_jit, st, tiers=tiers)
@@ -326,7 +334,7 @@ def schedule_cycle_staged(
         state = _timed(
             action, _run_stage, st, sess, state,
             action=action, tiers=tiers, s_max=s_max, max_rounds=max_rounds,
-            native_ops=native_ops,
+            native_ops=native_ops, rounds_of=lambda s: s,
         )
     dec = _timed("commit", _commit_jit, st, sess, state)
     return dec, timings

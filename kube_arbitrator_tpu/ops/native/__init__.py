@@ -10,4 +10,12 @@ cycle.  The kernel is only legal in programs compiled FOR CPU — callers
 thread the static ``native_ops`` flag from the device-selection seam
 (framework/decider.py, bench.py), never from a trace-time backend guess.
 """
-from .segsum import available, cumsum_f32, per_node_sums  # noqa: F401
+from .segsum import (  # noqa: F401
+    available,
+    cumsum_f32,
+    per_node_sums,
+    scatter_add_f32,
+    scatter_minmax_f32,
+    scatter_set_i32,
+    seg_cumsum_f32,
+)

@@ -90,3 +90,175 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(
     ffi::Ffi::Bind()
         .Arg<ffi::Buffer<ffi::F32>>()
         .Ret<ffi::Buffer<ffi::F32>>());
+
+// SEGMENTED inclusive column-wise prefix sum over [P, C] f32: the running
+// sums reset wherever seg_start is set (slot 0 is an implicit segment
+// start).  This is the batched-turn round's primitive (ops/preempt.py
+// batched rounds + SortLayout.rank_and_cum): one pass yields every
+// (job | queue | node,queue) segment's victim ranks and resource
+// cumulatives for ALL queues' turns at once.  Strict left-to-right order
+// within a segment — the sequential oracle's accumulation order — and a
+// slot's result reads only its own segment's values, so per-queue results
+// are bit-identical whether the mask covers one queue's turn or the whole
+// round's union (the property the sequential-vs-batched parity suite
+// pins).
+static ffi::Error SegCumsumImpl(
+    ffi::Buffer<ffi::F32> x,          // [P, C]
+    ffi::Buffer<ffi::PRED> seg,       // [P] segment-start flags
+    ffi::ResultBuffer<ffi::F32> out   // [P, C]
+) {
+  if (x.dimensions().size() != 2) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "kat_seg_cumsum_f32 expects a rank-2 [P, C] buffer");
+  }
+  const int64_t p = x.dimensions()[0];
+  const int64_t c = x.dimensions()[1];
+  const float* s = x.typed_data();
+  const bool* f = seg.typed_data();
+  float* o = out->typed_data();
+  if (p == 0) return ffi::Error::Success();
+  for (int64_t k = 0; k < c; ++k) o[k] = s[k];
+  for (int64_t i = 1; i < p; ++i) {
+    const float* row = s + i * c;
+    const float* prev = o + (i - 1) * c;
+    float* dst = o + i * c;
+    if (f[i]) {
+      for (int64_t k = 0; k < c; ++k) dst[k] = row[k];
+    } else {
+      for (int64_t k = 0; k < c; ++k) dst[k] = prev[k] + row[k];
+    }
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    SegCumsumF32, SegCumsumImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::F32>>()
+        .Arg<ffi::Buffer<ffi::PRED>>()
+        .Ret<ffi::Buffer<ffi::F32>>());
+
+// Masked scatter-add onto a BASE array: out = base; for masked slots in
+// slot order, out[idx[p], :] += vals[p, :].  Slot order and the running
+// add into the base row make this bit-identical to XLA's
+// ``base.at[idx].add(vals)`` — which XLA:CPU lowers to a dimension-
+// general ~100 ns/index serial loop, ~0.6 ms per claim turn at P~6k;
+// this loop is the same adds at memory speed.  Out-of-range indices are
+// skipped (the jnp callers' mode="drop").
+static ffi::Error ScatterAddImpl(
+    ffi::Buffer<ffi::F32> base,      // [N, C]
+    ffi::Buffer<ffi::PRED> mask,     // [P]
+    ffi::Buffer<ffi::S32> idx,       // [P]
+    ffi::Buffer<ffi::F32> vals,      // [P, C]
+    ffi::ResultBuffer<ffi::F32> out  // [N, C]
+) {
+  const int64_t n = base.dimensions()[0];
+  const int64_t c = base.dimensions()[1];
+  const int64_t p = mask.dimensions()[0];
+  const bool* m = mask.typed_data();
+  const int32_t* ix = idx.typed_data();
+  const float* v = vals.typed_data();
+  const float* b = base.typed_data();
+  float* o = out->typed_data();
+  for (int64_t i = 0; i < n * c; ++i) o[i] = b[i];
+  for (int64_t s = 0; s < p; ++s) {
+    if (!m[s]) continue;
+    const int64_t node = ix[s];
+    if (node < 0 || node >= n) continue;
+    float* dst = o + node * c;
+    const float* src = v + s * c;
+    for (int64_t k = 0; k < c; ++k) dst[k] += src[k];
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    ScatterAddF32, ScatterAddImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::F32>>()
+        .Arg<ffi::Buffer<ffi::PRED>>()
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Arg<ffi::Buffer<ffi::F32>>()
+        .Ret<ffi::Buffer<ffi::F32>>());
+
+// Masked per-node column-wise max/min: out[n, :R] = max, out[n, R:] =
+// min over masked slots with idx == n; identities +-3e38 (the jnp
+// fallback's BIG) where a node has no masked slot.  Max/min are exact,
+// so this is bit-identical to the jnp scatter-max/min pair.
+static ffi::Error ScatterMinMaxImpl(
+    ffi::Buffer<ffi::PRED> mask,     // [P]
+    ffi::Buffer<ffi::S32> idx,       // [P]
+    ffi::Buffer<ffi::F32> vals,      // [P, R]
+    ffi::ResultBuffer<ffi::F32> out  // [N, 2R]
+) {
+  const int64_t p = mask.dimensions()[0];
+  const int64_t r = vals.dimensions()[1];
+  const int64_t n = out->dimensions()[0];
+  const bool* m = mask.typed_data();
+  const int32_t* ix = idx.typed_data();
+  const float* v = vals.typed_data();
+  float* o = out->typed_data();
+  const float kBig = 3.0e38f;
+  for (int64_t node = 0; node < n; ++node) {
+    float* dst = o + node * 2 * r;
+    for (int64_t k = 0; k < r; ++k) dst[k] = -kBig;
+    for (int64_t k = 0; k < r; ++k) dst[r + k] = kBig;
+  }
+  for (int64_t s = 0; s < p; ++s) {
+    if (!m[s]) continue;
+    const int64_t node = ix[s];
+    if (node < 0 || node >= n) continue;
+    float* dst = o + node * 2 * r;
+    const float* src = v + s * r;
+    for (int64_t k = 0; k < r; ++k) {
+      if (src[k] > dst[k]) dst[k] = src[k];
+      if (src[k] < dst[r + k]) dst[r + k] = src[k];
+    }
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    ScatterMinMax, ScatterMinMaxImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::PRED>>()
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Arg<ffi::Buffer<ffi::F32>>()
+        .Ret<ffi::Buffer<ffi::F32>>());
+
+// Masked scatter-set of i32 values onto a base: out = base;
+// out[idx[p]] = val[p] for masked slots (slot order; callers' indices
+// are unique, so order is immaterial).  The eviction status/attribution
+// writes ([P] panel slots into [T] task arrays) are this shape.
+static ffi::Error ScatterSetImpl(
+    ffi::Buffer<ffi::S32> base,      // [T]
+    ffi::Buffer<ffi::PRED> mask,     // [P]
+    ffi::Buffer<ffi::S32> idx,       // [P]
+    ffi::Buffer<ffi::S32> val,       // [P]
+    ffi::ResultBuffer<ffi::S32> out  // [T]
+) {
+  const int64_t t = base.dimensions()[0];
+  const int64_t p = mask.dimensions()[0];
+  const bool* m = mask.typed_data();
+  const int32_t* ix = idx.typed_data();
+  const int32_t* v = val.typed_data();
+  const int32_t* b = base.typed_data();
+  int32_t* o = out->typed_data();
+  for (int64_t i = 0; i < t; ++i) o[i] = b[i];
+  for (int64_t s = 0; s < p; ++s) {
+    if (!m[s]) continue;
+    const int64_t i = ix[s];
+    if (i < 0 || i >= t) continue;
+    o[i] = v[s];
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    ScatterSetI32, ScatterSetImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Arg<ffi::Buffer<ffi::PRED>>()
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Ret<ffi::Buffer<ffi::S32>>());

@@ -18,11 +18,23 @@ _SO = os.path.join(_HERE, "libsegsum.so")
 _state: dict = {"ready": None, "why": None}  # tri-state: None = not tried
 
 
+def _ffi():
+    """The FFI namespace across jax versions: ``jax.ffi`` (>= 0.4.38) or
+    its ``jax.extend.ffi`` predecessor — same API surface for the calls
+    used here (include_dir / register_ffi_target / pycapsule / ffi_call)."""
+    import jax
+
+    mod = getattr(jax, "ffi", None)
+    if mod is not None and hasattr(mod, "include_dir"):
+        return mod
+    import jax.extend.ffi
+
+    return jax.extend.ffi
+
+
 def _jaxlib_include() -> Optional[str]:
     try:
-        import jax
-
-        return jax.ffi.include_dir()
+        return _ffi().include_dir()
     except Exception:
         return None
 
@@ -46,17 +58,36 @@ def available() -> bool:
         try:
             import ctypes
 
-            import jax
-
+            ffi = _ffi()
             lib = ctypes.cdll.LoadLibrary(_SO)
-            jax.ffi.register_ffi_target(
+            ffi.register_ffi_target(
                 "kat_segsum_masked",
-                jax.ffi.pycapsule(lib.SegSumMasked),
+                ffi.pycapsule(lib.SegSumMasked),
                 platform="cpu",
             )
-            jax.ffi.register_ffi_target(
+            ffi.register_ffi_target(
                 "kat_cumsum_f32",
-                jax.ffi.pycapsule(lib.CumsumF32),
+                ffi.pycapsule(lib.CumsumF32),
+                platform="cpu",
+            )
+            ffi.register_ffi_target(
+                "kat_seg_cumsum_f32",
+                ffi.pycapsule(lib.SegCumsumF32),
+                platform="cpu",
+            )
+            ffi.register_ffi_target(
+                "kat_scatter_add_f32",
+                ffi.pycapsule(lib.ScatterAddF32),
+                platform="cpu",
+            )
+            ffi.register_ffi_target(
+                "kat_scatter_minmax_f32",
+                ffi.pycapsule(lib.ScatterMinMax),
+                platform="cpu",
+            )
+            ffi.register_ffi_target(
+                "kat_scatter_set_i32",
+                ffi.pycapsule(lib.ScatterSetI32),
                 platform="cpu",
             )
         except Exception as e:  # registration API drift, dlopen failure
@@ -76,7 +107,7 @@ def per_node_sums(mask, res, bstart, num_nodes: int):
     import jax
     import jax.numpy as jnp
 
-    return jax.ffi.ffi_call(
+    return _ffi().ffi_call(
         "kat_segsum_masked",
         jax.ShapeDtypeStruct((num_nodes, res.shape[1] + 1), jnp.float32),
     )(mask, res, bstart)
@@ -89,6 +120,60 @@ def cumsum_f32(x):
     import jax
     import jax.numpy as jnp
 
-    return jax.ffi.ffi_call(
+    return _ffi().ffi_call(
         "kat_cumsum_f32", jax.ShapeDtypeStruct(x.shape, jnp.float32)
     )(x)
+
+
+def seg_cumsum_f32(x, seg_start):
+    """SEGMENTED inclusive column-wise prefix sum of f32[P, C]: running
+    sums reset where bool[P] ``seg_start`` is set.  Strict left-to-right
+    within a segment, and a slot's result reads only its own segment —
+    the bit-stability property the batched turn kernel rests on.  Same
+    caller contract as :func:`per_node_sums`."""
+    import jax
+    import jax.numpy as jnp
+
+    return _ffi().ffi_call(
+        "kat_seg_cumsum_f32", jax.ShapeDtypeStruct(x.shape, jnp.float32)
+    )(x, seg_start)
+
+
+def scatter_add_f32(base, mask, idx, vals):
+    """``base.at[idx[mask]].add(vals[mask])`` in slot order — bit-identical
+    to the XLA scatter (same adds, same order), without its ~100 ns/index
+    dimension-general serial loop.  base f32[N, C], mask bool[P],
+    idx i32[P] (out-of-range dropped), vals f32[P, C].  Same caller
+    contract as :func:`per_node_sums`."""
+    import jax
+    import jax.numpy as jnp
+
+    return _ffi().ffi_call(
+        "kat_scatter_add_f32", jax.ShapeDtypeStruct(base.shape, jnp.float32)
+    )(base, mask, idx, vals)
+
+
+def scatter_minmax_f32(mask, idx, vals, num_nodes: int):
+    """f32[N, 2R]: per-node column-wise (max | min) of masked slots —
+    identities ±BIG where a node has no masked slot, matching the jnp
+    scatter-max/min fallback exactly.  Same caller contract as
+    :func:`per_node_sums`."""
+    import jax
+    import jax.numpy as jnp
+
+    return _ffi().ffi_call(
+        "kat_scatter_minmax_f32",
+        jax.ShapeDtypeStruct((num_nodes, 2 * vals.shape[1]), jnp.float32),
+    )(mask, idx, vals)
+
+
+def scatter_set_i32(base, mask, idx, val):
+    """``base.at[idx[mask]].set(val[mask])`` (unique indices; out-of-range
+    dropped).  base i32[T], mask bool[P], idx i32[P], val i32[P].  Same
+    caller contract as :func:`per_node_sums`."""
+    import jax
+    import jax.numpy as jnp
+
+    return _ffi().ffi_call(
+        "kat_scatter_set_i32", jax.ShapeDtypeStruct(base.shape, jnp.int32)
+    )(base, mask, idx, val)

@@ -45,9 +45,11 @@ from .allocate import (
     PIPELINED,
     SessionCtx,
     _copies_fit,
+    _select_turn,
+    _selection_shared,
     group_live_mask,
     queue_has_live_job,
-    turn_budget,
+    select_turns,
 )
 from .common import BIG, EPS, fair, lex_argmin, mm_cumsum, safe_share, seg_cumsum
 from .fairness import drf_shares, queue_shares
@@ -80,6 +82,7 @@ class SortLayout:
     order: jax.Array     # i32[T] sorted position -> task index
     inv: jax.Array       # i32[T] task index -> sorted position
     base_idx: jax.Array  # i32[T] sorted position -> its segment's start position
+    seg_start: jax.Array  # bool[T] sorted position is its segment's first
     res_sorted: jax.Array  # f32[T, R] task resreq pre-gathered into sort order
 
     @classmethod
@@ -103,53 +106,71 @@ class SortLayout:
             seg_start = seg_start.at[1:].max(s_s[1:] != s_s[:-1])
         base_idx = jax.lax.associative_scan(jnp.maximum, jnp.where(seg_start, pos, 0))
         inv = jnp.zeros(T, jnp.int32).at[order].set(pos.astype(jnp.int32))
-        return cls(order=order, inv=inv, base_idx=base_idx, res_sorted=resreq[order])
+        return cls(order=order, inv=inv, base_idx=base_idx, seg_start=seg_start,
+                   res_sorted=resreq[order])
 
     def rank_and_cum(self, mask: jax.Array, native_ops: bool = False):
         """Per-task exclusive in-segment candidate rank and INCLUSIVE
         cumulative resreq among candidates, in task-index space.
         Non-candidates get the rank/cum of the candidates before them.
 
-        The count column rides one fused prefix sum with the resource
-        columns; the resreq gather is pre-staged in ``res_sorted`` at
-        build time.  ``native_ops`` (host-CPU programs only) swaps the
-        blocked-matmul mm_cumsum (~0.29 ms at P=12.5k, three calls per
-        preempt turn) for the C++ FFI serial scan (~0.03 ms), whose
-        strict left-to-right order is the sequential oracle's
-        accumulation order.  NOTE: unlike the segsum kernel (same slot
-        order both paths), the two prefix-sum paths ASSOCIATE float adds
-        differently, so native/jnp decision equality is an empirical
-        property of the workloads (zero divergence across the pinned
-        parity seeds and a 20-seed full-action sweep), not a structural
-        guarantee — a >=1-ulp running-sum difference on pathological
-        resreqs could legally flip a tie."""
+        SEGMENT-LOCAL by construction: the scan resets at ``seg_start``
+        (segmented scan, not global-cumsum-minus-base), so a slot's
+        rank/cum is a function of its OWN segment's masked values only —
+        mask content in other segments cannot perturb it, not even at
+        the ulp level.  That property is what lets the batched turn
+        kernel run ONE scan over the whole round's union victim mask and
+        read per-queue results bit-identical to the sequential
+        turn-at-a-time masks (segments are queue-pure in every layout
+        the preempt phases use).
+
+        The count column rides one fused segmented scan with the
+        resource columns; the resreq gather is pre-staged in
+        ``res_sorted`` at build time.  ``native_ops`` (host-CPU programs
+        only) swaps the log-depth associative scan for the C++ FFI
+        serial segmented scan (ops/native/segsum.cc), whose strict
+        left-to-right order is the sequential oracle's accumulation
+        order.  NOTE: the two paths ASSOCIATE float adds differently
+        (tree vs serial), so native/jnp decision equality is an
+        empirical property of the workloads (zero divergence across the
+        pinned parity seeds and a 20-seed full-action sweep), not a
+        structural guarantee — a >=1-ulp running-sum difference on
+        pathological resreqs could legally flip a tie."""
         m_s = mask[self.order]
         m_f = m_s.astype(jnp.float32)
         v_s = jnp.where(m_s[:, None], self.res_sorted, 0.0)
         cols = jnp.concatenate([m_f[:, None], v_s], axis=1)
         if native_ops:
-            from .native import cumsum_f32
+            from .native import seg_cumsum_f32
 
-            both = cumsum_f32(cols)
+            both = seg_cumsum_f32(cols, self.seg_start)
         else:
-            both = mm_cumsum(cols)
+            both = seg_cumsum(cols, self.seg_start)
         cnt, res = both[:, 0], both[:, 1:]
-        cnt_base = cnt[self.base_idx] - m_f[self.base_idx]
-        res_base = res[self.base_idx] - v_s[self.base_idx]
-        rank_s = (cnt - m_f - cnt_base).astype(jnp.int32)  # exclusive candidate rank
-        cum_s = res - res_base                    # inclusive candidate resreq
-        return rank_s[self.inv], cum_s[self.inv]
+        rank_s = (cnt - m_f).astype(jnp.int32)  # exclusive candidate rank
+        return rank_s[self.inv], res[self.inv]
 
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class VictimLayouts:
     """The three fixed victim orders a preempt phase needs (built over the
-    victim-view panel by :func:`_build_view`)."""
+    victim-view panel by :func:`_build_view`).
 
-    by_job: SortLayout     # segment = victim's job
-    global_: SortLayout    # one segment (cluster-wide cumulative)
-    by_node: SortLayout    # segment = victim's node
+    Every layout's segments are QUEUE-PURE — a job belongs to one queue,
+    and the queue/node layouts carry the queue in the segment key — so
+    with the segment-local ``rank_and_cum`` the union of all queues'
+    turn masks yields, inside each segment, exactly the values the
+    per-queue masks would: the invariant the batched round kernel rests
+    on.  (``by_queue`` replaces the old cluster-wide ``global_`` layout:
+    a turn's drf cumulative only ever ran over ONE queue's candidates —
+    phase 1 scopes victims to the claimant's queue, phase 2 to the
+    claimant's job — so segmenting by queue is value-identical and makes
+    the layout safe under a multi-queue union mask.)"""
+
+    by_job: SortLayout       # segment = victim's job
+    by_queue: SortLayout     # segment = victim's queue (drf cumulative)
+    by_node_queue: SortLayout  # segment = (node, queue), node-major
 
 
 @jax.tree_util.register_dataclass
@@ -213,11 +234,12 @@ def _build_view(st: SnapshotTensors, state: AllocState, qualify: jax.Array,
     priority = jnp.where(valid, st.task_priority[idxc], int_max)
     uid = jnp.where(valid, st.task_uid_rank[idxc], int_max)
     resreq = jnp.where(valid[:, None], st.task_resreq[idxc], 0.0)
-    zeros = jnp.zeros(P, jnp.int32)
     layouts = VictimLayouts(
         by_job=SortLayout.build(job, priority, uid, resreq),
-        global_=SortLayout.build(zeros, priority, uid, resreq),
-        by_node=SortLayout.build(node, priority, uid, resreq),
+        by_queue=SortLayout.build(queue, priority, uid, resreq),
+        # segs are minor-to-major for lexsort: node is the primary key,
+        # queue subdivides each node block into queue-pure segments
+        by_node_queue=SortLayout.build((queue, node), priority, uid, resreq),
     )
     return VictimView(idx=idx, valid=valid, job=job, queue=queue, node=node,
                       priority=priority, resreq=resreq, layouts=layouts)
@@ -229,14 +251,22 @@ def _victim_verdict(
     sess: SessionCtx,
     tiers: Tiers,
     candidates: jax.Array,  # bool[P] over the victim view
-    claimant_job: jax.Array,  # scalar job ordinal
-    req: jax.Array,  # f32[R] claimant per-task resreq
+    claimant_job: jax.Array,  # i32[P] per-slot claimant job ordinal
+    req: jax.Array,  # f32[P, R] per-slot claimant per-task resreq
     view: VictimView,
     native_ops: bool = False,
 ) -> jax.Array:
     """Tiered Preemptable victim filter for the preempt phases; reclaim
     verdicts live in ``_reclaim_fast`` (session_plugins.go:59-140: within
     a tier verdicts intersect; the first tier producing any victim wins).
+
+    Batched form: ``claimant_job``/``req`` are PER-SLOT (each slot reads
+    its own queue's claimant), so one call evaluates every queue's turn
+    of a round at once over the union candidate mask — the sequential
+    path passes the turn's scalar claimant broadcast across the panel.
+    Since every layout's segments are queue-pure and ``rank_and_cum`` is
+    segment-local, the two call shapes produce bit-identical verdicts
+    for any given queue's slots.
 
     Per-victim in-segment ranks and cumulative resreqs mirror the
     reference's per-job ``allocations`` map, which subtracts every
@@ -264,16 +294,16 @@ def _victim_verdict(
         # so a multi-task turn progresses ls exactly like the sequential
         # evict-one/place-one interleave.
         total = sess.drf_total
-        _, global_cum = layouts.global_.rank_and_cum(candidates, native_ops)
+        _, queue_cum = layouts.by_queue.rank_and_cum(candidates, native_ops)
         supported = jnp.min(
-            jnp.where(req[None, :] > 0, global_cum / jnp.maximum(req[None, :], 1e-30), BIG),
+            jnp.where(req > 0, queue_cum / jnp.maximum(req, 1e-30), BIG),
             axis=-1,
         )
         supported = jnp.floor(jnp.maximum(supported - 1.0, 0.0))  # tasks placed before this victim
         ls = jnp.max(
             safe_share(
-                state.job_alloc[claimant_job][None, :]
-                + (supported[:, None] + 1.0) * req[None, :],
+                state.job_alloc[claimant_job]
+                + (supported[:, None] + 1.0) * req,
                 total[None, :],
             ),
             axis=-1,
@@ -302,6 +332,24 @@ def _victim_verdict(
     return jnp.zeros_like(candidates)
 
 
+def _phase_budget(mode, budget, was_ready, need, has_grp, grp_rem_g, s_max):
+    """Preempt-phase shaping of the shared fairness budget — factored so
+    the sequential turn and the batched round apply the identical rule
+    (works elementwise for [Q]-batched inputs)."""
+    if mode == "preempt":
+        # a not-ready preemptor's statement pops tasks until JobReady with
+        # no mid-statement re-ordering (preempt.go:89-120), so its turn
+        # budget is exactly the tasks-to-ready gap, not the drf clamp
+        budget = jnp.where(
+            was_ready, budget,
+            jnp.where(has_grp, jnp.minimum(jnp.maximum(need, 1), grp_rem_g), 0),
+        )
+    # the mode overrides can exceed s_max (a tasks-to-ready gap is
+    # unbounded) but the slot decode only covers s_max slots — re-clamp so
+    # placed_total can never outrun the decodable range
+    return jnp.minimum(budget, s_max)
+
+
 def _claim_turn(
     q: jax.Array,
     st: SnapshotTensors,
@@ -317,84 +365,118 @@ def _claim_turn(
     select victims, evict the minimal prefix, pipeline claimant tasks onto
     the freed (releasing) capacity.  (Reclaim runs in ``_reclaim_fast``.)
 
+    This is the SEQUENTIAL turn — selection via the shared
+    ``_select_turn`` (one definition with allocate and the batched
+    round), verdicts over this turn's single-queue mask, then the shared
+    ``_apply_claim`` tail.  The batched round (``_rounds_batched``)
+    hoists the selection and the verdict/prefix scans to round level and
+    calls the same ``_apply_claim`` — bit-identical by the queue-locality
+    and segment-locality arguments documented there.
+
     Victim-side tensors live in the compacted ``view`` panel [P]; only
     the claimant decode and the final status/attribution scatters touch
     [T] arrays."""
-    J = st.num_jobs
-    T = st.num_tasks
-
     q_ok = st.queue_valid[q]  # preempt has no overused gate
 
     # (inactive/padding queues are skipped via the active-queue trip
     # bound in _rounds, not a lax.cond — a cond's passthrough branch would
     # copy the state pytree per turn)
-    grp_remaining = st.group_size - state.group_placed
-    grp_elig = group_live_mask(st, sess, state.group_placed, state.group_unfit)
-    job_has_pending = jnp.zeros(J, dtype=bool).at[st.group_job].max(grp_elig)
-    jmask = (st.job_queue == q) & job_has_pending & st.job_valid & q_ok
-
-    # ---- claimant selection (same order machinery as allocate) ----
-    job_ready = state.job_ready_cnt >= sess.min_avail
-    job_share = drf_shares(state.job_alloc, sess.drf_total)
-    jkeys = job_order_keys(tiers, st.job_priority, job_ready, st.job_creation_rank, job_share)
-    j, has_job = lex_argmin(jkeys, jmask)
-
-    gmask = (st.group_job == j) & grp_elig & has_job
-    gkeys = group_order_keys(tiers, st.group_priority, st.group_uid_rank)
-    g, has_grp = lex_argmin(gkeys, gmask)
-    req = st.group_resreq[g]
-
-    # Fairness-batched budget, shared with allocate: the reference's
-    # push-back loop (preempt.go:116-131) keeps re-popping the same job
-    # one task at a time until JobOrderFn prefers a contender — exactly
-    # the share-crossing/equilibrium budget.  The cumulative victim
-    # verdicts below were built for multi-task turns (per-victim rank and
-    # prefix caps), so a batched turn replays the same evict-one/place-one
-    # chain.  Preempt has no overused gate so the queue clamp is off.
-    budget = turn_budget(
-        st, sess, tiers, j, q, req, job_share, job_ready, jmask, state, s_max,
-        queue_clamp=False,
+    shared = _selection_shared(st, sess, state, tiers, None)
+    (grp_remaining, _grp_elig, _jhp, job_ready, _job_share, _jk, _gk) = shared
+    j, g, has_grp, req, budget = _select_turn(
+        st, sess, state, tiers, s_max, mode, shared, q, q_ok
     )
-    budget = jnp.clip(budget, 0, s_max)
-    budget = jnp.where(has_grp, jnp.minimum(budget, grp_remaining[g]), 0)
     was_ready = job_ready[j]
     need = jnp.maximum(sess.min_avail[j] - state.job_ready_cnt[j], 0)
-    if mode == "preempt":
-        # a not-ready preemptor's statement pops tasks until JobReady with
-        # no mid-statement re-ordering (preempt.go:89-120), so its turn
-        # budget is exactly the tasks-to-ready gap, not the drf clamp
-        budget = jnp.where(
-            was_ready, budget,
-            jnp.where(has_grp, jnp.minimum(jnp.maximum(need, 1), grp_remaining[g]), 0),
-        )
-    # the mode overrides can exceed s_max (a tasks-to-ready gap is
-    # unbounded) but the slot decode below only covers s_max slots —
-    # re-clamp so placed_total can never outrun the decodable range
-    budget = jnp.minimum(budget, s_max)
+    budget = _phase_budget(
+        mode, budget, was_ready, need, has_grp, grp_remaining[g], s_max
+    )
 
     # ---- victim candidates by scope (panel space) ----
     p_running = view.running(state.task_status)
+    P = p_running.shape[0]
     vj = view.job
     if mode == "preempt":
         scope = p_running & (vj != j) & (view.queue == q)
     else:  # preempt_intra: lower-priority tasks of the same job
         scope = p_running & (vj == j) & (view.priority < st.group_priority[g])
     victims = (
-        _victim_verdict(st, state, sess, tiers, scope, j, req, view, native_ops)
+        _victim_verdict(
+            st, state, sess, tiers, scope,
+            jnp.broadcast_to(j.astype(jnp.int32), (P,)),
+            jnp.broadcast_to(req, (P, req.shape[0])),
+            view, native_ops,
+        )
         & has_grp
     )
 
     # ---- per-node victim prefix sums (deterministic order) ----
-    node_rank, node_cum = view.layouts.by_node.rank_and_cum(victims, native_ops)
+    node_rank, node_cum = view.layouts.by_node_queue.rank_and_cum(victims, native_ops)
+    return _apply_claim(
+        st, sess, state, tiers, s_max, mode, view, native_ops,
+        q, j, g, has_grp, req, budget, was_ready, need,
+        victims, node_rank, node_cum,
+    )
+
+
+def _apply_claim(
+    st: SnapshotTensors,
+    sess: SessionCtx,
+    state: AllocState,
+    tiers: Tiers,
+    s_max: int,
+    mode: str,
+    view: VictimView,
+    native_ops: bool,
+    q: jax.Array,          # queue ordinal
+    j: jax.Array,          # claimant job ordinal
+    g: jax.Array,          # claimant group ordinal
+    has_grp: jax.Array,    # bool scalar
+    req: jax.Array,        # f32[R]
+    budget: jax.Array,     # i32 scalar (phase-shaped)
+    was_ready: jax.Array,  # bool scalar
+    need: jax.Array,       # i32 scalar
+    victims: jax.Array,    # bool[P] verdict-filtered victims of THIS queue
+    node_rank: jax.Array,  # i32[P] in-(node,queue) victim rank
+    node_cum: jax.Array,   # f32[P, R] in-(node,queue) inclusive victim cum
+) -> AllocState:
+    """The selection-independent tail of one queue turn: per-node claim
+    capacity over the victim set, covering-prefix evictions, claimant
+    decode, and the state scatters.  ONE definition shared by the
+    sequential turn (``_claim_turn``) and the batched round
+    (``_rounds_batched``) so the placement/eviction math of the two paths
+    cannot drift.
+
+    ``native_ops`` swaps the XLA scatters — the turn's dominant cost on
+    host CPU (~0.6 ms per scatter at P~6k; XLA:CPU lowers scatter to a
+    dimension-general ~100 ns/index serial loop) — for the C++ FFI
+    scatter kernels (ops/native/segsum.cc), which apply the same updates
+    in the same slot order."""
+    J = st.num_jobs
+    T = st.num_tasks
+    vj = view.job
+
     vres = jnp.where(victims[:, None], view.resreq, 0.0)
     c_excl = node_cum - vres  # per-victim exclusive in-node prefix
 
-    totfree = jnp.zeros_like(state.node_releasing).at[
-        jnp.where(victims, view.node, st.num_nodes)
-    ].add(vres, mode="drop")
-    node_victims = jnp.zeros(st.num_nodes, jnp.int32).at[
-        jnp.where(victims, view.node, st.num_nodes)
-    ].add(victims.astype(jnp.int32), mode="drop")
+    if native_ops:
+        from .native import scatter_add_f32
+
+        P = victims.shape[0]
+        agg = scatter_add_f32(
+            jnp.zeros((st.num_nodes, 1 + view.resreq.shape[1]), jnp.float32),
+            victims, view.node,
+            jnp.concatenate([jnp.ones((P, 1), jnp.float32), view.resreq], axis=1),
+        )
+        node_victims = agg[:, 0].astype(jnp.int32)
+        totfree = agg[:, 1:]
+    else:
+        totfree = jnp.zeros_like(state.node_releasing).at[
+            jnp.where(victims, view.node, st.num_nodes)
+        ].add(vres, mode="drop")
+        node_victims = jnp.zeros(st.num_nodes, jnp.int32).at[
+            jnp.where(victims, view.node, st.num_nodes)
+        ].add(victims.astype(jnp.int32), mode="drop")
 
     # ---- claimant placement capacity on freed+releasing space ----
     preds_on = _plugin_on(tiers, "predicates", "predicate_disabled")
@@ -441,13 +523,20 @@ def _claim_turn(
     reqpos = req[None, :] > 0
 
     # Per-node victim-size spread, for the chunked claim count below.
-    vnode_for_minmax = jnp.where(victims, view.node, st.num_nodes)
-    vmax = jnp.full_like(totfree, -BIG).at[vnode_for_minmax].max(
-        jnp.where(victims[:, None], view.resreq, -BIG), mode="drop"
-    )
-    vmin = jnp.full_like(totfree, BIG).at[vnode_for_minmax].min(
-        jnp.where(victims[:, None], view.resreq, BIG), mode="drop"
-    )
+    if native_ops:
+        from .native import scatter_minmax_f32
+
+        R = view.resreq.shape[1]
+        mm = scatter_minmax_f32(victims, view.node, view.resreq, st.num_nodes)
+        vmax, vmin = mm[:, :R], mm[:, R:]
+    else:
+        vnode_for_minmax = jnp.where(victims, view.node, st.num_nodes)
+        vmax = jnp.full_like(totfree, -BIG).at[vnode_for_minmax].max(
+            jnp.where(victims[:, None], view.resreq, -BIG), mode="drop"
+        )
+        vmin = jnp.full_like(totfree, BIG).at[vnode_for_minmax].min(
+            jnp.where(victims[:, None], view.resreq, BIG), mode="drop"
+        )
     node_uniform = jnp.all(vmax - vmin <= EPS, axis=-1) & (node_victims > 0)
 
     # Claim count per node.  The sequential evict loop consumes a whole
@@ -499,7 +588,15 @@ def _claim_turn(
         cap = apply_seed(st, pafit, cap)
         cap = apply_domain_cap(st, pafit, cap, None)
 
-    cum = jnp.cumsum(cap)
+    if native_ops:
+        # XLA:CPU lowers the [N] cumsum to a ~8.5 ns/element serial scan;
+        # the FFI serial scan is the same order at memory speed.  cap sums
+        # are bounded by T < 2**24, so the f32 round-trip is exact.
+        from .native import cumsum_f32
+
+        cum = cumsum_f32(cap.astype(jnp.float32)[:, None])[:, 0].astype(jnp.int32)
+    else:
+        cum = jnp.cumsum(cap)
     placed_total = jnp.minimum(budget, cum[-1])
     p = jnp.clip(placed_total - (cum - cap), 0, cap)  # i32[N]
 
@@ -543,19 +640,43 @@ def _claim_turn(
     evict = victims & jnp.where(node_uniform[vnode_safe], rank_rule, cum_rule)
     evict = evict & (p[vnode_safe] > 0)
 
-    freed = jnp.zeros_like(state.node_releasing).at[
-        jnp.where(evict, view.node, st.num_nodes)
-    ].add(jnp.where(evict[:, None], view.resreq, 0.0), mode="drop")
+    if native_ops:
+        from .native import scatter_add_f32
 
-    # ---- decode claimant task assignment (same slot trick as allocate) ----
+        freed = scatter_add_f32(
+            jnp.zeros_like(state.node_releasing), evict, view.node, view.resreq
+        )
+    else:
+        freed = jnp.zeros_like(state.node_releasing).at[
+            jnp.where(evict, view.node, st.num_nodes)
+        ].add(jnp.where(evict[:, None], view.resreq, 0.0), mode="drop")
+
+    # ---- decode claimant task assignment (same slot trick as allocate).
+    # Gated on placed_total > 0: a zero-placement turn's decode is the
+    # identity (assigned is all-False), and the ~8 [T]-wide passes it
+    # spends are the thin batched turn's single largest cost ----
     placed_before = state.group_placed[g]
-    slots = jnp.arange(s_max)
-    node_of_slot = jnp.searchsorted(cum, slots, side="right").astype(jnp.int32)
-    slot_of_task = st.task_group_rank - placed_before
-    assigned = (
-        (st.task_group == g) & (slot_of_task >= 0) & (slot_of_task < placed_total) & st.task_valid
-    )
-    tnode = node_of_slot[jnp.clip(slot_of_task, 0, s_max - 1)]
+
+    def _decode(_):
+        slots = jnp.arange(s_max)
+        node_of_slot = jnp.searchsorted(cum, slots, side="right").astype(jnp.int32)
+        slot_of_task = st.task_group_rank - placed_before
+        assigned = (
+            (st.task_group == g)
+            & (slot_of_task >= 0)
+            & (slot_of_task < placed_total)
+            & st.task_valid
+        )
+        tnode = node_of_slot[jnp.clip(slot_of_task, 0, s_max - 1)]
+        return assigned, tnode
+
+    def _no_decode(_):
+        return (
+            jnp.zeros(T, bool),
+            jnp.zeros(T, jnp.int32),
+        )
+
+    assigned, tnode = jax.lax.cond(placed_total > 0, _decode, _no_decode, None)
 
     # ---- apply (scatter updates; no-ops when nothing placed) ----
     evict_res = jnp.where(evict[:, None], view.resreq, 0.0)
@@ -563,25 +684,56 @@ def _claim_turn(
     ptf = placed_total.astype(jnp.float32) * req
     uncond = mode == "preempt_intra"
 
-    ev_t = jnp.where(evict, view.idx, T)
-    new_status = state.task_status.at[ev_t].set(RELEASING, mode="drop")
-    new_status = jnp.where(assigned, PIPELINED, new_status)
-    evicted_for = state.evicted_for.at[ev_t].set(
-        jnp.int32(-2) if uncond else j.astype(jnp.int32), mode="drop"
-    )
+    if native_ops:
+        from .native import scatter_add_f32, scatter_set_i32
 
-    job_alloc = state.job_alloc.at[jnp.where(evict, vj, J)].add(
-        -evict_res, mode="drop"
-    )
-    job_alloc = job_alloc.at[j].add(ptf)
-    queue_alloc = state.queue_alloc.at[
-        jnp.where(evict, view.queue, st.num_queues)
-    ].add(-evict_res, mode="drop")
-    queue_alloc = queue_alloc.at[q].add(ptf)
-    job_ready_cnt = state.job_ready_cnt.at[jnp.where(evict, vj, J)].add(
-        -evict_cnt, mode="drop"
-    )
-    job_ready_cnt = job_ready_cnt.at[j].add(placed_total)
+        P = victims.shape[0]
+        mark = (
+            jnp.full(P, -2, jnp.int32)
+            if uncond
+            else jnp.broadcast_to(j.astype(jnp.int32), (P,))
+        )
+        new_status = scatter_set_i32(
+            state.task_status, evict, view.idx, jnp.full(P, RELEASING, jnp.int32)
+        )
+        new_status = jnp.where(assigned, PIPELINED, new_status)
+        evicted_for = scatter_set_i32(state.evicted_for, evict, view.idx, mark)
+        # the ready-count column rides the job scatter in f32: counts are
+        # integers far below 2**24, so the float adds are exact and the
+        # round-trip matches the i32 scatter bit-for-bit
+        jbase = jnp.concatenate(
+            [state.job_ready_cnt.astype(jnp.float32)[:, None], state.job_alloc],
+            axis=1,
+        )
+        jout = scatter_add_f32(
+            jbase, evict, vj,
+            -jnp.concatenate([jnp.ones((P, 1), jnp.float32), view.resreq], axis=1),
+        )
+        job_ready_cnt = jout[:, 0].astype(jnp.int32).at[j].add(placed_total)
+        job_alloc = jout[:, 1:].at[j].add(ptf)
+        queue_alloc = scatter_add_f32(
+            state.queue_alloc, evict, view.queue, -view.resreq
+        ).at[q].add(ptf)
+    else:
+        ev_t = jnp.where(evict, view.idx, T)
+        new_status = state.task_status.at[ev_t].set(RELEASING, mode="drop")
+        new_status = jnp.where(assigned, PIPELINED, new_status)
+        evicted_for = state.evicted_for.at[ev_t].set(
+            jnp.int32(-2) if uncond else j.astype(jnp.int32), mode="drop"
+        )
+
+        job_alloc = state.job_alloc.at[jnp.where(evict, vj, J)].add(
+            -evict_res, mode="drop"
+        )
+        job_alloc = job_alloc.at[j].add(ptf)
+        queue_alloc = state.queue_alloc.at[
+            jnp.where(evict, view.queue, st.num_queues)
+        ].add(-evict_res, mode="drop")
+        queue_alloc = queue_alloc.at[q].add(ptf)
+        job_ready_cnt = state.job_ready_cnt.at[jnp.where(evict, vj, J)].add(
+            -evict_cnt, mode="drop"
+        )
+        job_ready_cnt = job_ready_cnt.at[j].add(placed_total)
 
     port_upd = jnp.where(
         ((p > 0) & has_ports)[:, None],
@@ -613,70 +765,97 @@ def _claim_turn(
     )
 
 
+def _round_gate(st, sess, s, mode, view, native_ops=False):
+    """bool[Q]: queues that get a turn this round — live-claimant queues
+    refined by the victims-possible gate.  ONE definition shared by the
+    sequential and batched rounds (and the turn-bound assertions in the
+    perf lane), so the trip bound can never drift between paths.
+
+    Victims-possible gate — decision-identical pruning.  A queue
+    turn whose victim scope is empty for EVERY poppable claimant
+    can only set group_unfit/progress (placed_total and evict are
+    forced 0 by cap=0), never a placement or eviction, so skipping
+    it leaves the action's decisions bit-identical.  This is the
+    q512 ladder row's dominant cost: ~1 claimant job per
+    namespace-queue means phase 1 has no legal victim (the scope
+    excludes the claimant's own job, preempt.go:74-131) yet every
+    round still paid a full-price turn per queue, and the
+    unfit-marking kept ``progress`` true for extra rounds.  The
+    RUNNING victim pool only shrinks within the action, so a
+    gated-off queue can never become possible mid-action (claimant
+    churn is re-checked each round).  The gate reads the victim
+    view: it is a superset of every turn's scope by construction."""
+    Q = st.num_queues
+    J = st.num_jobs
+    grp_live = group_live_mask(st, sess, s.group_placed, s.group_unfit)
+    q_active = st.queue_valid & queue_has_live_job(st, grp_live)
+    p_running = view.running(s.task_status)
+    if mode == "preempt":
+        # scope = running tasks of a DIFFERENT job in the same queue:
+        # possible iff the queue has >=2 jobs with running tasks, or
+        # exactly one and a claimant job that is not it.  Victims are
+        # NOT filtered by job_valid (the turn's scope isn't either —
+        # an invalid job's running tasks are legal victims), only
+        # claimants are.
+        if native_ops:
+            # any == (count > 0): exact for bools, and the [P]-indexed
+            # scatter is the gate's dominant op on XLA:CPU
+            from .native import scatter_add_f32
+
+            P = p_running.shape[0]
+            run_job = scatter_add_f32(
+                jnp.zeros((J, 1), jnp.float32), p_running, view.job,
+                jnp.ones((P, 1), jnp.float32),
+            )[:, 0] > 0
+        else:
+            run_job = jnp.zeros(J, bool).at[view.job].max(p_running, mode="drop")
+        nrun = jnp.zeros(Q, jnp.int32).at[st.job_queue].add(
+            run_job.astype(jnp.int32)
+        )
+        job_claim = jnp.zeros(J, bool).at[st.group_job].max(grp_live)
+        claim_not_run = jnp.zeros(Q, bool).at[st.job_queue].max(
+            job_claim & ~run_job & st.job_valid
+        )
+        possible = (nrun >= 2) | ((nrun == 1) & claim_not_run)
+    else:  # preempt_intra: a lower-priority running task of the SAME job
+        int_max = jnp.iinfo(jnp.int32).max
+        minp = jnp.full(J, int_max, jnp.int32).at[view.job].min(
+            jnp.where(p_running, view.priority, int_max), mode="drop"
+        )
+        g_pos = grp_live & (minp[st.group_job] < st.group_priority)
+        possible = jnp.zeros(Q, bool).at[st.job_queue[st.group_job]].max(g_pos)
+    return q_active & possible
+
+
+def _queue_perm(st, sess, s, tiers, q_active):
+    """(trip, perm): active-queue count and the round's queue processing
+    order (active queues first, by the tiered queue keys) — shared by the
+    sequential and batched rounds.
+
+    trip = nq exactly: a zero-trip fori_loop is the correct "no
+    active queue" round (the former 1-turn floor relied on the
+    dummy queue no-opping via an empty jmask, which the gate
+    breaks — a gated-off queue HAS live jobs and its dummy turn
+    would mark unfit and keep progress true forever)."""
+    nq = jnp.sum(q_active.astype(jnp.int32))
+    q_share = queue_shares(s.queue_alloc, sess.deserved)
+    keys = queue_order_keys(tiers, q_share, st.queue_uid_rank)
+    keys = [jnp.where(q_active, k, BIG) for k in keys]
+    keys.insert(0, jnp.where(q_active, 0.0, 1.0))
+    perm = jnp.lexsort(tuple(reversed(keys)))
+    return nq, perm
+
+
 def _rounds(st, sess, state, tiers, s_max, max_rounds, mode, view, native_ops=False):
     # as in allocate._round: only ACTIVE queues (with an eligible claimant
     # job) get turns — a claimant-less queue's turn is a strict no-op, so
     # 512 namespace-queues with a handful of preemptors pay ~a-handful of
     # turns per round, not 512 (traced bound)
-    Q = st.num_queues
-    J = st.num_jobs
-    T = st.num_tasks
 
     def round_body(s):
         s = dataclasses.replace(s, progress=jnp.array(False))
-        grp_live = group_live_mask(st, sess, s.group_placed, s.group_unfit)
-        q_active = st.queue_valid & queue_has_live_job(st, grp_live)
-        # Victims-possible gate — decision-identical pruning.  A queue
-        # turn whose victim scope is empty for EVERY poppable claimant
-        # can only set group_unfit/progress (placed_total and evict are
-        # forced 0 by cap=0), never a placement or eviction, so skipping
-        # it leaves the action's decisions bit-identical.  This is the
-        # q512 ladder row's dominant cost: ~1 claimant job per
-        # namespace-queue means phase 1 has no legal victim (the scope
-        # excludes the claimant's own job, preempt.go:74-131) yet every
-        # round still paid a full-price turn per queue, and the
-        # unfit-marking kept ``progress`` true for extra rounds.  The
-        # RUNNING victim pool only shrinks within the action, so a
-        # gated-off queue can never become possible mid-action (claimant
-        # churn is re-checked each round).  The gate reads the victim
-        # view: it is a superset of every turn's scope by construction.
-        p_running = view.running(s.task_status)
-        if mode == "preempt":
-            # scope = running tasks of a DIFFERENT job in the same queue:
-            # possible iff the queue has >=2 jobs with running tasks, or
-            # exactly one and a claimant job that is not it.  Victims are
-            # NOT filtered by job_valid (the turn's scope isn't either —
-            # an invalid job's running tasks are legal victims), only
-            # claimants are.
-            run_job = jnp.zeros(J, bool).at[view.job].max(p_running, mode="drop")
-            nrun = jnp.zeros(Q, jnp.int32).at[st.job_queue].add(
-                run_job.astype(jnp.int32)
-            )
-            job_claim = jnp.zeros(J, bool).at[st.group_job].max(grp_live)
-            claim_not_run = jnp.zeros(Q, bool).at[st.job_queue].max(
-                job_claim & ~run_job & st.job_valid
-            )
-            possible = (nrun >= 2) | ((nrun == 1) & claim_not_run)
-        else:  # preempt_intra: a lower-priority running task of the SAME job
-            int_max = jnp.iinfo(jnp.int32).max
-            minp = jnp.full(J, int_max, jnp.int32).at[view.job].min(
-                jnp.where(p_running, view.priority, int_max), mode="drop"
-            )
-            g_pos = grp_live & (minp[st.group_job] < st.group_priority)
-            possible = jnp.zeros(Q, bool).at[st.job_queue[st.group_job]].max(g_pos)
-        q_active = q_active & possible
-        # trip = nq exactly: a zero-trip fori_loop is the correct "no
-        # active queue" round (the former 1-turn floor relied on the
-        # dummy queue no-opping via an empty jmask, which the gate
-        # breaks — a gated-off queue HAS live jobs and its dummy turn
-        # would mark unfit and keep progress true forever)
-        nq = jnp.sum(q_active.astype(jnp.int32))
-        trip = nq
-        q_share = queue_shares(s.queue_alloc, sess.deserved)
-        keys = queue_order_keys(tiers, q_share, st.queue_uid_rank)
-        keys = [jnp.where(q_active, k, BIG) for k in keys]
-        keys.insert(0, jnp.where(q_active, 0.0, 1.0))
-        perm = jnp.lexsort(tuple(reversed(keys)))
+        q_active = _round_gate(st, sess, s, mode, view, native_ops)
+        trip, perm = _queue_perm(st, sess, s, tiers, q_active)
 
         def body(qi, ss):
             return _claim_turn(
@@ -689,10 +868,144 @@ def _rounds(st, sess, state, tiers, s_max, max_rounds, mode, view, native_ops=Fa
     def cond(s):
         return s.progress & (s.rounds < max_rounds)
 
+    # rounds deliberately NOT reset here: preempt's phases accumulate into
+    # one per-action counter (kernel_rounds_total attribution); the action
+    # entry resets it once
     state = dataclasses.replace(
         state,
         progress=jnp.array(True),
-        rounds=jnp.int32(0),
+        group_unfit=jnp.zeros_like(state.group_unfit),
+    )
+    return jax.lax.while_loop(cond, round_body, state)
+
+
+def _rounds_batched(
+    st, sess, state, tiers, s_max, max_rounds, mode, view, native_ops=False
+):
+    """The BATCHED turn kernel: per round, every active queue's claimant
+    selection, fairness budget, victim verdict, and per-(node, queue)
+    victim prefix scans run as ONE fused batch; only the thin
+    node-capacity/commit tail (``_apply_claim``) stays sequential, in the
+    round's queue order.
+
+    Decision-identity with the sequential turn loop (``_rounds``) is
+    structural, not empirical — it rests on two properties, both pinned
+    by the sequential-vs-batched parity suite (tests/test_batched_turns):
+
+    * QUEUE-LOCALITY of everything hoisted.  A preempt turn's selection
+      (claimant job/group, budget) and verdict read only rows its own
+      queue owns — group_placed/group_unfit/job_alloc/job_ready_cnt rows
+      of the queue's jobs, and panel slots of the queue's victims (phase
+      1 scopes victims to the claimant's queue, phase 2 to the
+      claimant's own job).  Turns only write rows their own queue owns,
+      so round-start state gives every queue's turn exactly what the
+      sequential loop's live state would.  The ONLY cross-queue channels
+      are the node pool (max-pods headroom, host ports: two queues
+      claiming capacity on the same node) — and those are consumed
+      inside the sequential ``_apply_claim`` tail, in the same perm
+      order the turn loop used, which is the deterministic
+      conflict-resolution rule.  (Same-victim conflicts cannot arise:
+      victim scopes are queue-disjoint by construction.  Reclaim, whose
+      cross-queue verdicts genuinely chain turn-to-turn, keeps its
+      sequential pop-for-pop kernels.)
+    * SEGMENT-LOCALITY of the scans.  Every victim layout's segments are
+      queue-pure and ``rank_and_cum`` is a segmented scan, so one scan
+      over the round's UNION victim mask returns, for each queue's
+      slots, bit-identical values to that queue's single-turn mask.
+
+    Pod affinity forces the sequential path (the fit reads live task
+    placements mid-turn — a real cross-queue channel).
+
+    The batched selection runs over a compacted ACTIVE-QUEUE PANEL — the
+    first ``TURN_PANEL`` slots of the round's queue perm (active queues
+    sort first) — because the vmapped selection materializes
+    [panel, J]-shaped intermediates and the active count is typically a
+    handful against hundreds of namespace-queues.  The rare round with
+    more active queues than the panel runs its overflow turns through
+    the full sequential ``_claim_turn`` — decision-identical (it is the
+    same selection + verdict at single-queue width), just slower."""
+    Q = st.num_queues
+    R = st.task_resreq.shape[1]
+    QA = min(Q, TURN_PANEL)
+
+    def round_body(s):
+        s = dataclasses.replace(s, progress=jnp.array(False))
+        q_active = _round_gate(st, sess, s, mode, view, native_ops)
+        trip, perm = _queue_perm(st, sess, s, tiers, q_active)
+
+        # ---- batched selection: every panel queue's (job, group, budget)
+        # from round-start state (valid for the whole round by
+        # queue-locality) ----
+        shared = _selection_shared(st, sess, s, tiers, None)
+        (grp_remaining, _grp_elig, _jhp, job_ready, _js, _jk, _gk) = shared
+        q_panel = jax.lax.dynamic_slice(perm, (0,), (QA,))
+        jp, gp, hgp, reqp, budp = select_turns(
+            st, sess, s, tiers, s_max, mode, shared, q_panel, q_active[q_panel]
+        )
+        wrp = job_ready[jp]
+        needp = jnp.maximum(sess.min_avail[jp] - s.job_ready_cnt[jp], 0)
+        budp = _phase_budget(mode, budp, wrp, needp, hgp, grp_remaining[gp], s_max)
+        # scatter the panel back to [Q]-indexed maps (the verdict's
+        # per-slot gathers key by the slot's queue); queues beyond the
+        # panel keep has_grp False and take the sequential fallback below
+        j_sel = jnp.zeros(Q, jnp.int32).at[q_panel].set(jp)
+        g_sel = jnp.zeros(Q, jnp.int32).at[q_panel].set(gp)
+        has_grp = jnp.zeros(Q, bool).at[q_panel].set(hgp)
+        req_all = jnp.zeros((Q, R), jnp.float32).at[q_panel].set(reqp)
+        budget_all = jnp.zeros(Q, jnp.int32).at[q_panel].set(budp)
+        was_ready = jnp.zeros(Q, bool).at[q_panel].set(wrp)
+        need = jnp.zeros(Q, jnp.int32).at[q_panel].set(needp)
+
+        # ---- batched verdicts over the union scope (per-slot claimant) ----
+        p_running = view.running(s.task_status)
+        qp = jnp.minimum(view.queue, Q - 1)  # padding slots clamp; masked below
+        cl = j_sel[qp]
+        slot_on = view.valid & q_active[qp] & has_grp[qp]
+        if mode == "preempt":
+            scope = p_running & (view.job != cl) & slot_on
+        else:  # preempt_intra
+            scope = (
+                p_running
+                & (view.job == cl)
+                & (view.priority < st.group_priority[g_sel[qp]])
+                & slot_on
+            )
+        victims_all = _victim_verdict(
+            st, s, sess, tiers, scope, cl, req_all[qp], view, native_ops
+        )
+        node_rank, node_cum = view.layouts.by_node_queue.rank_and_cum(
+            victims_all, native_ops
+        )
+
+        # ---- thin sequential tail: node-pool conflicts resolved in the
+        # round's queue order ----
+        def thin(qi, ss):
+            q = perm[qi]
+            return _apply_claim(
+                st, sess, ss, tiers, s_max, mode, view, native_ops,
+                q, j_sel[q], g_sel[q], has_grp[q], req_all[q], budget_all[q],
+                was_ready[q], need[q],
+                victims_all & (view.queue == q), node_rank, node_cum,
+            )
+
+        s = jax.lax.fori_loop(0, jnp.minimum(trip, QA), thin, s)
+        if QA < Q:
+            # overflow turns (a round with more active queues than the
+            # panel): the full sequential turn, zero iterations normally
+            def fallback(qi, ss):
+                return _claim_turn(
+                    perm[qi], st, sess, ss, tiers, s_max, mode, view, native_ops
+                )
+
+            s = jax.lax.fori_loop(jnp.int32(QA), trip, fallback, s)
+        return dataclasses.replace(s, rounds=s.rounds + 1)
+
+    def cond(s):
+        return s.progress & (s.rounds < max_rounds)
+
+    state = dataclasses.replace(
+        state,
+        progress=jnp.array(True),
         group_unfit=jnp.zeros_like(state.group_unfit),
     )
     return jax.lax.while_loop(cond, round_body, state)
@@ -728,6 +1041,21 @@ def _entry_qualify(st, sess, state, running0):
     return qual1 | qual2
 
 
+# Batched-round gate: the vmapped selection materializes [panel, J]- and
+# [panel, G]-shaped intermediates per round; above this cell cap (64 MB-
+# class at 4 B/cell across the ~6 key columns) fall back to sequential
+# turns.
+TURN_BATCH_MAX_CELLS = 1 << 22
+
+# Active-queue panel width of the batched round's selection stage: the
+# first TURN_PANEL perm slots (active queues sort first) get the vmapped
+# selection; overflow turns (a round with more active queues than this)
+# take the sequential _claim_turn fallback inside the same round.
+# Measured q512@50kx5k preempt rounds carry ~7 active queues, so 32 is
+# ample headroom while keeping the [panel, J] selection cells small.
+TURN_PANEL = 32
+
+
 def preempt_action(
     st: SnapshotTensors,
     sess: SessionCtx,
@@ -737,6 +1065,7 @@ def preempt_action(
     max_rounds: int = 100_000,
     panel_floor: int = 1024,
     native_ops: bool = False,
+    turn_batch=None,
 ) -> AllocState:
     """Phase 1 (inter-job within queue) then phase 2 (intra-job priority).
 
@@ -753,17 +1082,48 @@ def preempt_action(
     ``panel_floor`` gates the multi-compile path: snapshots with
     T//8 < panel_floor use one full-width panel (tests lower it to force
     the compacted branches on small snapshots — see
-    test_preempt.py::test_panel_branch_matches_full)."""
+    test_preempt.py::test_panel_branch_matches_full).
+
+    ``turn_batch`` selects the round engine: None (default) auto-picks
+    the batched turn kernel (``_rounds_batched``) unless pod affinity is
+    on (its fit reads live task placements mid-turn) or the vmapped
+    selection would blow the ``TURN_BATCH_MAX_CELLS`` cap; True/False
+    force a path (the sequential-vs-batched parity suite pins the two
+    bit-identical)."""
     T = st.num_tasks
     running0 = (
         (state.task_status == RUNNING) & st.task_valid & (state.task_node >= 0)
     )
+    preds_on = _plugin_on(tiers, "predicates", "predicate_disabled")
+    if turn_batch is None:
+        panel_w = min(st.num_queues, TURN_PANEL)
+        turn_batch = (
+            not (preds_on and pa_enabled(st))
+            and panel_w * st.num_jobs <= TURN_BATCH_MAX_CELLS
+            and panel_w * st.num_groups <= TURN_BATCH_MAX_CELLS
+        )
+    elif turn_batch and preds_on and pa_enabled(st):
+        # Mirror allocate_action: forcing the batched engine past the
+        # legality gate must fail at trace time, not silently diverge —
+        # pod-affinity fit reads live task placements mid-turn, a
+        # cross-queue channel the batched round does not model.  (The
+        # TURN_BATCH_MAX_CELLS cap is compile-size only and may be
+        # forced past.)
+        raise ValueError(
+            "turn_batch=True but pod affinity is enabled for this "
+            "snapshot/tiers; the batched round is not decision-identical "
+            "under pod affinity"
+        )
+    rounds_fn = _rounds_batched if turn_batch else _rounds
+    # one rounds counter per ACTION: both phases accumulate into it
+    # (kernel_rounds_total attribution reads it at stage boundaries)
+    state = dataclasses.replace(state, rounds=jnp.int32(0))
 
     def run_phases(view, state):
-        s = _rounds(
+        s = rounds_fn(
             st, sess, state, tiers, s_max, max_rounds, "preempt", view, native_ops
         )
-        return _rounds(
+        return rounds_fn(
             st, sess, s, tiers, s_max, max_rounds, "preempt_intra", view, native_ops
         )
 

@@ -216,7 +216,10 @@ class PipelinedExecutor:
         action_ms = dict(
             getattr(ep.session._decider(), "last_action_ms", None) or {}
         )
-        return dec, binds, evicts, conditions, action_ms, {
+        action_rounds = dict(
+            getattr(ep.session._decider(), "last_action_rounds", None) or {}
+        )
+        return dec, binds, evicts, conditions, (action_ms, action_rounds), {
             "kernel_ms": kernel_ms,
             "transport_ms": transport_ms,
             "decode_ms": (t2 - t1) * 1000,
@@ -273,7 +276,9 @@ class PipelinedExecutor:
         ep = self._inflight
         try:
             ingest_ms = self._wait(ep)
-            dec, binds0, evicts0, conditions, action_ms, t = ep.future.result()
+            dec, binds0, evicts0, conditions, (action_ms, action_rounds), t = (
+                ep.future.result()
+            )
         except BaseException as err:
             self._inflight = None
             sched._flight_failure(ep.corr or "", ep.ts, err)
@@ -342,6 +347,7 @@ class PipelinedExecutor:
                     transport_ms=t["transport_ms"],
                     upload_ms=ep.upload_ms,
                     action_ms=action_ms,
+                    action_rounds=action_rounds,
                 )
                 sched._write_back(result, task_conditions=conditions)
             t_end = time.perf_counter()
@@ -369,7 +375,7 @@ class PipelinedExecutor:
             upload_ms=ep.upload_ms,
         )
         sched.history.append(stats)
-        sched._record_metrics(stats, action_ms)
+        sched._record_metrics(stats, action_ms, action_rounds)
         sched.last_cycle_ts = time.time()
         sched._flight_success(ep.seq, ep.corr, ep.ts, stats, result)
         self._record_occupancy(

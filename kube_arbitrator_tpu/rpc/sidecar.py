@@ -140,6 +140,12 @@ class DecisionService:
                 "kernel_action_duration_seconds", ms / 1000,
                 labels={"action": stage},
             )
+        for action, rounds in (
+            getattr(decider, "last_action_rounds", None) or {}
+        ).items():
+            m.counter_add(
+                "kernel_rounds_total", rounds, labels={"action": action}
+            )
         m.counter_add("rpc_cycles_served_total")
         # the blocking decide above MUST stay outside this lock
         # (KAT-LCK-002: a wedged device would stall every handler)

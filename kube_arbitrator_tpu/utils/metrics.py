@@ -31,6 +31,7 @@ METRIC_HELP: Dict[str, str] = {
     "e2e_scheduling_duration_seconds": "Full cycle latency: snapshot through actuation.",
     "cycle_phase_duration_seconds": "Per-phase cycle latency (snapshot/upload/kernel/decode/close/actuate/transport).",
     "kernel_action_duration_seconds": "Per-action decision-kernel wall time (staged runner; action label).",
+    "kernel_rounds_total": "Rounds executed per action kernel (staged runner; evictive round-loop attribution).",
     "binds_total": "Committed bind intents.",
     "evicts_total": "Committed evict intents.",
     "pending_tasks": "Pending tasks observed at cycle start.",

@@ -109,6 +109,22 @@ def test_arena_producer_clean_on_real_tree():
     assert contracts.check_arena_producer() == []
 
 
+def test_batched_turns_clean_on_real_tree():
+    assert contracts.check_batched_turns() == []
+
+
+def test_mutated_turn_schema_reports_exactly_that_field():
+    # KAT-CTR-008: declare the batched selection's budget column as
+    # float32 — the real select_turns (correctly) returns int32, and the
+    # slot loops of BOTH evictive paths index by it, so the analyzer must
+    # flag exactly this field for both budget modes
+    seeded = contracts.mutated(contracts.TURN_SCHEMA, "budget", "float32")
+    findings = contracts.check_batched_turns(turn_schema=seeded)
+    assert len(findings) == 2  # one per budget mode (allocate, preempt)
+    assert {f.rule for f in findings} == {"KAT-CTR-008"}
+    assert all("`budget`" in f.message for f in findings)
+
+
 def test_producer_crash_becomes_a_finding_not_a_traceback(monkeypatch):
     # a build_snapshot that RAISES (e.g. its own pack-dtype guard firing)
     # must surface as a KAT-CTR-002 finding, not crash the analyzer and
