@@ -65,40 +65,33 @@ def _emit(obj, stream=sys.stdout):
     print(json.dumps(obj), file=stream, flush=True)
 
 
-class _RetraceCounter:
-    """Counts XLA backend compiles inside an armed window via
-    jax.monitoring — the attribution channel for rep-spread regressions:
-    a steady-state rep that recompiles (shape drift, cache miss, sticky-
-    bucket change) is a RETRACE artifact, not kernel time, and BENCH_r05's
-    380-858 ms q512 spread conflated the two.  Registered once per
-    process; armed only around the timed region."""
+# The armed retrace-window counter moved to the runtime profiling plane
+# (utils/profiling.py) so bench and the scheduler share ONE
+# jax.monitoring listener: the same compile events that mark a rep list
+# retrace-contaminated here feed xla_retraces_total{fn}/
+# xla_compile_seconds at runtime when the profiler is enabled.
+from kube_arbitrator_tpu.utils.profiling import RetraceCounter as _RetraceCounter
 
-    _installed = None
 
-    def __init__(self):
-        self.count = 0
-        self.armed = False
-        if _RetraceCounter._installed is None:
-            import jax.monitoring
+def _history_append(rows) -> None:
+    """Append this run's measured rows to the host-class-fingerprinted
+    perf history (the regression sentinel's baseline).  BENCH_HISTORY
+    names the file ("0" disables); rows without timings are skipped.
+    Append failures never cost the bench artifact."""
+    path = os.environ.get("BENCH_HISTORY", "BENCH_HISTORY.jsonl")
+    if path == "0":
+        return
+    try:
+        from kube_arbitrator_tpu import sentinel
 
-            def _on(event, duration, **kw):
-                inst = _RetraceCounter._installed
-                if inst is not None and inst.armed and event.endswith(
-                    "backend_compile_duration"
-                ):
-                    inst.count += 1
-
-            jax.monitoring.register_event_duration_secs_listener(_on)
-        _RetraceCounter._installed = self
-
-    def __enter__(self):
-        _RetraceCounter._installed = self
-        self.armed = True
-        return self
-
-    def __exit__(self, *exc):
-        self.armed = False
-        return False
+        host = sentinel.host_fingerprint(devices=_device_desc())
+        hist = [r for r in (
+            sentinel.rows_from_bench(row, host=host) for row in rows
+        ) if r is not None]
+        if hist:
+            sentinel.append_history(path, hist)
+    except Exception as e:  # the artifact matters more than the history
+        print(f"# bench history append failed: {e}", file=sys.stderr)
 
 
 def _time_cycle(schedule_cycle, instances, actions, reps=3):
@@ -505,6 +498,7 @@ def _pipeline_main() -> int:
     # the parent wrapper (when active) reprints the contract line from
     # the spill, so a wedge after this point still yields it
     _spill({"primary": summary, "final": True})
+    _history_append(rows)
     return 0
 
 
@@ -777,6 +771,7 @@ def _measure_main() -> None:
     primary["ladder"] = ladder_rows
     _emit(primary)
     _spill({"primary": primary, "final": True})
+    _history_append([primary] + ladder_rows)
 
 
 def _measure_primary(schedule_cycle, num_tasks, num_nodes, oracle_cap_s):

@@ -93,7 +93,25 @@ def build_parser() -> argparse.ArgumentParser:
         type=float,
         default=0.0,
         help="cycle-latency SLO in ms; a slower cycle triggers a "
-        "flight-recorder dump (0 = disabled)",
+        "flight-recorder dump, and the timeseries plane computes "
+        "multi-window error-budget burn rates over it (0 = disabled)",
+    )
+    p.add_argument(
+        "--trace-sample-rate",
+        type=float,
+        default=1.0,
+        metavar="RATE",
+        help="fraction of cycles span-traced (deterministic stride; "
+        "sampled-out cycles allocate no spans — keeps tracing on at "
+        "50k-task scale; default 1.0 = every cycle)",
+    )
+    p.add_argument(
+        "--profile-kernels",
+        action="store_true",
+        help="kernel cost attribution: run cycles through the staged "
+        "per-action runner, attribute XLA recompiles to stages "
+        "(xla_retraces_total{fn}), and serve estimated-vs-measured HLO "
+        "cost per action per shape at /debug/kernels",
     )
     # decision-plane RPC (SURVEY §5: the gRPC hop to the JAX sidecar)
     p.add_argument(
@@ -225,16 +243,28 @@ def main(argv=None) -> int:
     # the staged per-action kernel timing); --obs-port serves the plane
     obs_enabled = (
         args.obs_port is not None or args.flight_dump_dir or args.cycle_slo_ms
+        or args.profile_kernels
     )
     flight = None
+    sampler = None
     if obs_enabled:
         from .utils.flightrec import FlightRecorder
+        from .utils.timeseries import CycleSampler
         from .utils.tracing import tracer
 
         tracer().enable()
+        tracer().sample_rate = args.trace_sample_rate
         flight = FlightRecorder(
             capacity=args.flight_ring, dump_dir=args.flight_dump_dir or None
         )
+        # per-cycle metric samples + SLO burn (slo off -> ring only)
+        sampler = CycleSampler(
+            slo_ms=args.cycle_slo_ms or None, flight=flight
+        )
+    if args.profile_kernels:
+        from .utils.profiling import profiler
+
+        profiler().enable()
 
     def _serve_obs(status_fn=None):
         if args.obs_port is None:
@@ -243,7 +273,7 @@ def main(argv=None) -> int:
 
         server, _thread, url = serve_obs(
             host=args.obs_host, port=args.obs_port,
-            flight=flight, status_fn=status_fn,
+            flight=flight, status_fn=status_fn, timeseries=sampler,
         )
         print(f"observability plane on {url}", file=sys.stderr)
         return server
@@ -347,6 +377,7 @@ def main(argv=None) -> int:
             flight=flight,
             cycle_slo_ms=args.cycle_slo_ms or None,
             arena=arena,
+            timeseries=sampler,
         )
     except (ValueError, OSError) as e:
         print(f"error: invalid scheduler conf: {e}", file=sys.stderr)

@@ -8,6 +8,7 @@ from __future__ import annotations
 import time
 from typing import Dict, Tuple
 
+from ..utils.profiling import profiler
 from ..utils.tracing import tracer
 
 
@@ -15,11 +16,16 @@ class LocalDecider:
     """Run the cycle in-process (the default path Session uses).
 
     decide() returns (CycleDecisions, device-time ms).  When tracing is
-    enabled the cycle runs through the staged per-action runner instead
-    of the fused program: each action becomes its own span and its wall
-    time lands in ``last_action_ms`` (the scheduler turns that into the
-    ``kernel_action_duration_seconds{action=...}`` histograms).  The
-    fused program stays the fast path when observability is off."""
+    enabled (and the cycle sampled in) or the kernel profiler is on, the
+    cycle runs through the staged per-action runner instead of the fused
+    program: each action becomes its own span, its wall time lands in
+    ``last_action_ms`` (the scheduler turns that into the
+    ``kernel_action_duration_seconds{action=...}`` histograms), and the
+    profiler's estimated-vs-measured cost table fills in (shared seam:
+    this decider serves the sequential loop, the pipelined executor's
+    decide worker, AND the RPC sidecar's handlers — one wiring covers
+    all three).  The fused program stays the fast path when
+    observability is off."""
 
     # arena cycles: the Session pre-places the pack on the routed device
     # (dirty-range upload) because this decider consumes it in-process
@@ -52,7 +58,7 @@ class LocalDecider:
         )
         tr = tracer()
         t0 = time.perf_counter()
-        if tr.enabled and tr.current_corr_id() is not None:
+        if (tr.enabled and tr.current_corr_id() is not None) or profiler().enabled:
             with ctx:
                 dec, stages = schedule_cycle_staged(
                     st, tiers=config.tiers, actions=config.actions,

@@ -98,6 +98,7 @@ class Scheduler:
         phase_hook=None,
         max_cycle_retries: int = 8,
         wait_for_event=None,
+        timeseries=None,
     ):
         # conf is re-loadable per Run like the reference (scheduler.go:66-78)
         self.sim = sim
@@ -147,6 +148,10 @@ class Scheduler:
         # timed out, exit.  LiveCache.event_waiter() builds one fed by
         # watch delivery; None keeps the sim behavior (stop when idle).
         self.wait_for_event = wait_for_event
+        # metric time-series plane (utils/timeseries.CycleSampler): one
+        # ring sample per committed cycle + the multi-window SLO
+        # burn-rate check; None costs nothing
+        self.timeseries = timeseries
         self._consecutive_cycle_errors = 0
         self.job_status: Dict[str, PodGroupStatus] = {}
         self.history: List[CycleStats] = []
@@ -165,7 +170,9 @@ class Scheduler:
             ctx = jax.profiler.trace(self.profile_dir)
         tr = tracer()
         self._cycle_seq += 1
-        corr = tr.new_corr_id(self._cycle_seq) if tr.enabled else None
+        # sampling-aware (--trace-sample-rate): a sampled-out cycle gets
+        # corr None, so activate() passes through and no spans allocate
+        corr = tr.corr_for_cycle(self._cycle_seq)
         cycle_ts = time.time()
         with ctx, tr.activate(corr):
             try:
@@ -181,9 +188,12 @@ class Scheduler:
     def _flight_success(
         self, seq: int, corr: Optional[str], cycle_ts: float,
         stats: CycleStats, result: CycleResult,
+        discards: Optional[Dict[str, int]] = None,
     ) -> None:
         """Record a completed cycle in the flight ring (+ the SLO-breach
-        anomaly check) — shared by run_once and the pipelined executor."""
+        anomaly check) — shared by run_once and the pipelined executor
+        (which passes its per-cycle revalidation ``discards`` so dumps
+        carry the speculation-gate outcome, not just the metric)."""
         if self.flight is None:
             return
         self.flight.record(
@@ -198,6 +208,8 @@ class Scheduler:
                     "pending_before": stats.pending_before,
                     "pending_per_job": dict(self._last_pending_hist),
                     "action_ms": dict(result.action_ms),
+                    "action_rounds": dict(result.action_rounds),
+                    "discards": dict(discards or {}),
                 },
                 spans=[s.to_dict() for s in tracer().spans(corr)] if corr else [],
             )
@@ -430,6 +442,8 @@ class Scheduler:
         m.counter_add("binds_total", s.binds)
         m.counter_add("evicts_total", s.evicts)
         m.gauge_set("pending_tasks", s.pending_before)
+        if self.timeseries is not None:
+            self.timeseries.on_cycle(s, action_ms, action_rounds)
 
     def _run_loop(self, step_fn, max_cycles: int, until_idle: bool) -> int:
         """The shared cycle loop behind :meth:`run` and

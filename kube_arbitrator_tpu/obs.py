@@ -8,16 +8,20 @@ served it; this module closes that gap with the same stdlib
 
 Endpoints:
 
-=======================  ====================================================
-path                     serves
-=======================  ====================================================
-``/metrics``             Prometheus text exposition (``MetricsRegistry.render``)
-``/healthz``             liveness: 200 + process/device info JSON
-``/readyz``              readiness: 200 when scheduling (leader + fresh
-                         cycle), 503 otherwise — the k8s probe split
-``/debug/cycles``        recent flight-recorder entries as JSON
-``/debug/trace/<corr>``  one cycle's span tree as Chrome-trace/Perfetto JSON
-=======================  ====================================================
+=========================  ==================================================
+path                       serves
+=========================  ==================================================
+``/metrics``               Prometheus text exposition (``MetricsRegistry.render``)
+``/healthz``               liveness: 200 + process/device info JSON
+``/readyz``                readiness: 200 when scheduling (leader + fresh
+                           cycle), 503 otherwise — the k8s probe split
+``/debug/cycles``          recent flight-recorder entries as JSON
+``/debug/trace/<corr>``    one cycle's span tree as Chrome-trace/Perfetto JSON
+``/debug/kernels``         estimated-vs-measured kernel cost per action per
+                           shape (utils/profiling.KernelProfiler.table)
+``/debug/timeseries``      per-cycle metric samples + SLO burn status
+                           (``?window=<seconds>`` bounds the range)
+=========================  ==================================================
 
 Handlers only READ: the registry snapshots under its own lock, the flight
 recorder copies its ring under its lock, and the status callable reads
@@ -28,11 +32,13 @@ from __future__ import annotations
 
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
 
 from .utils.flightrec import FlightRecorder
 from .utils.metrics import MetricsRegistry, metrics
+from .utils.profiling import KernelProfiler, profiler
 from .utils.tracing import Tracer, tracer
 
 
@@ -103,13 +109,17 @@ class _ObsHandler(BaseHTTPRequestHandler):
         flight: Optional[FlightRecorder] = self.server.obs_flight  # type: ignore[attr-defined]
         tr: Tracer = self.server.obs_tracer  # type: ignore[attr-defined]
         status_fn = self.server.obs_status_fn  # type: ignore[attr-defined]
-        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        prof: KernelProfiler = self.server.obs_profiler  # type: ignore[attr-defined]
+        timeseries = self.server.obs_timeseries  # type: ignore[attr-defined]
+        path, _, query = self.path.partition("?")
+        path = path.rstrip("/") or "/"
         # fixed route vocabulary for the counter label: a scanner probing
         # random paths must not mint unbounded label series in the
         # process-wide registry (each series lives forever)
         route = path if not path.startswith("/debug/trace/") else "/debug/trace"
         if route not in ("/", "/metrics", "/healthz", "/readyz",
-                         "/debug/cycles", "/debug/trace"):
+                         "/debug/cycles", "/debug/trace",
+                         "/debug/kernels", "/debug/timeseries"):
             route = "other"
         registry.counter_add("obs_requests_total", labels={"path": route})
 
@@ -131,6 +141,32 @@ class _ObsHandler(BaseHTTPRequestHandler):
             self._send_json(200, {"capacity": getattr(flight, "capacity", 0),
                                   "cycles": entries})
             return
+        if path == "/debug/kernels":
+            self._send_json(200, prof.table())
+            return
+        if path == "/debug/timeseries":
+            window = None
+            try:
+                qs = urllib.parse.parse_qs(query)
+                if qs.get("window"):
+                    window = float(qs["window"][0])
+            except ValueError:
+                self._send_json(400, {"error": f"bad window {query!r}"})
+                return
+            # accept a CycleSampler (ring + burn monitor) or a bare ring
+            ring = getattr(timeseries, "ring", timeseries)
+            body: Dict[str, object] = {"window_s": window}
+            if ring is None:
+                body["rows"] = []
+                body["error"] = "no timeseries wired (pass timeseries= to serve_obs)"
+            else:
+                body["capacity"] = getattr(ring, "capacity", 0)
+                body["rows"] = ring.rows(window)
+            burn = getattr(timeseries, "burn", None)
+            if burn is not None:
+                body["slo_burn"] = burn.status()
+            self._send_json(200, body)
+            return
         if path.startswith("/debug/trace/"):
             corr = path[len("/debug/trace/"):]
             trace = tr.export_chrome(corr)
@@ -144,6 +180,7 @@ class _ObsHandler(BaseHTTPRequestHandler):
             self._send_json(200, {"endpoints": [
                 "/metrics", "/healthz", "/readyz",
                 "/debug/cycles", "/debug/trace/<corr_id>",
+                "/debug/kernels", "/debug/timeseries?window=<s>",
             ]})
             return
         self._send_json(404, {"error": f"no route {path}"})
@@ -156,16 +193,22 @@ def serve_obs(
     flight: Optional[FlightRecorder] = None,
     trace: Optional[Tracer] = None,
     status_fn: Optional[Callable[[], Dict[str, object]]] = None,
+    kernel_profiler: Optional[KernelProfiler] = None,
+    timeseries=None,
 ) -> Tuple[ThreadingHTTPServer, threading.Thread, str]:
     """Serve the observability plane; returns (server, thread, base_url).
     ``port=0`` picks a free port; ``server.shutdown()`` stops it.  The
-    defaults bind the process-wide registry/tracer, so a bare
-    ``serve_obs()`` next to any scheduler run already serves real data."""
+    defaults bind the process-wide registry/tracer/profiler, so a bare
+    ``serve_obs()`` next to any scheduler run already serves real data.
+    ``timeseries`` takes a :class:`utils.timeseries.CycleSampler` (ring +
+    burn monitor, the Scheduler's ``timeseries=``) or a bare ring."""
     server = ThreadingHTTPServer((host, port), _ObsHandler)
     server.obs_registry = registry if registry is not None else metrics()  # type: ignore[attr-defined]
     server.obs_flight = flight  # type: ignore[attr-defined]
     server.obs_tracer = trace if trace is not None else tracer()  # type: ignore[attr-defined]
     server.obs_status_fn = status_fn if status_fn is not None else (lambda: {"ready": True})  # type: ignore[attr-defined]
+    server.obs_profiler = kernel_profiler if kernel_profiler is not None else profiler()  # type: ignore[attr-defined]
+    server.obs_timeseries = timeseries  # type: ignore[attr-defined]
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     return server, thread, f"http://{host}:{server.server_address[1]}"

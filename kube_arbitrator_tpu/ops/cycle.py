@@ -310,24 +310,37 @@ def schedule_cycle_staged(
     stages.  The scheduler turns rounds into the
     ``kernel_rounds_total{action=...}`` counters, attributing WHERE the
     evictive round loops spend their turns.  Used by the deciders only
-    when tracing is enabled: the fused program stays the fast path
-    (stage boundaries forfeit cross-action fusion and pay a dispatch +
-    sync per stage)."""
+    when tracing or kernel profiling is enabled: the fused program stays
+    the fast path (stage boundaries forfeit cross-action fusion and pay
+    a dispatch + sync per stage).
+
+    With the kernel profiler enabled (utils/profiling.py), every stage
+    additionally runs inside a profiler stage scope (retrace attribution
+    + jax.profiler TraceAnnotation), its wall time lands in the
+    estimated-vs-measured cost table keyed by the pack's shape, and the
+    per-action HLO cost-model estimates are computed ONCE per (action,
+    shape) by lowering the same staged program ``/debug/kernels``
+    serves.  Disabled profiler costs one attribute read per stage."""
     import time
 
+    from ..utils import profiling
+
+    prof = profiling.profiler()
     timings = []
 
     def _timed(stage, fn, *args, rounds_of=None, **kw):
         ts = time.time()
         t0 = time.perf_counter()
-        out = fn(*args, **kw)
-        jax.block_until_ready(out)
+        with prof.stage_scope(stage):
+            out = fn(*args, **kw)
+            jax.block_until_ready(out)
         ms = (time.perf_counter() - t0) * 1000
         rounds = int(rounds_of(out).rounds) if rounds_of is not None else None
         timings.append((stage, ts, ms, rounds))
         return out
 
     sess, state = _timed("open_session", _open_session_jit, st, tiers=tiers)
+    state0 = state  # AllocState shapes are stage-invariant (estimate args)
     for action in actions:
         if action not in ACTION_KERNELS:
             raise ValueError(f"unknown action: {action}")
@@ -337,4 +350,16 @@ def schedule_cycle_staged(
             native_ops=native_ops, rounds_of=lambda s: s,
         )
     dec = _timed("commit", _commit_jit, st, sess, state)
+    if prof.enabled:
+        key = profiling.shape_key(st)
+        prof.record_cycle(key, timings)
+        prof.ensure_estimates(key, {
+            action: (
+                lambda a=action: _run_stage.lower(
+                    st, sess, state0, action=a, tiers=tiers, s_max=s_max,
+                    max_rounds=max_rounds, native_ops=native_ops,
+                )
+            )
+            for action in actions
+        })
     return dec, timings

@@ -142,7 +142,7 @@ class PipelinedExecutor:
         tr = tracer()
         sched._cycle_seq += 1
         seq = sched._cycle_seq
-        corr = tr.new_corr_id(seq) if tr.enabled else None
+        corr = tr.corr_for_cycle(seq)  # sampling-aware (--trace-sample-rate)
         ts = time.time()
         with tr.activate(corr), tr.span("pipeline.freeze", seq=seq):
             sched._pre_cycle(census=False)
@@ -306,8 +306,10 @@ class PipelinedExecutor:
         # discard accounting only for epochs that actually committed —
         # past the fence, so the counter and discard_totals (bench's
         # discard_rate source) can never diverge on a fenced cycle
+        step_discard_counts: Dict[str, int] = {}
         for d in step_discards:
             self.discard_totals[d.reason] = self.discard_totals.get(d.reason, 0) + 1
+            step_discard_counts[d.reason] = step_discard_counts.get(d.reason, 0) + 1
             metrics().counter_add(
                 "pipeline_discards_total", labels={"reason": d.reason}
             )
@@ -377,7 +379,10 @@ class PipelinedExecutor:
         sched.history.append(stats)
         sched._record_metrics(stats, action_ms, action_rounds)
         sched.last_cycle_ts = time.time()
-        sched._flight_success(ep.seq, ep.corr, ep.ts, stats, result)
+        sched._flight_success(
+            ep.seq, ep.corr, ep.ts, stats, result,
+            discards=step_discard_counts,
+        )
         self._record_occupancy(
             period_ms,
             {

@@ -1,0 +1,508 @@
+"""Perf-regression sentinel: host-fingerprinted bench history + verdicts.
+
+PR 7 found the native FFI binding had been silently dead for several
+PRs — q512 cycles ran at 5.5 s instead of ~0.7 s and nothing noticed,
+because perf evidence lived in per-round BENCH_*.json artifacts nobody
+diffs mechanically.  This module makes the trajectory a first-class,
+machine-checked artifact:
+
+* ``BENCH_HISTORY.jsonl`` — append-only rows, one per measured metric per
+  run, stamped with a **host-class fingerprint** (platform/CPU model/
+  core count/devices).  bench.py appends its ladder + cadence rows after
+  every run; the ``measure`` subcommand records a small rung directly.
+* ``compare`` — noise-aware verdicts: the baseline for a metric is the
+  set of same-host-class rows, its noise band derived from their
+  recorded p10/p90 rep spread (PR 7 records it per rung precisely so
+  regressions can be told from jitter).  Retrace-contaminated rows
+  (``retraces > 0``) are excluded from the baseline center when
+  retrace-free rows exist — a recompile blip must not widen the band.
+* ``canary`` — the sensitivity proof (chaos-plane pattern): rewrite the
+  newest baseline row as if the host had slowed down by a factor and
+  compare it; ``--slowdown 2.0`` MUST exit 1 and ``--slowdown 1.0``
+  (identical history) MUST exit 0, or the gate has gone blind.
+
+Exit codes: 0 ok / no baseline for this host class, 1 regression,
+2 usage or data error.
+
+The verdict rule, spelled out (``compare_row``):
+
+    center  = median cycle_ms of baseline rows (retrace-free preferred)
+    noise   = median relative rep spread (p90 - p10) / cycle_ms,
+              floored at NOISE_FLOOR
+    margin  = clamp(SPREAD_MULT * noise, REL_FLOOR, REL_CEIL)
+    regression  iff  current cycle_ms > center * (1 + margin)
+    improved    iff  current cycle_ms < center * (1 - margin)
+
+Medians on both sides: the per-run median is already robust to one
+contaminated rep, and REL_CEIL < 1.0 guarantees a genuine 2x slowdown
+always clears the band no matter how noisy the recorded history is —
+the canary's must-fire contract is structural, not tuned.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import hashlib
+import json
+import os
+import statistics
+import sys
+import time
+from typing import Callable, Dict, List, Optional
+
+HISTORY_SCHEMA_VERSION = 1
+DEFAULT_HISTORY = "BENCH_HISTORY.jsonl"
+
+NOISE_FLOOR = 0.10   # no metric is quieter than ±10% on shared hosts
+REL_FLOOR = 0.30     # never flag a <30% delta as regression
+REL_CEIL = 0.90      # never let noisy history hide a 2x slowdown
+SPREAD_MULT = 3.0    # band = 3x the recorded rep spread
+
+
+# ---------------------------------------------------------------------------
+# host-class fingerprint
+
+
+def _cpu_model() -> str:
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith("model name"):
+                    return line.split(":", 1)[1].strip()
+    except OSError:
+        pass
+    import platform as _platform
+
+    return _platform.processor() or "unknown"
+
+
+def host_fingerprint(devices: Optional[str] = None) -> Dict[str, object]:
+    """The host-class descriptor perf rows are keyed by.  Two hosts with
+    the same fingerprint are comparable; rows from a different class are
+    never used as a baseline (the BENCH_r05-host vs this-host calibration
+    gap is exactly what this guards)."""
+    import platform as _platform
+
+    if devices is None:
+        devices = os.environ.get("KAT_SENTINEL_DEVICES", "")
+        if not devices:
+            try:
+                import jax
+
+                devices = ",".join(str(d) for d in jax.devices())
+            except Exception:
+                devices = "unavailable"
+    desc = {
+        "platform": _platform.system().lower(),
+        "machine": _platform.machine(),
+        "cpu_model": _cpu_model(),
+        "cpu_count": os.cpu_count() or 0,
+        "devices": devices,
+    }
+    blob = json.dumps(desc, sort_keys=True).encode()
+    desc["fingerprint"] = hashlib.sha256(blob).hexdigest()[:12]
+    return desc
+
+
+# ---------------------------------------------------------------------------
+# history rows
+
+
+def load_history(path: str) -> List[Dict[str, object]]:
+    """JSONL rows, bad lines skipped (a torn append must not kill the
+    gate that reads the file)."""
+    rows: List[Dict[str, object]] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and "metric" in rec:
+                    rows.append(rec)
+    except OSError:
+        pass
+    return rows
+
+
+def append_history(path: str, rows: List[Dict[str, object]]) -> None:
+    with open(path, "a") as f:
+        for row in rows:
+            f.write(json.dumps(row, sort_keys=True) + "\n")
+
+
+def history_row(
+    metric: str,
+    cycle_ms: float,
+    p10_ms: Optional[float] = None,
+    p90_ms: Optional[float] = None,
+    rep_ms: Optional[List[float]] = None,
+    retraces: Optional[int] = None,
+    extra: Optional[Dict[str, object]] = None,
+    host: Optional[Dict[str, object]] = None,
+    now_fn: Callable[[], float] = time.time,
+) -> Dict[str, object]:
+    """One history row; host fields flattened in so `compare` needs no
+    joins."""
+    host = host or host_fingerprint()
+    row: Dict[str, object] = {
+        "schema": HISTORY_SCHEMA_VERSION,
+        "metric": metric,
+        "cycle_ms": round(float(cycle_ms), 2),
+        "recorded_at": now_fn(),
+        **{k: host[k] for k in ("fingerprint", "cpu_model", "cpu_count", "devices")},
+    }
+    if p10_ms is not None:
+        row["cycle_ms_p10"] = round(float(p10_ms), 2)
+    if p90_ms is not None:
+        row["cycle_ms_p90"] = round(float(p90_ms), 2)
+    if rep_ms is not None:
+        row["rep_ms"] = [round(float(t), 2) for t in rep_ms]
+    if retraces is not None:
+        row["retraces"] = int(retraces)
+    if extra:
+        row.update(extra)
+    return row
+
+
+def rows_from_bench(bench_row: Dict[str, object], host=None, now_fn=time.time):
+    """A bench.py ladder/cadence row -> history row (None when the row
+    carries no timing, e.g. an error row)."""
+    metric = bench_row.get("metric")
+    if not metric:
+        return None
+    # pipeline-cadence rung rows keep their timing in the pipelined leg
+    pipe = bench_row.get("pipelined")
+    if isinstance(pipe, dict) and "period_ms" in pipe:
+        bench_row = {**pipe, "metric": bench_row["metric"],
+                     "value": bench_row.get("value"), "unit": bench_row.get("unit")}
+    cycle_ms = bench_row.get("cycle_ms") or bench_row.get("period_ms")
+    rep = bench_row.get("rep_ms") or bench_row.get("period_ms_reps")
+    if cycle_ms is None and rep:
+        cycle_ms = _median([float(t) for t in rep])
+    if cycle_ms is None:
+        return None
+    p10, p90 = bench_row.get("cycle_ms_p10"), bench_row.get("cycle_ms_p90")
+    if (p10 is None or p90 is None) and rep:
+        srt = sorted(float(t) for t in rep)
+        p10, p90 = srt[0], srt[-1]
+    extra = {"source": "bench"}
+    for k in ("value", "unit", "native_ops", "binds"):
+        if k in bench_row:
+            extra[k] = bench_row[k]
+    return history_row(
+        str(metric), float(cycle_ms), p10, p90,
+        [float(t) for t in rep] if rep else None,
+        bench_row.get("retraces"), extra, host=host, now_fn=now_fn,
+    )
+
+
+# ---------------------------------------------------------------------------
+# verdicts
+
+
+@dataclasses.dataclass
+class Verdict:
+    metric: str
+    status: str           # ok | regression | improved | no-baseline
+    detail: str
+    current_ms: Optional[float] = None
+    baseline_ms: Optional[float] = None
+    margin: Optional[float] = None
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+_median = statistics.median
+
+
+def baseline_rows(
+    history: List[Dict[str, object]], metric: str, fingerprint: str
+) -> List[Dict[str, object]]:
+    return [
+        r for r in history
+        if r.get("metric") == metric and r.get("fingerprint") == fingerprint
+    ]
+
+
+def compare_row(
+    baseline: List[Dict[str, object]], current: Dict[str, object]
+) -> Verdict:
+    """Noise-aware verdict for one metric (rule in the module docstring)."""
+    metric = str(current.get("metric"))
+    if not baseline:
+        return Verdict(metric, "no-baseline",
+                       "no same-host-class history rows for this metric")
+    # retrace-free rows anchor the center when any exist: a recompile
+    # inside a recorded rep inflates its times without meaning the
+    # kernels got slower
+    clean = [r for r in baseline if not r.get("retraces")]
+    anchor = clean or baseline
+    center = _median([float(r["cycle_ms"]) for r in anchor])
+    if center <= 0:
+        return Verdict(metric, "no-baseline", "baseline center is zero")
+    spreads = []
+    for r in anchor:
+        p10, p90 = r.get("cycle_ms_p10"), r.get("cycle_ms_p90")
+        if p10 is not None and p90 is not None and float(r["cycle_ms"]) > 0:
+            spreads.append((float(p90) - float(p10)) / float(r["cycle_ms"]))
+    noise = max(_median(spreads) if spreads else 0.0, NOISE_FLOOR)
+    margin = min(max(SPREAD_MULT * noise, REL_FLOOR), REL_CEIL)
+    cur_med = float(current["cycle_ms"])
+    hi, lo = center * (1 + margin), center * (1 - margin)
+    if cur_med > hi:
+        return Verdict(
+            metric, "regression",
+            f"current {cur_med:.1f} ms > {hi:.1f} ms "
+            f"(baseline {center:.1f} ms x (1 + {margin:.2f}), "
+            f"{len(anchor)} baseline rows, noise {noise:.2f})",
+            cur_med, center, margin,
+        )
+    if cur_med < lo:
+        return Verdict(
+            metric, "improved",
+            f"current {cur_med:.1f} ms < {lo:.1f} ms "
+            f"(baseline {center:.1f} ms x (1 - {margin:.2f}))",
+            cur_med, center, margin,
+        )
+    return Verdict(
+        metric, "ok",
+        f"current {cur_med:.1f} ms within ±{margin:.0%} of "
+        f"baseline {center:.1f} ms",
+        cur_med, center, margin,
+    )
+
+
+def compare(
+    history: List[Dict[str, object]], current_rows: List[Dict[str, object]]
+) -> List[Verdict]:
+    out = []
+    for cur in current_rows:
+        fp = str(cur.get("fingerprint", ""))
+        base = baseline_rows(
+            [r for r in history if r is not cur], str(cur.get("metric")), fp
+        )
+        out.append(compare_row(base, cur))
+    return out
+
+
+def exit_code(verdicts: List[Verdict]) -> int:
+    return 1 if any(v.status == "regression" for v in verdicts) else 0
+
+
+# ---------------------------------------------------------------------------
+# the small-rung measurement (the PERF_SENTINEL lane's probe)
+
+
+def measure_rung(
+    num_tasks: int = 2000,
+    num_nodes: int = 200,
+    num_queues: int = 8,
+    running_fraction: float = 0.0,
+    actions=("allocate", "backfill"),
+    reps: int = 3,
+) -> Dict[str, object]:
+    """Time one small rung under bench.py's measurement rules (distinct-
+    content instances, two-exec warmup, device->host end, armed retrace
+    window) and return a history row.  Small enough for a CI lane; the
+    full ladder stays bench.py's job."""
+    import numpy as np
+
+    from .platform import enable_persistent_cache, ensure_jax_backend
+
+    ensure_jax_backend()
+    enable_persistent_cache()
+    import jax
+
+    from .cache import build_snapshot, generate_cluster
+    from .ops import schedule_cycle
+    from .platform import decision_route
+    from .utils.profiling import RetraceCounter
+
+    # jobs of 100 tasks each; the metric label states what actually ran
+    # (a --rung not divisible by 100 would otherwise record a rung that
+    # was never measured — the label is the baseline key)
+    num_jobs = max(1, num_tasks // 100)
+    actual_tasks = num_jobs * 100
+
+    def snap(seed):
+        sim = generate_cluster(
+            num_nodes=num_nodes, num_jobs=num_jobs,
+            tasks_per_job=100, num_queues=num_queues, seed=seed,
+            running_fraction=running_fraction,
+        )
+        return build_snapshot(sim.cluster).tensors
+
+    instances = [snap(42 + i) for i in range(reps + 1)]
+    # the production crossover seam, exactly as framework/decider.py
+    # routes real cycles: the rung measures what the scheduler ships
+    ctx, _dev, native = decision_route(
+        int(instances[0].task_valid.shape[0]), tuple(actions),
+        instances[0].task_status,
+    )
+
+    def run(st):
+        with ctx:
+            return schedule_cycle(st, actions=tuple(actions), native_ops=native)
+    dec = run(instances[0])
+    jax.block_until_ready(dec)            # compile + first-exec
+    np.asarray(run(instances[0]).bind_mask)  # settle exec
+    times = []
+    with RetraceCounter() as rt:
+        for i in range(reps):
+            st = instances[i + 1]
+            jax.block_until_ready(st)
+            t0 = time.perf_counter()
+            np.asarray(run(st).bind_mask)
+            times.append((time.perf_counter() - t0) * 1000)
+    srt = sorted(times)
+    metric = (
+        f"sentinel:{'+'.join(actions)}@{actual_tasks}x{num_nodes}q{num_queues}"
+    )
+    return history_row(
+        metric, _median(times), srt[0], srt[-1], times, rt.count,
+        {"source": "sentinel", "native_ops": native},
+    )
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def _print_verdicts(verdicts: List[Verdict]) -> None:
+    for v in verdicts:
+        print(json.dumps(v.to_dict()))
+
+
+def _cmd_measure(args) -> int:
+    try:
+        t, n = (int(x) for x in args.rung.lower().split("x"))
+    except ValueError:
+        print(json.dumps({"status": "error",
+                          "detail": f"bad --rung {args.rung!r}; "
+                                    "expected TASKSxNODES, e.g. 2000x200"}))
+        return 2
+    row = measure_rung(
+        t, n, args.queues, args.running_fraction,
+        tuple(a.strip() for a in args.actions.split(",") if a.strip()),
+        args.reps,
+    )
+    print(json.dumps(row))
+    rc = 0
+    if args.compare:
+        verdicts = compare(load_history(args.history), [row])
+        _print_verdicts(verdicts)
+        rc = exit_code(verdicts)
+    if args.append:
+        append_history(args.history, [row])
+    return rc
+
+
+def _cmd_compare(args) -> int:
+    history = load_history(args.history)
+    if args.row:
+        with open(args.row) as f:
+            current = [json.loads(line) for line in f if line.strip()]
+    else:
+        # newest row per metric for THIS host class is the implicit target
+        fp = host_fingerprint()["fingerprint"]
+        newest: Dict[str, Dict[str, object]] = {}
+        for r in history:
+            if r.get("fingerprint") == fp:
+                newest[str(r["metric"])] = r
+        current = list(newest.values())
+    if not current:
+        print(json.dumps({"status": "no-baseline",
+                          "detail": "no rows to compare for this host class"}))
+        return 0
+    verdicts = compare(history, current)
+    _print_verdicts(verdicts)
+    return exit_code(verdicts)
+
+
+def _cmd_canary(args) -> int:
+    """The gate-can-fire proof: scale the newest row per metric by
+    ``--slowdown`` and compare against the untouched history.  2.0 must
+    regress; 1.0 (identical history) must not."""
+    history = load_history(args.history)
+    if not history:
+        print(json.dumps({"status": "error",
+                          "detail": f"no history at {args.history}"}))
+        return 2
+    newest: Dict[str, Dict[str, object]] = {}
+    for r in history:
+        key = (str(r["metric"]), str(r.get("fingerprint")))
+        newest[key] = r
+    factor = args.slowdown
+    current = []
+    for r in newest.values():
+        cur = dict(r)
+        for k in ("cycle_ms", "cycle_ms_p10", "cycle_ms_p90"):
+            if k in cur:
+                cur[k] = float(cur[k]) * factor
+        if "rep_ms" in cur:
+            cur["rep_ms"] = [float(t) * factor for t in cur["rep_ms"]]
+        cur["source"] = f"canary:x{factor:g}"
+        current.append(cur)
+    if args.metric:
+        current = [c for c in current if c["metric"] == args.metric]
+        if not current:
+            print(json.dumps({"status": "error",
+                              "detail": f"metric {args.metric!r} not in history"}))
+            return 2
+    # the synthetic row plays "today's run" against the FULL untouched
+    # history (its own source row included — exactly what a real re-run
+    # of an unchanged tree would face)
+    verdicts = compare(history, current)
+    _print_verdicts(verdicts)
+    return exit_code(verdicts)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m kube_arbitrator_tpu.sentinel",
+        description="perf-regression sentinel over BENCH_HISTORY.jsonl",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    m = sub.add_parser("measure", help="time a small rung; optionally compare/append")
+    m.add_argument("--rung", default="2000x200", help="TASKSxNODES (default 2000x200)")
+    m.add_argument("--queues", type=int, default=8)
+    m.add_argument("--running-fraction", type=float, default=0.0)
+    m.add_argument("--actions", default="allocate,backfill")
+    m.add_argument("--reps", type=int, default=3)
+    m.add_argument("--history", default=DEFAULT_HISTORY)
+    m.add_argument("--compare", action="store_true",
+                   help="verdict vs same-host-class history (exit 1 on regression)")
+    m.add_argument("--append", action="store_true",
+                   help="append the measured row to the history file")
+    m.set_defaults(fn=_cmd_measure)
+
+    c = sub.add_parser("compare", help="verdicts for rows vs the history")
+    c.add_argument("--history", default=DEFAULT_HISTORY)
+    c.add_argument("--row", default="",
+                   help="JSONL file of current rows (default: newest history "
+                        "row per metric for this host class)")
+    c.set_defaults(fn=_cmd_compare)
+
+    k = sub.add_parser("canary", help="synthetic-slowdown sensitivity proof")
+    k.add_argument("--history", default=DEFAULT_HISTORY)
+    k.add_argument("--slowdown", type=float, default=2.0,
+                   help="scale factor applied to the newest rows (default 2.0)")
+    k.add_argument("--metric", default="", help="restrict to one metric")
+    k.set_defaults(fn=_cmd_canary)
+
+    f = sub.add_parser("fingerprint", help="print this host's class fingerprint")
+    f.set_defaults(fn=lambda a: (print(json.dumps(host_fingerprint())), 0)[1])
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

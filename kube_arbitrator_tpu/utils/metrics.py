@@ -72,6 +72,11 @@ METRIC_HELP: Dict[str, str] = {
     # flight recorder
     "flight_anomalies_total": "Anomalies noted by the flight recorder (kind label).",
     "flight_dumps_total": "Flight-recorder dump files written.",
+    # profiling plane (utils/profiling.py + utils/timeseries.py)
+    "xla_retraces_total": "XLA backend compiles observed at runtime (fn label: the kernel stage active when the compile fired).",
+    "xla_compile_seconds": "XLA backend compile durations observed at runtime.",
+    "slo_burn_rate": "Cycle-SLO error-budget burn rate per long window (window label; 1.0 = burning exactly the budget).",
+    "slo_burn_alerts_total": "Multi-window SLO burn alerts fired (window label; one per episode).",
     # observability server
     "obs_requests_total": "Observability-plane HTTP requests served (path label).",
 }
@@ -178,6 +183,30 @@ class MetricsRegistry:
         snapshot its fields promptly if consistency matters."""
         with self._lock:
             return self._hists.get(self._key(name, labels))
+
+    # ---- read accessors (the timeseries sampler's counter-delta source) ----
+
+    def counter_total(self, name: str) -> float:
+        """Sum of one counter family across all its label sets (0.0 when
+        never incremented)."""
+        with self._lock:
+            return sum(v for (n, _l), v in self._counters.items() if n == name)
+
+    def counter_value(self, name: str, labels: Optional[Dict[str, str]] = None) -> float:
+        with self._lock:
+            return self._counters.get(self._key(name, labels), 0.0)
+
+    def gauge_value(
+        self, name: str, labels: Optional[Dict[str, str]] = None,
+        default: Optional[float] = None,
+    ) -> Optional[float]:
+        with self._lock:
+            return self._gauges.get(self._key(name, labels), default)
+
+    def gauge_values(self, name: str) -> Dict[Tuple[Tuple[str, str], ...], float]:
+        """Every label set of one gauge family -> its current value."""
+        with self._lock:
+            return {l: v for (n, l), v in self._gauges.items() if n == name}
 
     # ---- rendering ----
 

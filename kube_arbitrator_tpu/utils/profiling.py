@@ -1,0 +1,301 @@
+"""Kernel cost attribution: retrace/compile telemetry + HLO cost estimates.
+
+PR 3's obs plane reports *durations* (``kernel_action_duration_seconds``)
+but not *why* a kernel costs what it costs; retrace/compile tracking
+lived only inside bench.py's ``_RetraceCounter``; and nothing at runtime
+could answer "is this action compute-bound or launch-bound at this
+shape?".  This module closes all three gaps:
+
+* **Retrace accounting, promoted to runtime metrics.**  One process-wide
+  ``jax.monitoring`` listener feeds both the bench-style armed
+  :class:`RetraceCounter` window (bench.py imports it from here now) and
+  — when the profiler is enabled — the ``xla_retraces_total{fn=...}``
+  counter and ``xla_compile_seconds`` histogram, with ``fn`` attributed
+  to the kernel stage that was active when the compile fired (the staged
+  cycle runner brackets each stage in :meth:`KernelProfiler.stage_scope`).
+  A steady-state cycle that recompiles is a RETRACE artifact, not kernel
+  time; at runtime that now shows up labeled instead of as unexplained
+  p90 spread.
+
+* **HLO cost-model estimates per ACTION_KERNELS entry.**  For every
+  (action, arena-epoch shape) the profiler lowers the per-action staged
+  program once and extracts XLA's cost analysis (flops, bytes accessed)
+  — ``jax.stages.Lowered.cost_analysis()``, no backend compile paid.
+  Together with the measured wall times the staged runner records, the
+  ``/debug/kernels`` endpoint serves estimated-vs-measured cost per
+  action per shape: a kernel whose measured ms grew while its estimated
+  flops did not is dispatch/launch overhead, not compute.
+
+* **Stage scoping** doubles as a ``jax.profiler.TraceAnnotation`` so a
+  ``--profile-dir`` TensorBoard trace carries the same stage names.
+
+Cheap when off: every hook is one ``enabled`` attribute read.  The
+clock is injectable (:meth:`KernelProfiler.set_now_fn`) so chaos-plane
+runs on a VirtualClock stay deterministic — timestamps in the cost
+table come from the plan's clock, never the host's.
+
+Thread-correct: the active stage is thread-local (the pipelined
+executor's decide worker and the sidecar's handler pool both run staged
+cycles); the measured/estimate tables are guarded by one lock and only
+dict ops run under it (KAT-LCK discipline) — estimate *computation*
+(a trace + lower) happens outside the lock.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+_tls = threading.local()
+
+
+def current_stage() -> Optional[str]:
+    """The kernel stage active on this thread (retrace attribution)."""
+    return getattr(_tls, "stage", None)
+
+
+# ---------------------------------------------------------------------------
+# the one jax.monitoring listener (bench window + runtime metrics)
+
+_listener_installed = False
+_armed_counter: Optional["RetraceCounter"] = None
+
+
+def _ensure_listener() -> None:
+    global _listener_installed
+    if _listener_installed:
+        return
+    import jax.monitoring
+
+    def _on_event(event, duration, **kw):
+        if not event.endswith("backend_compile_duration"):
+            return
+        inst = _armed_counter
+        if inst is not None and inst.armed:
+            inst.count += 1
+        prof = _profiler
+        if prof is not None and prof.enabled:
+            from .metrics import metrics
+
+            metrics().counter_add(
+                "xla_retraces_total",
+                labels={"fn": current_stage() or "other"},
+            )
+            metrics().observe("xla_compile_seconds", float(duration))
+
+    jax.monitoring.register_event_duration_secs_listener(_on_event)
+    _listener_installed = True
+
+
+class RetraceCounter:
+    """Counts XLA backend compiles inside an armed window (bench.py's
+    attribution channel for rep-spread regressions, hoisted here so the
+    runtime and the bench share ONE listener).  Armed only around the
+    timed region; the last-armed instance wins, matching the original
+    bench semantics (one measurement window at a time)."""
+
+    def __init__(self):
+        self.count = 0
+        self.armed = False
+        _ensure_listener()
+
+    def __enter__(self) -> "RetraceCounter":
+        global _armed_counter
+        _armed_counter = self
+        self.armed = True
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.armed = False
+        return False
+
+
+# ---------------------------------------------------------------------------
+# shape identity
+
+def shape_key(st) -> str:
+    """The arena-epoch shape signature costs are keyed by: padded task/
+    node/queue/job/group dims of a SnapshotTensors pack.  Two cycles with
+    the same key run the same compiled programs."""
+    return (
+        f"T{int(st.task_valid.shape[0])}"
+        f"xN{int(st.node_valid.shape[0])}"
+        f"xQ{int(st.queue_valid.shape[0])}"
+        f"xJ{int(st.job_valid.shape[0])}"
+        f"xG{int(st.num_groups)}"
+    )
+
+
+class _NullScope:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SCOPE = _NullScope()
+
+
+class KernelProfiler:
+    """Per-(stage, shape) measured cost + HLO cost-model estimates."""
+
+    def __init__(self, now_fn: Optional[Callable[[], float]] = None):
+        self.enabled = False
+        self.now: Callable[[], float] = now_fn or time.time
+        self._lock = threading.Lock()
+        # (shape_key, stage) -> measured aggregate
+        self._measured: Dict[tuple, Dict[str, float]] = {}
+        # (shape_key, stage) -> {"flops": .., "bytes_accessed": ..} | {"error": ..}
+        self._estimates: Dict[tuple, Dict[str, object]] = {}
+
+    def enable(self, on: bool = True) -> None:
+        if on:
+            _ensure_listener()
+        self.enabled = on
+
+    def set_now_fn(self, now_fn: Callable[[], float]) -> None:
+        """Swap the wall clock (the chaos plane hands in its
+        VirtualClock's ``now`` so replayed runs stamp identical times)."""
+        self.now = now_fn
+
+    def reset(self) -> None:
+        with self._lock:
+            self._measured.clear()
+            self._estimates.clear()
+
+    # ---- stage scoping (retrace attribution + TraceAnnotation) ----
+
+    @contextlib.contextmanager
+    def _stage_scope_live(self, stage: str):
+        import jax
+
+        prev = getattr(_tls, "stage", None)
+        _tls.stage = stage
+        try:
+            with jax.profiler.TraceAnnotation(f"kat.{stage}"):
+                yield
+        finally:
+            _tls.stage = prev
+
+    def stage_scope(self, stage: str):
+        """Bracket one kernel stage: compiles inside are attributed to
+        ``stage`` and the region is a named jax.profiler annotation.
+        Disabled profiler -> free null context (one attribute read)."""
+        if not self.enabled:
+            return _NULL_SCOPE
+        return self._stage_scope_live(stage)
+
+    # ---- measured costs (the staged runner records every cycle) ----
+
+    def record_measured(
+        self, stage: str, key: str, ms: float, rounds: Optional[int] = None
+    ) -> None:
+        now = self.now()
+        with self._lock:
+            agg = self._measured.get((key, stage))
+            if agg is None:
+                agg = self._measured[(key, stage)] = {
+                    "count": 0, "total_ms": 0.0,
+                    "min_ms": ms, "max_ms": ms,
+                    "last_ms": ms, "last_ts": now, "rounds_total": 0,
+                }
+            agg["count"] += 1
+            agg["total_ms"] += ms
+            agg["min_ms"] = min(agg["min_ms"], ms)
+            agg["max_ms"] = max(agg["max_ms"], ms)
+            agg["last_ms"] = ms
+            agg["last_ts"] = now
+            if rounds is not None:
+                agg["rounds_total"] += int(rounds)
+                agg["last_rounds"] = int(rounds)
+
+    def record_cycle(self, key: str, timings) -> None:
+        """One staged cycle's ``(stage, ts, ms, rounds)`` list."""
+        for stage, _ts, ms, rounds in timings:
+            self.record_measured(stage, key, ms, rounds)
+
+    # ---- HLO cost-model estimates ----
+
+    def ensure_estimates(self, key: str, builders: Dict[str, Callable]) -> None:
+        """Lazily compute the cost-model estimate for every (stage ->
+        zero-arg ``Lowered`` builder) not yet known at this shape.  The
+        trace+lower runs OUTSIDE the lock; a racing duplicate compute is
+        idempotent (last write wins, same value)."""
+        todo = []
+        with self._lock:
+            for stage in builders:
+                if (key, stage) not in self._estimates:
+                    # claim the slot so a concurrent cycle skips it
+                    self._estimates[(key, stage)] = {"pending": True}
+                    todo.append(stage)
+        for stage in todo:
+            est = self._estimate_one(builders[stage])
+            with self._lock:
+                self._estimates[(key, stage)] = est
+
+    def _estimate_one(self, builder: Callable) -> Dict[str, object]:
+        try:
+            lowered = builder()
+            ca = lowered.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            out: Dict[str, object] = {"estimated_at": self.now()}
+            for src, dst in (
+                ("flops", "flops"),
+                ("bytes accessed", "bytes_accessed"),
+                ("transcendentals", "transcendentals"),
+            ):
+                v = ca.get(src)
+                if v is not None:
+                    out[dst] = float(v)
+            return out
+        except Exception as err:  # gated: cost analysis is best-effort
+            return {"error": f"{type(err).__name__}: {err}",
+                    "estimated_at": self.now()}
+
+    # ---- the /debug/kernels view ----
+
+    def table(self) -> Dict[str, object]:
+        """JSON-ready estimated-vs-measured cost table, grouped by shape
+        key then stage.  Derived rates pair the ESTIMATED flops/bytes
+        with the MEASURED mean wall time — the est-vs-measured signal:
+        a stage whose gflops_per_s is tiny is launch/dispatch-bound,
+        not compute-bound, at that shape."""
+        with self._lock:
+            measured = {k: dict(v) for k, v in self._measured.items()}
+            estimates = {k: dict(v) for k, v in self._estimates.items()}
+        shapes: Dict[str, Dict[str, object]] = {}
+        for (key, stage) in sorted(set(measured) | set(estimates)):
+            entry: Dict[str, object] = {}
+            m = measured.get((key, stage))
+            e = estimates.get((key, stage))
+            if m:
+                m["mean_ms"] = m["total_ms"] / m["count"] if m["count"] else 0.0
+                entry["measured"] = m
+            if e and not e.get("pending"):
+                entry["estimate"] = e
+                if m and m["mean_ms"] > 0 and "flops" in e:
+                    entry["gflops_per_s"] = round(
+                        float(e["flops"]) / (m["mean_ms"] / 1000.0) / 1e9, 3
+                    )
+                if m and m["mean_ms"] > 0 and "bytes_accessed" in e:
+                    entry["gbytes_per_s"] = round(
+                        float(e["bytes_accessed"]) / (m["mean_ms"] / 1000.0) / 1e9,
+                        3,
+                    )
+            shapes.setdefault(key, {})[stage] = entry
+        return {"generated_at": self.now(), "shapes": shapes}
+
+
+_profiler: Optional[KernelProfiler] = None
+
+
+def profiler() -> KernelProfiler:
+    """Process-wide kernel profiler (disabled until something enables it
+    — the CLI's ``--profile-kernels`` does)."""
+    global _profiler
+    if _profiler is None:
+        _profiler = KernelProfiler()
+    return _profiler

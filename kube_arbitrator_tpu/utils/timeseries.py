@@ -1,0 +1,300 @@
+"""Metric time-series ring + multi-window SLO burn-rate monitoring.
+
+The registry (:mod:`utils.metrics`) holds *cumulative* state — counters
+and histogram buckets since process start.  Operators debugging a cycle
+regression need the *trajectory*: what did the cycle period, per-action
+kernel time, upload volume, and pipeline occupancy look like over the
+last N minutes?  This module keeps a fixed-size ring of per-cycle
+samples, served at ``/debug/timeseries?window=<seconds>`` — no external
+TSDB required, bounded memory by construction.
+
+On top of the ring sits the multi-window **SLO burn-rate** monitor (the
+SRE-workbook alerting policy): the cycle-latency SLO (``--cycle-slo-ms``)
+grants an error budget (fraction of cycles allowed over the SLO); the
+burn rate of a window is ``breach_fraction / budget``.  A page fires
+only when BOTH a long and a short window burn faster than the pair's
+threshold — the long window proves the problem is sustained, the short
+window proves it is still happening — which is why a single slow cycle
+(PR 3's ``slo_breach`` anomaly, kept) no longer needs to be the only
+latency signal.  A firing pair raises the flight-recorder anomaly kind
+``slo_burn`` once per episode (hysteresis: re-arms when the short
+window recovers below burn 1.0).
+
+Clocks are injectable everywhere (``now_fn``) so chaos-plane runs on a
+VirtualClock sample deterministic timestamps.
+
+Thread-safety: ring appends/reads take one lock around deque ops only
+(KAT-LCK discipline).  The sampler is called from whichever thread owns
+cycle commit (the scheduler loop, or the pipelined executor's ingest
+thread) — one writer per scheduler, many readers via the obs server.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .metrics import MetricsRegistry, metrics
+
+# (long_s, short_s, burn_threshold) pairs, fastest-burn first.  Scaled
+# for a ~1 s cycle cadence: the fast pair catches an acute stall inside
+# a minute, the slow pair catches a simmering 2x-budget burn.
+DEFAULT_BURN_WINDOWS: Tuple[Tuple[float, float, float], ...] = (
+    (300.0, 30.0, 10.0),
+    (3600.0, 300.0, 2.0),
+)
+DEFAULT_BUDGET = 0.05  # 5% of cycles may exceed the SLO
+
+
+class TimeSeriesRing:
+    """Fixed-size ring of ``{"ts": t, <key>: value, ...}`` sample rows."""
+
+    def __init__(self, capacity: int = 4096,
+                 now_fn: Optional[Callable[[], float]] = None):
+        self.capacity = capacity
+        self.now: Callable[[], float] = now_fn or time.time
+        self._lock = threading.Lock()
+        self._ring = collections.deque(maxlen=capacity)
+
+    def sample(self, values: Dict[str, float],
+               ts: Optional[float] = None) -> None:
+        row = {"ts": float(ts if ts is not None else self.now())}
+        row.update(values)
+        with self._lock:
+            self._ring.append(row)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def rows(self, window_s: Optional[float] = None,
+             now: Optional[float] = None) -> List[Dict[str, float]]:
+        """Samples oldest-first; ``window_s`` keeps only rows newer than
+        ``now - window_s``."""
+        with self._lock:
+            out = list(self._ring)
+        if window_s is not None:
+            cutoff = (now if now is not None else self.now()) - window_s
+            out = [r for r in out if r["ts"] >= cutoff]
+        return out
+
+    def series(self, key: str, window_s: Optional[float] = None
+               ) -> List[Tuple[float, float]]:
+        return [(r["ts"], r[key]) for r in self.rows(window_s) if key in r]
+
+
+class SloBurnMonitor:
+    """Multi-window burn-rate alerts over a ring's ``cycle_ms`` series."""
+
+    def __init__(
+        self,
+        ring: TimeSeriesRing,
+        slo_ms: float,
+        budget: float = DEFAULT_BUDGET,
+        windows: Tuple[Tuple[float, float, float], ...] = DEFAULT_BURN_WINDOWS,
+        registry: Optional[MetricsRegistry] = None,
+        min_samples: int = 10,
+    ):
+        if slo_ms <= 0:
+            raise ValueError(f"slo_ms must be positive, got {slo_ms}")
+        if not 0 < budget < 1:
+            raise ValueError(f"budget must be in (0, 1), got {budget}")
+        self.ring = ring
+        self.slo_ms = float(slo_ms)
+        self.budget = float(budget)
+        self.windows = tuple(windows)
+        self.registry = registry if registry is not None else metrics()
+        # a pair may only fire once its long window holds this many
+        # samples: one slow warmup cycle is 100% breach of a 1-sample
+        # window — a page at process start, not a signal
+        self.min_samples = min_samples
+        # per-pair firing state (hysteresis): long-window key -> active
+        self._active: Dict[str, bool] = {}
+
+    def _window_vals(self, window_s: float,
+                     now: Optional[float] = None) -> List[float]:
+        return [
+            r["cycle_ms"] for r in self.ring.rows(window_s, now)
+            if r.get("cycle_ms") is not None
+        ]
+
+    def _burn_of(self, vals: List[float]) -> Optional[float]:
+        """Budget-burn multiple of a window's samples (None: no samples):
+        ``(breach fraction) / budget`` — the ONE formula every caller
+        shares."""
+        if not vals:
+            return None
+        return sum(1 for v in vals if v > self.slo_ms) / len(vals) / self.budget
+
+    def breach_fraction(self, window_s: float,
+                        now: Optional[float] = None) -> Optional[float]:
+        """Fraction of window cycles over the SLO (None: no samples)."""
+        vals = self._window_vals(window_s, now)
+        if not vals:
+            return None
+        return sum(1 for v in vals if v > self.slo_ms) / len(vals)
+
+    def burn_rate(self, window_s: float,
+                  now: Optional[float] = None) -> Optional[float]:
+        return self._burn_of(self._window_vals(window_s, now))
+
+    def status(self, now: Optional[float] = None) -> Dict[str, object]:
+        """The /debug/timeseries burn block: per-pair long/short burn
+        rates, thresholds, and firing state."""
+        pairs = []
+        for long_s, short_s, threshold in self.windows:
+            pairs.append({
+                "long_s": long_s,
+                "short_s": short_s,
+                "threshold": threshold,
+                "long_burn": self.burn_rate(long_s, now),
+                "short_burn": self.burn_rate(short_s, now),
+                "firing": self._active.get(f"{long_s:g}s", False),
+            })
+        return {"slo_ms": self.slo_ms, "budget": self.budget, "pairs": pairs}
+
+    def check(self, now: Optional[float] = None) -> List[Dict[str, float]]:
+        """Evaluate every window pair; returns the pairs that NEWLY fired
+        (one anomaly per episode — an already-firing pair stays silent
+        until its short window recovers below burn 1.0).  Long-window
+        burn rates land in the ``slo_burn_rate{window=...}`` gauge every
+        call, firing or not."""
+        fired = []
+        for long_s, short_s, threshold in self.windows:
+            key = f"{long_s:g}s"
+            long_vals = self._window_vals(long_s, now)
+            long_burn = self._burn_of(long_vals)
+            short_burn = self.burn_rate(short_s, now)
+            if long_burn is not None:
+                self.registry.gauge_set(
+                    "slo_burn_rate", long_burn, labels={"window": key}
+                )
+            if long_burn is None or short_burn is None:
+                continue
+            if len(long_vals) < self.min_samples:
+                continue
+            if long_burn >= threshold and short_burn >= threshold:
+                if not self._active.get(key):
+                    self._active[key] = True
+                    self.registry.counter_add(
+                        "slo_burn_alerts_total", labels={"window": key}
+                    )
+                    fired.append({
+                        "window_s": long_s, "short_s": short_s,
+                        "burn": long_burn, "short_burn": short_burn,
+                        "threshold": threshold,
+                    })
+            elif short_burn < 1.0:
+                self._active[key] = False
+        return fired
+
+
+class CycleSampler:
+    """Samples the key families into the ring once per committed cycle
+    and runs the burn monitor — the scheduler calls :meth:`on_cycle`
+    from ``_record_metrics`` (sequential and pipelined paths both).
+
+    Sampled per cycle:
+
+    * ``cycle_ms`` — the cycle period (pipelined: commit-to-commit),
+      plus binds/evicts/pending and the per-phase ms from CycleStats;
+    * ``kernel_<action>_ms`` / ``rounds_<action>`` — staged-runner
+      attribution when tracing/profiling is on;
+    * counter DELTAS since the previous sample (upload bytes, pipeline
+      discards, backpressure, retraces) — the ring stores per-cycle
+      increments, not cumulative totals;
+    * ``occ_<stage>`` — the pipeline occupancy gauges as-is.
+    """
+
+    COUNTER_DELTAS = {
+        "upload_bytes": "device_upload_bytes_total",
+        "discards": "pipeline_discards_total",
+        "backpressure": "pipeline_backpressure_total",
+        "retraces": "xla_retraces_total",
+    }
+    OCCUPANCY_GAUGE = "pipeline_stage_occupancy"
+
+    def __init__(
+        self,
+        ring: Optional[TimeSeriesRing] = None,
+        registry: Optional[MetricsRegistry] = None,
+        slo_ms: Optional[float] = None,
+        budget: float = DEFAULT_BUDGET,
+        windows: Tuple[Tuple[float, float, float], ...] = DEFAULT_BURN_WINDOWS,
+        flight=None,
+        now_fn: Optional[Callable[[], float]] = None,
+    ):
+        # `is not None`, not truthiness: an EMPTY ring is len()==0 falsy
+        # and `ring or default` would silently replace the injected one
+        self.ring = ring if ring is not None else TimeSeriesRing(now_fn=now_fn)
+        self.registry = registry if registry is not None else metrics()
+        self.flight = flight
+        self.burn = (
+            SloBurnMonitor(self.ring, slo_ms, budget, windows, self.registry)
+            if slo_ms else None
+        )
+        self._prev_counters: Dict[str, float] = {}
+
+    def set_now_fn(self, now_fn: Callable[[], float]) -> None:
+        self.ring.now = now_fn
+
+    def on_cycle(
+        self,
+        stats,
+        action_ms: Optional[Dict[str, float]] = None,
+        action_rounds: Optional[Dict[str, int]] = None,
+        ts: Optional[float] = None,
+    ) -> List[Dict[str, float]]:
+        """Record one committed cycle; returns the burn pairs that newly
+        fired (after raising their ``slo_burn`` flight anomaly)."""
+        values: Dict[str, float] = {
+            "cycle_ms": stats.cycle_ms,
+            "binds": stats.binds,
+            "evicts": stats.evicts,
+            "pending": stats.pending_before,
+            "snapshot_ms": stats.snapshot_ms,
+            "upload_ms": stats.upload_ms,
+            "kernel_ms": stats.kernel_ms,
+            "decode_ms": stats.decode_ms,
+            "close_ms": stats.close_ms,
+            "actuate_ms": stats.actuate_ms,
+        }
+        for stage, ms in (action_ms or {}).items():
+            values[f"kernel_{stage}_ms"] = ms
+        for action, rounds in (action_rounds or {}).items():
+            values[f"rounds_{action}"] = rounds
+        for key, family in self.COUNTER_DELTAS.items():
+            total = self.registry.counter_total(family)
+            prev = self._prev_counters.get(key)
+            self._prev_counters[key] = total
+            # once a family has ever incremented, every row carries its
+            # delta — including 0 — so a window mean over the series sees
+            # the quiet cycles too; never-used families stay out of rows
+            if prev is None:
+                if total:
+                    values[key] = total
+            elif total or prev:
+                values[key] = total - prev
+        for labels, v in self.registry.gauge_values(self.OCCUPANCY_GAUGE).items():
+            stage = dict(labels).get("stage", "")
+            if stage:
+                values[f"occ_{stage}"] = round(v, 4)
+        self.ring.sample(values, ts=ts)
+        if self.burn is None:
+            return []
+        fired = self.burn.check(ts)
+        for pair in fired:
+            if self.flight is not None:
+                self.flight.anomaly(
+                    "slo_burn",
+                    detail=(
+                        f"burn {pair['burn']:.1f}x over {pair['window_s']:g}s "
+                        f"(short {pair['short_burn']:.1f}x / "
+                        f"{pair['short_s']:g}s, threshold "
+                        f"{pair['threshold']:g}x, slo "
+                        f"{self.burn.slo_ms:g} ms, budget "
+                        f"{self.burn.budget:g})"
+                    ),
+                )
+        return fired

@@ -67,9 +67,16 @@ _NULL_SPAN = _NullSpan()
 class Tracer:
     """Correlation-id span tracer with a bounded completed-trace store."""
 
-    def __init__(self, max_traces: int = 256, enabled: bool = False):
+    def __init__(self, max_traces: int = 256, enabled: bool = False,
+                 sample_rate: float = 1.0):
         self.max_traces = max_traces
         self.enabled = enabled
+        # fraction of cycles traced (deterministic stride sampling);
+        # sampled-out cycles get corr_id None, so every span() inside
+        # them is the free null context — NO spans are allocated.  Lets
+        # tracing stay on at 50k-task scale where per-cycle span trees
+        # would otherwise dominate the obs overhead.
+        self.sample_rate = sample_rate
         self._lock = threading.Lock()
         # corr id -> completed spans, insertion-ordered for eviction
         self._traces: Dict[str, List[Span]] = {}
@@ -90,6 +97,26 @@ class Tracer:
         sort and read chronologically in dumps."""
         tail = uuid.uuid4().hex[:8]
         return f"c{seq:06d}-{tail}" if seq is not None else f"c-{tail}"
+
+    def corr_for_cycle(self, seq: int) -> Optional[str]:
+        """Sampling-aware correlation id for cycle ordinal ``seq``: None
+        when the tracer is disabled OR the cycle is sampled out.  The
+        stride rule (a cycle is sampled iff ``floor(seq*rate)`` advances
+        over ``floor((seq-1)*rate)``) is deterministic and spreads the
+        sampled cycles uniformly — rate 0.25 traces every 4th cycle, the
+        same cycles every run."""
+        if not self.enabled:
+            return None
+        rate = self.sample_rate
+        if rate >= 1.0:
+            return self.new_corr_id(seq)
+        if rate <= 0.0:
+            return None
+        import math
+
+        if math.floor(seq * rate) == math.floor((seq - 1) * rate):
+            return None
+        return self.new_corr_id(seq)
 
     def current_corr_id(self) -> Optional[str]:
         return getattr(self._tls, "corr", None)

@@ -101,6 +101,60 @@ def test_scheduler_slo_breach_dumps_matching_cycle(tmp_path):
     assert set(last["digests"]["pending_per_job"]) == {"0", "1-9", "10-99", ">=100"}
 
 
+def test_digests_carry_action_rounds_and_discards(tmp_path):
+    """Cycle digests include the per-action round counts (staged runs)
+    and the pipelined revalidation discard counts — both existed as
+    metrics but were missing from dumps, so a post-mortem couldn't see
+    WHERE the evictive rounds went or what the gate dropped."""
+    from kube_arbitrator_tpu.pipeline import PipelinedExecutor
+    from kube_arbitrator_tpu.utils.tracing import tracer
+
+    tr = tracer()
+    tr.reset()
+    tr.enable()
+    try:
+        sim = generate_cluster(num_nodes=8, num_jobs=2, tasks_per_job=3,
+                               num_queues=2, seed=6)
+        fr = FlightRecorder(capacity=8)
+        sched = Scheduler(sim, flight=fr)
+        sched.run(max_cycles=1, until_idle=False)
+        digests = fr.last().digests
+        assert "allocate" in digests["action_rounds"], digests
+        assert digests["discards"] == {}  # sequential: no gate
+    finally:
+        tr.enable(False)
+        tr.reset()
+    # pipelined: a mid-window delete (deterministic mode pumps ingest
+    # exactly once inside the speculation window) forces a task_gone
+    # discard, which must land in the committed cycle's digest
+    from kube_arbitrator_tpu.api.types import TaskStatus
+
+    sim2 = generate_cluster(num_nodes=8, num_jobs=2, tasks_per_job=3,
+                            num_queues=2, seed=6)
+    fr2 = FlightRecorder(capacity=8)
+    sched2 = Scheduler(sim2, arena=True, flight=fr2)
+    deleted = []
+
+    def _ingest():
+        if not deleted:
+            for j in sim2.cluster.jobs.values():
+                for uid, t in list(j.tasks.items()):
+                    if t.status == TaskStatus.PENDING:
+                        j.tasks.pop(uid)
+                        sim2.delta_sink.structural("task_set")
+                        deleted.append(uid)
+                        return 1
+        return 0
+
+    executor = PipelinedExecutor(sched2, deterministic=True, ingest_fn=_ingest)
+    try:
+        out = executor.step()
+    finally:
+        executor.close()
+    assert deleted and [d.reason for d in out.discards] == ["task_gone"]
+    assert fr2.last().digests["discards"] == {"task_gone": 1}
+
+
 def test_scheduler_dtype_contract_violation_dumps(tmp_path):
     """A decider returning drifted dtypes trips the decision contract
     assert; the flight recorder files it under dtype_contract."""

@@ -126,6 +126,11 @@ def test_metric_help_table_covers_scheduler_families():
         "pending_tasks",
         "rpc_decide_duration_seconds",
         "leader_renew_duration_seconds",
+        # profiling / timeseries plane (PR 8)
+        "xla_retraces_total",
+        "xla_compile_seconds",
+        "slo_burn_rate",
+        "slo_burn_alerts_total",
     ):
         assert fam in METRIC_HELP, fam
     r = MetricsRegistry(namespace="kat")

@@ -1,0 +1,135 @@
+"""Kernel cost attribution: runtime retrace metrics, the estimated-vs-
+measured cost table over real scheduler cycles, the /debug/kernels
+endpoint, and the injectable clock (chaos-plane determinism seam)."""
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kube_arbitrator_tpu.obs import serve_obs
+from kube_arbitrator_tpu.utils import profiling
+from kube_arbitrator_tpu.utils.metrics import METRIC_HELP, metrics
+from kube_arbitrator_tpu.utils.profiling import (
+    KernelProfiler,
+    RetraceCounter,
+    profiler,
+    shape_key,
+)
+from tests.test_obs import check_promtext
+
+
+@pytest.fixture
+def clean_profiler():
+    prof = profiler()
+    prof.reset()
+    prof.enable()
+    metrics().reset()
+    yield prof
+    prof.enable(False)
+    prof.reset()
+
+
+def _force_compile(tag: int):
+    """A jit the process has never compiled (fresh lambda + unique shape)."""
+    fn = jax.jit(lambda x: x * 2 + tag)
+    fn(jnp.ones(3 + tag)).block_until_ready()
+
+
+def test_retrace_counter_window_semantics():
+    """The bench-style armed window (moved here from bench.py): compiles
+    inside the window count, compiles outside do not."""
+    with RetraceCounter() as rt:
+        _force_compile(101)
+    outside = rt.count
+    _force_compile(102)  # window closed: must not count
+    assert outside >= 1
+    assert rt.count == outside
+
+
+def test_retraces_attributed_to_active_stage(clean_profiler):
+    """A compile firing inside a stage scope lands in
+    xla_retraces_total{fn=<stage>} and xla_compile_seconds."""
+    with clean_profiler.stage_scope("allocate"):
+        _force_compile(201)
+    _force_compile(202)  # no stage active -> fn="other"
+    m = metrics()
+    assert m.counter_value("xla_retraces_total", {"fn": "allocate"}) >= 1
+    assert m.counter_value("xla_retraces_total", {"fn": "other"}) >= 1
+    hist = m.histogram("xla_compile_seconds")
+    assert hist is not None and hist.n >= 2
+    text = m.render()
+    check_promtext(text)
+    assert "# HELP kube_arbitrator_tpu_xla_retraces_total" in text
+    for fam in ("xla_retraces_total", "xla_compile_seconds",
+                "slo_burn_rate", "slo_burn_alerts_total"):
+        assert fam in METRIC_HELP, fam
+
+
+def test_disabled_profiler_stage_scope_is_noop():
+    prof = KernelProfiler()
+    with prof.stage_scope("allocate"):
+        assert profiling.current_stage() is None  # null scope: no TLS write
+    assert prof.table()["shapes"] == {}
+
+
+def test_staged_cycles_fill_cost_table(clean_profiler):
+    """Real scheduler cycles with the profiler on (tracing OFF — the
+    profiler alone must route decides through the staged runner) fill
+    measured ms and HLO estimates per action at the pack's shape key."""
+    from kube_arbitrator_tpu.cache import build_snapshot, generate_cluster
+    from kube_arbitrator_tpu.framework import Scheduler
+
+    sim = generate_cluster(num_nodes=16, num_jobs=4, tasks_per_job=4,
+                           num_queues=2, seed=11)
+    key = shape_key(build_snapshot(sim.cluster).tensors)
+    sched = Scheduler(sim)
+    sched.run(max_cycles=2, until_idle=False)
+    table = clean_profiler.table()
+    assert key in table["shapes"], table["shapes"].keys()
+    stages = table["shapes"][key]
+    assert "allocate" in stages and "open_session" in stages
+    alloc = stages["allocate"]
+    assert alloc["measured"]["count"] >= 2
+    assert alloc["measured"]["mean_ms"] > 0
+    est = alloc["estimate"]
+    assert est.get("flops", 0) > 0, est
+    assert est.get("bytes_accessed", 0) > 0, est
+    assert alloc["gflops_per_s"] >= 0
+    # the scheduler still recorded the action histograms (staged path)
+    assert metrics().histogram(
+        "kernel_action_duration_seconds", {"action": "allocate"}
+    ).n >= 2
+
+
+def test_debug_kernels_endpoint_serves_table(clean_profiler):
+    clean_profiler.record_measured("allocate", "T64xN16xQ2xJ8xG8", 3.5, 2)
+    server, _t, url = serve_obs(kernel_profiler=clean_profiler)
+    try:
+        with urllib.request.urlopen(url + "/debug/kernels", timeout=10) as r:
+            assert r.status == 200
+            body = json.load(r)
+    finally:
+        server.shutdown()
+    stage = body["shapes"]["T64xN16xQ2xJ8xG8"]["allocate"]
+    assert stage["measured"]["last_ms"] == 3.5
+    assert stage["measured"]["rounds_total"] == 2
+
+
+def test_now_fn_injectable_for_virtual_clock():
+    """The chaos plane's VirtualClock seam: every timestamp the profiler
+    stamps comes from the injected clock, so replays are byte-stable."""
+    prof = KernelProfiler(now_fn=lambda: 777.0)
+    prof.enable()
+    prof.record_measured("allocate", "k", 1.0)
+    est = prof._estimate_one(lambda: (_ for _ in ()).throw(RuntimeError("x")))
+    assert est["estimated_at"] == 777.0
+    table = prof.table()
+    assert table["generated_at"] == 777.0
+    assert table["shapes"]["k"]["allocate"]["measured"]["last_ts"] == 777.0
+    clock = [1.0]
+    prof.set_now_fn(lambda: clock[0])
+    clock[0] = 9.0
+    prof.record_measured("allocate", "k", 2.0)
+    assert prof.table()["shapes"]["k"]["allocate"]["measured"]["last_ts"] == 9.0

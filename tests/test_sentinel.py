@@ -1,0 +1,191 @@
+"""Perf-regression sentinel: host-fingerprinted history, noise-aware
+verdicts on synthetic histories (clear regression -> fail, within-noise
+jitter -> pass), the canary's must-fire/must-pass contract, and the
+small-rung measure path."""
+import json
+
+import pytest
+
+from kube_arbitrator_tpu import sentinel
+from kube_arbitrator_tpu.sentinel import (
+    Verdict,
+    append_history,
+    compare,
+    compare_row,
+    exit_code,
+    history_row,
+    host_fingerprint,
+    load_history,
+    main,
+    rows_from_bench,
+)
+
+
+def _row(metric="full_actions@50000x5000", cycle_ms=600.0, spread=0.1,
+         retraces=0, fp="hostA"):
+    """A synthetic history row with a given relative p10-p90 spread."""
+    half = cycle_ms * spread / 2
+    return {
+        "schema": 1, "metric": metric, "cycle_ms": cycle_ms,
+        "cycle_ms_p10": cycle_ms - half, "cycle_ms_p90": cycle_ms + half,
+        "rep_ms": [cycle_ms - half, cycle_ms, cycle_ms + half],
+        "retraces": retraces, "fingerprint": fp,
+        "cpu_model": "x", "cpu_count": 2, "devices": "cpu",
+        "recorded_at": 1.0,
+    }
+
+
+def test_host_fingerprint_stable_and_keyed():
+    a, b = host_fingerprint(devices="cpu"), host_fingerprint(devices="cpu")
+    assert a["fingerprint"] == b["fingerprint"]
+    assert a["fingerprint"] != host_fingerprint(devices="tpu")["fingerprint"]
+    assert a["cpu_count"] >= 1
+
+
+def test_history_roundtrip_skips_torn_lines(tmp_path):
+    path = str(tmp_path / "h.jsonl")
+    rows = [history_row("m1", 100.0, 95.0, 105.0, [95, 100, 105], 0),
+            history_row("m2", 50.0)]
+    append_history(path, rows)
+    with open(path, "a") as f:
+        f.write('{"torn": ')  # SIGKILLed writer mid-append
+    loaded = load_history(path)
+    assert [r["metric"] for r in loaded] == ["m1", "m2"]
+    assert loaded[0]["fingerprint"] == host_fingerprint()["fingerprint"]
+
+
+def test_clear_regression_fails():
+    base = [_row(cycle_ms=600.0, spread=0.1) for _ in range(3)]
+    v = compare_row(base, _row(cycle_ms=1250.0, spread=0.1))
+    assert v.status == "regression"
+    assert exit_code([v]) == 1
+
+
+def test_within_noise_jitter_passes():
+    base = [_row(cycle_ms=600.0, spread=0.3)]
+    # +25% is inside the 3x-spread (90%-capped) band
+    v = compare_row(base, _row(cycle_ms=750.0))
+    assert v.status == "ok"
+    assert exit_code([v]) == 0
+
+
+def test_two_x_slowdown_always_fails_even_on_noisy_history():
+    """The margin ceiling is structural: REL_CEIL < 1.0 means a genuine
+    2x median slowdown clears the band no matter the recorded spread."""
+    for spread in (0.1, 0.5, 0.8, 2.0):
+        base = [_row(cycle_ms=600.0, spread=spread) for _ in range(4)]
+        v = compare_row(base, _row(cycle_ms=1200.0))
+        assert v.status == "regression", (spread, v.detail)
+
+
+def test_improvement_reported_not_failed():
+    base = [_row(cycle_ms=600.0, spread=0.1)]
+    v = compare_row(base, _row(cycle_ms=200.0))
+    assert v.status == "improved"
+    assert exit_code([v]) == 0
+
+
+def test_other_host_class_is_no_baseline():
+    history = [_row(fp="hostA")]
+    v = compare(history, [_row(cycle_ms=5000.0, fp="hostB")])[0]
+    assert v.status == "no-baseline"
+    assert exit_code([v]) == 0
+
+
+def test_retrace_contaminated_rows_excluded_from_anchor():
+    """A recompile-inflated row must not drag the baseline center up
+    (masking a regression) when clean rows exist."""
+    base = [_row(cycle_ms=600.0), _row(cycle_ms=600.0),
+            _row(cycle_ms=5000.0, retraces=3)]
+    v = compare_row(base, _row(cycle_ms=1300.0))
+    assert v.status == "regression"  # vs the clean 600 center, not 5000
+    assert v.baseline_ms == 600.0
+
+
+def test_rows_from_bench_ladder_and_cadence():
+    host = host_fingerprint(devices="cpu")
+    ladder = {"metric": "allocate@1000x100", "cycle_ms": 2.5,
+              "cycle_ms_p10": 2.4, "cycle_ms_p90": 2.7,
+              "rep_ms": [2.4, 2.5, 2.7], "retraces": 0, "value": 9.9,
+              "unit": "pods/s", "native_ops": True}
+    r = rows_from_bench(ladder, host=host)
+    assert r["metric"] == "allocate@1000x100" and r["cycle_ms"] == 2.5
+    assert r["source"] == "bench" and r["native_ops"] is True
+    cadence = {"metric": "pipeline_cadence_q512@5000x500", "value": 5.3,
+               "unit": "x",
+               "pipelined": {"period_ms": 32.4,
+                             "period_ms_reps": [40.5, 30.0, 32.4]}}
+    r2 = rows_from_bench(cadence, host=host)
+    assert r2["cycle_ms"] == 32.4 and r2["cycle_ms_p10"] == 30.0
+    # error rows (no timing) are skipped, not crashed on
+    assert rows_from_bench({"metric": "x", "error": "boom"}, host=host) is None
+
+
+@pytest.fixture
+def seeded_history(tmp_path):
+    path = str(tmp_path / "BENCH_HISTORY.jsonl")
+    host = host_fingerprint()
+    rows = [
+        history_row("full_actions@50000x5000", 600.0, 550.0, 680.0,
+                    [550, 600, 680], 0, host=host),
+        history_row("allocate@1000x100", 2.5, 2.4, 2.7, [2.4, 2.5, 2.7], 0,
+                    host=host),
+    ]
+    append_history(path, rows)
+    return path
+
+
+def test_canary_cli_contract(seeded_history, capsys):
+    """The acceptance gate: a seeded synthetic 2x slowdown exits 1, an
+    identical-history run exits 0."""
+    assert main(["canary", "--history", seeded_history,
+                 "--slowdown", "2.0"]) == 1
+    out = capsys.readouterr().out
+    verdicts = [json.loads(line) for line in out.splitlines()]
+    assert all(v["status"] == "regression" for v in verdicts)
+    assert main(["canary", "--history", seeded_history,
+                 "--slowdown", "1.0"]) == 0
+    out = capsys.readouterr().out
+    assert all(json.loads(l)["status"] == "ok" for l in out.splitlines())
+    # single-metric restriction works; unknown metric is a usage error
+    assert main(["canary", "--history", seeded_history, "--slowdown", "2.0",
+                 "--metric", "allocate@1000x100"]) == 1
+    capsys.readouterr()
+    assert main(["canary", "--history", seeded_history, "--slowdown", "2.0",
+                 "--metric", "nope"]) == 2
+    capsys.readouterr()
+
+
+def test_canary_empty_history_is_usage_error(tmp_path, capsys):
+    assert main(["canary", "--history", str(tmp_path / "missing.jsonl")]) == 2
+    capsys.readouterr()
+
+
+def test_compare_cli_against_row_file(seeded_history, tmp_path, capsys):
+    slow = history_row("full_actions@50000x5000", 1400.0, 1300.0, 1500.0)
+    row_file = str(tmp_path / "current.jsonl")
+    with open(row_file, "w") as f:
+        f.write(json.dumps(slow) + "\n")
+    assert main(["compare", "--history", seeded_history,
+                 "--row", row_file]) == 1
+    capsys.readouterr()
+    ok = history_row("full_actions@50000x5000", 610.0, 580.0, 640.0)
+    with open(row_file, "w") as f:
+        f.write(json.dumps(ok) + "\n")
+    assert main(["compare", "--history", seeded_history,
+                 "--row", row_file]) == 0
+    capsys.readouterr()
+
+
+@pytest.mark.slow
+def test_measure_rung_records_comparable_row(tmp_path, capsys):
+    """The PERF_SENTINEL lane's probe: a tiny rung measures, appends,
+    and a re-measure compares ok against it (same host class, no code
+    change in between)."""
+    path = str(tmp_path / "h.jsonl")
+    rc = main(["measure", "--rung", "400x32", "--actions", "allocate",
+               "--reps", "2", "--history", path, "--append"])
+    assert rc == 0
+    row = load_history(path)[0]
+    assert row["cycle_ms"] > 0 and row["metric"].startswith("sentinel:allocate@")
+    capsys.readouterr()

@@ -1,0 +1,197 @@
+"""Metric time-series ring, the per-cycle sampler's counter-delta math,
+multi-window SLO burn-rate alerts (hysteresis + the slo_burn flight
+anomaly), and the /debug/timeseries endpoint."""
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kube_arbitrator_tpu.framework.scheduler import CycleStats
+from kube_arbitrator_tpu.obs import serve_obs
+from kube_arbitrator_tpu.utils.flightrec import FlightRecorder
+from kube_arbitrator_tpu.utils.metrics import MetricsRegistry, metrics
+from kube_arbitrator_tpu.utils.timeseries import (
+    CycleSampler,
+    SloBurnMonitor,
+    TimeSeriesRing,
+)
+from tests.test_obs import check_promtext
+
+
+def _stats(cycle_ms, binds=1, **kw):
+    return CycleStats(cycle_ms=cycle_ms, snapshot_ms=1.0, binds=binds,
+                      evicts=0, pending_before=5, **kw)
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_ring_bounded_and_window_filtered():
+    clock = _Clock()
+    ring = TimeSeriesRing(capacity=4, now_fn=clock)
+    for i in range(7):
+        clock.t = 1000.0 + i
+        ring.sample({"cycle_ms": float(i)})
+    rows = ring.rows()
+    assert len(rows) == 4 and [r["cycle_ms"] for r in rows] == [3, 4, 5, 6]
+    # window keeps rows with ts >= now - window_s (boundary inclusive)
+    assert [r["cycle_ms"] for r in ring.rows(window_s=2.0)] == [4, 5, 6]
+    assert ring.series("cycle_ms", window_s=1.0) == [(1005.0, 5.0), (1006.0, 6.0)]
+
+
+def test_sampler_samples_families_and_counter_deltas():
+    reg = MetricsRegistry(namespace="kat")
+    clock = _Clock()
+    sampler = CycleSampler(
+        ring=TimeSeriesRing(capacity=16, now_fn=clock), registry=reg
+    )
+    reg.counter_add("device_upload_bytes_total", 1000, labels={"mode": "full"})
+    sampler.on_cycle(_stats(12.0, binds=3), action_ms={"allocate": 7.5},
+                     action_rounds={"preempt": 4})
+    reg.counter_add("device_upload_bytes_total", 250, labels={"mode": "delta"})
+    reg.counter_add("pipeline_discards_total", 2, labels={"reason": "task_gone"})
+    reg.gauge_set("pipeline_stage_occupancy", 0.75, labels={"stage": "decide"})
+    sampler.on_cycle(_stats(15.0))
+    rows = sampler.ring.rows()
+    assert rows[0]["cycle_ms"] == 12.0
+    assert rows[0]["kernel_allocate_ms"] == 7.5
+    assert rows[0]["rounds_preempt"] == 4
+    assert rows[0]["upload_bytes"] == 1000  # first sample: full total
+    # second sample carries per-cycle DELTAS, not cumulative totals
+    assert rows[1]["upload_bytes"] == 250
+    assert rows[1]["discards"] == 2
+    assert rows[1]["occ_decide"] == 0.75
+    assert "discards" not in rows[0]
+
+
+def test_burn_monitor_multiwindow_fires_and_rearms(tmp_path):
+    """Burn alerts need BOTH windows over threshold, fire once per
+    episode (hysteresis), raise the slo_burn flight anomaly, and re-arm
+    after the short window recovers."""
+    metrics().reset()
+    clock = _Clock()
+    ring = TimeSeriesRing(capacity=512, now_fn=clock)
+    flight = FlightRecorder(capacity=8, dump_dir=str(tmp_path))
+    sampler = CycleSampler(
+        ring=ring, registry=metrics(), slo_ms=100.0, budget=0.1,
+        windows=((60.0, 10.0, 3.0),), flight=flight,
+    )
+    # healthy cycles: burn 0, nothing fires
+    for i in range(20):
+        clock.t += 1
+        assert sampler.on_cycle(_stats(50.0)) == []
+    # sustained breach: every cycle over SLO -> burn 1/0.1 = 10x > 3x
+    fired_at = []
+    for i in range(20):
+        clock.t += 1
+        if sampler.on_cycle(_stats(200.0)):
+            fired_at.append(i)
+    assert len(fired_at) == 1, fired_at  # one anomaly per episode
+    assert metrics().counter_value(
+        "slo_burn_alerts_total", {"window": "60s"}
+    ) == 1
+    assert metrics().gauge_value("slo_burn_rate", {"window": "60s"}) > 3.0
+    dumps = list(tmp_path.glob("flight-*-slo_burn.json"))
+    assert len(dumps) == 1
+    dump = json.load(open(dumps[0]))
+    assert "burn" in dump["detail"] and "100 ms" in dump["detail"]
+    # recovery: short window (10s) drains below burn 1 -> monitor re-arms
+    for i in range(15):
+        clock.t += 1
+        sampler.on_cycle(_stats(50.0))
+    assert sampler.burn._active == {"60s": False}
+    # second episode fires a second anomaly
+    refired = []
+    for i in range(20):
+        clock.t += 1
+        refired += sampler.on_cycle(_stats(300.0))
+    assert len(refired) == 1
+    assert len(list(tmp_path.glob("flight-*-slo_burn.json"))) == 2
+    check_promtext(metrics().render())
+
+
+def test_burn_within_budget_never_fires():
+    clock = _Clock()
+    ring = TimeSeriesRing(capacity=256, now_fn=clock)
+    mon = SloBurnMonitor(ring, slo_ms=100.0, budget=0.2,
+                         windows=((60.0, 10.0, 3.0),),
+                         registry=MetricsRegistry(namespace="kat"))
+    # 1 breach in 10 cycles = 10% < budget 20% -> burn 0.5, no alert
+    for i in range(40):
+        clock.t += 1
+        ring.sample({"cycle_ms": 300.0 if i % 10 == 0 else 50.0})
+        assert mon.check() == []
+    assert 0 < mon.burn_rate(60.0) < 1.0
+
+
+def test_burn_monitor_validates_config():
+    ring = TimeSeriesRing()
+    with pytest.raises(ValueError):
+        SloBurnMonitor(ring, slo_ms=0)
+    with pytest.raises(ValueError):
+        SloBurnMonitor(ring, slo_ms=100.0, budget=1.5)
+
+
+def test_debug_timeseries_endpoint(tmp_path):
+    clock = _Clock()
+    sampler = CycleSampler(
+        ring=TimeSeriesRing(capacity=32, now_fn=clock),
+        registry=MetricsRegistry(namespace="kat"), slo_ms=100.0,
+    )
+    for i in range(6):
+        clock.t += 10
+        sampler.on_cycle(_stats(float(10 * i)))
+    server, _t, url = serve_obs(timeseries=sampler)
+    try:
+        with urllib.request.urlopen(url + "/debug/timeseries", timeout=10) as r:
+            body = json.load(r)
+        assert len(body["rows"]) == 6
+        assert body["rows"][-1]["cycle_ms"] == 50.0
+        assert body["slo_burn"]["slo_ms"] == 100.0
+        assert body["slo_burn"]["pairs"][0]["firing"] is False
+        # ?window= bounds the range (ring.now is the injected clock)
+        with urllib.request.urlopen(
+            url + "/debug/timeseries?window=25", timeout=10
+        ) as r:
+            body = json.load(r)
+        # samples at t=1010..1060 step 10; cutoff 1060-25=1035 -> 3 rows
+        assert len(body["rows"]) == 3 and body["window_s"] == 25.0
+        # bad window -> 400, not a handler crash
+        try:
+            urllib.request.urlopen(url + "/debug/timeseries?window=x", timeout=10)
+            assert False, "expected HTTP 400"
+        except urllib.error.HTTPError as err:
+            assert err.code == 400
+    finally:
+        server.shutdown()
+
+
+def test_scheduler_samples_each_cycle():
+    """End-to-end: a Scheduler with timeseries wired samples once per
+    committed cycle, sequential and pipelined alike."""
+    from kube_arbitrator_tpu.cache.sim import generate_cluster
+    from kube_arbitrator_tpu.framework import Scheduler
+
+    metrics().reset()
+    sampler = CycleSampler(ring=TimeSeriesRing(capacity=64))
+    sim = generate_cluster(num_nodes=16, num_jobs=4, tasks_per_job=4,
+                           num_queues=2, seed=5)
+    sched = Scheduler(sim, timeseries=sampler)
+    sched.run(max_cycles=3, until_idle=False)
+    rows = sampler.ring.rows()
+    assert len(rows) == 3
+    assert all(r["cycle_ms"] > 0 for r in rows)
+    assert sum(r["binds"] for r in rows) == sum(s.binds for s in sched.history)
+
+    sampler2 = CycleSampler(ring=TimeSeriesRing(capacity=64))
+    sim2 = generate_cluster(num_nodes=16, num_jobs=4, tasks_per_job=4,
+                            num_queues=2, seed=5)
+    sched2 = Scheduler(sim2, arena=True, timeseries=sampler2)
+    sched2.run_pipelined(max_cycles=3, until_idle=False)
+    assert len(sampler2.ring.rows()) == 3
