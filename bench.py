@@ -662,6 +662,14 @@ def _measure_main() -> None:
             ("allocate@50000x5000", 50_000, 5_000, 8, 0.0, ("allocate", "backfill")),
             ("allocate_q512@50000x5000", 50_000, 5_000, 512, 0.0, ("allocate", "backfill")),
             ("full_actions_q512@50000x5000", 50_000, 5_000, 512, 0.5, FULL_ACTIONS),
+            # rounds-heavy rung: 4 queues x ~50 jobs each, heavily
+            # oversubscribed — the canonical instance runs ~60 reclaim +
+            # ~60 preempt rounds (120+ evictive rounds/cycle), the shape
+            # whose per-round phase-A overhead the incremental round gate
+            # and the batched reclaim rounds target; high per-instance
+            # variance (a seed-43 instance drains in a handful of rounds)
+            # is expected and shows up as rep spread, not retraces
+            ("full_actions_rounds_q4@20000x2000", 20_000, 2_000, 4, 0.7, FULL_ACTIONS),
         ]
         from kube_arbitrator_tpu.platform import decision_device
 

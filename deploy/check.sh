@@ -177,18 +177,21 @@ fi
 
 # PERF_SMOKE=1: the batched-turn kernel lane — the sequential-vs-batched
 # decision-equality soak (3 seeds x q in {8, 64, 512} x every action,
-# bit-for-bit streams + round counts), the traced turn-bound assertion
-# (a q512 world with k claimant queues pays k gate-admitted turns per
-# preempt round, not 512), and kat-lint over the batched modules + the
-# native FFI bindings.
+# bit-for-bit streams + round counts, reclaim round-batched + allocate
+# pruned + preempt round-gate on/off legs included), the traced
+# turn-bound assertion (a q512 world with k claimant queues pays k
+# gate-admitted turns per preempt round, not 512), a reclaim
+# round-batched + gate-on==gate-off live smoke, and kat-lint over the
+# batched modules + the native FFI bindings.
 rc_perf=0
 if [ "${PERF_SMOKE:-0}" = "1" ]; then
   env JAX_PLATFORMS=cpu python -m pytest -q tests/test_batched_turns.py \
     || rc_perf=$?
-  # rounds-x-turns smoke on a live preempt run: the batched engine must
-  # finish the q512 contention world in a handful of rounds and leave
-  # decisions identical to the sequential engine (redundant with the
-  # suite above, but cheap and self-contained for local bisecting)
+  # rounds-x-turns smoke on a live run: the batched engines must finish
+  # the q512 contention world in a handful of rounds and leave decisions
+  # identical to the sequential engines, with the round gate on AND off
+  # (redundant with the suite above, but cheap and self-contained for
+  # local bisecting)
   env JAX_PLATFORMS=cpu python - <<'EOF' || rc_perf=$?
 import numpy as np
 from kube_arbitrator_tpu.cache import build_snapshot, generate_cluster
@@ -201,19 +204,33 @@ st = build_snapshot(sim.cluster).tensors
 tiers, sess, state = _open(st)
 import jax
 import numpy as np
-from kube_arbitrator_tpu.ops.preempt import preempt_action
-run = lambda tb: jax.jit(
-    lambda st, se, s: preempt_action(st, se, s, tiers, turn_batch=tb)
-)(st, sess, state)
-out, ref = run(True), run(False)
-rounds = int(out.rounds)
-assert rounds < 64, f"preempt rounds blew the traced bound: {rounds}"
-assert rounds == int(ref.rounds), (rounds, int(ref.rounds))
+from kube_arbitrator_tpu.ops.preempt import preempt_action, reclaim_action
+
+# reclaim: round-batched vs sequential canon, bit-for-bit
+rb = jax.jit(lambda st, se, s: reclaim_action(st, se, s, tiers, turn_batch=True))(st, sess, state)
+rs = jax.jit(lambda st, se, s: reclaim_action(st, se, s, tiers, turn_batch=False))(st, sess, state)
 for f in ("task_status", "task_node", "node_releasing", "node_num_tasks"):
-    a, b = np.asarray(getattr(out, f)), np.asarray(getattr(ref, f))
-    assert (a == b).all(), f"batched vs sequential diverged on {f}"
-print(f"perf smoke: q512 preempt converged in {rounds} rounds, "
-      "batched == sequential")
+    a, b = np.asarray(getattr(rb, f)), np.asarray(getattr(rs, f))
+    assert (a == b).all(), f"batched vs sequential reclaim diverged on {f}"
+assert int(rb.rounds) == int(rs.rounds)
+state = rb
+
+run = lambda tb, rg=None: jax.jit(
+    lambda st, se, s: preempt_action(st, se, s, tiers, turn_batch=tb,
+                                     round_gate=rg)
+)(st, sess, state)
+gate_on, gate_off, ref = run(True, True), run(True, False), run(False)
+rounds = int(gate_on.rounds)
+assert rounds < 64, f"preempt rounds blew the traced bound: {rounds}"
+assert rounds == int(ref.rounds) == int(gate_off.rounds)
+for f in ("task_status", "task_node", "node_releasing", "node_num_tasks"):
+    a, b, c = (np.asarray(getattr(x, f)) for x in (gate_on, gate_off, ref))
+    assert (a == c).all(), f"gate-on vs sequential diverged on {f}"
+    assert (b == c).all(), f"gate-off vs sequential diverged on {f}"
+print(f"perf smoke: q512 reclaim {int(rb.rounds)} rounds "
+      f"({int(rb.rounds_gated)} gated), preempt {rounds} rounds "
+      f"({int(gate_on.rounds_gated)} gated), batched == sequential "
+      "with gate on and off")
 EOF
   python -m kube_arbitrator_tpu.analysis --rules KAT-LCK,KAT-DTY \
     kube_arbitrator_tpu/ops/preempt.py \
@@ -222,7 +239,7 @@ EOF
   if [ "${rc_perf}" -ne 0 ]; then
     echo "perf smoke job: FAILED (exit ${rc_perf})" >&2
   else
-    echo "perf smoke job: ok (parity soak + turn bound + kat-lint)"
+    echo "perf smoke job: ok (parity soak + turn bound + reclaim/gate smoke + kat-lint)"
   fi
 fi
 

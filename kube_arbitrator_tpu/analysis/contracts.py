@@ -52,6 +52,12 @@ Sub-ids:
   abstract evaluation or returns per-queue tensors drifting from the
   declared :data:`TURN_SCHEMA` — both eviction paths read these, so a
   silent drift here corrupts two kernels at once.
+- ``KAT-CTR-009``: the round-batched reclaim engine's selection stage
+  (``ops/preempt.reclaim_select_turns`` — every panel queue's pop from
+  round-start state, consumed by ``_reclaim_canon_batched``'s thin
+  tail) fails abstract evaluation or drifts from the declared
+  :data:`RECLAIM_TURN_SCHEMA` — the thin tail gathers these per turn,
+  so a dtype drift silently corrupts every thin reclaim claim.
 
 The harness takes the schemas as parameters so the regression tests can
 seed one mutated dtype and assert the checker reports exactly the
@@ -189,6 +195,7 @@ STATE_SCHEMA: Dict[str, Tuple[Tuple[str, ...], str]] = {
     "evicted_for": (("T",), "int32"),
     "progress": ((), "bool"),
     "rounds": ((), "int32"),
+    "rounds_gated": ((), "int32"),
 }
 
 SESSION_SCHEMA: Dict[str, Tuple[Tuple[str, ...], str]] = {
@@ -211,6 +218,20 @@ TURN_SCHEMA: Dict[str, Tuple[Tuple[str, ...], str]] = {
     "has_grp": (("Q",), "bool"),
     "req": (("Q", "R"), "float32"),
     "budget": (("Q",), "int32"),
+}
+
+#: The round-batched reclaim selection contract (KAT-CTR-009): per-queue
+#: (claimant job, group, has_grp, per-task resreq, pop, burn) in
+#: reclaim_select_turns' return order.  The queue-ids axis is symbolic Q
+#: here; the production caller passes the round perm's TURN_PANEL prefix
+#: — the kernel is shape-polymorphic over the batch width.
+RECLAIM_TURN_SCHEMA: Dict[str, Tuple[Tuple[str, ...], str]] = {
+    "j_sel": (("Q",), "int32"),
+    "g_sel": (("Q",), "int32"),
+    "has_grp": (("Q",), "bool"),
+    "req": (("Q", "R"), "float32"),
+    "pop": (("Q",), "bool"),
+    "burn": (("Q",), "bool"),
 }
 
 #: What framework/session.py's actuation decode consumes.
@@ -666,6 +687,76 @@ def check_batched_turns(
     return findings
 
 
+def check_reclaim_turns(
+    schema: Optional[Mapping[str, Tuple[Tuple[str, ...], str]]] = None,
+    axes: Optional[Mapping[str, int]] = None,
+    turn_schema: Optional[Mapping[str, Tuple[Tuple[str, ...], str]]] = None,
+) -> List[Finding]:
+    """KAT-CTR-009: abstract-evaluate the round-batched reclaim engine's
+    selection stage (``reclaim_select_turns``) against the declared
+    snapshot/state/session contracts and verify its per-queue outputs
+    against :data:`RECLAIM_TURN_SCHEMA`.  Seeding a mutated
+    ``turn_schema`` must make this pass report the drifted field
+    (regression-tested)."""
+    import jax
+    import numpy as np
+
+    from ..ops import preempt as pre
+    from ..ops.ordering import DEFAULT_TIERS
+
+    axes = axes or DEFAULT_AXES
+    turn_schema = turn_schema or RECLAIM_TURN_SCHEMA
+    findings: List[Finding] = []
+    path, line = _anchor(pre.reclaim_select_turns)
+    st = snapshot_struct(schema, axes)
+    state = _state_struct(STATE_SCHEMA, axes)
+    sess = _session_struct(axes)
+    Q = axes["Q"]
+    J = axes["J"]
+    q_ids = jax.ShapeDtypeStruct((Q,), np.dtype("int32"))
+    q_entries = jax.ShapeDtypeStruct((Q,), np.dtype("int32"))
+    job_consumed = jax.ShapeDtypeStruct((J,), np.dtype("bool"))
+    names = tuple(turn_schema)  # declaration order == return order
+
+    def run(st, sess, state, qi, qe, jc):
+        shared = pre._reclaim_shared(st, sess, state, DEFAULT_TIERS, jc)
+        return pre.reclaim_select_turns(
+            st, sess, state, DEFAULT_TIERS, shared, qi, qe
+        )
+
+    with jax.default_device(jax.devices("cpu")[0]):
+        try:
+            out = jax.eval_shape(run, st, sess, state, q_ids, q_entries,
+                                 job_consumed)
+        except Exception as err:
+            return findings + [Finding(
+                "KAT-CTR-009", "error", path, line,
+                f"batched reclaim selection failed abstract evaluation: "
+                f"{type(err).__name__}: {err}",
+                hint="reclaim_select_turns no longer composes over the "
+                "declared snapshot/state contract; _reclaim_canon_batched's "
+                "thin tail consumes it",
+            )]
+        for name, val in zip(names, out):
+            sym_shape, dtype = turn_schema[name]
+            want_shape = _concrete_shape(sym_shape, axes)
+            got_shape = tuple(getattr(val, "shape", ()))
+            got_dtype = str(getattr(val, "dtype", type(val).__name__))
+            if got_shape != want_shape or got_dtype != dtype:
+                findings.append(Finding(
+                    "KAT-CTR-009", "error", path, line,
+                    f"batched reclaim selection: `{name}` is "
+                    f"{_describe(val)}, contract says "
+                    f"{dtype}[{','.join(map(str, want_shape))}] "
+                    f"(shape symbols {sym_shape})",
+                    hint="the round-batched reclaim tail gathers these "
+                    "per turn; a drifted dtype/shape silently corrupts "
+                    "every thin reclaim claim — fix reclaim_select_turns "
+                    "or the schema if the contract legitimately changed",
+                ))
+    return findings
+
+
 def _state_struct(state_schema, axes):
     import jax
     import numpy as np
@@ -705,5 +796,6 @@ def check_contracts(
     findings += check_arena_producer(schema)
     findings += check_kernels(schema, state_schema=state_schema)
     findings += check_batched_turns(schema, turn_schema=turn_schema)
+    findings += check_reclaim_turns(schema)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings

@@ -71,10 +71,15 @@ class LocalDecider:
             # previous complete dict or this one, never a dict mid-fill
             action_ms = {}
             action_rounds = {}
-            for stage, ts, ms, rounds in stages:
+            for stage, ts, ms, rounds, rounds_gated in stages:
                 action_ms[stage] = ms
                 if rounds is not None:
                     action_rounds[stage] = rounds
+                    # ":gated" suffix rides the same dict; the metric
+                    # emitters map it to the variant="gated" series of
+                    # kernel_rounds_total{action}
+                    if rounds_gated:
+                        action_rounds[f"{stage}:gated"] = rounds_gated
                 tr.record_span(f"kernel.{stage}", ts, ms / 1000)
             self.last_action_ms = action_ms
             self.last_action_rounds = action_rounds

@@ -15,7 +15,7 @@ from typing import Dict, List, Optional
 
 from ..cache.sim import SimCluster
 from ..utils.flightrec import CycleRecord, FlightRecorder
-from ..utils.metrics import metrics
+from ..utils.metrics import metrics, record_kernel_rounds
 from ..utils.tracing import tracer
 from .conf import SchedulerConfig, load_conf_file
 from .leader import LeaderElector, LeaderLost, TransientLockError
@@ -434,10 +434,7 @@ class Scheduler:
                 "kernel_action_duration_seconds", ms / 1000,
                 labels={"action": stage},
             )
-        for action, rounds in (action_rounds or {}).items():
-            m.counter_add(
-                "kernel_rounds_total", rounds, labels={"action": action}
-            )
+        record_kernel_rounds(m, action_rounds)
         m.counter_add("cycles_total")
         m.counter_add("binds_total", s.binds)
         m.counter_add("evicts_total", s.evicts)

@@ -40,7 +40,16 @@ import jax.numpy as jnp
 
 from ..api.types import TaskStatus
 from ..cache.snapshot import SnapshotTensors
-from .common import BIG, EPS, ceil_div_pos, dominant_share, fair, lex_argmin, safe_share
+from .common import (
+    BIG,
+    EPS,
+    ceil_div_pos,
+    dominant_share,
+    fair,
+    lex_argmin,
+    plugin_on,
+    safe_share,
+)
 from .fairness import drf_equilibrium_level, drf_shares, overused, queue_shares
 from .ordering import (
     Tiers,
@@ -82,6 +91,11 @@ class AllocState:
     evicted_for: jax.Array   # i32[T]
     progress: jax.Array      # bool scalar — placements in current round
     rounds: jax.Array        # i32 scalar
+    # Rounds served by an incremental fast path: preempt's round gate
+    # (carried phase-A state, ops/preempt._rounds_batched) and reclaim's
+    # fully-thin batched rounds both count here — the `gated` variant of
+    # kernel_rounds_total{action}.  Always <= rounds; 0 for allocate.
+    rounds_gated: jax.Array  # i32 scalar
 
 
 @jax.tree_util.register_dataclass
@@ -329,6 +343,72 @@ def _use_deferred_decode(st: SnapshotTensors, tiers: Tiers) -> bool:
     )
 
 
+# Feasibility pre-pruning (the allocate residual): smallest compacted
+# node-panel width worth the extra compiled loop variant.  Below it the
+# full-width path is already cheap and the multi-compile is pure loss.
+PRUNE_FLOOR = 256
+
+
+def _prune_feasible(st, state, tiers, best_effort_pass):
+    """bool[K, N]: once-per-action node x request-class feasibility.
+    A False cell is a node that can NEVER grant a copy to any group of
+    the class during this action, so dropping it from the per-turn
+    candidate scans is decision-identical:
+
+    * static predicates (class_fit x node_klass, validity, cordon) gate
+      ``ok`` identically every turn;
+    * capacity: resources only shrink during allocate (idle and
+      releasing both only decrease — evictive growth happens in OTHER
+      actions), so a node whose entry-time max(idle, releasing) sits
+      strictly below the class's elementwise-min per-task request in
+      some requested dim yields ``_copies_fit == 0`` for every group of
+      the class (req_g >= minreq elementwise), idle or releasing path
+      alike.  Backfill places without a resource constraint
+      (backfill.go:40-71), so its mask carries predicates only."""
+    K = st.class_fit.shape[0]
+    N = st.num_nodes
+    preds_on = plugin_on(tiers, "predicates", "predicate_disabled")
+    if preds_on:
+        feas = (
+            st.class_fit[:, st.node_klass]
+            & st.node_valid[None, :]
+            & ~st.node_unsched[None, :]
+        )
+    else:
+        feas = jnp.broadcast_to(st.node_valid[None, :], (K, N))
+    if not best_effort_pass:
+        gmask = st.group_valid & ~st.group_best_effort
+        minreq = jnp.full((K, st.task_resreq.shape[1]), BIG, jnp.float32).at[
+            jnp.where(gmask, st.group_klass, K)
+        ].min(jnp.where(gmask[:, None], st.group_resreq, BIG), mode="drop")
+        basis = jnp.maximum(state.node_idle, state.node_releasing)  # f32[N, R]
+        never = jnp.any(
+            (minreq[:, None, :] > 0)
+            & (minreq[:, None, :] < BIG / 2)
+            & (basis[None, :, :] < minreq[:, None, :] - EPS),
+            axis=-1,
+        )  # bool[K, N]
+        feas = feas & ~never
+    return feas
+
+
+def _compact_rows(feas, NC: int):
+    """i32[K, NC]: per-class stable compaction of the feasible-node mask
+    (node-ordinal order preserved, so prefix-fill order is unchanged);
+    slots beyond the class's count hold N (padding).  Callers guarantee
+    every row's count <= NC via the tiered branch on the max count."""
+    K, N = feas.shape
+    dest = jnp.cumsum(feas.astype(jnp.int32), axis=1) - 1
+    slot = jnp.where(feas & (dest < NC), dest, NC)
+    idx = jnp.full((K, NC), N, jnp.int32).at[
+        jnp.arange(K)[:, None], slot
+    ].set(
+        jnp.broadcast_to(jnp.arange(N, dtype=jnp.int32)[None, :], (K, N)),
+        mode="drop",
+    )
+    return idx
+
+
 def _selection_shared(st, sess, state, tiers, best_effort_pass):
     """Queue-independent arrays a turn's (job, group, budget) selection
     reads — computed from the CURRENT aggregates.  The batched round
@@ -444,11 +524,7 @@ def _process_queue(
     # The predicates plugin owns selector/taint/port/max-pod/unschedulable
     # checks (predicates.go:34-204); disabling it leaves only node validity
     # and the resource fit that allocate itself performs.
-    preds_on = any(
-        p.name == "predicates" and not p.predicate_disabled
-        for tier in tiers
-        for p in tier.plugins
-    )
+    preds_on = plugin_on(tiers, "predicates", "predicate_disabled")
     if preds_on:
         static_ok = (
             st.class_fit[st.group_klass[g], st.node_klass]
@@ -559,6 +635,7 @@ def _process_queue(
         # end the action before later jobs get a turn)
         progress=state.progress | (placed_total > 0) | unfit_now,
         rounds=state.rounds,
+        rounds_gated=state.rounds_gated,
     )
     return new_state
 
@@ -576,6 +653,8 @@ def _round_batched(
     gn,
     perm: jax.Array,
     trip: jax.Array,
+    native_ops: bool = False,
+    prune_idx=None,
 ):
     """One round on the deferred-decode path: the (job, group, budget)
     SELECTION of up to TURN_CHUNK queue turns runs as one vmapped batch;
@@ -590,9 +669,23 @@ def _round_batched(
     perm order the turn loop used.  Dispatch cost per round drops from
     ~turns×full-turn-graph to one batched selection plus a thin [N]-only
     loop (the round-4 north-star profile: 241 rounds × 8 turns at
-    ~0.29 ms/turn, over half of it per-turn thunk dispatch)."""
+    ~0.29 ms/turn, over half of it per-turn thunk dispatch).
+
+    ``prune_idx`` (i32[K, NC] from :func:`_compact_rows`, or None) routes
+    the slot loop through the feasibility-pruned candidate panel: every
+    per-turn node scan (ports, pods headroom, copy capacity, prefix fill)
+    runs over the class's NC-wide compacted node set instead of the full
+    N axis, and the node-state writebacks become NC-row scatters (the C++
+    FFI scatter kernels under ``native_ops`` — XLA:CPU lowers the
+    equivalent ~100 ns/index).  Decision-identical: pruned-out nodes have
+    zero copy capacity for every group of the class (see
+    :func:`_prune_feasible`), so they contribute nothing to the prefix
+    fill the full-width path runs, and stable compaction preserves the
+    node-ordinal prefix order the deferred decode assumes."""
     Q = st.num_queues
     S = TURN_CHUNK
+    N = st.num_nodes
+    NC = None if prune_idx is None else prune_idx.shape[1]
 
     # ---- round-start shared selection arrays.  Valid for EVERY chunk of
     # the round: earlier chunks commit only rows owned by queues already
@@ -603,11 +696,7 @@ def _round_batched(
     else:
         q_served = st.queue_valid & ~overused(state.queue_alloc, sess.deserved)
 
-    preds_on = any(
-        p.name == "predicates" and not p.predicate_disabled
-        for tier in tiers
-        for p in tier.plugins
-    )
+    preds_on = plugin_on(tiers, "predicates", "predicate_disabled")
 
     sel_mode = "backfill" if best_effort_pass else "allocate"
 
@@ -624,14 +713,16 @@ def _round_batched(
         )
 
         if preds_on:
-            # static node feasibility for the S selected groups, batched
-            static_ok = (
-                st.class_fit[st.group_klass[g_sel]][:, st.node_klass]
-                & st.node_valid[None, :]
-                & ~st.node_unsched[None, :]
-            )  # bool[S, N]
             ports_s = st.group_ports[g_sel]              # i32[S, W]
             has_ports_s = jnp.any(ports_s != 0, axis=1)  # bool[S]
+            if prune_idx is None:
+                # static node feasibility for the S selected groups,
+                # batched (the pruned panel encodes this as membership)
+                static_ok = (
+                    st.class_fit[st.group_klass[g_sel]][:, st.node_klass]
+                    & st.node_valid[None, :]
+                    & ~st.node_unsched[None, :]
+                )  # bool[S, N]
 
         def slot_body(i, nc):
             (node_idle, node_releasing, node_ports, node_num_tasks,
@@ -639,15 +730,38 @@ def _round_batched(
             g = g_sel[i]
             req = req_s[i]
             budget = budget_s[i]
-            if preds_on:
-                has_ports = has_ports_s[i]
-                ports_ok = jnp.all((ports_s[i][None, :] & node_ports) == 0, axis=-1)
-                pods_head = st.node_max_tasks - node_num_tasks
-                ok = static_ok[i] & ports_ok & (pods_head > 0)
+            if prune_idx is not None:
+                # ---- pruned candidate panel: all per-turn node scans run
+                # over the class's NC compacted rows (idxk == N padding) ----
+                idxk = prune_idx[st.group_klass[g]]      # i32[NC]
+                valid_k = idxk < N
+                idxc = jnp.minimum(idxk, N - 1)
+                num_r = node_num_tasks[idxc]
+                if preds_on:
+                    has_ports = has_ports_s[i]
+                    ports_ok = jnp.all(
+                        (ports_s[i][None, :] & node_ports[idxc]) == 0, axis=-1
+                    )
+                    pods_head = st.node_max_tasks[idxc] - num_r
+                    ok = valid_k & ports_ok & (pods_head > 0)
+                else:
+                    pods_head = jnp.full_like(num_r, s_max)
+                    ok = valid_k
+                    has_ports = jnp.array(False)
+                avail_idle = node_idle[idxc]
+                avail_rel = lambda: node_releasing[idxc]
             else:
-                pods_head = jnp.full_like(node_num_tasks, s_max)
-                ok = st.node_valid
-                has_ports = jnp.array(False)
+                if preds_on:
+                    has_ports = has_ports_s[i]
+                    ports_ok = jnp.all((ports_s[i][None, :] & node_ports) == 0, axis=-1)
+                    pods_head = st.node_max_tasks - node_num_tasks
+                    ok = static_ok[i] & ports_ok & (pods_head > 0)
+                else:
+                    pods_head = jnp.full_like(node_num_tasks, s_max)
+                    ok = st.node_valid
+                    has_ports = jnp.array(False)
+                avail_idle = node_idle
+                avail_rel = lambda: node_releasing
             if best_effort_pass:
                 # backfill: no resource constraint (backfill.go:40-71)
                 k_eff = jnp.where(
@@ -655,14 +769,14 @@ def _round_batched(
                 ).astype(jnp.int32)
                 use_rel = jnp.array(False)
             else:
-                k_idle = _node_capacity(node_idle, req, ok, pods_head, has_ports)
+                k_idle = _node_capacity(avail_idle, req, ok, pods_head, has_ports)
                 use_rel = (jnp.sum(k_idle) == 0) & (budget > 0)
                 # releasing capacity only matters on the rare pipeline
                 # fallback — skip its [N, R] scan otherwise
                 k_eff = jax.lax.cond(
                     use_rel,
                     lambda: _node_capacity(
-                        node_releasing, req, ok, pods_head, has_ports
+                        avail_rel(), req, ok, pods_head, has_ports
                     ),
                     lambda: k_idle,
                 )
@@ -695,23 +809,76 @@ def _round_batched(
                 pb,
                 (b * C2,),
             )[: k_eff.shape[0]]
-            p_idle = jnp.where(use_rel, 0, p)
-            p_rel = p - p_idle
-            node_idle = node_idle - p_idle.astype(jnp.float32)[:, None] * req[None, :]
-            node_releasing = (
-                node_releasing - p_rel.astype(jnp.float32)[:, None] * req[None, :]
-            )
-            if preds_on:
-                node_ports = jnp.where(
-                    ((p > 0) & has_ports)[:, None],
-                    node_ports | ports_s[i][None, :],
-                    node_ports,
+            if prune_idx is not None:
+                # ---- compacted writeback: NC-row scatters onto the [N]
+                # node state (C++ FFI kernels under native_ops; XLA:CPU's
+                # scatter is a ~100 ns/index serial loop) — identical adds
+                # in identical slot order either way ----
+                pf = p.astype(jnp.float32)[:, None] * req[None, :]
+                dm = valid_k & (p > 0)
+                dm_idle = dm & ~use_rel
+                dm_rel = dm & use_rel
+                i_idle = jnp.where(dm_idle, idxk, N)
+                i_rel = jnp.where(dm_rel, idxk, N)
+                if native_ops:
+                    from .native import scatter_add_f32, scatter_add_i32
+
+                    node_idle = scatter_add_f32(node_idle, dm_idle, idxk, -pf)
+                    node_releasing = scatter_add_f32(
+                        node_releasing, dm_rel, idxk, -pf
+                    )
+                    node_num_tasks = scatter_add_i32(
+                        node_num_tasks[:, None], dm, idxk, p[:, None]
+                    )[:, 0]
+                else:
+                    node_idle = node_idle.at[i_idle].add(-pf, mode="drop")
+                    node_releasing = node_releasing.at[i_rel].add(
+                        -pf, mode="drop"
+                    )
+                    node_num_tasks = node_num_tasks.at[
+                        jnp.where(dm, idxk, N)
+                    ].add(p, mode="drop")
+                # the [G, N] count matrices stay on XLA's scatter on BOTH
+                # paths: they can reach DEFER_MAX_CELLS cells, and the
+                # FFI kernel declares no input/output aliasing, so
+                # routing them through it would memcpy the whole matrix
+                # per slot to update <= NC rows; integer adds are exact,
+                # so the paths are bit-identical regardless
+                grow = jnp.broadcast_to(g, idxk.shape)
+                gn_a = gn_a.at[grow, i_idle].add(p, mode="drop")
+                if not best_effort_pass:
+                    gn_p = gn_p.at[grow, i_rel].add(p, mode="drop")
+                if preds_on:
+                    # host-port groups are capped at one copy per node and
+                    # rare — the row-OR scatter hides behind the cond
+                    def _ports_upd(np_):
+                        rows = np_[idxc] | ports_s[i][None, :]
+                        return np_.at[jnp.where(dm, idxk, N)].set(
+                            rows, mode="drop"
+                        )
+
+                    node_ports = jax.lax.cond(
+                        has_ports & jnp.any(p > 0), _ports_upd,
+                        lambda np_: np_, node_ports,
+                    )
+            else:
+                p_idle = jnp.where(use_rel, 0, p)
+                p_rel = p - p_idle
+                node_idle = node_idle - p_idle.astype(jnp.float32)[:, None] * req[None, :]
+                node_releasing = (
+                    node_releasing - p_rel.astype(jnp.float32)[:, None] * req[None, :]
                 )
-            node_num_tasks = node_num_tasks + p
-            gn_a = gn_a.at[g].add(p_idle)
-            if not best_effort_pass:
-                # backfill never pipelines; its gn_p is a [1, 1] dummy
-                gn_p = gn_p.at[g].add(p_rel)
+                if preds_on:
+                    node_ports = jnp.where(
+                        ((p > 0) & has_ports)[:, None],
+                        node_ports | ports_s[i][None, :],
+                        node_ports,
+                    )
+                node_num_tasks = node_num_tasks + p
+                gn_a = gn_a.at[g].add(p_idle)
+                if not best_effort_pass:
+                    # backfill never pipelines; its gn_p is a [1, 1] dummy
+                    gn_p = gn_p.at[g].add(p_rel)
             placed_v = placed_v.at[i].set(placed_total)
             use_rel_v = use_rel_v.at[i].set(use_rel)
             return (node_idle, node_releasing, node_ports, node_num_tasks,
@@ -780,6 +947,8 @@ def _round(
     s_max: int,
     best_effort_pass: bool,
     gn=None,
+    native_ops: bool = False,
+    prune_idx=None,
 ):
     # ACTIVE queues only: a queue whose jobs have no eligible pending
     # groups (or that is overused, for fairness passes) takes a strict
@@ -815,7 +984,8 @@ def _round(
         state = jax.lax.fori_loop(0, trip, body, state)
     else:
         state, gn = _round_batched(
-            st, sess, state, tiers, s_max, best_effort_pass, gn, perm, trip
+            st, sess, state, tiers, s_max, best_effort_pass, gn, perm, trip,
+            native_ops=native_ops, prune_idx=prune_idx,
         )
     return dataclasses.replace(state, rounds=state.rounds + 1), gn
 
@@ -897,7 +1067,10 @@ def _decode_deferred(
 
 @partial(
     jax.jit,
-    static_argnames=("tiers", "s_max", "max_rounds", "best_effort_pass", "turn_batch"),
+    static_argnames=(
+        "tiers", "s_max", "max_rounds", "best_effort_pass", "native_ops",
+        "turn_batch", "prune", "prune_floor",
+    ),
 )
 def allocate_action(
     st: SnapshotTensors,
@@ -907,8 +1080,10 @@ def allocate_action(
     s_max: int = 4096,
     max_rounds: int = 100_000,
     best_effort_pass: bool = False,
-    native_ops: bool = False,  # ACTION_KERNELS uniformity; inert here
+    native_ops: bool = False,
     turn_batch=None,
+    prune=None,
+    prune_floor: int = PRUNE_FLOOR,
 ) -> AllocState:
     """Run rounds until a full round places nothing (queues drained).
 
@@ -916,47 +1091,96 @@ def allocate_action(
     (``_round_batched`` — deferred decode + batched selection) when
     legal (:func:`_use_deferred_decode`); False forces the immediate
     sequential turn loop (the parity suite's reference); True asserts
-    the batched path is legal and takes it."""
+    the batched path is legal and takes it.
+
+    ``prune``: None (default) auto-enables feasibility pre-pruning on
+    the batched path when the compacted panel is worth a compile tier
+    (N // 8 >= ``prune_floor``); True forces it (tests lower
+    ``prune_floor`` to reach the compacted branches on small
+    snapshots); False forces the full-width scans.  Three panel tiers
+    (N//8, N//4, full) mirror preempt's victim-panel switch: the branch
+    picks the smallest panel the LARGEST class's feasible-node count
+    fits, so evict-heavy or permissive-class snapshots degrade to a
+    wider panel instead of overflowing.
+
+    ``native_ops`` routes the pruned path's node-state writebacks
+    through the C++ FFI scatter kernels (host-CPU programs only)."""
     defer = _use_deferred_decode(st, tiers) if turn_batch is None else turn_batch
     if turn_batch and not _use_deferred_decode(st, tiers):
         raise ValueError(
             "turn_batch=True but the deferred/batched round is not legal "
             "for this snapshot/tiers (node order, pod affinity, or cell cap)"
         )
+    N = st.num_nodes
+    if prune is None:
+        prune = defer and N // 8 >= prune_floor
+    if prune and not defer:
+        raise ValueError(
+            "prune=True requires the batched (deferred-decode) round; "
+            "the immediate turn loop is the parity reference and stays "
+            "full-width"
+        )
 
     def cond(carry):
         s = carry[0] if defer else carry
         return s.progress & (s.rounds < max_rounds)
 
-    def body(carry):
-        if defer:
-            s, gn = carry
-        else:
-            s, gn = carry, None
-        s = dataclasses.replace(s, progress=jnp.array(False))
-        s, gn = _round(st, sess, s, tiers, s_max, best_effort_pass, gn=gn)
-        return (s, gn) if defer else s
+    def make_body(prune_idx):
+        def body(carry):
+            if defer:
+                s, gn = carry
+            else:
+                s, gn = carry, None
+            s = dataclasses.replace(s, progress=jnp.array(False))
+            s, gn = _round(
+                st, sess, s, tiers, s_max, best_effort_pass, gn=gn,
+                native_ops=native_ops, prune_idx=prune_idx,
+            )
+            return (s, gn) if defer else s
+
+        return body
 
     entry_placed = state.group_placed
     state = dataclasses.replace(
         state,
         progress=jnp.array(True),
         rounds=jnp.int32(0),
+        rounds_gated=jnp.int32(0),
         group_unfit=jnp.zeros_like(state.group_unfit),
     )
     if not defer:
-        return jax.lax.while_loop(cond, body, state)
-    gn0 = (
-        jnp.zeros((st.num_groups, st.num_nodes), jnp.int32),
-        # backfill (best-effort) statically never pipelines — dummy buffer
-        jnp.zeros(
-            (1, 1) if best_effort_pass else (st.num_groups, st.num_nodes),
-            jnp.int32,
-        ),
-        jnp.array(False),  # any turn allocated (idle path)
-        jnp.array(False),  # any turn pipelined (releasing fallback)
-    )
-    state, (gn_a, gn_p, any_a, any_p) = jax.lax.while_loop(cond, body, (state, gn0))
+        return jax.lax.while_loop(cond, make_body(None), state)
+
+    def run_loop(state, prune_idx):
+        gn0 = (
+            jnp.zeros((st.num_groups, st.num_nodes), jnp.int32),
+            # backfill (best-effort) statically never pipelines — dummy
+            jnp.zeros(
+                (1, 1) if best_effort_pass else (st.num_groups, st.num_nodes),
+                jnp.int32,
+            ),
+            jnp.array(False),  # any turn allocated (idle path)
+            jnp.array(False),  # any turn pipelined (releasing fallback)
+        )
+        return jax.lax.while_loop(cond, make_body(prune_idx), (state, gn0))
+
+    if prune:
+        feas = _prune_feasible(st, state, tiers, best_effort_pass)
+        cmax = jnp.max(jnp.sum(feas.astype(jnp.int32), axis=1))
+        branch = (cmax > N // 8).astype(jnp.int32) + (cmax > N // 4).astype(
+            jnp.int32
+        )
+        state, (gn_a, gn_p, any_a, any_p) = jax.lax.switch(
+            branch,
+            [
+                lambda s: run_loop(s, _compact_rows(feas, N // 8)),
+                lambda s: run_loop(s, _compact_rows(feas, N // 4)),
+                lambda s: run_loop(s, None),
+            ],
+            state,
+        )
+    else:
+        state, (gn_a, gn_p, any_a, any_p) = run_loop(state, None)
     # an action that placed nothing (e.g. a backfill pass with no
     # best-effort groups) skips the [G*N] decode entirely; the gate is the
     # loop-tracked scalar, not an 80 MB jnp.any over the count matrices
@@ -975,10 +1199,11 @@ def backfill_action(
     tiers: Tiers,
     s_max: int = 4096,
     max_rounds: int = 100_000,
-    native_ops: bool = False,  # ACTION_KERNELS uniformity; inert here
+    native_ops: bool = False,
 ) -> AllocState:
     """backfill.go:40-71: place BestEffort (empty-resreq) pending tasks on
     any node passing the non-resource predicates."""
     return allocate_action(
-        st, sess, state, tiers, s_max=s_max, max_rounds=max_rounds, best_effort_pass=True
+        st, sess, state, tiers, s_max=s_max, max_rounds=max_rounds,
+        best_effort_pass=True, native_ops=native_ops,
     )

@@ -135,3 +135,15 @@ def mm_cumsum(x: jnp.ndarray, block: int = 512) -> jnp.ndarray:
     out = (inner + outer[:, None, :]).reshape(-1, x.shape[-1])[:T]
     out = out.astype(x.dtype) if jnp.issubdtype(x.dtype, jnp.floating) else out
     return out[:, 0] if squeeze else out
+
+
+def plugin_on(tiers, name: str, attr: str) -> bool:
+    """True when any tier enables plugin ``name`` (its ``attr`` disable
+    flag unset) — the static plugin gate every action kernel evaluates at
+    trace time.  ONE definition: preempt/reclaim/allocate all branch on
+    it, and the allocate feasibility pruning additionally bakes it into
+    panel membership, so a drifted copy would silently break the pruned
+    panels' decision-identity with the full-width path."""
+    return any(
+        p.name == name and not getattr(p, attr) for t in tiers for p in t.plugins
+    )

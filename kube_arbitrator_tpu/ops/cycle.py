@@ -31,7 +31,12 @@ from .allocate import (
 from .common import fair, safe_share
 from .fairness import drf_equilibrium_levels_per_job, drf_shares, proportion_deserved
 from .ordering import DEFAULT_ACTIONS, DEFAULT_TIERS, Tiers
-from .preempt import preempt_action, reclaim_action
+from .preempt import (
+    phase_a_probe,
+    preempt_action,
+    preempt_panel_width,
+    reclaim_action,
+)
 
 # Name -> staged kernel. The framework registry (framework/registry.py)
 # adds custom actions here; the conf loader validates against these keys.
@@ -186,6 +191,7 @@ def open_session(st: SnapshotTensors, tiers: Tiers) -> Tuple[SessionCtx, AllocSt
         evicted_for=jnp.full(st.num_tasks, -1, jnp.int32),
         progress=jnp.array(False),
         rounds=jnp.int32(0),
+        rounds_gated=jnp.int32(0),
     )
     return sess, state
 
@@ -302,17 +308,28 @@ def schedule_cycle_staged(
     PER STAGE (open → each action → commit) with a device sync between
     stages, so each action's wall time is honestly measurable.
 
-    Returns ``(CycleDecisions, [(stage, wall_ts, dur_ms, rounds), ...])``
-    where stage is ``open_session`` / each action name / ``commit`` and
-    ``rounds`` is the action's round count (``AllocState.rounds`` after
-    the stage — every action kernel resets it at entry; preempt's two
-    phases accumulate into one counter) or None for the non-action
-    stages.  The scheduler turns rounds into the
-    ``kernel_rounds_total{action=...}`` counters, attributing WHERE the
-    evictive round loops spend their turns.  Used by the deciders only
-    when tracing or kernel profiling is enabled: the fused program stays
-    the fast path (stage boundaries forfeit cross-action fusion and pay
-    a dispatch + sync per stage).
+    Returns ``(CycleDecisions,
+    [(stage, wall_ts, dur_ms, rounds, rounds_gated), ...])`` where stage
+    is ``open_session`` / each action name / ``commit`` and ``rounds``
+    is the action's round count (``AllocState.rounds`` after the stage —
+    every action kernel resets it at entry; preempt's two phases
+    accumulate into one counter) or None for the non-action stages.
+    ``rounds_gated`` counts the rounds the incremental fast paths served
+    (preempt's round gate, reclaim's fully-thin batched rounds) — the
+    scheduler emits them as the ``variant="gated"`` series of
+    ``kernel_rounds_total{action=...}``, attributing WHERE the evictive
+    round loops spend their turns and how often the gate hit.  Used by
+    the deciders only when tracing or kernel profiling is enabled: the
+    fused program stays the fast path (stage boundaries forfeit
+    cross-action fusion and pay a dispatch + sync per stage).
+
+    The runner also surfaces silent de-optimization: when the auto
+    ``turn_batch`` gates of preempt/reclaim would fall back to their
+    sequential engines for this pack (pod affinity, cell caps, missing
+    canon pack), ``turn_batch_fallback_total{action, reason}``
+    increments once per staged cycle — the fallback decision is a pure
+    function of static pack shape + tiers, evaluated host-side so the
+    kernels stay pure.
 
     With the kernel profiler enabled (utils/profiling.py), every stage
     additionally runs inside a profiler stage scope (retrace attribution
@@ -335,15 +352,23 @@ def schedule_cycle_staged(
             out = fn(*args, **kw)
             jax.block_until_ready(out)
         ms = (time.perf_counter() - t0) * 1000
-        rounds = int(rounds_of(out).rounds) if rounds_of is not None else None
-        timings.append((stage, ts, ms, rounds))
+        if rounds_of is not None:
+            rounds = int(rounds_of(out).rounds)
+            gated = int(rounds_of(out).rounds_gated)
+        else:
+            rounds = gated = None
+        timings.append((stage, ts, ms, rounds, gated))
         return out
 
+    _record_fallback_reasons(st, tiers, actions)
     sess, state = _timed("open_session", _open_session_jit, st, tiers=tiers)
     state0 = state  # AllocState shapes are stage-invariant (estimate args)
+    state_preempt = state  # state preempt actually entered with (probe tier)
     for action in actions:
         if action not in ACTION_KERNELS:
             raise ValueError(f"unknown action: {action}")
+        if action == "preempt":
+            state_preempt = state
         state = _timed(
             action, _run_stage, st, sess, state,
             action=action, tiers=tiers, s_max=s_max, max_rounds=max_rounds,
@@ -362,4 +387,89 @@ def schedule_cycle_staged(
             )
             for action in actions
         })
+        if "preempt" in actions:
+            prof.ensure_phase_split(
+                key,
+                lambda: _measure_phase_split(
+                    st, sess, state_preempt, tiers, s_max, native_ops
+                ),
+            )
     return dec, timings
+
+
+# fallback reasons already logged this process, so the warning fires once
+# per distinct (action, reason) instead of once per cycle
+_FALLBACKS_SEEN: set = set()
+
+
+def _record_fallback_reasons(st, tiers, actions) -> None:
+    """Emit ``turn_batch_fallback_total{action, reason}`` (and a
+    once-per-reason warning) when an evictive action's auto batched-engine
+    gate would fall back to its sequential engine for this pack — silent
+    de-optimization made visible in /metrics and the time-series ring."""
+    from ..utils.metrics import metrics
+    from .preempt import reclaim_batch_fallback_reason, turn_batch_fallback_reason
+
+    for action, reason_fn, fell_to in (
+        ("preempt", turn_batch_fallback_reason, "sequential turn loop"),
+        ("reclaim", reclaim_batch_fallback_reason,
+         "sorted-space _reclaim_fast kernel"),
+    ):
+        if action not in actions:
+            continue
+        reason = reason_fn(st, tiers)
+        if reason is None:
+            continue
+        metrics().counter_add(
+            "turn_batch_fallback_total",
+            labels={"action": action, "reason": reason},
+        )
+        if (action, reason) not in _FALLBACKS_SEEN:
+            _FALLBACKS_SEEN.add((action, reason))
+            import sys
+
+            print(
+                f"# kat: {action} fast-path engine disabled for this "
+                f"pack shape (reason={reason}); running the {fell_to}",
+                file=sys.stderr,
+            )
+
+
+# module-cached jitted phase-A probe: one compilation cache for the
+# process (the probe runs once per pack shape x variant)
+_PHASE_PROBE = jax.jit(
+    phase_a_probe,
+    static_argnames=("tiers", "s_max", "native_ops", "gated", "panel_w"),
+)
+
+
+def _measure_phase_split(st, sess, state, tiers, s_max, native_ops):
+    """Host-timed one-round preempt phase-A cost at this pack shape, full
+    vs gated variant — the per-round phase-A vs conflict-tail split
+    served at /debug/kernels.  Best-of-3 after a compile warmup; the
+    gated probe re-derives the carried aux it would reuse in production,
+    so the reported full-vs-gated delta is a conservative lower bound on
+    the gate's per-round saving.  tail_ms ~= measured preempt mean_ms -
+    rounds_full*phase_a_full_ms - rounds_gated*phase_a_gated_ms."""
+    import time
+
+    fn = _PHASE_PROBE
+    out = {}
+    # pin the probe to the victim-panel tier production selects for this
+    # state (T//8 / T//4 / full) so the split measures the tier the
+    # measured preempt stage actually ran
+    panel_w = preempt_panel_width(st, sess, state)
+    out["panel_w"] = panel_w
+    for name, gated in (("phase_a_full_ms", False), ("phase_a_gated_ms", True)):
+        args = dict(
+            tiers=tiers, s_max=s_max, native_ops=native_ops, gated=gated,
+            panel_w=panel_w,
+        )
+        jax.block_until_ready(fn(st, sess, state, **args))  # compile
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(st, sess, state, **args))
+            best = min(best, (time.perf_counter() - t0) * 1000)
+        out[name] = round(best, 3)
+    return out

@@ -15,6 +15,7 @@ from .segsum import (  # noqa: F401
     cumsum_f32,
     per_node_sums,
     scatter_add_f32,
+    scatter_add_i32,
     scatter_minmax_f32,
     scatter_set_i32,
     seg_cumsum_f32,

@@ -181,6 +181,52 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(
         .Arg<ffi::Buffer<ffi::F32>>()
         .Ret<ffi::Buffer<ffi::F32>>());
 
+// Masked scatter-add of i32 values onto a base: out = base; for masked
+// slots in slot order, out[idx[p], :] += vals[p, :].  Integer adds are
+// exact and commutative, so this is bit-identical to XLA's
+// ``base.at[idx].add(vals)`` in any order — the win is purely the
+// ~100 ns/index dimension-general serial loop XLA:CPU lowers scatters
+// to.  Allocate's pruned-panel node pod-count writebacks are this
+// shape.  Keep bases [N]-small: there is no input/output aliasing, so
+// every call copies the whole base — a [G*N]-flattened matrix here
+// would memcpy megabytes per slot to update a handful of rows.
+// Out-of-range indices are skipped (mode="drop").
+static ffi::Error ScatterAddI32Impl(
+    ffi::Buffer<ffi::S32> base,      // [N, C]
+    ffi::Buffer<ffi::PRED> mask,     // [P]
+    ffi::Buffer<ffi::S32> idx,       // [P]
+    ffi::Buffer<ffi::S32> vals,      // [P, C]
+    ffi::ResultBuffer<ffi::S32> out  // [N, C]
+) {
+  const int64_t n = base.dimensions()[0];
+  const int64_t c = base.dimensions()[1];
+  const int64_t p = mask.dimensions()[0];
+  const bool* m = mask.typed_data();
+  const int32_t* ix = idx.typed_data();
+  const int32_t* v = vals.typed_data();
+  const int32_t* b = base.typed_data();
+  int32_t* o = out->typed_data();
+  for (int64_t i = 0; i < n * c; ++i) o[i] = b[i];
+  for (int64_t s = 0; s < p; ++s) {
+    if (!m[s]) continue;
+    const int64_t row = ix[s];
+    if (row < 0 || row >= n) continue;
+    int32_t* dst = o + row * c;
+    const int32_t* src = v + s * c;
+    for (int64_t k = 0; k < c; ++k) dst[k] += src[k];
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    ScatterAddI32, ScatterAddI32Impl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Arg<ffi::Buffer<ffi::PRED>>()
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Ret<ffi::Buffer<ffi::S32>>());
+
 // Masked per-node column-wise max/min: out[n, :R] = max, out[n, R:] =
 // min over masked slots with idx == n; identities +-3e38 (the jnp
 // fallback's BIG) where a node has no masked slot.  Max/min are exact,

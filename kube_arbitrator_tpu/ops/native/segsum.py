@@ -81,6 +81,11 @@ def available() -> bool:
                 platform="cpu",
             )
             ffi.register_ffi_target(
+                "kat_scatter_add_i32",
+                ffi.pycapsule(lib.ScatterAddI32),
+                platform="cpu",
+            )
+            ffi.register_ffi_target(
                 "kat_scatter_minmax_f32",
                 ffi.pycapsule(lib.ScatterMinMax),
                 platform="cpu",
@@ -150,6 +155,23 @@ def scatter_add_f32(base, mask, idx, vals):
 
     return _ffi().ffi_call(
         "kat_scatter_add_f32", jax.ShapeDtypeStruct(base.shape, jnp.float32)
+    )(base, mask, idx, vals)
+
+
+def scatter_add_i32(base, mask, idx, vals):
+    """``base.at[idx[mask]].add(vals[mask])`` for i32 (out-of-range
+    dropped).  Integer adds are exact, so the result is bit-identical to
+    the XLA scatter regardless of order; the win is skipping XLA:CPU's
+    ~100 ns/index serial scatter loop.  base i32[N, C], mask bool[P],
+    idx i32[P], vals i32[P, C].  Same caller contract as
+    :func:`per_node_sums` — and like every kernel here there is NO
+    input/output aliasing, so each call copies the base: keep bases
+    [N]-small (node state), never [G*N]-shaped matrices."""
+    import jax
+    import jax.numpy as jnp
+
+    return _ffi().ffi_call(
+        "kat_scatter_add_i32", jax.ShapeDtypeStruct(base.shape, jnp.int32)
     )(base, mask, idx, vals)
 
 

@@ -21,7 +21,7 @@ import time
 from concurrent import futures
 from typing import Dict, Optional, Tuple
 
-from ..utils.metrics import metrics
+from ..utils.metrics import metrics, record_kernel_rounds
 from ..utils.tracing import tracer
 from . import decision_pb2 as pb
 from .codec import (
@@ -140,12 +140,9 @@ class DecisionService:
                 "kernel_action_duration_seconds", ms / 1000,
                 labels={"action": stage},
             )
-        for action, rounds in (
-            getattr(decider, "last_action_rounds", None) or {}
-        ).items():
-            m.counter_add(
-                "kernel_rounds_total", rounds, labels={"action": action}
-            )
+        record_kernel_rounds(
+            m, getattr(decider, "last_action_rounds", None)
+        )
         m.counter_add("rpc_cycles_served_total")
         # the blocking decide above MUST stay outside this lock
         # (KAT-LCK-002: a wedged device would stall every handler)

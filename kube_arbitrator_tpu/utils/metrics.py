@@ -31,7 +31,8 @@ METRIC_HELP: Dict[str, str] = {
     "e2e_scheduling_duration_seconds": "Full cycle latency: snapshot through actuation.",
     "cycle_phase_duration_seconds": "Per-phase cycle latency (snapshot/upload/kernel/decode/close/actuate/transport).",
     "kernel_action_duration_seconds": "Per-action decision-kernel wall time (staged runner; action label).",
-    "kernel_rounds_total": "Rounds executed per action kernel (staged runner; evictive round-loop attribution).",
+    "kernel_rounds_total": "Rounds executed per action kernel (staged runner; evictive round-loop attribution; variant=gated counts rounds served by the incremental fast paths).",
+    "turn_batch_fallback_total": "Staged cycles whose auto turn_batch gate fell back to a sequential engine (action + reason; silent de-optimization visibility).",
     "binds_total": "Committed bind intents.",
     "evicts_total": "Committed evict intents.",
     "pending_tasks": "Pending tasks observed at cycle start.",
@@ -300,3 +301,23 @@ def metrics() -> MetricsRegistry:
     if _registry is None:
         _registry = MetricsRegistry()
     return _registry
+
+
+def record_kernel_rounds(registry: MetricsRegistry, action_rounds) -> None:
+    """Emit ``kernel_rounds_total`` for one staged cycle's action-rounds
+    dict, mapping ``"<action>:gated"`` entries (the staged runner's
+    encoding for rounds the incremental fast paths served) to the
+    ``variant="gated"`` series — ONE definition shared by the local
+    scheduler and the RPC sidecar so the label encoding cannot drift
+    between deployments."""
+    for action, rounds in (action_rounds or {}).items():
+        if action.endswith(":gated"):
+            registry.counter_add(
+                "kernel_rounds_total", rounds,
+                labels={"action": action[: -len(":gated")],
+                        "variant": "gated"},
+            )
+        else:
+            registry.counter_add(
+                "kernel_rounds_total", rounds, labels={"action": action}
+            )

@@ -190,7 +190,8 @@ class KernelProfiler:
     # ---- measured costs (the staged runner records every cycle) ----
 
     def record_measured(
-        self, stage: str, key: str, ms: float, rounds: Optional[int] = None
+        self, stage: str, key: str, ms: float, rounds: Optional[int] = None,
+        rounds_gated: Optional[int] = None,
     ) -> None:
         now = self.now()
         with self._lock:
@@ -200,6 +201,7 @@ class KernelProfiler:
                     "count": 0, "total_ms": 0.0,
                     "min_ms": ms, "max_ms": ms,
                     "last_ms": ms, "last_ts": now, "rounds_total": 0,
+                    "rounds_gated_total": 0,
                 }
             agg["count"] += 1
             agg["total_ms"] += ms
@@ -210,11 +212,38 @@ class KernelProfiler:
             if rounds is not None:
                 agg["rounds_total"] += int(rounds)
                 agg["last_rounds"] = int(rounds)
+            if rounds_gated is not None:
+                agg["rounds_gated_total"] += int(rounds_gated)
+                agg["last_rounds_gated"] = int(rounds_gated)
 
     def record_cycle(self, key: str, timings) -> None:
-        """One staged cycle's ``(stage, ts, ms, rounds)`` list."""
-        for stage, _ts, ms, rounds in timings:
-            self.record_measured(stage, key, ms, rounds)
+        """One staged cycle's ``(stage, ts, ms, rounds, rounds_gated)``
+        list (older 4-tuples without the gated column still accepted)."""
+        for row in timings:
+            stage, _ts, ms, rounds = row[:4]
+            gated = row[4] if len(row) > 4 else None
+            self.record_measured(stage, key, ms, rounds, gated)
+
+    def ensure_phase_split(self, key: str, prober: Callable) -> None:
+        """Lazily record the per-round preempt phase-A probe for a shape
+        (``prober`` returns ``{"phase_a_full_ms": .., "phase_a_gated_ms":
+        ..}`` measured host-side — ops/cycle._measure_phase_split).  The
+        probe runs OUTSIDE the lock; served as the ``preempt:phase_a``
+        pseudo-stage so /debug/kernels can attribute phase-A vs
+        conflict-tail cost per round: tail ~= measured_mean -
+        rounds_full*full_ms - rounds_gated*gated_ms."""
+        stage = "preempt:phase_a"
+        with self._lock:
+            if (key, stage) in self._estimates:
+                return
+            self._estimates[(key, stage)] = {"pending": True}
+        try:
+            split = dict(prober())
+        except Exception as err:  # best-effort, like cost estimates
+            split = {"error": f"{type(err).__name__}: {err}"}
+        split["estimated_at"] = self.now()
+        with self._lock:
+            self._estimates[(key, stage)] = split
 
     # ---- HLO cost-model estimates ----
 

@@ -212,6 +212,9 @@ class CycleSampler:
         "discards": "pipeline_discards_total",
         "backpressure": "pipeline_backpressure_total",
         "retraces": "xla_retraces_total",
+        # silent de-optimization: staged cycles whose auto turn_batch
+        # gate fell back to a sequential evictive engine
+        "turn_batch_fallbacks": "turn_batch_fallback_total",
     }
     OCCUPANCY_GAUGE = "pipeline_stage_occupancy"
 
@@ -263,7 +266,8 @@ class CycleSampler:
         for stage, ms in (action_ms or {}).items():
             values[f"kernel_{stage}_ms"] = ms
         for action, rounds in (action_rounds or {}).items():
-            values[f"rounds_{action}"] = rounds
+            # ":gated"-suffixed entries become rounds_<action>_gated rows
+            values[f"rounds_{action.replace(':', '_')}"] = rounds
         for key, family in self.COUNTER_DELTAS.items():
             total = self.registry.counter_total(family)
             prev = self._prev_counters.get(key)

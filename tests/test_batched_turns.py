@@ -5,13 +5,23 @@ must replay the sequential turn loop's decisions BIT-FOR-BIT — identical
 bind/evict streams, identical task->node pairing, identical round counts.
 The soak here runs both engines action-for-action over randomized loaded
 clusters at q in {8, 64, 512} and asserts every decision-bearing
-AllocState field equal after every action; reclaim (inherently
-sequential pop-for-pop — its cross-queue verdicts chain turn-to-turn)
-is pinned by comparing its two engines (canon-layout vs sorted-space)
-the same way, plus a directed two-queues-one-victim-queue oracle case
-for the cross-queue contention the batched doctrine excludes.
+AllocState field equal after every action.  The matrix covers:
+
+* reclaim: canon-sequential vs sorted-space vs the ROUND-BATCHED canon
+  engine (`_reclaim_canon_batched` — phase-A pops/eligibility/per-node
+  sums with a thin clean tail and a sequential fallback after the
+  round's first claim);
+* allocate/backfill: batched (deferred) vs immediate rounds, with the
+  feasibility-pruned candidate panels forced on (`prune=True,
+  prune_floor=1`) so the compacted branches run on these small worlds;
+* preempt: the batched turn kernel with the incremental round gate ON
+  and OFF vs the sequential turn loop.
+
+A directed two-queues-one-victim-queue oracle case pins the cross-queue
+same-victim contention class explicitly under BOTH reclaim engines.
 """
 import dataclasses
+import functools
 
 import numpy as np
 import pytest
@@ -26,13 +36,54 @@ FIELDS = (
 )
 
 
-def _open(st):
+@functools.lru_cache(maxsize=None)
+def _engines():
+    """Module-cached jitted engines: the soak's parametrize matrix runs
+    3 seeds per q with IDENTICAL shapes, so sharing one jitted callable
+    per engine compiles once per q instead of once per (q, seed) — the
+    matrix is compile-dominated (tiny worlds, many engines)."""
     import jax
 
-    from kube_arbitrator_tpu.ops.cycle import open_session
+    from kube_arbitrator_tpu.ops.cycle import commit_cycle, open_session
+    from kube_arbitrator_tpu.ops.preempt import (
+        _reclaim_canon,
+        _reclaim_canon_batched,
+        _reclaim_fast,
+        preempt_action,
+    )
 
     tiers = SchedulerConfig.default().tiers
-    sess, state = jax.jit(lambda s: open_session(s, tiers))(st)
+    return tiers, {
+        "open": jax.jit(lambda s: open_session(s, tiers)),
+        "commit": jax.jit(commit_cycle),
+        "reclaim_canon": jax.jit(
+            lambda st, se, s: _reclaim_canon(st, se, s, tiers, 100_000)
+        ),
+        "reclaim_fast": jax.jit(
+            lambda st, se, s: _reclaim_fast(st, se, s, tiers, 100_000)
+        ),
+        "reclaim_batched": jax.jit(
+            lambda st, se, s: _reclaim_canon_batched(st, se, s, tiers, 100_000)
+        ),
+        "preempt_gate_on": jax.jit(
+            lambda st, se, s: preempt_action(
+                st, se, s, tiers, turn_batch=True, round_gate=True
+            )
+        ),
+        "preempt_gate_off": jax.jit(
+            lambda st, se, s: preempt_action(
+                st, se, s, tiers, turn_batch=True, round_gate=False
+            )
+        ),
+        "preempt_seq": jax.jit(
+            lambda st, se, s: preempt_action(st, se, s, tiers, turn_batch=False)
+        ),
+    }
+
+
+def _open(st):
+    tiers, eng = _engines()
+    sess, state = eng["open"](st)
     return tiers, sess, state
 
 
@@ -70,35 +121,31 @@ def test_sequential_vs_batched_decision_soak(q, seed):
     same entry state produces the identical AllocState (bind/evict
     streams ride task_status/task_node/evicted_for) and round count.
     The batched result is threaded forward (the production path)."""
-    import jax
-
     from kube_arbitrator_tpu.ops.allocate import allocate_action
-    from kube_arbitrator_tpu.ops.cycle import commit_cycle
-    from kube_arbitrator_tpu.ops.preempt import (
-        _reclaim_canon,
-        _reclaim_fast,
-        preempt_action,
-    )
 
     sim = _world(q, seed)
     st = build_snapshot(sim.cluster).tensors
     tiers, sess, state = _open(st)
+    eng = _engines()[1]
 
-    # ---- reclaim: canon-layout vs sorted-space engines ----
-    canon = jax.jit(
-        lambda st, se, s: _reclaim_canon(st, se, s, tiers, 100_000)
-    )(st, sess, state)
-    fast = jax.jit(
-        lambda st, se, s: _reclaim_fast(st, se, s, tiers, 100_000)
-    )(st, sess, state)
+    # ---- reclaim: canon-sequential vs sorted-space vs round-batched ----
+    canon = eng["reclaim_canon"](st, sess, state)
+    fast = eng["reclaim_fast"](st, sess, state)
+    rbatched = eng["reclaim_batched"](st, sess, state)
     _assert_state_equal(canon, fast, f"reclaim q={q} seed={seed}")
-    state = canon
+    _assert_state_equal(
+        canon, rbatched, f"reclaim-batched q={q} seed={seed}"
+    )
+    # the batched result is threaded forward (the production path)
+    state = rbatched
 
-    # ---- allocate + backfill: batched (deferred) vs immediate rounds ----
+    # ---- allocate + backfill: batched (deferred, feasibility-pruned)
+    # vs immediate rounds ----
     for best_effort in (False, True):
         name = "backfill" if best_effort else "allocate"
         batched = allocate_action(
-            st, sess, state, tiers, best_effort_pass=best_effort, turn_batch=True
+            st, sess, state, tiers, best_effort_pass=best_effort,
+            turn_batch=True, prune=True, prune_floor=1,
         )
         seq = allocate_action(
             st, sess, state, tiers, best_effort_pass=best_effort, turn_batch=False
@@ -106,20 +153,20 @@ def test_sequential_vs_batched_decision_soak(q, seed):
         _assert_state_equal(batched, seq, f"{name} q={q} seed={seed}")
         state = batched
 
-    # ---- preempt: batched turn kernel vs sequential turn loop ----
-    batched = jax.jit(
-        lambda st, se, s: preempt_action(st, se, s, tiers, turn_batch=True)
-    )(st, sess, state)
-    seq = jax.jit(
-        lambda st, se, s: preempt_action(st, se, s, tiers, turn_batch=False)
-    )(st, sess, state)
-    _assert_state_equal(batched, seq, f"preempt q={q} seed={seed}")
-    state = batched
+    # ---- preempt: batched turn kernel, round gate ON and OFF, vs the
+    # sequential turn loop ----
+    gate_on = eng["preempt_gate_on"](st, sess, state)
+    gate_off = eng["preempt_gate_off"](st, sess, state)
+    seq = eng["preempt_seq"](st, sess, state)
+    _assert_state_equal(gate_on, seq, f"preempt gate-on q={q} seed={seed}")
+    _assert_state_equal(gate_off, seq, f"preempt gate-off q={q} seed={seed}")
+    assert int(gate_off.rounds_gated) == 0, "gate-off must never count gated"
+    state = gate_on
 
     # the run must have exercised the evictive machinery, or the parity
     # above is vacuous (placements may land as PIPELINED claims rather
     # than committed binds when the claimant gang stays short)
-    dec = jax.jit(commit_cycle)(st, sess, state)
+    dec = eng["commit"](st, sess, state)
     from kube_arbitrator_tpu.api import TaskStatus
 
     ts = np.asarray(dec.task_status)
@@ -132,13 +179,20 @@ def test_sequential_vs_batched_decision_soak(q, seed):
 
 def test_two_queues_contending_for_same_victim_matches_oracle():
     """Cross-queue same-victim contention — the conflict class the
-    batched doctrine leaves to reclaim's sequential pop-for-pop: queues
-    qb and qc both reclaim from qa's only node.  The queue-order turn
+    batched round resolves through its serial tail (and, after the first
+    claim dirties round state, the sequential fallback turn): queues qb
+    and qc both reclaim from qa's only node.  The queue-order turn
     sequence decides who gets which victim; kernel and oracle must agree
-    exactly (evict set AND claimant placements)."""
+    exactly (evict set AND claimant placements), and the forced-batched
+    vs forced-sequential engines must agree bit-for-bit (both claims
+    land in one round — the second exercises the batched tail's
+    post-claim live-pop path)."""
+    import jax
+
     from kube_arbitrator_tpu.api import TaskStatus
     from kube_arbitrator_tpu.cache.decode import decode_decisions
     from kube_arbitrator_tpu.ops import schedule_cycle
+    from kube_arbitrator_tpu.ops.preempt import reclaim_action
     from kube_arbitrator_tpu.oracle import SequentialScheduler
 
     sim = SimCluster()
@@ -173,6 +227,19 @@ def test_two_queues_contending_for_same_victim_matches_oracle():
     }
     assert k_pipe == set(oracle.pipelined)
     assert k_pipe == {"b-p0", "c-p0"}
+
+    # the same contention case at the kernel level: forced round-batched
+    # vs forced sequential canon must agree bit-for-bit (both queues'
+    # claims land in one round — the second claim exercises the batched
+    # tail's post-claim sequential fallback)
+    tiers, sess, state = _open(snap.tensors)
+    bat = jax.jit(
+        lambda st, se, s: reclaim_action(st, se, s, tiers, turn_batch=True)
+    )(snap.tensors, sess, state)
+    seq = jax.jit(
+        lambda st, se, s: reclaim_action(st, se, s, tiers, turn_batch=False)
+    )(snap.tensors, sess, state)
+    _assert_state_equal(bat, seq, "two-queue same-victim reclaim")
 
 
 def test_q512_preempt_turn_bound_is_active_count():
@@ -226,3 +293,75 @@ def test_q512_preempt_turn_bound_is_active_count():
     assert int(np.asarray(gate).sum()) == k, (
         "preempt round gate must admit exactly the contended queues"
     )
+
+
+def test_pruned_allocate_native_writebacks_match_jnp():
+    """The production pairing the soak leaves untested: feasibility-pruned
+    panels with the NATIVE i32/f32 scatter writebacks (ops/native
+    kat_scatter_add_i32 et al).  On a host-CPU deployment with
+    N >= 8*PRUNE_FLOOR both switch on together, so the pruned+native leg
+    must be bit-identical to pruned+jnp AND to the unpruned sequential
+    reference on a world that exercises real contention."""
+    import jax
+
+    from kube_arbitrator_tpu.ops.allocate import allocate_action
+    from kube_arbitrator_tpu.ops.native import segsum
+
+    if not segsum.available():
+        import pytest
+
+        pytest.skip("native FFI kernels unavailable on this host")
+
+    sim = _world(8, 0)
+    st = build_snapshot(sim.cluster).tensors
+    tiers, sess, state = _open(st)
+    for best_effort in (False, True):
+        legs = {
+            "native": allocate_action(
+                st, sess, state, tiers, best_effort_pass=best_effort,
+                turn_batch=True, prune=True, prune_floor=1, native_ops=True,
+            ),
+            "jnp": allocate_action(
+                st, sess, state, tiers, best_effort_pass=best_effort,
+                turn_batch=True, prune=True, prune_floor=1, native_ops=False,
+            ),
+            "seq": allocate_action(
+                st, sess, state, tiers, best_effort_pass=best_effort,
+                turn_batch=False,
+            ),
+        }
+        name = "backfill" if best_effort else "allocate"
+        _assert_state_equal(legs["native"], legs["jnp"], f"{name} native-vs-jnp")
+        _assert_state_equal(legs["native"], legs["seq"], f"{name} native-vs-seq")
+        state = legs["native"]
+
+
+def test_round_gate_parity_with_overflow_turns(monkeypatch):
+    """The regime the soak's worlds keep small: more simultaneously
+    active queues than the selection panel.  Overflow turns run the full
+    sequential body and never refresh their carried verdict slots, so a
+    queue re-entering the panel in a gated round after a commit must NOT
+    reuse pre-commit verdicts just because its selection matches the
+    stale carried one — the per-queue `vic_valid` carry forces the
+    recompute.  TURN_PANEL is pinned to 2 so every q=8 world exercises
+    overflow + panel churn; gate-on must stay bit-identical to the
+    sequential loop."""
+    import jax
+
+    from kube_arbitrator_tpu.ops import preempt as pre
+
+    monkeypatch.setattr(pre, "TURN_PANEL", 2)
+    tiers = SchedulerConfig.default().tiers
+    for seed in (0, 1, 2):
+        sim = _world(8, seed)
+        st = build_snapshot(sim.cluster).tensors
+        _, sess, state = _open(st)
+        gate_on = jax.jit(
+            lambda st, se, s: pre.preempt_action(
+                st, se, s, tiers, turn_batch=True, round_gate=True
+            )
+        )(st, sess, state)
+        seq = jax.jit(
+            lambda st, se, s: pre.preempt_action(st, se, s, tiers, turn_batch=False)
+        )(st, sess, state)
+        _assert_state_equal(gate_on, seq, f"overflow gate-on seed={seed}")

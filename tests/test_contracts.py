@@ -125,6 +125,22 @@ def test_mutated_turn_schema_reports_exactly_that_field():
     assert all("`budget`" in f.message for f in findings)
 
 
+def test_reclaim_turns_clean_on_real_tree():
+    assert contracts.check_reclaim_turns() == []
+
+
+def test_mutated_reclaim_turn_schema_reports_exactly_that_field():
+    # KAT-CTR-009: declare the batched reclaim selection's pop column as
+    # float32 — the real reclaim_select_turns (correctly) returns bool,
+    # and _reclaim_canon_batched's thin tail gathers it per turn, so the
+    # analyzer must flag exactly this field
+    seeded = contracts.mutated(contracts.RECLAIM_TURN_SCHEMA, "pop", "float32")
+    findings = contracts.check_reclaim_turns(turn_schema=seeded)
+    assert len(findings) == 1
+    assert findings[0].rule == "KAT-CTR-009"
+    assert "`pop`" in findings[0].message
+
+
 def test_producer_crash_becomes_a_finding_not_a_traceback(monkeypatch):
     # a build_snapshot that RAISES (e.g. its own pack-dtype guard firing)
     # must surface as a KAT-CTR-002 finding, not crash the analyzer and

@@ -284,3 +284,51 @@ def test_property_random_clusters_vs_oracle(seed):
     assert abs(len(binds) - len(oracle.binds)) <= slack, (
         f"kernel {len(binds)} binds vs oracle {len(oracle.binds)}"
     )
+
+
+def test_staged_runner_surfaces_turn_batch_fallbacks():
+    """Silent de-optimization visibility: a pod-affinity snapshot forces
+    the evictive actions off their batched/canon fast paths, and the
+    staged runner must say so — once per staged cycle per action —
+    through turn_batch_fallback_total{action, reason}.  A plain snapshot
+    must emit nothing (the fast paths are taken)."""
+    from kube_arbitrator_tpu.api import PodAffinityTerm
+    from kube_arbitrator_tpu.ops.cycle import schedule_cycle_staged
+    from kube_arbitrator_tpu.utils.metrics import metrics
+
+    m = metrics()
+    m.reset()
+    sim = SimCluster()
+    sim.add_queue("q")
+    sim.add_node("n0", cpu_milli=4000, memory=8 * GB, labels={"z": "a"})
+    j0 = sim.add_job("leader", queue="q")
+    sim.add_task(j0, 1000, GB, name="lead", status=TaskStatus.RUNNING,
+                 node="n0", labels={"app": "store"})
+    j1 = sim.add_job("follower", queue="q")
+    sim.add_task(
+        j1, 1000, GB, name="f1",
+        affinity=[PodAffinityTerm(match_labels=(("app", "store"),),
+                                  topology_key="z")],
+    )
+    st = build_snapshot(sim.cluster).tensors
+    actions = ("reclaim", "allocate", "backfill", "preempt")
+    schedule_cycle_staged(st, actions=actions)
+    assert m.counter_value(
+        "turn_batch_fallback_total",
+        {"action": "preempt", "reason": "pod_affinity"},
+    ) == 1
+    assert m.counter_value(
+        "turn_batch_fallback_total",
+        {"action": "reclaim", "reason": "pod_affinity"},
+    ) == 1
+
+    # a plain world takes the fast paths: no fallback rows
+    m.reset()
+    sim2 = SimCluster()
+    sim2.add_queue("q")
+    sim2.add_node("n0", cpu_milli=4000, memory=8 * GB)
+    j2 = sim2.add_job("j", queue="q")
+    sim2.add_task(j2, 1000, GB, name="p0")
+    schedule_cycle_staged(build_snapshot(sim2.cluster).tensors,
+                          actions=actions)
+    assert m.counter_total("turn_batch_fallback_total") == 0

@@ -129,3 +129,35 @@ def test_scheduler_records_phase_timings():
     assert m.histogram("cycle_phase_duration_seconds", {"phase": "kernel"}).n == 1
     text = m.render()
     assert "kube_arbitrator_tpu_binds_total 1" in text
+
+
+def test_gated_rounds_variant_label_mapping():
+    """The staged runner encodes gate-served rounds as an ":gated"
+    suffix in action_rounds; the scheduler's metric emitter must map it
+    to the variant="gated" series of kernel_rounds_total{action} (and
+    leave plain actions label-compatible with the pre-gate series)."""
+    from kube_arbitrator_tpu.cache import SimCluster
+    from kube_arbitrator_tpu.framework.scheduler import CycleStats, Scheduler
+    from kube_arbitrator_tpu.utils.metrics import metrics
+
+    m = metrics()
+    m.reset()
+    sim = SimCluster()
+    sim.add_queue("q")
+    sim.add_node("n0", cpu_milli=1000, memory=1024)
+    sched = Scheduler(sim)
+    sched._record_metrics(
+        CycleStats(cycle_ms=1.0, snapshot_ms=0.1, binds=0, evicts=0,
+                   pending_before=0),
+        {"preempt": 3.0},
+        {"preempt": 62, "preempt:gated": 57, "reclaim": 58},
+    )
+    assert m.counter_value(
+        "kernel_rounds_total", {"action": "preempt"}
+    ) == 62
+    assert m.counter_value(
+        "kernel_rounds_total", {"action": "preempt", "variant": "gated"}
+    ) == 57
+    assert m.counter_value(
+        "kernel_rounds_total", {"action": "reclaim"}
+    ) == 58

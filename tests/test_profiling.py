@@ -133,3 +133,32 @@ def test_now_fn_injectable_for_virtual_clock():
     clock[0] = 9.0
     prof.record_measured("allocate", "k", 2.0)
     assert prof.table()["shapes"]["k"]["allocate"]["measured"]["last_ts"] == 9.0
+
+
+def test_staged_evictive_cycle_records_phase_split_and_gated_rounds(clean_profiler):
+    """An evictive staged cycle with the profiler on serves the per-round
+    preempt phase-A attribution row (``preempt:phase_a`` pseudo-stage:
+    ``phase_a_full_ms`` / ``phase_a_gated_ms`` — full-vs-gated is the
+    round gate's per-round saving) and carries the ``rounds_gated_total``
+    aggregate on the evictive stages, so /debug/kernels can attribute
+    gate hits vs full recomputes."""
+    from kube_arbitrator_tpu.cache import build_snapshot, generate_cluster
+    from kube_arbitrator_tpu.ops.cycle import schedule_cycle_staged
+
+    GB = 1024 ** 3
+    sim = generate_cluster(num_nodes=16, num_jobs=24, tasks_per_job=2,
+                           num_queues=4, seed=3, node_cpu_milli=4000,
+                           node_memory=8 * GB, running_fraction=0.6)
+    st = build_snapshot(sim.cluster).tensors
+    key = shape_key(st)
+    schedule_cycle_staged(
+        st, actions=("reclaim", "allocate", "backfill", "preempt")
+    )
+    stages = clean_profiler.table()["shapes"][key]
+    pre = stages["preempt"]["measured"]
+    assert pre["count"] == 1
+    assert pre["rounds_total"] >= 1
+    assert "rounds_gated_total" in pre  # the gated variant aggregate
+    split = stages["preempt:phase_a"]["estimate"]
+    assert split["phase_a_full_ms"] > 0, split
+    assert split["phase_a_gated_ms"] > 0, split

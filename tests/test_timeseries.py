@@ -53,19 +53,25 @@ def test_sampler_samples_families_and_counter_deltas():
     )
     reg.counter_add("device_upload_bytes_total", 1000, labels={"mode": "full"})
     sampler.on_cycle(_stats(12.0, binds=3), action_ms={"allocate": 7.5},
-                     action_rounds={"preempt": 4})
+                     action_rounds={"preempt": 4, "preempt:gated": 3})
     reg.counter_add("device_upload_bytes_total", 250, labels={"mode": "delta"})
     reg.counter_add("pipeline_discards_total", 2, labels={"reason": "task_gone"})
+    reg.counter_add("turn_batch_fallback_total",
+                    labels={"action": "preempt", "reason": "pod_affinity"})
     reg.gauge_set("pipeline_stage_occupancy", 0.75, labels={"stage": "decide"})
     sampler.on_cycle(_stats(15.0))
     rows = sampler.ring.rows()
     assert rows[0]["cycle_ms"] == 12.0
     assert rows[0]["kernel_allocate_ms"] == 7.5
     assert rows[0]["rounds_preempt"] == 4
+    # the ":gated" variant becomes its own ring column
+    assert rows[0]["rounds_preempt_gated"] == 3
     assert rows[0]["upload_bytes"] == 1000  # first sample: full total
     # second sample carries per-cycle DELTAS, not cumulative totals
     assert rows[1]["upload_bytes"] == 250
     assert rows[1]["discards"] == 2
+    # silent de-optimization lands in the ring too
+    assert rows[1]["turn_batch_fallbacks"] == 1
     assert rows[1]["occ_decide"] == 0.75
     assert "discards" not in rows[0]
 
