@@ -35,6 +35,7 @@ import json, sys, urllib.request
 from kube_arbitrator_tpu.cache.sim import generate_cluster
 from kube_arbitrator_tpu.framework import Scheduler
 from kube_arbitrator_tpu.obs import scheduler_status_fn, serve_obs
+from kube_arbitrator_tpu.utils.audit import AuditLog
 from kube_arbitrator_tpu.utils.flightrec import FlightRecorder
 from kube_arbitrator_tpu.utils.profiling import profiler
 from kube_arbitrator_tpu.utils.timeseries import CycleSampler
@@ -45,15 +46,22 @@ profiler().enable()
 sim = generate_cluster(num_nodes=16, num_jobs=3, tasks_per_job=4, num_queues=2, seed=0)
 flight = FlightRecorder(capacity=8)
 sampler = CycleSampler(slo_ms=10_000.0, flight=flight)
-sched = Scheduler(sim, flight=flight, timeseries=sampler)
+audit = AuditLog(capacity=8, flight=flight)
+sched = Scheduler(sim, flight=flight, timeseries=sampler, audit=audit)
 sched.run(max_cycles=2, until_idle=False)
 server, _t, url = serve_obs(flight=flight, status_fn=scheduler_status_fn(sched),
-                            timeseries=sampler)
+                            timeseries=sampler, audit=audit)
 try:
     text = urllib.request.urlopen(url + "/metrics", timeout=10).read().decode()
     for fam in ("e2e_scheduling_duration_seconds",
-                "kernel_action_duration_seconds", "cycles_total"):
+                "kernel_action_duration_seconds", "cycles_total",
+                "audit_records_total", "fairness_share",
+                "queue_starvation_seconds"):
         assert fam in text, f"missing metric family {fam}"
+    # promtext conformance of the new families: HELP/TYPE emitted once,
+    # audit gauges labeled (full conformance suite runs in test_audit)
+    assert text.count("# TYPE kube_arbitrator_tpu_fairness_share") == 1
+    assert 'fairness_share{kind="deserved",queue=' in text, "unlabeled ledger gauge"
     health = json.load(urllib.request.urlopen(url + "/healthz", timeout=10))
     assert health["ok"] and health["cycles"] == 2, health
     kernels = json.load(urllib.request.urlopen(url + "/debug/kernels", timeout=10))
@@ -61,9 +69,12 @@ try:
     ts = json.load(urllib.request.urlopen(url + "/debug/timeseries?window=3600", timeout=10))
     assert len(ts["rows"]) == 2, ts
     assert ts["slo_burn"]["slo_ms"] == 10_000.0, ts
+    au = json.load(urllib.request.urlopen(url + "/debug/audit?n=8", timeout=10))
+    assert au["schema_version"] == 1 and len(au["records"]) == 2, au
+    assert au["records"][0]["fairness"], "audit record missing fairness ledger"
 finally:
     server.shutdown()
-print("obs smoke: /metrics + /healthz + /debug/kernels + /debug/timeseries ok")
+print("obs smoke: /metrics + /healthz + /debug/kernels + /debug/timeseries + /debug/audit ok")
 EOF
   python -m kube_arbitrator_tpu.analysis --rules KAT-LCK,KAT-DTY \
     kube_arbitrator_tpu/utils/tracing.py \
@@ -71,6 +82,7 @@ EOF
     kube_arbitrator_tpu/utils/metrics.py \
     kube_arbitrator_tpu/utils/profiling.py \
     kube_arbitrator_tpu/utils/timeseries.py \
+    kube_arbitrator_tpu/utils/audit.py \
     kube_arbitrator_tpu/obs.py || rc_obs=$?
   if [ "${rc_obs}" -ne 0 ]; then
     echo "obs smoke job: FAILED (exit ${rc_obs})" >&2
@@ -126,10 +138,22 @@ if [ "${CHAOS:-0}" = "1" ]; then
     echo "chaos sensitivity canary did not breach (exit ${rc_canary})" >&2
     rc_chaos=1
   fi
+  # audit sensitivity canary: a seeded dropped-edge mutation in the
+  # decision audit records MUST make the audit_consistency reconciler
+  # breach (exit exactly 1) — a pass here would mean the audit trail
+  # can silently drift from what was actuated
+  env JAX_PLATFORMS=cpu python -m kube_arbitrator_tpu.chaos \
+    --seed 0 --cycles 6 --profile smoke --disable audit-edges \
+    --out-dir /tmp >/dev/null
+  rc_canary=$?
+  if [ "${rc_canary}" -ne 1 ]; then
+    echo "audit dropped-edge canary did not breach (exit ${rc_canary})" >&2
+    rc_chaos=1
+  fi
   if [ "${rc_chaos}" -ne 0 ]; then
     echo "chaos smoke job: FAILED (exit ${rc_chaos})" >&2
   else
-    echo "chaos smoke job: ok (8-seed matrix + sensitivity canary)"
+    echo "chaos smoke job: ok (8-seed matrix + sensitivity + audit canaries)"
   fi
 fi
 

@@ -58,6 +58,14 @@ Sub-ids:
   tail) fails abstract evaluation or drifts from the declared
   :data:`RECLAIM_TURN_SCHEMA` — the thin tail gathers these per turn,
   so a dtype drift silently corrupts every thin reclaim claim.
+- ``KAT-CTR-010``: the decision AUDIT aux contract — ``commit_cycle``'s
+  attribution outputs (preemptor→victim claimant/phase/round arrays)
+  and fairness-ledger inputs (queue deserved/allocated) drift from the
+  declared :data:`AUDIT_AUX_SCHEMA`.  utils/audit.py decodes these
+  host-side and they cross the RPC reply pack by name; nothing on the
+  decision path reads them, so this pass (plus the runtime decode twin,
+  which holds the full DECISIONS_SCHEMA including this subset) is the
+  only drift detector.
 
 The harness takes the schemas as parameters so the regression tests can
 seed one mutated dtype and assert the checker reports exactly the
@@ -193,6 +201,9 @@ STATE_SCHEMA: Dict[str, Tuple[Tuple[str, ...], str]] = {
     "group_placed": (("G",), "int32"),
     "group_unfit": (("G",), "bool"),
     "evicted_for": (("T",), "int32"),
+    "evict_claimant": (("T",), "int32"),
+    "evict_phase": (("T",), "int32"),
+    "evict_round": (("T",), "int32"),
     "progress": ((), "bool"),
     "rounds": ((), "int32"),
     "rounds_gated": ((), "int32"),
@@ -234,7 +245,21 @@ RECLAIM_TURN_SCHEMA: Dict[str, Tuple[Tuple[str, ...], str]] = {
     "burn": (("Q",), "bool"),
 }
 
-#: What framework/session.py's actuation decode consumes.
+#: The decision audit plane's aux outputs (KAT-CTR-010): the
+#: preemptor→victim attribution channel plus the per-queue fairness
+#: ledger inputs utils/audit.py decodes.  Split out from the actuation
+#: set so the dedicated audit-aux pass (and its seeded-mutation
+#: regression test) names exactly the audit surface.
+AUDIT_AUX_SCHEMA: Dict[str, Tuple[Tuple[str, ...], str]] = {
+    "evict_claimant": (("T",), "int32"),
+    "evict_phase": (("T",), "int32"),
+    "evict_round": (("T",), "int32"),
+    "queue_deserved": (("Q", "R"), "float32"),
+    "queue_alloc": (("Q", "R"), "float32"),
+}
+
+#: What framework/session.py's actuation decode consumes (the audit aux
+#: rides the same CycleDecisions pack — see AUDIT_AUX_SCHEMA).
 DECISIONS_SCHEMA: Dict[str, Tuple[Tuple[str, ...], str]] = {
     "task_node": (("T",), "int32"),
     "task_status": (("T",), "int32"),
@@ -245,6 +270,7 @@ DECISIONS_SCHEMA: Dict[str, Tuple[Tuple[str, ...], str]] = {
     "node_idle": (("N", "R"), "float32"),
     "node_num_tasks": (("N",), "int32"),
     "node_ports": (("N", "W"), "int32"),
+    **AUDIT_AUX_SCHEMA,
 }
 
 
@@ -757,6 +783,59 @@ def check_reclaim_turns(
     return findings
 
 
+def check_audit_aux(
+    schema: Optional[Mapping[str, Tuple[Tuple[str, ...], str]]] = None,
+    axes: Optional[Mapping[str, int]] = None,
+    audit_schema: Optional[Mapping[str, Tuple[Tuple[str, ...], str]]] = None,
+) -> List[Finding]:
+    """KAT-CTR-010: the decision AUDIT aux contract.  Abstract-evaluate
+    the commit tail (``commit_cycle``) over the declared session/state
+    structs and verify the audit-plane outputs — the preemptor→victim
+    attribution arrays and the fairness-ledger inputs — against
+    :data:`AUDIT_AUX_SCHEMA`.  utils/audit.py decodes these host-side
+    (and they cross the RPC codec by name), so a drifted dtype here
+    corrupts the audit trail without any decision-path symptom — exactly
+    the silent class the actuation decode's runtime twin
+    (``session._assert_decision_dtypes``) only catches once a real cycle
+    runs.  Seeding a mutated ``audit_schema`` must make this pass report
+    the drifted field (regression-tested)."""
+    import jax
+
+    from ..ops import cycle as cyc
+
+    axes = axes or DEFAULT_AXES
+    audit_schema = audit_schema or AUDIT_AUX_SCHEMA
+    findings: List[Finding] = []
+    path, line = _anchor(cyc.commit_cycle)
+    st = snapshot_struct(schema, axes)
+    state = _state_struct(STATE_SCHEMA, axes)
+    sess = _session_struct(axes)
+    with jax.default_device(jax.devices("cpu")[0]):
+        try:
+            dec = jax.eval_shape(cyc.commit_cycle, st, sess, state)
+        except Exception as err:
+            return [Finding(
+                "KAT-CTR-010", "error", path, line,
+                f"commit_cycle failed abstract evaluation against the "
+                f"declared session/state contract: "
+                f"{type(err).__name__}: {err}",
+                hint="the commit tail no longer composes over the "
+                "declared AllocState/SessionCtx — the audit aux cannot "
+                "be checked until it does",
+            )]
+        findings += _check_fields(
+            dec, audit_schema, axes, "KAT-CTR-010", path, line,
+            stage="commit_cycle → audit aux (CycleDecisions)",
+            hint="utils/audit.py decodes these as the decision audit "
+            "record (preemptor→victim edges + fairness ledger) and they "
+            "cross the RPC reply pack by name; a drifted dtype/shape "
+            "silently corrupts the audit trail — fix commit_cycle/"
+            "AllocState or AUDIT_AUX_SCHEMA if the contract "
+            "legitimately changed",
+        )
+    return findings
+
+
 def _state_struct(state_schema, axes):
     import jax
     import numpy as np
@@ -797,5 +876,6 @@ def check_contracts(
     findings += check_kernels(schema, state_schema=state_schema)
     findings += check_batched_turns(schema, turn_schema=turn_schema)
     findings += check_reclaim_turns(schema)
+    findings += check_audit_aux(schema)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings

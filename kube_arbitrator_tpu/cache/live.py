@@ -838,29 +838,40 @@ class LiveCache:
     def record_event(self, kind: str, object_uid: str, reason: str, message: str = "") -> None:
         self.events.append(Event(kind=kind, object_uid=object_uid, reason=reason, message=message))
 
-    def apply_binds(self, binds: Sequence[BindIntent]) -> None:
+    def apply_binds(self, binds: Sequence[BindIntent]):
         """POST the binding subresource per intent (async goroutine in the
-        reference, cache.go:437-444); failures divert to the resync FIFO."""
+        reference, cache.go:437-444); failures divert to the resync FIFO.
+        Returns the uids that did NOT actuate (diverted or vanished) —
+        the decision audit plane marks their rows unactuated so the
+        audit trail reconciles with the store, not the intent list."""
+        failed = []
         for b in binds:
             ref = self._pod_ref.get(b.task_uid)
             if ref is None:
+                failed.append(b.task_uid)
                 continue  # pod vanished between snapshot and actuation
             try:
                 self.api.bind_pod(ref[0], ref[1], b.node_name)
             except ApiError as err:
                 self._defer_resync(b.task_uid, "Bind", str(err))
+                failed.append(b.task_uid)
+        return failed
 
-    def apply_evicts(self, evicts: Sequence[EvictIntent]) -> None:
+    def apply_evicts(self, evicts: Sequence[EvictIntent]):
+        failed = []
         for e in evicts:
             ref = self._pod_ref.get(e.task_uid)
             if ref is None:
+                failed.append(e.task_uid)
                 continue
             try:
                 self.api.evict_pod(ref[0], ref[1])
             except ApiError as err:
                 self._defer_resync(e.task_uid, "Evict", str(err))
+                failed.append(e.task_uid)
                 continue
             self.record_event("Evict", e.task_uid, "Evict")
+        return failed
 
     def update_job_status(self, job_uid: str, status) -> None:
         """PUT PodGroup status (closeSession write-back,

@@ -369,15 +369,17 @@ class SimCluster:
     def _task_index(self) -> Dict[str, TaskInfo]:
         return {uid: t for j in self.cluster.jobs.values() for uid, t in j.tasks.items()}
 
-    def apply_binds(self, binds: Sequence[BindIntent]) -> None:
+    def apply_binds(self, binds: Sequence[BindIntent]):
         """Commit bind intents: allocate volumes for the whole job first
         (gang-atomic: a volume failure drops the job's entire batch, the
         stronger form of session.go:243-259 failing the task before any
         accounting), then per task BindVolumes + Bind (session.go:295-316).
         Backend failures divert the task to the resync FIFO instead of
-        raising (cache.go:437-444)."""
+        raising (cache.go:437-444).  Returns the uids that did NOT
+        actuate (the decision audit plane marks their rows unactuated)."""
+        failed = []
         if not binds:
-            return  # skip the O(cluster) index build on idle cycles
+            return failed  # skip the O(cluster) index build on idle cycles
         index = self._task_index()
         by_job: Dict[str, List[BindIntent]] = {}
         for b in binds:
@@ -394,6 +396,7 @@ class SimCluster:
             except BindFailure as err:
                 for b in job_binds:
                     self._defer_resync(b.task_uid, "AllocateVolumes", str(err))
+                    failed.append(b.task_uid)
                 continue
             for b in job_binds:
                 task = index[b.task_uid]
@@ -403,6 +406,7 @@ class SimCluster:
                     self.binder.bind(b.task_uid, b.node_name)
                 except BindFailure as err:
                     self._defer_resync(b.task_uid, "Bind", str(err))
+                    failed.append(b.task_uid)
                     # no model change, but the emission is idempotent and
                     # keeps the failure path indistinguishable to the arena
                     self._emit_task(b.task_uid, b.node_name)
@@ -411,11 +415,14 @@ class SimCluster:
                 task.node_name = b.node_name
                 node.add_task(task)
                 self._emit_task(b.task_uid, b.node_name)
+        return failed
 
-    def apply_evicts(self, evicts: Sequence[EvictIntent]) -> None:
-        """Evict: running task -> Releasing on its node (cache.go:369-405)."""
+    def apply_evicts(self, evicts: Sequence[EvictIntent]):
+        """Evict: running task -> Releasing on its node (cache.go:369-405).
+        Returns the uids that did NOT actuate (diverted to resync)."""
+        failed = []
         if not evicts:
-            return
+            return failed
         index = self._task_index()
         for e in evicts:
             task = index.get(e.task_uid)
@@ -425,6 +432,7 @@ class SimCluster:
                 self.evictor.evict(e.task_uid)
             except BindFailure as err:
                 self._defer_resync(e.task_uid, "Evict", str(err))
+                failed.append(e.task_uid)
                 continue
             if task.node_name:
                 node = self.cluster.nodes[task.node_name]
@@ -435,6 +443,7 @@ class SimCluster:
                 task.status = TaskStatus.RELEASING
             self._emit_task(e.task_uid, task.node_name)
             self.record_event("Evict", e.task_uid, "Evict")
+        return failed
 
     # ---- failure handling (errTasks resync, cache.go:519-547) ----
 

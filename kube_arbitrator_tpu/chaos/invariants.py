@@ -25,6 +25,13 @@ Invariants:
 * ``gang_atomicity`` — end-of-run (after the fault-free drain): every
   gang is either uncommitted or committed to at least ``minMember`` —
   no partially committed group survived a faulted commit.
+* ``audit_consistency`` — after every settled OK cycle, the decision
+  audit record's bind/evict edges reconcile 1:1 with the apiserver
+  actuation events of that cycle: every actuation has an audit edge and
+  every audit edge has an actuation.  An audit trail that drifts from
+  what actually hit the store is worse than none — it would *explain*
+  decisions that never happened (the dropped-edge sensitivity canary
+  proves this checker actually compares, ``--disable audit-edges``).
 """
 from __future__ import annotations
 
@@ -73,10 +80,15 @@ class InvariantChecker:
     # ---- per-cycle ----
 
     def after_cycle(
-        self, api, cache, cycle: int, events: List[Tuple], fenced: bool
+        self, api, cache, cycle: int, events: List[Tuple], fenced: bool,
+        audit_rec=None,
     ) -> List[Breach]:
         """``events`` is the apiserver event-log slice this cycle
-        produced; ``fenced`` marks a cycle the leader fence discarded."""
+        produced; ``fenced`` marks a cycle the leader fence discarded.
+        ``audit_rec`` (a dict, the cycle's decision-audit record) arms
+        the ``audit_consistency`` reconciliation — pass it only for
+        settled OK cycles: a cycle that died mid-actuation legitimately
+        leaves the record and the store out of step."""
         out: List[Breach] = []
         if fenced and events:
             self._breach(
@@ -110,8 +122,62 @@ class InvariantChecker:
                 out, "no_bind_and_evict", cycle,
                 f"pod {uid} bound and evicted in one cycle",
             )
+        if audit_rec is not None:
+            out += self._check_audit(audit_rec, bound_now, evicted_now, cycle)
         out += self.check_overcommit(api, cycle)
         out += self.check_cache_consistency(api, cache, cycle)
+        return out
+
+    def _check_audit(
+        self, audit_rec: dict, bound_now: set, evicted_now: set, cycle: int
+    ) -> List[Breach]:
+        """The audit trail must reconcile 1:1 with actuations: the
+        record's bind rows against the cycle's first-seen-nodeName pod
+        events, its ACTUATED eviction edges against the cycle's pod
+        deletions.  Direction matters both ways — a missing edge means
+        the audit under-reports (the dropped-edge canary's class), a
+        phantom edge means it claims decisions the store never saw."""
+        out: List[Breach] = []
+        bind_rows_all = {r["task"] for r in audit_rec.get("binds", ())}
+        bind_rows_actuated = {
+            r["task"] for r in audit_rec.get("binds", ())
+            if r.get("actuated", True)
+        }
+        evict_rows_all = {
+            e["victim"] for e in audit_rec.get("evictions", ())
+            if e.get("committed", True)
+        }
+        evict_rows_actuated = {
+            e["victim"] for e in audit_rec.get("evictions", ())
+            if e.get("actuated")
+        }
+        # An event with NO row at all is a missing edge (the dropped-edge
+        # canary's class); a row claiming actuation with no event is a
+        # phantom.  The third case — a row honestly marked UNACTUATED
+        # whose event exists anyway — is the apply-then-timeout ambiguity
+        # (the store applied the write, the caller saw a 504): the record
+        # still names the decision and the store confirms it, so it
+        # reconciles.
+        for uid in sorted(bound_now - bind_rows_all):
+            self._breach(
+                out, "audit_consistency", cycle,
+                f"pod {uid} bound with no audit bind row",
+            )
+        for uid in sorted(bind_rows_actuated - bound_now):
+            self._breach(
+                out, "audit_consistency", cycle,
+                f"audit bind row for {uid} without an actuation event",
+            )
+        for uid in sorted(evicted_now - evict_rows_all):
+            self._breach(
+                out, "audit_consistency", cycle,
+                f"pod {uid} evicted with no audit eviction edge",
+            )
+        for uid in sorted(evict_rows_actuated - evicted_now):
+            self._breach(
+                out, "audit_consistency", cycle,
+                f"audit eviction edge for {uid} without a deletion event",
+            )
         return out
 
     def check_overcommit(self, api, cycle: int) -> List[Breach]:

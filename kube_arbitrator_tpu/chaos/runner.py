@@ -52,10 +52,12 @@ from .plan import PROFILES, ChaosProfile, FaultPlan
 REPRO_VERSION = 1
 
 # sensitivity knobs --disable accepts: each turns OFF one safety
-# mechanism so a test can prove the invariant checkers catch the damage
-# the mechanism normally prevents (chaos that only passes clean runs
-# proves nothing)
-DISABLE_CHOICES = ("arena-verify",)
+# mechanism (or seeds one mutation) so a test can prove the invariant
+# checkers catch the damage the mechanism normally prevents (chaos that
+# only passes clean runs proves nothing).  "audit-edges" drops the first
+# bind row from every non-empty decision-audit record — the
+# audit_consistency reconciler MUST breach.
+DISABLE_CHOICES = ("arena-verify", "audit-edges")
 
 
 def seed_world(api, profile: ChaosProfile, seed: int) -> None:
@@ -218,12 +220,23 @@ def run_chaos(
     )
     elector.sleep = clock.sleep
     decider = ChaosDecider(LocalDecider(), injector, clock, jitter_seed=seed)
+    # decision audit on the virtual clock: every committed cycle's record
+    # is reconciled against the apiserver's actuation events below
+    # (audit_consistency); "audit-edges" seeds the dropped-edge mutation
+    # the sensitivity canary requires to breach
+    from ..utils.audit import AuditLog
+
+    audit = AuditLog(
+        capacity=cycles + prof.drain_cycles + 1, now_fn=clock.now
+    )
+    audit.drop_first_edge = "audit-edges" in disabled
     sched = Scheduler(
         cache,
         elector=elector,
         decider=decider,
         arena=arena,
         phase_hook=make_phase_hook(injector, clock, elector),
+        audit=audit,
     )
     if not elector.acquire_blocking(timeout_s=120.0):
         raise RuntimeError("chaos: initial leader acquisition failed")
@@ -252,6 +265,7 @@ def run_chaos(
         _run_cycles(
             total, cycles, injector, arena, clock, api, elector, sched,
             executor, cache, checker, detect, outcomes, digests, breaches,
+            audit,
         )
     finally:
         if executor is not None:
@@ -274,7 +288,7 @@ def run_chaos(
 
 def _run_cycles(
     total, cycles, injector, arena, clock, api, elector, sched, executor,
-    cache, checker, detect, outcomes, digests, breaches,
+    cache, checker, detect, outcomes, digests, breaches, audit=None,
 ) -> None:
     for cycle in range(total):
         injector.begin_cycle(cycle)
@@ -284,6 +298,7 @@ def _run_cycles(
             apply_arena_corruption(arena, injector)
         clock.advance(1.0)  # cycle cadence
         rv0 = api._rv
+        prev_audit = audit.last() if audit is not None else None
         fenced = False
         outcome = "ok"
         if not elector.renew():
@@ -325,7 +340,25 @@ def _run_cycles(
         injector.disarm()
         cache.sync()  # settle: deliver every pending event before checking
         events = [e for e in api.event_log if e[0] > rv0]
-        breaches += checker.after_cycle(api, cache, cycle, events, fenced=fenced)
+        # audit reconciliation only for settled OK cycles: a cycle that
+        # died mid-actuation legitimately leaves record and store out of
+        # step.  An OK cycle that produced NO fresh record is itself a
+        # breach — auditing must cover every committed cycle.
+        audit_rec = None
+        if audit is not None and outcome == "ok":
+            rec = audit.last()
+            if rec is None or rec is prev_audit:
+                # one breach-emission path (Breach + metric) for the
+                # whole plane: InvariantChecker._breach
+                checker._breach(
+                    breaches, "audit_consistency", cycle,
+                    "committed cycle produced no audit record",
+                )
+            else:
+                audit_rec = rec.to_dict()
+        breaches += checker.after_cycle(
+            api, cache, cycle, events, fenced=fenced, audit_rec=audit_rec
+        )
         outcomes.append(outcome)
         digests.append(_digest(cycle, outcome, events))
 

@@ -105,6 +105,30 @@ def build_parser() -> argparse.ArgumentParser:
         "sampled-out cycles allocate no spans — keeps tracing on at "
         "50k-task scale; default 1.0 = every cycle)",
     )
+    # decision audit & fairness accounting plane (utils/audit.py)
+    p.add_argument(
+        "--audit-log",
+        default="",
+        metavar="PATH",
+        help="append one JSON decision-audit record per committed cycle "
+        "here (bind rows, preemptor→victim eviction edges, per-queue "
+        "fairness ledger, gang verdicts); the in-memory audit ring and "
+        "/debug/audit are on whenever any obs flag is",
+    )
+    p.add_argument(
+        "--audit-ring",
+        type=int,
+        default=256,
+        help="decision-audit ring capacity in cycles (default 256)",
+    )
+    p.add_argument(
+        "--starvation-slo-s",
+        type=float,
+        default=0.0,
+        help="flight anomaly `starvation` fires when a pending, "
+        "under-entitled queue goes this long without a placement or "
+        "eviction claim (0 = disabled)",
+    )
     p.add_argument(
         "--profile-kernels",
         action="store_true",
@@ -243,11 +267,13 @@ def main(argv=None) -> int:
     # the staged per-action kernel timing); --obs-port serves the plane
     obs_enabled = (
         args.obs_port is not None or args.flight_dump_dir or args.cycle_slo_ms
-        or args.profile_kernels
+        or args.profile_kernels or args.audit_log or args.starvation_slo_s
     )
     flight = None
     sampler = None
+    audit = None
     if obs_enabled:
+        from .utils.audit import AuditLog
         from .utils.flightrec import FlightRecorder
         from .utils.timeseries import CycleSampler
         from .utils.tracing import tracer
@@ -260,6 +286,13 @@ def main(argv=None) -> int:
         # per-cycle metric samples + SLO burn (slo off -> ring only)
         sampler = CycleSampler(
             slo_ms=args.cycle_slo_ms or None, flight=flight
+        )
+        # decision audit: ring (+ optional JSONL) per committed cycle
+        audit = AuditLog(
+            capacity=args.audit_ring,
+            log_path=args.audit_log or None,
+            flight=flight,
+            starvation_slo_s=args.starvation_slo_s or None,
         )
     if args.profile_kernels:
         from .utils.profiling import profiler
@@ -274,6 +307,7 @@ def main(argv=None) -> int:
         server, _thread, url = serve_obs(
             host=args.obs_host, port=args.obs_port,
             flight=flight, status_fn=status_fn, timeseries=sampler,
+            audit=audit,
         )
         print(f"observability plane on {url}", file=sys.stderr)
         return server
@@ -378,6 +412,7 @@ def main(argv=None) -> int:
             cycle_slo_ms=args.cycle_slo_ms or None,
             arena=arena,
             timeseries=sampler,
+            audit=audit,
         )
     except (ValueError, OSError) as e:
         print(f"error: invalid scheduler conf: {e}", file=sys.stderr)

@@ -99,6 +99,7 @@ class Scheduler:
         max_cycle_retries: int = 8,
         wait_for_event=None,
         timeseries=None,
+        audit=None,
     ):
         # conf is re-loadable per Run like the reference (scheduler.go:66-78)
         self.sim = sim
@@ -152,6 +153,12 @@ class Scheduler:
         # ring sample per committed cycle + the multi-window SLO
         # burn-rate check; None costs nothing
         self.timeseries = timeseries
+        # decision audit plane (utils/audit.AuditLog): one AuditRecord —
+        # actuated bind rows, preemptor→victim eviction edges, the
+        # per-queue fairness ledger, gang verdicts — per committed cycle
+        # (run_once AND the pipelined executor, which passes its
+        # post-revalidation actuated sets); None costs nothing
+        self.audit = audit
         self._consecutive_cycle_errors = 0
         self.job_status: Dict[str, PodGroupStatus] = {}
         self.history: List[CycleStats] = []
@@ -182,8 +189,34 @@ class Scheduler:
                 self._flight_failure(corr or "", cycle_ts, err)
                 raise
         self.last_cycle_ts = time.time()
+        self._audit_cycle(self._cycle_seq, corr, cycle_ts, result)
         self._flight_success(self._cycle_seq, corr, cycle_ts, self.history[-1], result)
         return result
+
+    def _audit_cycle(
+        self, seq: int, corr: Optional[str], cycle_ts: float, result: CycleResult
+    ) -> None:
+        """Record the committed cycle's decision audit — shared by
+        run_once and the pipelined executor (whose ``result`` carries the
+        post-revalidation actuated bind/evict sets, so the record
+        reconciles with what actually hit the apiserver)."""
+        if self.audit is None:
+            return
+        self.audit.observe_cycle(seq, corr, cycle_ts, result)
+
+    def _fairness_digest(self) -> list:
+        """Compact top-|delta| ledger rows for the flight digest, reused
+        from the audit record of the cycle just observed (``_audit_cycle``
+        always runs before ``_flight_success`` on both the sequential and
+        pipelined paths); [] when the audit plane is off."""
+        if self.audit is None:
+            return []
+        rec = self.audit.last()
+        if rec is None:
+            return []
+        from ..utils.audit import fairness_top_of
+
+        return fairness_top_of(rec.fairness)
 
     def _flight_success(
         self, seq: int, corr: Optional[str], cycle_ts: float,
@@ -196,6 +229,8 @@ class Scheduler:
         carry the speculation-gate outcome, not just the metric)."""
         if self.flight is None:
             return
+        from ..utils.audit import evict_edge_counts, fairness_top_of
+
         self.flight.record(
             CycleRecord(
                 seq=seq,
@@ -210,6 +245,17 @@ class Scheduler:
                     "action_ms": dict(result.action_ms),
                     "action_rounds": dict(result.action_rounds),
                     "discards": dict(discards or {}),
+                    # decision-audit digest: eviction edges by
+                    # action:phase (one bincount — always on) + the
+                    # top-|delta| fairness-ledger rows (who was over/
+                    # under entitlement when this cycle — possibly the
+                    # failing one — ran), REUSED from the record
+                    # _audit_cycle just assembled for this same cycle —
+                    # flight-without-audit keeps its "None costs
+                    # nothing" footprint, flight-with-audit pays the
+                    # O(T) ledger pass exactly once.
+                    "evict_edges": evict_edge_counts(result.decisions),
+                    "fairness_top": self._fairness_digest(),
                 },
                 spans=[s.to_dict() for s in tracer().spans(corr)] if corr else [],
             )
@@ -333,17 +379,26 @@ class Scheduler:
                     f"not actuated) — holder {self.elector.identity}"
                 )
 
-    def _actuate(self, binds, evicts) -> None:
+    def _actuate(self, binds, evicts) -> set:
+        """Apply the intents; returns the uids that did NOT actuate
+        (backends divert failures to the errTasks resync FIFO — the
+        audit plane needs to know the store never saw them)."""
         with tracer().span("actuate", binds=len(binds), evicts=len(evicts)):
-            self.sim.apply_binds(binds)
-            self.sim.apply_evicts(evicts)
+            failed = set(self.sim.apply_binds(binds) or ())
+            failed |= set(self.sim.apply_evicts(evicts) or ())
+        return failed
 
-    def _write_back(self, result: CycleResult, task_conditions=None) -> None:
+    def _write_back(
+        self, result: CycleResult, task_conditions=None, pending_reasons=None
+    ) -> None:
         """Close-side status/condition/event write-back (the reference's
         closeSession -> cache.UpdateJobStatus path).  ``task_conditions``
         accepts a precomputed explain_pending_tasks result — a pure
         function of (snapshot, decisions) the pipelined executor derives
-        on its decide worker so the ingest thread doesn't stall on it."""
+        on its decide worker so the ingest thread doesn't stall on it —
+        with ``pending_reasons`` its aggregate reason histogram (emitted
+        here as ``pending_reason_total{reason}`` so unschedulability is
+        graphable per cycle, not just dumpable per pod)."""
         self.job_status.update(result.job_status)  # cache.UpdateJobStatus equivalent
         # live backends PUT the PodGroup status back to the apiserver
         # (closeSession -> cache.UpdateJobStatus, session.go:130-144)
@@ -355,14 +410,20 @@ class Scheduler:
         # of condition-less runs (bench, raw kernels) stays bounded
         if hasattr(self.sim, "update_pod_condition"):
             if task_conditions is None:
-                from ..ops.diagnostics import explain_pending_tasks
+                from ..ops.diagnostics import explain_pending_tasks_with_reasons
 
-                task_conditions = explain_pending_tasks(
-                    result.snapshot, result.decisions
+                task_conditions, pending_reasons = (
+                    explain_pending_tasks_with_reasons(
+                        result.snapshot, result.decisions
+                    )
                 )
             result.task_conditions = task_conditions
             for uid, msg in result.task_conditions.items():
                 self.sim.update_pod_condition(uid, msg)
+            for reason, n in (pending_reasons or {}).items():
+                metrics().counter_add(
+                    "pending_reason_total", n, labels={"reason": reason}
+                )
         # user-facing Unschedulable events (cache.go:637-662 parity),
         # deduplicated like the kube EventRecorder aggregates repeats
         for uid, st in result.job_status.items():
@@ -386,7 +447,7 @@ class Scheduler:
             self.trace_recorder.record(result.snapshot.tensors)
         t1 = time.perf_counter()
         self._commit_fence(len(result.binds), len(result.evicts))
-        self._actuate(result.binds, result.evicts)
+        result.failed_actuations = self._actuate(result.binds, result.evicts)
         self._write_back(result)
         t2 = time.perf_counter()
         stats = CycleStats(

@@ -50,7 +50,10 @@ def default_decider():
 def _assert_decision_dtypes(dec: CycleDecisions) -> None:
     """Decisions-side twin of cache/snapshot.py's pack assert: every
     tensor the actuation decode consumes must carry the declared dtype
-    (analysis/contracts.py DECISIONS_SCHEMA).  ~9 dtype compares/cycle."""
+    (analysis/contracts.py DECISIONS_SCHEMA — which includes the
+    decision-audit aux subset, AUDIT_AUX_SCHEMA/KAT-CTR-010, so a
+    drifted attribution or ledger tensor out of the RPC codec is caught
+    here before utils/audit.py decodes it).  ~14 dtype compares/cycle."""
     from ..analysis.contracts import DECISIONS_SCHEMA  # lazy: no cycle
 
     for name, (_shape, dtype) in DECISIONS_SCHEMA.items():
@@ -115,6 +118,11 @@ class CycleResult:
     # action -> round count from the same staged runner (evictive round
     # loops; feeds kernel_rounds_total{action})
     action_rounds: Dict[str, int] = dataclasses.field(default_factory=dict)
+    # task uids whose bind/evict intent did NOT actuate (diverted to the
+    # errTasks resync FIFO or vanished mid-cycle) — filled by the
+    # Scheduler after actuation; the decision audit plane marks their
+    # rows unactuated so the trail reconciles with the store
+    failed_actuations: set = dataclasses.field(default_factory=set)
 
 
 class Session:

@@ -21,6 +21,12 @@ path                       serves
                            shape (utils/profiling.KernelProfiler.table)
 ``/debug/timeseries``      per-cycle metric samples + SLO burn status
                            (``?window=<seconds>`` bounds the range)
+``/debug/audit``           recent decision audit records (utils/audit.py:
+                           bind rows, preemptor→victim edges, fairness
+                           ledger, gang verdicts; ``?n=<count>`` bounds)
+``/debug/audit/<corr>``    one cycle's audit record by trace corr-id —
+                           joinable with ``/debug/trace/<corr>`` and the
+                           flight ring's per-cycle digests
 =========================  ==================================================
 
 Handlers only READ: the registry snapshots under its own lock, the flight
@@ -40,6 +46,12 @@ from .utils.flightrec import FlightRecorder
 from .utils.metrics import MetricsRegistry, metrics
 from .utils.profiling import KernelProfiler, profiler
 from .utils.tracing import Tracer, tracer
+
+
+def _audit_version() -> int:
+    from .utils.audit import AUDIT_SCHEMA_VERSION
+
+    return AUDIT_SCHEMA_VERSION
 
 
 def device_info() -> Dict[str, object]:
@@ -111,14 +123,20 @@ class _ObsHandler(BaseHTTPRequestHandler):
         status_fn = self.server.obs_status_fn  # type: ignore[attr-defined]
         prof: KernelProfiler = self.server.obs_profiler  # type: ignore[attr-defined]
         timeseries = self.server.obs_timeseries  # type: ignore[attr-defined]
+        audit = self.server.obs_audit  # type: ignore[attr-defined]
         path, _, query = self.path.partition("?")
         path = path.rstrip("/") or "/"
         # fixed route vocabulary for the counter label: a scanner probing
         # random paths must not mint unbounded label series in the
         # process-wide registry (each series lives forever)
-        route = path if not path.startswith("/debug/trace/") else "/debug/trace"
+        if path.startswith("/debug/trace/"):
+            route = "/debug/trace"
+        elif path.startswith("/debug/audit/"):
+            route = "/debug/audit"
+        else:
+            route = path
         if route not in ("/", "/metrics", "/healthz", "/readyz",
-                         "/debug/cycles", "/debug/trace",
+                         "/debug/cycles", "/debug/trace", "/debug/audit",
                          "/debug/kernels", "/debug/timeseries"):
             route = "other"
         registry.counter_add("obs_requests_total", labels={"path": route})
@@ -167,6 +185,35 @@ class _ObsHandler(BaseHTTPRequestHandler):
                 body["slo_burn"] = burn.status()
             self._send_json(200, body)
             return
+        if path == "/debug/audit":
+            n = None
+            try:
+                qs = urllib.parse.parse_qs(query)
+                if qs.get("n"):
+                    n = int(qs["n"][0])
+            except ValueError:
+                self._send_json(400, {"error": f"bad n {query!r}"})
+                return
+            if audit is None:
+                self._send_json(200, {
+                    "records": [],
+                    "error": "no audit log wired (pass audit= to serve_obs)",
+                })
+                return
+            self._send_json(200, {
+                "schema_version": _audit_version(),
+                "capacity": getattr(audit, "capacity", 0),
+                "records": audit.entries(n),
+            })
+            return
+        if path.startswith("/debug/audit/"):
+            corr = path[len("/debug/audit/"):]
+            rec = audit.by_corr(corr) if audit is not None else None
+            if rec is None:
+                self._send_json(404, {"error": f"no audit record for corr {corr!r}"})
+                return
+            self._send_json(200, rec)
+            return
         if path.startswith("/debug/trace/"):
             corr = path[len("/debug/trace/"):]
             trace = tr.export_chrome(corr)
@@ -181,6 +228,7 @@ class _ObsHandler(BaseHTTPRequestHandler):
                 "/metrics", "/healthz", "/readyz",
                 "/debug/cycles", "/debug/trace/<corr_id>",
                 "/debug/kernels", "/debug/timeseries?window=<s>",
+                "/debug/audit?n=<count>", "/debug/audit/<corr_id>",
             ]})
             return
         self._send_json(404, {"error": f"no route {path}"})
@@ -195,13 +243,16 @@ def serve_obs(
     status_fn: Optional[Callable[[], Dict[str, object]]] = None,
     kernel_profiler: Optional[KernelProfiler] = None,
     timeseries=None,
+    audit=None,
 ) -> Tuple[ThreadingHTTPServer, threading.Thread, str]:
     """Serve the observability plane; returns (server, thread, base_url).
     ``port=0`` picks a free port; ``server.shutdown()`` stops it.  The
     defaults bind the process-wide registry/tracer/profiler, so a bare
     ``serve_obs()`` next to any scheduler run already serves real data.
     ``timeseries`` takes a :class:`utils.timeseries.CycleSampler` (ring +
-    burn monitor, the Scheduler's ``timeseries=``) or a bare ring."""
+    burn monitor, the Scheduler's ``timeseries=``) or a bare ring;
+    ``audit`` a :class:`utils.audit.AuditLog` (the Scheduler's
+    ``audit=``) for the ``/debug/audit`` routes."""
     server = ThreadingHTTPServer((host, port), _ObsHandler)
     server.obs_registry = registry if registry is not None else metrics()  # type: ignore[attr-defined]
     server.obs_flight = flight  # type: ignore[attr-defined]
@@ -209,6 +260,7 @@ def serve_obs(
     server.obs_status_fn = status_fn if status_fn is not None else (lambda: {"ready": True})  # type: ignore[attr-defined]
     server.obs_profiler = kernel_profiler if kernel_profiler is not None else profiler()  # type: ignore[attr-defined]
     server.obs_timeseries = timeseries  # type: ignore[attr-defined]
+    server.obs_audit = audit  # type: ignore[attr-defined]
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     return server, thread, f"http://{host}:{server.server_address[1]}"

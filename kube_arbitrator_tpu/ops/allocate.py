@@ -63,6 +63,14 @@ from .podaffinity import apply_domain_cap, apply_seed, pa_enabled, pod_affinity_
 ALLOCATED = jnp.int32(int(TaskStatus.ALLOCATED))
 PIPELINED = jnp.int32(int(TaskStatus.PIPELINED))
 
+# Eviction-phase codes carried by AllocState.evict_phase (the decision
+# audit plane's attribution channel, utils/audit.py).  Stable wire values:
+# audit records serialize them, so renumbering is a schema version bump.
+EVICT_PHASE_NONE = 0
+EVICT_PHASE_PREEMPT = 1        # preempt phase 1: inter-job, same queue
+EVICT_PHASE_PREEMPT_INTRA = 2  # preempt phase 2: within the claimant job
+EVICT_PHASE_RECLAIM = 3        # cross-queue reclaim
+
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
@@ -89,6 +97,22 @@ class AllocState:
     # committed iff that job ordinal ends the cycle gang-ready; -2 =
     # unconditional (reclaim / intra-job preemption).
     evicted_for: jax.Array   # i32[T]
+    # Decision audit aux (utils/audit.py): pure ATTRIBUTION outputs —
+    # written only where an eviction commits, read by nothing inside the
+    # kernels, so they are decision-neutral by construction (the parity
+    # soak pins them bit-identical across the sequential and batched
+    # engines).  ``evicted_for`` collapses reclaim/intra claimants to -2
+    # (the commit rule needs only the conditional ones); these keep the
+    # full preemptor→victim edge:
+    # claimant JOB ordinal for every eviction (-1 = not evicted)
+    evict_claimant: jax.Array  # i32[T]
+    # which kernel phase took the victim (EVICT_PHASE_*: 0 none,
+    # 1 preempt inter-job, 2 preempt intra-job, 3 reclaim)
+    evict_phase: jax.Array   # i32[T]
+    # the evicting action's round counter at claim time (-1 = none);
+    # joined with evict_phase this names the exact round of the exact
+    # phase, since every action resets ``rounds`` at entry
+    evict_round: jax.Array   # i32[T]
     progress: jax.Array      # bool scalar — placements in current round
     rounds: jax.Array        # i32 scalar
     # Rounds served by an incremental fast path: preempt's round gate
@@ -630,6 +654,9 @@ def _process_queue(
         group_placed=state.group_placed.at[g].add(placed_total),
         group_unfit=state.group_unfit.at[g].set(state.group_unfit[g] | unfit_now),
         evicted_for=state.evicted_for,
+        evict_claimant=state.evict_claimant,
+        evict_phase=state.evict_phase,
+        evict_round=state.evict_round,
         # marking a group unfit IS progress: it unblocks the queue's next
         # job for the following round (otherwise a failing top job would
         # end the action before later jobs get a turn)

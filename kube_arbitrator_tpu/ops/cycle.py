@@ -87,6 +87,24 @@ class CycleDecisions:
     node_idle: jax.Array      # f32[N, R]
     node_num_tasks: jax.Array  # i32[N]
     node_ports: jax.Array     # i32[N, W]
+    # ---- decision audit aux (utils/audit.py) ----
+    # Pure attribution outputs: nothing decision-bearing reads them, and
+    # they ride the same reply pack across the RPC boundary (rpc/codec.py
+    # serializes CycleDecisions fields generically), so remote cycles
+    # audit identically to local ones.
+    # Preemptor→victim edges (claimant job ordinal, kernel phase, round;
+    # see ops/allocate.EVICT_PHASE_*).  Discarded preemptions — claimant
+    # never reached gang-ready, evict_mask False — KEEP their edge, so
+    # the audit plane can explain the discard, not just the actuation.
+    evict_claimant: jax.Array  # i32[T] (-1 = not evicted)
+    evict_phase: jax.Array    # i32[T]
+    evict_round: jax.Array    # i32[T] (-1 = none)
+    # Per-queue fairness ledger inputs: the proportion water-fill result
+    # this cycle's overused gates ran against, and the end-of-cycle
+    # allocation aggregate (deserved vs allocated is the Gavel-style
+    # entitlement accounting, arxiv 2008.09213).
+    queue_deserved: jax.Array  # f32[Q, R]
+    queue_alloc: jax.Array    # f32[Q, R]
 
 
 def _plugin_enabled(tiers: Tiers, name: str) -> bool:
@@ -189,6 +207,9 @@ def open_session(st: SnapshotTensors, tiers: Tiers) -> Tuple[SessionCtx, AllocSt
         group_placed=jnp.zeros(st.num_groups, jnp.int32),
         group_unfit=jnp.zeros(st.num_groups, bool),
         evicted_for=jnp.full(st.num_tasks, -1, jnp.int32),
+        evict_claimant=jnp.full(st.num_tasks, -1, jnp.int32),
+        evict_phase=jnp.zeros(st.num_tasks, jnp.int32),
+        evict_round=jnp.full(st.num_tasks, -1, jnp.int32),
         progress=jnp.array(False),
         rounds=jnp.int32(0),
         rounds_gated=jnp.int32(0),
@@ -264,6 +285,11 @@ def commit_cycle(
         node_idle=state.node_idle,
         node_num_tasks=state.node_num_tasks,
         node_ports=state.node_ports,
+        evict_claimant=state.evict_claimant,
+        evict_phase=state.evict_phase,
+        evict_round=state.evict_round,
+        queue_deserved=sess.deserved,
+        queue_alloc=state.queue_alloc,
     )
 
 

@@ -19,7 +19,7 @@ per-pod channel costs O(G·N), not O(T·N).
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -70,16 +70,18 @@ class HostView:
         )
 
 
-def _fit_messages(
+def _fit_histograms(
     req: np.ndarray,    # f32[k, R] per-row resreq
     klass: np.ndarray,  # i32[k]
     ports: np.ndarray,  # i32[k, W]
     h: HostView,
-) -> List[str]:
-    """FitError histogram messages for ``k`` (resreq, class, ports) rows at
-    once — the single implementation behind both the per-job and the
-    per-pod channels: per node the FIRST failing reason in predicate-chain
-    order is attributed (job_info.go:329-358's reason counts)."""
+) -> Tuple[List[Dict[str, int]], np.ndarray, int]:
+    """Per-row FitError reason histograms for ``k`` (resreq, class,
+    ports) rows at once: per node the FIRST failing reason in
+    predicate-chain order is attributed (job_info.go:329-358's reason
+    counts).  Returns ``(reason-counts per row, fitting-node counts,
+    valid-node total)`` — the structured form behind both the message
+    formatter and the ``pending_reason_total`` metric channel."""
     n_nodes = int(h.node_valid.sum())
     pods_full = h.node_num_tasks >= h.node_max_tasks
     cf = h.class_fit[klass][:, h.node_klass]                          # [k, N]
@@ -102,16 +104,48 @@ def _fit_messages(
     res_fail = (insufficient & ~seen[:, :, None]).sum(axis=1)         # [k, R]
     fits = (~seen & ~insufficient.any(axis=-1)).sum(axis=1)
 
-    out = []
+    hists: List[Dict[str, int]] = []
     for i in range(req.shape[0]):
         reasons = {label: int(c[i]) for label, c in counts.items() if int(c[i])}
         for r in range(req.shape[1]):
             if int(res_fail[i, r]):
                 reasons[f"Insufficient {RESOURCE_NAMES[r]}"] = int(res_fail[i, r])
-        parts = [f"{cnt} {reason}" for reason, cnt in sorted(reasons.items())]
-        tail = f": {', '.join(parts)}." if parts else "."
-        out.append(f"{int(fits[i])}/{n_nodes} nodes are available{tail}")
-    return out
+        hists.append(reasons)
+    return hists, fits, n_nodes
+
+
+def dominant_reason(reasons: Dict[str, int]) -> str:
+    """The ONE reason attributed to a pod for the ``pending_reason_total``
+    metric: the reason blocking the most nodes (ties break
+    lexicographically, so attribution is deterministic)."""
+    if not reasons:
+        return "unknown"
+    return min(reasons.items(), key=lambda kv: (-kv[1], kv[0]))[0]
+
+
+def _format_fit_message(reasons: Dict[str, int], fit: int, n_nodes: int) -> str:
+    """ONE formatter for the FitError condition text — the per-job
+    channel, the per-pod channel, and the with-reasons variant all
+    format through here so the wording cannot diverge between paths."""
+    parts = [f"{cnt} {reason}" for reason, cnt in sorted(reasons.items())]
+    tail = f": {', '.join(parts)}." if parts else "."
+    return f"{int(fit)}/{n_nodes} nodes are available{tail}"
+
+
+def _fit_messages(
+    req: np.ndarray,    # f32[k, R] per-row resreq
+    klass: np.ndarray,  # i32[k]
+    ports: np.ndarray,  # i32[k, W]
+    h: HostView,
+) -> List[str]:
+    """FitError histogram messages for ``k`` (resreq, class, ports) rows at
+    once — the single implementation behind both the per-job and the
+    per-pod channels (formatting over :func:`_fit_histograms`)."""
+    hists, fits, n_nodes = _fit_histograms(req, klass, ports, h)
+    return [
+        _format_fit_message(reasons, fits[i], n_nodes)
+        for i, reasons in enumerate(hists)
+    ]
 
 
 def explain_job(
@@ -172,6 +206,18 @@ def explain_pending_tasks(
     same cluster, so the histogram is computed once per GROUP (chunked
     [group_chunk, N] passes) and broadcast to member pods.
     """
+    return explain_pending_tasks_with_reasons(snap, decisions, group_chunk)[0]
+
+
+def explain_pending_tasks_with_reasons(
+    snap: Snapshot, decisions, group_chunk: int = 256
+) -> Tuple[Dict[str, str], Dict[str, int]]:
+    """:func:`explain_pending_tasks` plus the aggregate ``reason ->
+    pod count`` histogram behind ``pending_reason_total{reason}``: each
+    unplaced pod is attributed its group's :func:`dominant_reason`, so
+    unschedulability is graphable per cycle, not just dumpable per pod.
+    One computation serves both channels (the scheduler's write-back and
+    the pipelined decide worker both call this form)."""
     t = snap.tensors
     job_ready = np.asarray(decisions.job_ready)
     task_status1 = np.asarray(decisions.task_status)
@@ -190,7 +236,7 @@ def explain_pending_tasks(
         & ~job_ready[task_job]
     )
     if not unplaced.any():
-        return {}
+        return {}, {}
 
     group_ids = np.unique(task_group[unplaced & (task_group >= 0)])
     g_res = np.asarray(t.group_resreq)
@@ -198,14 +244,26 @@ def explain_pending_tasks(
     g_ports = np.asarray(t.group_ports)
     h = HostView.build(snap, decisions)
     group_msg: Dict[int, str] = {}
+    group_reason: Dict[int, str] = {}
     for lo in range(0, len(group_ids), group_chunk):
         gs = group_ids[lo : lo + group_chunk]
-        for g, m in zip(gs, _fit_messages(g_res[gs], g_klass[gs], g_ports[gs], h)):
-            group_msg[int(g)] = m
+        hists, fits, n_nodes = _fit_histograms(
+            g_res[gs], g_klass[gs], g_ports[gs], h
+        )
+        for g, reasons, fit in zip(gs, hists, fits):
+            group_msg[int(g)] = _format_fit_message(reasons, fit, n_nodes)
+            # a group with fitting nodes but unplaced pods is gang-blocked,
+            # not node-blocked — attribute that, not a phantom node reason
+            group_reason[int(g)] = (
+                dominant_reason(reasons) if int(fit) == 0 else "gang not ready"
+            )
 
     out: Dict[str, str] = {}
+    reason_counts: Dict[str, int] = {}
     for i in np.nonzero(unplaced)[0]:
         g = int(task_group[i])
         if g in group_msg:
             out[snap.index.tasks[i].uid] = group_msg[g]
-    return out
+            r = group_reason[g]
+            reason_counts[r] = reason_counts.get(r, 0) + 1
+    return out, reason_counts

@@ -20,7 +20,12 @@ TPU-first re-design — **commit by attribution mask** instead of Statement
 rollback: every eviction records which claimant job it serves
 (``evicted_for``); at cycle close an eviction is committed iff its
 claimant ended gang-ready (or unconditionally, for reclaim/intra-job
-preemption).  The claimant's own placements ride the same mask, so a
+preemption).  The decision audit plane (utils/audit.py) rides the same
+mechanism with three pure aux arrays — ``evict_claimant`` /
+``evict_phase`` / ``evict_round`` — written at the same evict positions
+but read by NOTHING in-kernel, preserving the full preemptor→victim
+edge (claimant identity for reclaim/intra too, kernel phase, round)
+that the -2 commit code collapses.  The claimant's own placements ride the same mask, so a
 failed preemption attempt leaves nothing actuated.  Within-cycle side
 effects of failed attempts (victims transiently unavailable to later
 claimants) are not rolled back mid-cycle — a transient inefficiency the
@@ -43,6 +48,9 @@ from ..api.types import TaskStatus
 from ..cache.snapshot import SnapshotTensors
 from .allocate import (
     AllocState,
+    EVICT_PHASE_PREEMPT,
+    EVICT_PHASE_PREEMPT_INTRA,
+    EVICT_PHASE_RECLAIM,
     PIPELINED,
     SessionCtx,
     _copies_fit,
@@ -751,6 +759,22 @@ def _apply_claim(
     )
     pipe_consumed = p.astype(jnp.float32)[:, None] * req[None, :]
 
+    # ---- decision-audit attribution (utils/audit.py): the full
+    # preemptor→victim edge — claimant job, kernel phase, round at claim
+    # time.  Written at exactly the evict positions and read by nothing
+    # in-kernel, so the writes are decision-neutral; both the sequential
+    # turn and the batched round flow through this one tail, which is
+    # what pins the attribution bit-identical across engines. ----
+    ev_attr = jnp.where(evict, view.idx, T)
+    phase_code = EVICT_PHASE_PREEMPT_INTRA if uncond else EVICT_PHASE_PREEMPT
+    evict_claimant = state.evict_claimant.at[ev_attr].set(
+        j.astype(jnp.int32), mode="drop"
+    )
+    evict_phase = state.evict_phase.at[ev_attr].set(
+        jnp.int32(phase_code), mode="drop"
+    )
+    evict_round = state.evict_round.at[ev_attr].set(state.rounds, mode="drop")
+
     return AllocState(
         task_status=new_status,
         task_node=jnp.where(assigned, tnode, state.task_node),
@@ -766,6 +790,9 @@ def _apply_claim(
             state.group_unfit[g] | (has_grp & (placed_pre < budget))
         ),
         evicted_for=evicted_for,
+        evict_claimant=evict_claimant,
+        evict_phase=evict_phase,
+        evict_round=evict_round,
         # unfit-marking counts as progress so later jobs still get a turn
         progress=state.progress
         | (placed_total > 0)
@@ -1787,6 +1814,15 @@ def _reclaim_fast(
             group_placed=state.group_placed.at[g].add(claimed.astype(jnp.int32)),
             group_unfit=state.group_unfit,
             evicted_for=jnp.where(evict, jnp.int32(-2), state.evicted_for),
+            # audit attribution: reclaim keeps the claimant identity the
+            # -2 commit code collapses (same channel as _apply_claim)
+            evict_claimant=jnp.where(
+                evict, j.astype(jnp.int32), state.evict_claimant
+            ),
+            evict_phase=jnp.where(
+                evict, jnp.int32(EVICT_PHASE_RECLAIM), state.evict_phase
+            ),
+            evict_round=jnp.where(evict, state.rounds, state.evict_round),
             progress=state.progress | pop,
             rounds=state.rounds,
             rounds_gated=state.rounds_gated,
@@ -2108,6 +2144,21 @@ def _canon_fit_commit(
         state.node_ports.at[n_star].set(state.node_ports[n_star] | st.group_ports[g]),
         state.node_ports,
     )
+    # ---- audit attribution: W-wide scatter of the claimant edge onto
+    # the [T] aux arrays (the only per-turn task-array write the canon
+    # engines make — status/evicted_for marks stay deferred to
+    # _canon_writeback because the decision path reads them; the audit
+    # aux is read by nothing in-kernel, so writing it here is safe and
+    # keeps one definition for BOTH canon engines' tails) ----
+    vidx_w = jax.lax.dynamic_slice(st.rv_idx, (start,), (W,))
+    ev_attr = jnp.where(evict_w, vidx_w, st.num_tasks)
+    evict_claimant = state.evict_claimant.at[ev_attr].set(
+        j.astype(jnp.int32), mode="drop"
+    )
+    evict_phase = state.evict_phase.at[ev_attr].set(
+        jnp.int32(EVICT_PHASE_RECLAIM), mode="drop"
+    )
+    evict_round = state.evict_round.at[ev_attr].set(state.rounds, mode="drop")
     state = AllocState(
         task_status=state.task_status,
         task_node=state.task_node,
@@ -2121,6 +2172,9 @@ def _canon_fit_commit(
         group_placed=state.group_placed.at[g].add(claimed.astype(jnp.int32)),
         group_unfit=state.group_unfit,
         evicted_for=state.evicted_for,
+        evict_claimant=evict_claimant,
+        evict_phase=evict_phase,
+        evict_round=evict_round,
         progress=state.progress | pop,
         rounds=state.rounds,
         rounds_gated=state.rounds_gated,

@@ -204,11 +204,15 @@ class PipelinedExecutor:
                 # them here so the ingest thread's write-back doesn't
                 # stall on the [G,N] histogram passes (spiky 100s of ms
                 # on oversubscribed worlds)
-                conditions = None
+                conditions = reasons = None
                 if hasattr(self.sched.sim, "update_pod_condition"):
-                    from ..ops.diagnostics import explain_pending_tasks
+                    from ..ops.diagnostics import (
+                        explain_pending_tasks_with_reasons,
+                    )
 
-                    conditions = explain_pending_tasks(ep.snap, dec)
+                    conditions, reasons = explain_pending_tasks_with_reasons(
+                        ep.snap, dec
+                    )
                 t3 = time.perf_counter()
         # per-action timings captured HERE (same thread as the decide
         # that produced them) so pipelined cycles keep run_once's
@@ -219,7 +223,7 @@ class PipelinedExecutor:
         action_rounds = dict(
             getattr(ep.session._decider(), "last_action_rounds", None) or {}
         )
-        return dec, binds, evicts, conditions, (action_ms, action_rounds), {
+        return dec, binds, evicts, (conditions, reasons), (action_ms, action_rounds), {
             "kernel_ms": kernel_ms,
             "transport_ms": transport_ms,
             "decode_ms": (t2 - t1) * 1000,
@@ -276,7 +280,7 @@ class PipelinedExecutor:
         ep = self._inflight
         try:
             ingest_ms = self._wait(ep)
-            dec, binds0, evicts0, conditions, (action_ms, action_rounds), t = (
+            dec, binds0, evicts0, (conditions, reasons), (action_ms, action_rounds), t = (
                 ep.future.result()
             )
         except BaseException as err:
@@ -296,7 +300,7 @@ class PipelinedExecutor:
                     )
                 t_reval = time.perf_counter()
                 sched._commit_fence(len(binds), len(evicts))
-                sched._actuate(binds, evicts)
+                failed_actuations = sched._actuate(binds, evicts)
                 t_act = time.perf_counter()
         except BaseException as err:
             self._inflight = None
@@ -350,8 +354,11 @@ class PipelinedExecutor:
                     upload_ms=ep.upload_ms,
                     action_ms=action_ms,
                     action_rounds=action_rounds,
+                    failed_actuations=failed_actuations,
                 )
-                sched._write_back(result, task_conditions=conditions)
+                sched._write_back(
+                    result, task_conditions=conditions, pending_reasons=reasons
+                )
             t_end = time.perf_counter()
         result.close_ms = (t_end - t_close0) * 1000
         # effective cadence: commit-to-commit, the number pipelining
@@ -379,6 +386,9 @@ class PipelinedExecutor:
         sched.history.append(stats)
         sched._record_metrics(stats, action_ms, action_rounds)
         sched.last_cycle_ts = time.time()
+        # decision audit: `result` carries the POST-revalidation actuated
+        # bind/evict sets, so the record reconciles with the apiserver
+        sched._audit_cycle(ep.seq, ep.corr, ep.ts, result)
         sched._flight_success(
             ep.seq, ep.corr, ep.ts, stats, result,
             discards=step_discard_counts,

@@ -21,6 +21,7 @@ import time
 from concurrent import futures
 from typing import Dict, Optional, Tuple
 
+from ..utils.audit import record_eviction_attribution
 from ..utils.metrics import metrics, record_kernel_rounds
 from ..utils.tracing import tracer
 from . import decision_pb2 as pb
@@ -143,6 +144,11 @@ class DecisionService:
         record_kernel_rounds(
             m, getattr(decider, "last_action_rounds", None)
         )
+        # decision-audit attribution rides the reply pack (CycleDecisions
+        # aux fields serialize by name); the sidecar also owns the
+        # eviction-attribution metric for its replicas, since it serves
+        # decisions it never actuates
+        record_eviction_attribution(m, dec)
         m.counter_add("rpc_cycles_served_total")
         # the blocking decide above MUST stay outside this lock
         # (KAT-LCK-002: a wedged device would stall every handler)

@@ -1,0 +1,545 @@
+"""Decision audit & fairness accounting plane: who won, who lost, and why.
+
+The runtime-observability planes (tracing, flight recorder, profiling)
+answer *how long* a cycle took and *where* the time went; this plane
+answers what the cycle **decided** — the channel kube-batch exposes
+through events and pod conditions (``record_event``,
+``PodScheduled=False``) and that Gavel (arxiv 2008.09213) argues is the
+precondition for trusting any fairness policy: realized shares must be
+continuously accounted against entitlements, or "fair" is just a word in
+the config.
+
+Per committed cycle one :class:`AuditRecord` (stable, versioned schema)
+collects:
+
+* **binds** — every actuated placement: task, node, job, queue, and the
+  action that granted it (``allocate`` vs ``backfill``, derived from the
+  group's best-effort class; the deferred [G, N]-count decode erases
+  per-round placement attribution by design, so bind rows carry
+  ``round: -1`` — eviction edges carry exact rounds instead).
+* **evictions** — the preemptor→victim edges threaded through
+  ``AllocState`` by the eviction kernels (ops/preempt.py): victim task/
+  job/queue/node, claimant job/queue, the kernel phase that took the
+  victim (``preempt`` inter/intra, ``reclaim``), the round of that phase,
+  and whether the edge committed (a preemption whose claimant never
+  reached gang-ready keeps its edge with ``committed: false`` — the
+  audit plane explains discards, not just actuations).
+* **fairness ledger** — per queue: proportion's water-filled deserved vs
+  the end-of-cycle allocation (both ride ``CycleDecisions`` as audit
+  aux), dominant shares against the cluster fair total, the over/under-
+  entitlement delta, pending backlog, and the starvation clock.
+* **gang verdicts** — which gangs closed the cycle admitted (ready) vs
+  rejected, with the rejected list bounded.
+
+Records land in a bounded ring (served at ``/debug/audit`` and joinable
+with the trace/flight planes by corr-id at ``/debug/audit/<corr>``) and,
+optionally, an append-only JSONL audit log.  The kernels always compute
+the attribution aux (it is decision-neutral and rides the reply pack
+across the RPC boundary); this module's host-side record assembly is the
+only thing the audit switch toggles, which is what makes the audit-on ==
+audit-off decision parity trivial to hold and cheap to test.
+
+Thread-safety: the ring and the starvation state take one lock; file I/O
+happens outside it (KAT-LCK discipline, same as the flight recorder).
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Callable, Deque, Dict, List, Optional
+
+import numpy as np
+
+from .metrics import MetricsRegistry, metrics
+
+#: Bump when a field of the serialized record changes meaning or type.
+AUDIT_SCHEMA_VERSION = 1
+
+#: phase code (ops/allocate.EVICT_PHASE_*) -> (action, phase) labels.
+EVICT_PHASES: Dict[int, tuple] = {
+    1: ("preempt", "inter"),
+    2: ("preempt", "intra"),
+    3: ("reclaim", "reclaim"),
+}
+
+#: Per-queue gauge families are bounded: at most this many queues (ranked
+#: by |entitlement delta|, under-served first on ties) get
+#: ``fairness_share`` / ``queue_starvation_seconds`` series per process.
+AUDIT_METRIC_QUEUES = 64
+
+#: Rejected-gang rows kept per record (the admitted side is a count).
+MAX_GANG_ROWS = 200
+
+
+def _fair_dims() -> int:
+    from ..api.resource import NUM_FAIR_RESOURCES
+
+    return NUM_FAIR_RESOURCES
+
+
+def _task_uid(index, i: int) -> str:
+    if hasattr(index, "tasks"):
+        return index.tasks[i].uid
+    return index.task_uid(i)
+
+
+def _node_name(index, n: int) -> str:
+    if hasattr(index, "nodes"):
+        return index.nodes[n].name if 0 <= n < len(index.nodes) else str(n)
+    return index.node_name(n)
+
+
+def _queue_names(snap) -> List[str]:
+    queues = getattr(snap.index, "queues", None)
+    if queues is not None:
+        return [getattr(q, "name", "") or q.uid for q in queues]
+    return [f"q{i}" for i in range(int(snap.tensors.num_queues))]
+
+
+def _job_uids(snap) -> List[str]:
+    jobs = getattr(snap.index, "jobs", None)
+    if jobs is not None:
+        return [j.uid for j in jobs]
+    return [f"job{i}" for i in range(int(snap.tensors.num_jobs))]
+
+
+def _dominant_share(x: np.ndarray, total: np.ndarray) -> np.ndarray:
+    """max over fair dims of x/total (total<=0 dims excluded); x [Q, F]."""
+    with np.errstate(divide="ignore", invalid="ignore"):
+        s = np.where(total[None, :] > 0, x / np.maximum(total[None, :], 1e-30), 0.0)
+    return s.max(axis=1) if s.shape[1] else np.zeros(x.shape[0])
+
+
+def _pending_per_queue(snap) -> np.ndarray:
+    from ..api.types import TaskStatus
+
+    t = snap.tensors
+    n_real = len(getattr(snap.index, "tasks", [])) or int(t.num_tasks)
+    ts = np.asarray(t.task_status)[:n_real]
+    tj = np.asarray(t.task_job)[:n_real]
+    tq = np.asarray(t.job_queue)[tj]
+    pending = ts == int(TaskStatus.PENDING)
+    return np.bincount(tq[pending], minlength=int(t.num_queues))
+
+
+# ---------------------------------------------------------------------------
+# record assembly (pure functions of (snapshot, decisions, actuated sets))
+
+
+def bind_rows(snap, dec, actuated: Optional[set] = None) -> List[dict]:
+    """One row per committed bind.  ``actuated`` (uids) marks which rows
+    the actuation step really applied — under the pipelined executor the
+    revalidation gate may discard decoded binds, and backends divert
+    failed binds to the errTasks resync FIFO; both keep their row with
+    ``actuated: false`` so the audit trail reconciles against
+    ACTUATIONS (the chaos invariant's contract) while still explaining
+    what was decided."""
+    t = snap.tensors
+    index = snap.index
+    idx = np.nonzero(np.asarray(dec.bind_mask))[0]
+    if not len(idx):
+        return []
+    # batched gathers + one .tolist() per column: the mass-bind cycle of
+    # a 50k world produces thousands of rows, and per-row numpy scalar
+    # conversion is the dominant assembly cost
+    task_job = np.asarray(t.task_job)[idx]
+    jobs = task_job.tolist()
+    queues = np.asarray(t.job_queue)[task_job].tolist()
+    nodes = np.asarray(dec.task_node)[idx].tolist()
+    groups = np.asarray(t.task_group)[idx]
+    be = np.asarray(t.group_best_effort)[np.clip(groups, 0, None)]
+    backfill = ((groups >= 0) & be).tolist()
+    qnames = _queue_names(snap)
+    juids = _job_uids(snap)
+    rows: List[dict] = []
+    for k, i in enumerate(idx.tolist()):
+        uid = _task_uid(index, i)
+        rows.append({
+            "task": uid,
+            "node": _node_name(index, nodes[k]),
+            "job": juids[jobs[k]],
+            "queue": qnames[queues[k]],
+            "action": "backfill" if backfill[k] else "allocate",
+            # the deferred decode maps group ranks to nodes at action end,
+            # erasing per-round placement attribution; eviction edges
+            # carry exact rounds (see module docstring)
+            "round": -1,
+            "actuated": (uid in actuated) if actuated is not None else True,
+        })
+    return rows
+
+
+def eviction_edges(snap, dec, actuated: Optional[set] = None) -> List[dict]:
+    """Preemptor→victim edges, committed AND discarded (see module
+    docstring); ``actuated`` (uids) marks which committed edges the
+    actuation step really applied."""
+    t = snap.tensors
+    index = snap.index
+    claimant = np.asarray(dec.evict_claimant)
+    idx = np.nonzero(claimant >= 0)[0]
+    if not len(idx):
+        return []
+    cj = claimant[idx]
+    job_queue = np.asarray(t.job_queue)
+    vjob = np.asarray(t.task_job)[idx]
+    vjobs = vjob.tolist()
+    vqueues = job_queue[vjob].tolist()
+    vnodes = np.asarray(t.task_node)[idx].tolist()  # victims keep their node
+    cjobs = cj.tolist()
+    cqueues = job_queue[cj].tolist()
+    phases = np.asarray(dec.evict_phase)[idx].tolist()
+    rounds = np.asarray(dec.evict_round)[idx].tolist()
+    committed = np.asarray(dec.evict_mask)[idx].tolist()
+    qnames = _queue_names(snap)
+    juids = _job_uids(snap)
+    edges: List[dict] = []
+    for k, i in enumerate(idx.tolist()):
+        uid = _task_uid(index, i)
+        action, ph = EVICT_PHASES.get(phases[k], ("?", str(phases[k])))
+        edges.append({
+            "victim": uid,
+            "victim_job": juids[vjobs[k]],
+            "victim_queue": qnames[vqueues[k]],
+            "node": _node_name(index, vnodes[k]),
+            "claimant_job": juids[cjobs[k]],
+            "claimant_queue": qnames[cqueues[k]],
+            "action": action,
+            "phase": ph,
+            "round": rounds[k],
+            "committed": committed[k],
+            "actuated": (uid in actuated) if actuated is not None else committed[k],
+        })
+    return edges
+
+
+def fairness_ledger(snap, dec) -> List[dict]:
+    """Per-queue entitlement accounting rows (valid queues only).  A
+    deserved entry past the BIG sentinel (proportion plugin disabled)
+    reads as "uncapped": its share reports 1.0 — entitled to everything —
+    so the delta can only show over-use, never phantom starvation."""
+    from ..api.resource import RESOURCE_NAMES
+
+    t = snap.tensors
+    F = _fair_dims()
+    des = np.asarray(dec.queue_deserved)[:, :F].astype(float)
+    alloc = np.asarray(dec.queue_alloc)[:, :F].astype(float)
+    qvalid = np.asarray(t.queue_valid)
+    node_alloc = np.asarray(t.node_alloc)[:, :F].astype(float)
+    node_valid = np.asarray(t.node_valid)
+    total = node_alloc[node_valid].sum(axis=0) if node_valid.any() else np.zeros(F)
+    uncapped = des > 1e30
+    share_des = np.where(
+        uncapped.any(axis=1), 1.0,
+        _dominant_share(np.where(uncapped, 0.0, des), total),
+    )
+    share_alloc = _dominant_share(alloc, total)
+    pending = _pending_per_queue(snap)
+    qnames = _queue_names(snap)
+    dom = (
+        np.argmax(
+            np.where(total[None, :] > 0, alloc / np.maximum(total[None, :], 1e-30), 0.0),
+            axis=1,
+        )
+        if F
+        else np.zeros(len(qnames), int)
+    )
+    rows: List[dict] = []
+    for q in np.nonzero(qvalid)[0]:
+        if q >= len(qnames):
+            break
+        rows.append({
+            "queue": qnames[q],
+            "deserved": [round(float(x), 3) for x in des[q]],
+            "allocated": [round(float(x), 3) for x in alloc[q]],
+            "share_deserved": round(float(share_des[q]), 6),
+            "share_allocated": round(float(share_alloc[q]), 6),
+            # > 0: over its entitlement; < 0: under (the starvation side)
+            "delta": round(float(share_alloc[q] - share_des[q]), 6),
+            "dominant": RESOURCE_NAMES[int(dom[q])] if F else "",
+            "pending": int(pending[q]) if q < len(pending) else 0,
+            "starvation_s": 0.0,  # filled by AuditLog's progress clock
+        })
+    return rows
+
+
+def gang_verdicts(snap, dec) -> dict:
+    """Gang admission outcome: counts + the bounded rejected list."""
+    job_ready = np.asarray(dec.job_ready)
+    jobs = getattr(snap.index, "jobs", None)
+    out = {"admitted": 0, "rejected": 0, "rejected_jobs": []}
+    if jobs is None:
+        return out
+    qnames = _queue_names(snap)
+    job_queue = np.asarray(snap.tensors.job_queue)
+    for job in jobs:
+        if job.min_available <= 0:
+            continue
+        if job_ready[job.ordinal]:
+            out["admitted"] += 1
+            continue
+        out["rejected"] += 1
+        if len(out["rejected_jobs"]) < MAX_GANG_ROWS:
+            out["rejected_jobs"].append({
+                "job": job.uid,
+                "queue": qnames[int(job_queue[job.ordinal])],
+                "min_available": int(job.min_available),
+            })
+    return out
+
+
+def evict_edge_counts(dec) -> Dict[str, int]:
+    """Compact ``"<action>:<phase>" -> count`` histogram for flight
+    digests — one bincount, no uid decode."""
+    phase = np.asarray(dec.evict_phase)
+    counts = np.bincount(phase[phase > 0], minlength=4) if (phase > 0).any() else None
+    if counts is None:
+        return {}
+    out: Dict[str, int] = {}
+    for code, (action, ph) in EVICT_PHASES.items():
+        if code < len(counts) and counts[code]:
+            out[f"{action}:{ph}"] = int(counts[code])
+    return out
+
+
+def fairness_top_of(rows: List[dict], k: int = 5) -> List[dict]:
+    """Top-``k`` of already-assembled ledger rows by |entitlement delta|
+    (compact digest form) — the scheduler's flight digest reuses the
+    audit record's rows through this instead of recomputing the
+    ledger."""
+    ranked = sorted(rows, key=lambda r: (-abs(r["delta"]), r["delta"], r["queue"]))
+    keep = ("queue", "share_deserved", "share_allocated", "delta",
+            "pending", "starvation_s")
+    return [{k2: r[k2] for k2 in keep if k2 in r} for r in ranked[:k]]
+
+
+def fairness_top(snap, dec, k: int = 5) -> List[dict]:
+    """Top-``k`` ledger rows by |entitlement delta|, computed fresh from
+    (snapshot, decisions) — see :func:`fairness_top_of` for the
+    reuse-an-existing-record form."""
+    return fairness_top_of(fairness_ledger(snap, dec), k)
+
+
+@dataclasses.dataclass
+class AuditRecord:
+    """One cycle's decision audit, JSON-ready and versioned."""
+
+    seq: int
+    corr_id: str
+    ts: float
+    binds: List[dict] = dataclasses.field(default_factory=list)
+    evictions: List[dict] = dataclasses.field(default_factory=list)
+    fairness: List[dict] = dataclasses.field(default_factory=list)
+    gangs: dict = dataclasses.field(default_factory=dict)
+    version: int = AUDIT_SCHEMA_VERSION
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def build_audit_record(seq: int, corr: Optional[str], ts: float, result) -> AuditRecord:
+    """Assemble the record from a completed :class:`CycleResult`.  The
+    actuated sets come from ``result.binds``/``result.evicts`` — under
+    the pipelined executor those are the POST-revalidation subsets, so
+    the record reconciles with what actually hit the apiserver."""
+    snap, dec = result.snapshot, result.decisions
+    failed = getattr(result, "failed_actuations", None) or set()
+    actuated_binds = {b.task_uid for b in result.binds} - failed
+    actuated_evicts = {e.task_uid for e in result.evicts} - failed
+    return AuditRecord(
+        seq=seq,
+        corr_id=corr or "",
+        ts=ts,
+        binds=bind_rows(snap, dec, actuated=actuated_binds),
+        evictions=eviction_edges(snap, dec, actuated=actuated_evicts),
+        fairness=fairness_ledger(snap, dec),
+        gangs=gang_verdicts(snap, dec),
+    )
+
+
+def record_eviction_attribution(registry: MetricsRegistry, dec) -> None:
+    """Emit ``evictions_attributed_total{action, phase}`` from one
+    cycle's decisions — ONE definition shared by the AuditLog and the
+    RPC sidecar (which serves decisions it never actuates but still owns
+    the attribution metric for its replicas)."""
+    for key, n in evict_edge_counts(dec).items():
+        action, _, ph = key.partition(":")
+        registry.counter_add(
+            "evictions_attributed_total", n,
+            labels={"action": action, "phase": ph},
+        )
+
+
+class AuditLog:
+    """Bounded ring of :class:`AuditRecord` + optional JSONL append log +
+    the fairness/starvation metric emitter.
+
+    ``log_path`` appends one JSON line per record (write outside the
+    lock).  ``flight`` + ``starvation_slo_s`` arm the ``starvation``
+    flight anomaly: fired once per episode when a pending, under-entitled
+    queue has gone longer than the SLO without a single placement or
+    eviction claim, re-armed when the queue makes progress.
+    ``drop_first_edge`` is the chaos plane's sensitivity seam: it drops
+    the first bind row of every non-empty record, so the
+    ``audit_consistency`` invariant must breach — proof the reconciler
+    actually compares edges (a checker that passes mutated records is
+    blind)."""
+
+    def __init__(
+        self,
+        capacity: int = 256,
+        log_path: Optional[str] = None,
+        registry: Optional[MetricsRegistry] = None,
+        flight=None,
+        starvation_slo_s: Optional[float] = None,
+        now_fn: Optional[Callable[[], float]] = None,
+        metric_queues: int = AUDIT_METRIC_QUEUES,
+    ):
+        self.capacity = capacity
+        self.log_path = log_path
+        self.registry = registry
+        self.flight = flight
+        self.starvation_slo_s = starvation_slo_s
+        self.now = now_fn or time.time
+        self.metric_queues = metric_queues
+        self.drop_first_edge = False
+        self._lock = threading.Lock()
+        self._ring: Deque[AuditRecord] = collections.deque(maxlen=capacity)
+        self._last_progress: Dict[str, float] = {}
+        self._starving: set = set()
+        if log_path:
+            d = os.path.dirname(log_path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+
+    # ---- recording ----
+
+    def observe_cycle(self, seq: int, corr: Optional[str], ts: float, result) -> AuditRecord:
+        """Build, account, and store one committed cycle's record."""
+        rec = build_audit_record(seq, corr, ts, result)
+        if self.drop_first_edge:
+            # the mutation must hit an ACTUATED row, or the reconciler
+            # legitimately would not notice the drop
+            for k, row in enumerate(rec.binds):
+                if row["actuated"]:
+                    del rec.binds[k]
+                    break
+        progressed = {r["queue"] for r in rec.binds if r["actuated"]}
+        progressed |= {e["claimant_queue"] for e in rec.evictions if e["actuated"]}
+        anomalies: List[str] = []
+        # the starvation clock runs on the injectable now_fn (chaos runs
+        # pass the VirtualClock), independent of the record's wall ts
+        now = self.now()
+        with self._lock:
+            for row in rec.fairness:
+                q = row["queue"]
+                if row["pending"] <= 0 or q in progressed:
+                    self._last_progress[q] = now
+                    self._starving.discard(q)
+                    continue
+                since = self._last_progress.setdefault(q, now)
+                starv = max(now - since, 0.0)
+                # the starvation clock runs only while the queue is UNDER
+                # its entitlement — a backlogged-but-over-served queue is
+                # queuing, not starving (Gavel's distinction)
+                if row["delta"] < 0:
+                    row["starvation_s"] = round(starv, 3)
+                    if (
+                        self.starvation_slo_s is not None
+                        and starv > self.starvation_slo_s
+                        and q not in self._starving
+                    ):
+                        self._starving.add(q)
+                        anomalies.append(
+                            f"queue {q} starving: {starv:.1f}s without progress "
+                            f"(share {row['share_allocated']:.3f} < deserved "
+                            f"{row['share_deserved']:.3f}, "
+                            f"{row['pending']} pending)"
+                        )
+            self._ring.append(rec)
+        self._emit_metrics(rec)
+        if self.flight is not None:
+            for detail in anomalies:
+                self.flight.anomaly("starvation", detail=detail)
+        if self.log_path:
+            # an audit-log sink error must never fail a scheduling cycle
+            # that already actuated: log once per episode and keep going
+            # (the in-memory ring and metrics still record the cycle)
+            try:
+                with open(self.log_path, "a") as f:
+                    f.write(json.dumps(rec.to_dict(), sort_keys=True) + "\n")
+                self._log_broken = False
+            except OSError as err:
+                m = self.registry if self.registry is not None else metrics()
+                m.counter_add("audit_log_write_errors_total")
+                if not getattr(self, "_log_broken", False):
+                    self._log_broken = True
+                    import sys
+
+                    print(
+                        f"# kat: audit log {self.log_path} unwritable "
+                        f"({err}); records continue in the ring only",
+                        file=sys.stderr,
+                    )
+        return rec
+
+    def _emit_metrics(self, rec: AuditRecord) -> None:
+        m = self.registry if self.registry is not None else metrics()
+        m.counter_add("audit_records_total")
+        record_eviction_attribution(
+            m,
+            _DecLike(rec),
+        )
+        rows = sorted(
+            rec.fairness, key=lambda r: (-abs(r["delta"]), r["delta"], r["queue"])
+        )[: self.metric_queues]
+        for row in rows:
+            m.gauge_set(
+                "fairness_share", row["share_deserved"],
+                labels={"queue": row["queue"], "kind": "deserved"},
+            )
+            m.gauge_set(
+                "fairness_share", row["share_allocated"],
+                labels={"queue": row["queue"], "kind": "allocated"},
+            )
+            m.gauge_set(
+                "queue_starvation_seconds", row["starvation_s"],
+                labels={"queue": row["queue"]},
+            )
+
+    # ---- reading (obs server) ----
+
+    def entries(self, n: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            snapshot = list(self._ring)
+        if n is not None:
+            # n <= 0 means "none", not the whole ring ([-0:] == all)
+            snapshot = snapshot[-n:] if n > 0 else []
+        return [r.to_dict() for r in snapshot]
+
+    def by_corr(self, corr: str) -> Optional[dict]:
+        with self._lock:
+            for rec in reversed(self._ring):
+                if rec.corr_id == corr:
+                    return rec.to_dict()
+        return None
+
+    def last(self) -> Optional[AuditRecord]:
+        with self._lock:
+            return self._ring[-1] if self._ring else None
+
+
+class _DecLike:
+    """Adapter: re-derive the attribution histogram from an assembled
+    record (so metric emission counts exactly the record's edges — the
+    dropped-edge mutation seam must show up in the metric too)."""
+
+    def __init__(self, rec: AuditRecord):
+        codes = {v: k for k, v in EVICT_PHASES.items()}
+        phases = [
+            codes.get((e["action"], e["phase"]), 0) for e in rec.evictions
+        ]
+        self.evict_phase = np.asarray(phases or [0])

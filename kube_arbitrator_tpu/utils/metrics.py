@@ -78,6 +78,13 @@ METRIC_HELP: Dict[str, str] = {
     "xla_compile_seconds": "XLA backend compile durations observed at runtime.",
     "slo_burn_rate": "Cycle-SLO error-budget burn rate per long window (window label; 1.0 = burning exactly the budget).",
     "slo_burn_alerts_total": "Multi-window SLO burn alerts fired (window label; one per episode).",
+    # decision audit & fairness accounting plane (utils/audit.py)
+    "audit_records_total": "Decision audit records assembled (one per committed cycle with auditing on).",
+    "audit_log_write_errors_total": "Audit JSONL append failures (records continue in the in-memory ring).",
+    "fairness_share": "Per-queue dominant fair share (queue + kind label: deserved = proportion water-fill entitlement, allocated = realized).",
+    "queue_starvation_seconds": "Seconds a pending, under-entitled queue has gone without a placement or eviction claim (queue label; 0 when progressing).",
+    "evictions_attributed_total": "Eviction edges attributed by the decision audit plane (action + phase label: preempt inter/intra, reclaim).",
+    "pending_reason_total": "Unschedulable pending pods by dominant FitError reason at cycle close (reason label).",
     # observability server
     "obs_requests_total": "Observability-plane HTTP requests served (path label).",
 }

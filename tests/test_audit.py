@@ -1,0 +1,378 @@
+"""Decision audit & fairness accounting plane (utils/audit.py).
+
+Covers the acceptance bar of the audit PR:
+
+* a directed two-queue preemption (cross-queue reclaim) scenario pinned
+  to its EXACT preemptor→victim edge set — claimant, victim, phase,
+  round;
+* audit-on vs audit-off decision parity over full-action worlds (3
+  seeds): bit-identical decision tensors, identical actuated streams,
+  and ZERO added retraces (the kernels always compute the attribution
+  aux; the audit switch is host-side only);
+* the fairness ledger's entitlement math; starvation clock + the
+  ``starvation`` flight anomaly (hysteresis);
+* AuditLog mechanics: ring bound, JSONL append log, corr-id join,
+  schema version, the dropped-edge mutation seam;
+* the served ``/debug/audit`` routes and promtext conformance of the
+  new metric families;
+* flight digests carrying eviction-edge counts + top-K fairness rows.
+"""
+import dataclasses
+import json
+import types
+import urllib.request
+
+import numpy as np
+
+from kube_arbitrator_tpu.api import TaskStatus
+from kube_arbitrator_tpu.cache import SimCluster, build_snapshot, generate_cluster
+from kube_arbitrator_tpu.cache.decode import decode_decisions
+from kube_arbitrator_tpu.framework import Scheduler
+from kube_arbitrator_tpu.framework.conf import load_conf
+from kube_arbitrator_tpu.ops import schedule_cycle
+from kube_arbitrator_tpu.utils.audit import (
+    AUDIT_SCHEMA_VERSION,
+    AuditLog,
+    build_audit_record,
+    evict_edge_counts,
+    eviction_edges,
+    fairness_ledger,
+    fairness_top,
+)
+from kube_arbitrator_tpu.utils.metrics import MetricsRegistry
+
+GB = 1024**3
+FULL_CONF = load_conf('actions: "reclaim, allocate, backfill, preempt"\n')
+
+
+def _result_of(snap, dec):
+    """Minimal CycleResult stand-in for the record builders: decoded
+    intents ARE the actuated sets on the sequential path."""
+    binds, evicts = decode_decisions(snap, dec)
+    return types.SimpleNamespace(
+        snapshot=snap, decisions=dec, binds=binds, evicts=evicts
+    )
+
+
+def _two_queue_reclaim_world():
+    """qb and qc both reclaim from qa's only node (the same directed
+    world the batched-turn parity suite pins against the oracle)."""
+    sim = SimCluster()
+    sim.add_queue("qa", weight=1)
+    sim.add_queue("qb", weight=1)
+    sim.add_queue("qc", weight=1)
+    sim.add_node("n1", cpu_milli=4000, memory=8 * GB)
+    ja = sim.add_job("a", queue="qa", creation_ts=1)
+    for i in range(4):
+        sim.add_task(ja, 1000, GB, status=TaskStatus.RUNNING, node="n1",
+                     name=f"a-r{i}", priority=i)
+    jb = sim.add_job("b", queue="qb", min_available=1, creation_ts=2)
+    sim.add_task(jb, 1000, GB, name="b-p0")
+    jc = sim.add_job("c", queue="qc", min_available=1, creation_ts=3)
+    sim.add_task(jc, 1000, GB, name="c-p0")
+    return sim
+
+
+def test_two_queue_preemption_exact_edge_set():
+    """The known two-queue scenario decodes to its EXACT preemptor→victim
+    edge set: each claimant queue takes one distinct victim of qa, in the
+    deterministic (queue, job, priority, uid) victim order, both claims in
+    round 0 of the reclaim phase."""
+    sim = _two_queue_reclaim_world()
+    snap = build_snapshot(sim.cluster)
+    dec = schedule_cycle(snap.tensors, actions=("reclaim",))
+    edges = eviction_edges(snap, dec)
+    got = {
+        (e["claimant_job"], e["victim"], e["action"], e["phase"], e["round"])
+        for e in edges
+    }
+    # qb pops first (queue uid order), takes the lowest-(priority, uid)
+    # victim; qc's turn takes the next — exact, not just count-2
+    assert got == {
+        ("b", "a-r0", "reclaim", "reclaim", 0),
+        ("c", "a-r1", "reclaim", "reclaim", 0),
+    }, got
+    for e in edges:
+        assert e["victim_job"] == "a" and e["victim_queue"] == "qa"
+        assert e["node"] == "n1"
+        assert e["committed"] and e["actuated"]
+    assert evict_edge_counts(dec) == {"reclaim:reclaim": 2}
+
+
+def test_same_queue_preempt_edges_carry_phase_and_claimant():
+    """Preempt phase 1 (inter-job, same queue): the pending gang's edges
+    name it as claimant with action=preempt/phase=inter, and the
+    evicted_for conditional-commit channel agrees with the edge set."""
+    sim = SimCluster()
+    sim.add_queue("q", weight=1)
+    sim.add_node("n1", cpu_milli=4000, memory=8 * GB)
+    low = sim.add_job("low", queue="q", creation_ts=1)
+    for i in range(4):
+        sim.add_task(low, 1000, GB, status=TaskStatus.RUNNING, node="n1",
+                     name=f"low-r{i}", priority=0)
+    high = sim.add_job("high", queue="q", min_available=2, creation_ts=2)
+    for i in range(2):
+        sim.add_task(high, 1000, GB, name=f"high-p{i}", priority=2)
+    snap = build_snapshot(sim.cluster)
+    dec = schedule_cycle(snap.tensors, actions=("preempt",))
+    edges = eviction_edges(snap, dec)
+    got = {
+        (e["claimant_job"], e["victim"], e["action"], e["phase"], e["round"])
+        for e in edges
+    }
+    # the gang needs exactly 2 slots; victims fall in (priority, uid)
+    # order within the node, both in round 0 of the inter-job phase
+    assert got == {
+        ("high", "low-r0", "preempt", "inter", 0),
+        ("high", "low-r1", "preempt", "inter", 0),
+    }, got
+    assert all(
+        e["victim_job"] == "low" and e["committed"] and e["actuated"]
+        for e in edges
+    )
+    assert evict_edge_counts(dec) == {"preempt:inter": 2}
+
+
+def test_audit_on_off_decision_parity_and_zero_retraces():
+    """Audit on vs off over full-action worlds: identical actuated
+    streams cycle-for-cycle and ZERO retraces in the audited run once the
+    unaudited run warmed the compile caches (3 seeds — the kernel aux is
+    always computed, so nothing about the programs differs)."""
+    from kube_arbitrator_tpu.utils.profiling import RetraceCounter
+
+    for seed in (0, 1, 2):
+        def world():
+            return generate_cluster(
+                num_nodes=24, num_jobs=10, tasks_per_job=4, num_queues=4,
+                seed=seed, node_cpu_milli=4000, node_memory=8 * GB,
+                running_fraction=0.4,
+            )
+
+        streams = {}
+        for audited in (False, True):
+            sim = world()
+            audit = AuditLog(capacity=16) if audited else None
+            sched = Scheduler(sim, config=FULL_CONF, audit=audit)
+            stream = []
+            with RetraceCounter() as rc:
+                for _ in range(3):
+                    res = sched.run_once()
+                    stream.append((
+                        sorted(b.task_uid for b in res.binds),
+                        sorted(e.task_uid for e in res.evicts),
+                    ))
+            streams[audited] = stream
+            if audited:
+                assert rc.count == 0, (
+                    f"audit-on run retraced {rc.count}x (seed {seed})"
+                )
+                assert len(audit.entries()) == 3
+        assert streams[True] == streams[False], f"seed {seed} diverged"
+
+
+def test_fairness_ledger_entitlement_math():
+    """One queue hogging the cluster, one pending: the hog reads over (or
+    at) its entitlement, the pending queue under, with deserved following
+    the proportion water-fill."""
+    sim = SimCluster()
+    sim.add_queue("hog", weight=1)
+    sim.add_queue("starved", weight=1)
+    sim.add_node("n1", cpu_milli=4000, memory=8 * GB)
+    jh = sim.add_job("h", queue="hog", creation_ts=1)
+    for i in range(4):
+        sim.add_task(jh, 1000, 512 * 1024**2, status=TaskStatus.RUNNING,
+                     node="n1", name=f"h-r{i}")
+    js = sim.add_job("s", queue="starved", min_available=1, creation_ts=2)
+    sim.add_task(js, 2000, GB, name="s-p0")
+    snap = build_snapshot(sim.cluster)
+    dec = schedule_cycle(snap.tensors)  # allocate/backfill only: no evict
+    rows = {r["queue"]: r for r in fairness_ledger(snap, dec)}
+    hog, starved = rows["hog"], rows["starved"]
+    # the hog holds the whole node's cpu; water-fill grants each queue
+    # its request-capped share, so the hog is at/over entitlement
+    assert hog["share_allocated"] >= hog["share_deserved"] - 1e-6
+    assert hog["delta"] >= -1e-6
+    # the starved queue deserves a share but holds nothing
+    assert starved["share_allocated"] == 0.0
+    assert starved["share_deserved"] > 0.0
+    assert starved["delta"] < 0.0
+    assert starved["pending"] == 1
+    top = fairness_top(snap, dec, k=1)
+    assert top[0]["queue"] == "starved"  # largest |delta|
+
+
+def test_starvation_clock_and_flight_anomaly():
+    """A pending, under-entitled queue accrues starvation seconds on the
+    injectable clock; past the SLO the ``starvation`` flight anomaly
+    fires ONCE per episode (hysteresis) and the gauge is exported."""
+    from kube_arbitrator_tpu.utils.flightrec import FlightRecorder
+
+    sim = SimCluster()
+    sim.add_queue("hog", weight=1)
+    sim.add_queue("starved", weight=1)
+    sim.add_node("n1", cpu_milli=4000, memory=8 * GB)
+    jh = sim.add_job("h", queue="hog", creation_ts=1)
+    for i in range(4):
+        sim.add_task(jh, 1000, 512 * 1024**2, status=TaskStatus.RUNNING,
+                     node="n1", name=f"h-r{i}")
+    js = sim.add_job("s", queue="starved", min_available=1, creation_ts=2)
+    sim.add_task(js, 2000, GB, name="s-p0")  # can never fit: cpu > node
+    snap = build_snapshot(sim.cluster)
+    dec = schedule_cycle(snap.tensors)
+    result = _result_of(snap, dec)
+
+    clock = {"t": 100.0}
+    registry = MetricsRegistry()
+    flight = FlightRecorder(capacity=4)
+    audit = AuditLog(
+        capacity=8, registry=registry, flight=flight, starvation_slo_s=5.0,
+        now_fn=lambda: clock["t"],
+    )
+    anomalies = []
+    flight.anomaly = lambda kind, detail="": anomalies.append((kind, detail))
+    for step in range(4):
+        rec = audit.observe_cycle(step, f"c{step}", clock["t"], result)
+        clock["t"] += 4.0
+    starv = {r["queue"]: r["starvation_s"] for r in rec.fairness}
+    assert starv["starved"] == 12.0  # 3 barren cycles x 4 s
+    kinds = [k for k, _ in anomalies]
+    assert kinds.count("starvation") == 1, anomalies  # hysteresis: one episode
+    assert "starved" in anomalies[0][1]
+    g = registry.gauge_value(
+        "queue_starvation_seconds", labels={"queue": "starved"}
+    )
+    assert g == 12.0
+    # entitlement gauges exported for both kinds
+    assert registry.gauge_value(
+        "fairness_share", labels={"queue": "starved", "kind": "deserved"}
+    ) > 0.0
+    assert registry.gauge_value(
+        "fairness_share", labels={"queue": "starved", "kind": "allocated"}
+    ) == 0.0
+
+
+def test_audit_log_ring_jsonl_corr_join_and_drop_seam(tmp_path):
+    sim = _two_queue_reclaim_world()
+    snap = build_snapshot(sim.cluster)
+    dec = schedule_cycle(snap.tensors, actions=("reclaim",))
+    result = _result_of(snap, dec)
+    path = tmp_path / "audit.jsonl"
+    audit = AuditLog(capacity=2, log_path=str(path), registry=MetricsRegistry())
+    for i in range(3):
+        audit.observe_cycle(i + 1, f"corr-{i + 1}", 1000.0 + i, result)
+    # ring bounded at 2, JSONL append-only keeps all 3
+    assert [r["seq"] for r in audit.entries()] == [2, 3]
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [r["seq"] for r in lines] == [1, 2, 3]
+    assert all(r["version"] == AUDIT_SCHEMA_VERSION for r in lines)
+    rec = audit.by_corr("corr-2")
+    assert rec is not None and rec["seq"] == 2
+    assert audit.by_corr("corr-1") is None  # rolled out of the ring
+    assert len(rec["evictions"]) == 2 and rec["gangs"]["admitted"] == 2
+    # the chaos sensitivity seam drops exactly one bind row (needs a
+    # world that BINDS: a fitting pending job under the default actions)
+    sim2 = SimCluster()
+    sim2.add_queue("q")
+    sim2.add_node("n1", cpu_milli=4000, memory=8 * GB)
+    j = sim2.add_job("j", queue="q", min_available=1)
+    for i in range(2):
+        sim2.add_task(j, 1000, GB, name=f"j-p{i}")
+    snap2 = build_snapshot(sim2.cluster)
+    result2 = _result_of(snap2, schedule_cycle(snap2.tensors))
+    full = build_audit_record(9, "x", 0.0, result2)
+    assert len(full.binds) == 2
+    audit.drop_first_edge = True
+    mutated = audit.observe_cycle(9, "x", 0.0, result2)
+    assert len(mutated.binds) == len(full.binds) - 1
+
+
+def test_debug_audit_routes_and_promtext(tmp_path):
+    from kube_arbitrator_tpu.obs import serve_obs
+    from kube_arbitrator_tpu.utils.metrics import metrics
+    from tests.test_obs import check_promtext
+
+    sim = _two_queue_reclaim_world()
+    snap = build_snapshot(sim.cluster)
+    dec = schedule_cycle(snap.tensors, actions=("reclaim",))
+    audit = AuditLog(capacity=4)  # process-wide registry: families served
+    audit.observe_cycle(1, "corr-a", 1.0, _result_of(snap, dec))
+    server, _t, url = serve_obs(audit=audit)
+    try:
+        body = json.load(urllib.request.urlopen(url + "/debug/audit", timeout=10))
+        assert body["schema_version"] == AUDIT_SCHEMA_VERSION
+        assert len(body["records"]) == 1
+        assert body["records"][0]["evictions"]
+        one = json.load(
+            urllib.request.urlopen(url + "/debug/audit/corr-a", timeout=10)
+        )
+        assert one["seq"] == 1
+        try:
+            urllib.request.urlopen(url + "/debug/audit/nope", timeout=10)
+            assert False, "unknown corr must 404"
+        except urllib.error.HTTPError as err:
+            assert err.code == 404
+        text = urllib.request.urlopen(url + "/metrics", timeout=10).read().decode()
+        for fam in ("audit_records_total", "fairness_share",
+                    "queue_starvation_seconds", "evictions_attributed_total"):
+            assert fam in text, fam
+        check_promtext(text)
+    finally:
+        server.shutdown()
+    assert (
+        metrics().counter_value(
+            "evictions_attributed_total",
+            labels={"action": "reclaim", "phase": "reclaim"},
+        )
+        >= 2
+    )
+
+
+def test_flight_digests_carry_audit_channels():
+    from kube_arbitrator_tpu.utils.flightrec import FlightRecorder
+
+    def world():
+        return generate_cluster(
+            num_nodes=16, num_jobs=6, tasks_per_job=4, num_queues=2, seed=0,
+            node_cpu_milli=4000, node_memory=8 * GB, running_fraction=0.3,
+        )
+
+    flight = FlightRecorder(capacity=4)
+    sched = Scheduler(
+        sim=world(), config=FULL_CONF, flight=flight, audit=AuditLog(capacity=4)
+    )
+    sched.run(max_cycles=2, until_idle=False)
+    rec = flight.last()
+    assert "evict_edges" in rec.digests
+    assert isinstance(rec.digests["fairness_top"], list)
+    assert rec.digests["fairness_top"], "digest must carry ledger rows"
+    row = rec.digests["fairness_top"][0]
+    assert {"queue", "share_deserved", "share_allocated", "delta",
+            "pending"} <= set(row)
+    # flight WITHOUT the audit plane keeps its cheap footprint: edge
+    # counts (one bincount) stay, the O(T) ledger rows do not
+    flight2 = FlightRecorder(capacity=4)
+    sched2 = Scheduler(sim=world(), config=FULL_CONF, flight=flight2)
+    sched2.run(max_cycles=1, until_idle=False)
+    rec2 = flight2.last()
+    assert "evict_edges" in rec2.digests
+    assert rec2.digests["fairness_top"] == []
+
+
+def test_pipelined_cycles_audit_with_actuated_sets():
+    """run_pipelined records one audit record per committed epoch, and
+    the record's bind rows equal the ACTUATED (post-revalidation) set."""
+    sim = generate_cluster(
+        num_nodes=16, num_jobs=8, tasks_per_job=4, num_queues=2, seed=3,
+        running_fraction=0.3,
+    )
+    audit = AuditLog(capacity=32)
+    sched = Scheduler(sim, arena=True, audit=audit)
+    cycles = sched.run_pipelined(max_cycles=6, until_idle=False)
+    recs = audit.entries()
+    assert len(recs) == cycles
+    total_binds = sum(s.binds for s in sched.history)
+    actuated_rows = sum(
+        1 for r in recs for b in r["binds"] if b["actuated"]
+    )
+    assert actuated_rows == total_binds
+    assert total_binds > 0

@@ -33,6 +33,11 @@ GB = 1024**3
 FIELDS = (
     "task_status", "task_node", "evicted_for", "job_ready_cnt",
     "group_placed", "job_alloc", "queue_alloc", "node_num_tasks",
+    # decision-audit attribution (utils/audit.py): decision-NEUTRAL by
+    # construction, but the preemptor→victim edges must still be
+    # bit-identical across engines or the audit trail would depend on
+    # which engine ran — the soak pins claimant/phase/round too
+    "evict_claimant", "evict_phase", "evict_round",
 )
 
 

@@ -127,6 +127,26 @@ def test_sensitivity_disabled_verifier_breaches_invariants():
     assert "arena_corrupt" in [r["kind"] for r in rep.injected]
 
 
+def test_audit_consistency_holds_on_faulted_run():
+    """The decision audit trail reconciles 1:1 with actuation events on
+    a faulted-but-contained run: every settled OK cycle's record matches
+    the apiserver's bind/delete events (the runner wires an AuditLog into
+    every chaos scheduler, so the whole seed matrix exercises this)."""
+    rep = run_chaos(seed=1, cycles=8, profile="smoke")
+    assert rep.breaches == []
+
+
+def test_audit_dropped_edge_breaches_audit_consistency():
+    """Sensitivity: a seeded dropped-edge mutation in the audit records
+    (--disable audit-edges) MUST breach audit_consistency — a reconciler
+    that passes mutated records is blind."""
+    rep = run_chaos(seed=0, cycles=6, profile="smoke",
+                    disabled=("audit-edges",))
+    assert not rep.ok
+    assert "audit_consistency" in {b.invariant for b in rep.breaches}
+    assert any("no audit bind row" in b.detail for b in rep.breaches)
+
+
 def test_shrink_minimizes_to_the_causal_fault():
     """Shrinking a failing (verifier-off corruption) run must keep the
     failure while dropping the decoy faults and shortening the horizon."""

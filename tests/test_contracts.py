@@ -141,6 +141,24 @@ def test_mutated_reclaim_turn_schema_reports_exactly_that_field():
     assert "`pop`" in findings[0].message
 
 
+def test_audit_aux_clean_on_real_tree():
+    assert contracts.check_audit_aux() == []
+
+
+def test_mutated_audit_aux_schema_reports_exactly_that_field():
+    # KAT-CTR-010: declare the audit attribution's evict_round as float32
+    # — the real commit_cycle (correctly) passes int32 through from
+    # AllocState, and utils/audit.py decodes it host-side (and it crosses
+    # the RPC reply pack), so the analyzer must flag exactly this field
+    seeded = contracts.mutated(
+        contracts.AUDIT_AUX_SCHEMA, "evict_round", "float32"
+    )
+    findings = contracts.check_audit_aux(audit_schema=seeded)
+    assert len(findings) == 1
+    assert findings[0].rule == "KAT-CTR-010"
+    assert "`evict_round`" in findings[0].message
+
+
 def test_producer_crash_becomes_a_finding_not_a_traceback(monkeypatch):
     # a build_snapshot that RAISES (e.g. its own pack-dtype guard firing)
     # must surface as a KAT-CTR-002 finding, not crash the analyzer and

@@ -117,3 +117,87 @@ def test_pod_conditions_reach_fake_apiserver():
             c["type"] == "PodScheduled" and c["status"] == "False" and c["message"]
             for c in conds
         ), f"p{i} missing PodScheduled condition"
+
+
+def _blocked_gang_world():
+    """A gang that can never reach minMember (8 x 1cpu vs a 2cpu node) —
+    the explain_pending_tasks fixture shared by the path-coverage tests."""
+    sim = SimCluster()
+    sim.add_queue("q")
+    sim.add_node("n1", cpu_milli=2000, memory=4 * GB)
+    j = sim.add_job("gang", queue="q", min_available=8)
+    for i in range(8):
+        sim.add_task(j, 1000, GB // 4, name=f"g-{i}")
+    return sim
+
+
+def test_explain_pending_tasks_under_arena_path():
+    """The per-pod condition channel must work identically when the
+    snapshot comes from the incremental arena (delta-maintained pack),
+    not just the full-rebuild path — and the reason histogram lands in
+    pending_reason_total{reason}."""
+    from kube_arbitrator_tpu.utils.metrics import metrics
+
+    sim = _blocked_gang_world()
+    sched = Scheduler(sim, arena=True)
+    before = metrics().counter_value(
+        "pending_reason_total", labels={"reason": "Insufficient cpu"}
+    )
+    result = sched.run_once()
+    assert set(result.task_conditions) == {f"g-{i}" for i in range(8)}
+    for msg in result.task_conditions.values():
+        assert "nodes are available" in msg and "Insufficient cpu" in msg
+    assert set(sim.pod_conditions) == {f"g-{i}" for i in range(8)}
+    after = metrics().counter_value(
+        "pending_reason_total", labels={"reason": "Insufficient cpu"}
+    )
+    assert after - before == 8
+
+
+def test_explain_pending_tasks_under_pipelined_path():
+    """run_pipelined derives the conditions on its decide worker and the
+    write-back must still stamp every blocked pod + count the reasons —
+    the path test_diagnostics previously never exercised."""
+    from kube_arbitrator_tpu.utils.metrics import metrics
+
+    sim = _blocked_gang_world()
+    sched = Scheduler(sim, arena=True)
+    before = metrics().counter_value(
+        "pending_reason_total", labels={"reason": "Insufficient cpu"}
+    )
+    cycles = sched.run_pipelined(max_cycles=2, until_idle=False)
+    assert cycles == 2
+    assert set(sim.pod_conditions) == {f"g-{i}" for i in range(8)}
+    for msg in sim.pod_conditions.values():
+        assert "nodes are available" in msg and "Insufficient cpu" in msg
+    after = metrics().counter_value(
+        "pending_reason_total", labels={"reason": "Insufficient cpu"}
+    )
+    assert after - before == 8 * cycles
+
+
+def test_pending_reason_counts_attribute_dominant_and_gang_reasons():
+    """explain_pending_tasks_with_reasons: node-blocked pods carry their
+    dominant FitError reason; pods whose group HAS fitting nodes but sit
+    behind an unready gang are attributed 'gang not ready'."""
+    from kube_arbitrator_tpu.ops.diagnostics import (
+        explain_pending_tasks_with_reasons,
+    )
+
+    sim = SimCluster()
+    sim.add_queue("q")
+    sim.add_node("n1", cpu_milli=2000, memory=4 * GB)
+    # mixed-size gang: the small group's pods fit (and get session-
+    # Allocated) but the huge group can never fit, so minMember=4 blocks
+    # the whole gang — at close the small pods still see fitting
+    # capacity (gang-blocked), the huge ones see Insufficient cpu
+    j = sim.add_job("gang", queue="q", min_available=4)
+    for i in range(2):
+        sim.add_task(j, 500, GB // 4, name=f"small-{i}")
+    for i in range(2):
+        sim.add_task(j, 4000, GB // 4, name=f"huge-{i}")
+    snap = build_snapshot(sim.cluster)
+    dec = schedule_cycle(snap.tensors)
+    conditions, reasons = explain_pending_tasks_with_reasons(snap, dec)
+    assert set(conditions) == {"small-0", "small-1", "huge-0", "huge-1"}
+    assert reasons == {"Insufficient cpu": 2, "gang not ready": 2}, reasons

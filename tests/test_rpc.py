@@ -92,9 +92,19 @@ def test_remote_full_actions_with_preemption(sidecar):
         "  - name: proportion\n"
     )
     sim = generate_cluster(num_nodes=16, num_jobs=8, tasks_per_job=6, num_queues=3, seed=3)
-    sched = Scheduler(sim, config=conf, decider=RemoteDecider(sidecar))
+    from kube_arbitrator_tpu.utils.audit import AuditLog
+
+    audit = AuditLog(capacity=8)
+    sched = Scheduler(sim, config=conf, decider=RemoteDecider(sidecar), audit=audit)
     sched.run(max_cycles=4)
     assert sum(s.binds for s in sched.history) > 0
+    # the decision-audit aux crossed the RPC reply pack: remote cycles
+    # assemble the same record shape local ones do (fairness ledger from
+    # queue_deserved/queue_alloc, int-typed attribution arrays held to
+    # the decode-side DECISIONS_SCHEMA twin)
+    rec = audit.last()
+    assert rec is not None and rec.fairness, "remote cycle missing ledger"
+    assert len(audit.entries()) == len(sched.history)
     sched.decider.close()
 
 
