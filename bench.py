@@ -38,6 +38,15 @@ sum(stages) -> max(stage) comparison.  BENCH_PIPE_RUNGS ("TxN,TxN"),
 BENCH_PIPE_CYCLES, and BENCH_PIPE_CHURN (fraction of running tasks
 completed per cycle) shape it.
 
+BENCH_POOL=1 switches to the decision-pool fleet mode (rpc/pool.py):
+per (replicas, frontends) grid point, F tenant scheduler frontends on
+threads decide through one pool of R replicas (threaded bounded-delay
+batcher stacking same-shape packs), recording aggregate decided
+cycles/s and per-tenant cycle-latency p50/p99.  BENCH_POOL_GRID
+("RxF,RxF" — default "1x4,2x4,4x4,1x16,2x16,4x16"), BENCH_POOL_RUNG
+("TxN", default 2000x200), and BENCH_POOL_CYCLES shape it; rows land in
+BENCH_HISTORY.jsonl so the perf sentinel baselines pool throughput.
+
 Wedge containment: the measurement loop runs in a CHILD process that
 streams every completed row to a spill file; the parent enforces
 BENCH_TIMEOUT_S (default 2700 s) and, if the child hangs (the axon TPU
@@ -294,7 +303,136 @@ def main() -> None:
         sys.exit(_parent_main())
     if os.environ.get("BENCH_PIPELINE") == "1":
         sys.exit(_pipeline_main())
+    if os.environ.get("BENCH_POOL") == "1":
+        sys.exit(_pool_main())
     _measure_main()
+
+
+# ---------------------------------------------------------------------------
+# decision-pool fleet mode (BENCH_POOL=1)
+
+
+def _pool_point(replicas, frontends, T, N, cycles, queues, warm=1):
+    """One grid point: F tenant worlds (same snapshot shape, distinct
+    content) on R replicas through the threaded batcher.  Returns
+    aggregate decided cycles/s over the timed window plus per-tenant
+    cycle-latency quantiles (every tenant's post-warm CycleStats row —
+    provenance: each latency is that tenant's own committed cycle)."""
+    import threading
+
+    from kube_arbitrator_tpu.cache.sim import generate_cluster
+    from kube_arbitrator_tpu.framework import Scheduler
+    from kube_arbitrator_tpu.rpc.pool import DecisionPool, PoolClient
+
+    jobs = max(1, T // 100)
+    pool = DecisionPool(
+        replicas=replicas, threaded=True, min_fill=frontends,
+        batch_delay_s=0.05, max_batch=8,
+    )
+    sims = [
+        generate_cluster(
+            num_nodes=N, num_jobs=jobs, tasks_per_job=100, num_queues=queues,
+            seed=1000 + i,
+        )
+        for i in range(frontends)
+    ]
+    scheds = [
+        Scheduler(s, decider=PoolClient(pool, f"b{i}"), arena=True)
+        for i, s in enumerate(sims)
+    ]
+
+    def run_all(n):
+        threads = [
+            threading.Thread(
+                target=lambda s=s: s.run(max_cycles=n, until_idle=False)
+            )
+            for s in scheds
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    # Warm EVERY batch bucket this grid point can hit (1,2,4,..):
+    # flush-boundary jitter makes odd batch sizes, and a bucket compile
+    # landing inside the timed window poisons that tenant's latency row
+    # (observed: a 17 s p99 on the first grid point).  Decisions are
+    # discarded — no world state moves.
+    from kube_arbitrator_tpu.cache import build_snapshot
+    from kube_arbitrator_tpu.framework.conf import SchedulerConfig
+
+    cfg = SchedulerConfig.default()
+    st = build_snapshot(sims[0].cluster).tensors
+    b = 1
+    while b <= min(pool.max_batch, max(1, frontends)):
+        pool.replicas[0].decide_batch((st,) * b, cfg)
+        b *= 2
+    run_all(warm)  # settle + compile the real per-tenant programs
+    t0 = time.perf_counter()
+    run_all(cycles)
+    wall_s = time.perf_counter() - t0
+    pool.close()
+    lat = sorted(
+        s.cycle_ms for sc in scheds for s in sc.history[-cycles:]
+    )
+    sizes = [
+        e["batch"] for e in pool.decision_log
+        if e["outcome"] in ("served", "resent")
+    ]
+    q = lambda p: lat[min(len(lat) - 1, int(p * len(lat)))] if lat else None  # noqa: E731
+    return {
+        "decided_cycles_per_s": round(frontends * cycles / wall_s, 2),
+        "wall_s": round(wall_s, 3),
+        "cycle_ms": round(q(0.5), 3) if lat else None,
+        "tenant_latency_ms": {
+            "p50": round(q(0.5), 3) if lat else None,
+            "p99": round(q(0.99), 3) if lat else None,
+        },
+        "max_batch_stacked": max(sizes) if sizes else 0,
+        "binds": sum(s.binds for sc in scheds for s in sc.history),
+    }
+
+
+def _pool_main() -> int:
+    grid = []
+    for part in os.environ.get(
+        "BENCH_POOL_GRID", "1x4,2x4,4x4,1x16,2x16,4x16"
+    ).split(","):
+        r, f = part.strip().lower().split("x")
+        grid.append((int(r), int(f)))
+    t, n = os.environ.get("BENCH_POOL_RUNG", "2000x200").lower().split("x")
+    T, N = int(t), int(n)
+    cycles = int(os.environ.get("BENCH_POOL_CYCLES", 6))
+    queues = int(os.environ.get("BENCH_POOL_QUEUES", 8))
+    rows = []
+    for replicas, frontends in grid:
+        leg = _pool_point(replicas, frontends, T, N, cycles, queues)
+        row = {
+            "metric": f"pool_r{replicas}_f{frontends}@{T}x{N}",
+            "value": leg["decided_cycles_per_s"],
+            "unit": "cycles/s",
+            "replicas": replicas,
+            "frontends": frontends,
+            "cycles": cycles,
+            **leg,
+            "provenance": "aggregate committed cycles over the timed window; "
+            "latency quantiles over every tenant's own post-warm cycles",
+        }
+        rows.append(row)
+        _emit(row, stream=sys.stderr)
+        _spill(row)
+    summary = {
+        "metric": "pool_fleet",
+        "value": rows[-1]["value"] if rows else None,
+        "unit": "cycles/s",
+        "note": "aggregate decided cycles/s, last grid point",
+        "grid": rows,
+        "devices": _device_desc(),
+    }
+    _emit(summary)
+    _spill({"primary": summary, "final": True})
+    _history_append(rows)
+    return 0
 
 
 # ---------------------------------------------------------------------------

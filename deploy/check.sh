@@ -267,6 +267,77 @@ EOF
   fi
 fi
 
+# POOL_SMOKE=1: the decision-pool lane — a live 2-replica x 4-frontend
+# pooled run (threaded batcher stacking same-shape packs, decisions
+# asserted equal to independent runs), the pool suite, the 8-seed
+# multi-replica chaos matrix (replica kill/partition/slow mid-decide;
+# pool_consistency + the full per-tenant invariant set must hold), the
+# pool-log sensitivity canary (MUST breach), and kat-lint KAT-LCK/
+# KAT-DTY over the pool's threaded surface.
+rc_pool=0
+if [ "${POOL_SMOKE:-0}" = "1" ]; then
+  env JAX_PLATFORMS=cpu python - <<'EOF' || rc_pool=$?
+import threading
+from kube_arbitrator_tpu.cache.sim import generate_cluster
+from kube_arbitrator_tpu.framework import Scheduler
+from kube_arbitrator_tpu.rpc.pool import DecisionPool, PoolClient
+
+mk = lambda s: generate_cluster(num_nodes=16, num_jobs=4, tasks_per_job=4,
+                                num_queues=2, seed=s)
+pool = DecisionPool(replicas=2, threaded=True, min_fill=4,
+                    batch_delay_s=0.25, max_batch=8)
+sims = [mk(500 + i) for i in range(4)]
+scheds = [Scheduler(s, decider=PoolClient(pool, f"t{i}"), arena=True)
+          for i, s in enumerate(sims)]
+threads = [threading.Thread(target=lambda s=s: s.run(max_cycles=3, until_idle=False))
+           for s in scheds]
+for t in threads: t.start()
+for t in threads: t.join()
+pool.close()
+refs = [mk(500 + i) for i in range(4)]
+for r in refs:
+    Scheduler(r, arena=True).run(max_cycles=3, until_idle=False)
+bound = lambda sim: {t.uid: t.node_name for j in sim.cluster.jobs.values()
+                     for t in j.tasks.values()}
+for sim, ref in zip(sims, refs):
+    assert bound(sim) == bound(ref), "pooled tenant diverged from solo run"
+sizes = [e["batch"] for e in pool.decision_log if e["outcome"] in ("served", "resent")]
+assert max(sizes) >= 2, f"batcher never stacked: {sizes}"
+binds = sum(s.binds for sc in scheds for s in sc.history)
+print(f"pool smoke: 2 replicas x 4 frontends, max batch {max(sizes)}, "
+      f"{binds} binds, decisions == independent runs")
+EOF
+  env JAX_PLATFORMS=cpu python -m pytest -q tests/test_pool.py || rc_pool=$?
+  # 8-seed multi-replica chaos matrix: replica kills/partitions/slowdowns
+  # mid-decide must leave pool_consistency + every per-tenant invariant
+  # intact (exit nonzero on any breach)
+  for seed in 0 1 2 3 4 5 6 7; do
+    env JAX_PLATFORMS=cpu python -m kube_arbitrator_tpu.chaos \
+      --seed "${seed}" --cycles 8 --profile pool --out-dir /tmp \
+      || rc_pool=$?
+  done
+  # sensitivity canary: a dropped served entry in the pool decision log
+  # MUST breach pool_consistency — exit code exactly 1
+  env JAX_PLATFORMS=cpu python -m kube_arbitrator_tpu.chaos \
+    --seed 0 --cycles 6 --profile pool --disable pool-log \
+    --out-dir /tmp >/dev/null
+  rc_canary=$?
+  if [ "${rc_canary}" -ne 1 ]; then
+    echo "pool-log sensitivity canary did not breach (exit ${rc_canary})" >&2
+    rc_pool=1
+  fi
+  python -m kube_arbitrator_tpu.analysis --rules KAT-LCK,KAT-DTY \
+    kube_arbitrator_tpu/rpc/pool.py \
+    kube_arbitrator_tpu/rpc/sidecar.py \
+    kube_arbitrator_tpu/rpc/client.py \
+    kube_arbitrator_tpu/chaos/pool_runner.py || rc_pool=$?
+  if [ "${rc_pool}" -ne 0 ]; then
+    echo "pool smoke job: FAILED (exit ${rc_pool})" >&2
+  else
+    echo "pool smoke job: ok (2x4 live run + suite + 8-seed chaos + canary + kat-lint)"
+  fi
+fi
+
 # PERF_SENTINEL=1: the perf-regression gate — the profiling/timeseries/
 # sentinel suites, then the sentinel's sensitivity canaries against the
 # committed BENCH_HISTORY.jsonl: a seeded synthetic 2x slowdown MUST
@@ -325,6 +396,7 @@ if [ "${LINT_ONLY:-0}" = "1" ]; then
   if [ "${rc_chaos}" -ne 0 ]; then exit "${rc_chaos}"; fi
   if [ "${rc_perf}" -ne 0 ]; then exit "${rc_perf}"; fi
   if [ "${rc_sentinel}" -ne 0 ]; then exit "${rc_sentinel}"; fi
+  if [ "${rc_pool}" -ne 0 ]; then exit "${rc_pool}"; fi
   exit "${rc_pipe}"
 fi
 
@@ -343,4 +415,5 @@ if [ "${rc_chaos}" -ne 0 ]; then exit "${rc_chaos}"; fi
 if [ "${rc_pipe}" -ne 0 ]; then exit "${rc_pipe}"; fi
 if [ "${rc_perf}" -ne 0 ]; then exit "${rc_perf}"; fi
 if [ "${rc_sentinel}" -ne 0 ]; then exit "${rc_sentinel}"; fi
+if [ "${rc_pool}" -ne 0 ]; then exit "${rc_pool}"; fi
 exit "${rc_test}"

@@ -20,6 +20,9 @@ Modules:
 * :mod:`invariants` — the cluster-level safety checkers.
 * :mod:`runner` — builds the world, drives cycles, reports; the
   ``python -m kube_arbitrator_tpu.chaos`` entry point.
+* :mod:`pool_runner` — the multi-replica posture: M tenant worlds on N
+  shared decision replicas (rpc/pool.py), replica kill/partition/slow
+  faults mid-decide, and the ``pool_consistency`` invariant.
 * :mod:`shrink` — minimizes a failing plan (horizon prefix + ddmin-lite
   fault-subset search).
 """
@@ -27,6 +30,7 @@ from .clock import VirtualClock
 from .faults import ChaosApiServer, ChaosDecider, FaultInjector
 from .invariants import Breach, InvariantChecker
 from .plan import PROFILES, ChaosProfile, FaultPlan, FaultSpec
+from .pool_runner import run_pool_chaos
 from .runner import ChaosReport, run_chaos
 from .shrink import shrink
 
@@ -43,5 +47,6 @@ __all__ = [
     "FaultSpec",
     "ChaosReport",
     "run_chaos",
+    "run_pool_chaos",
     "shrink",
 ]

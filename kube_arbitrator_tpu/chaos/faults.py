@@ -23,6 +23,10 @@ Seam map (fault kind -> seam):
   the renew deadline, so the actuation fence must discard the cycle.
 * ``arena_corrupt`` — :meth:`cache.arena.SnapshotArena.corrupt`, the
   lost-delta emulation the byte-identity verifier exists to catch.
+* ``replica_kill`` / ``replica_partition`` / ``replica_slow`` — the
+  decision pool's ``fault_hook`` seam (:func:`make_pool_hook`), called
+  by :class:`rpc.pool.DecisionPool` at the serve entry of every routed
+  group, i.e. mid-decide from the tenant's point of view.
 """
 from __future__ import annotations
 
@@ -293,6 +297,50 @@ def make_phase_hook(injector: FaultInjector, clock: VirtualClock, elector):
             lease_duration_s=elector.lease_duration_s,
         )
         clock.advance(elector.renew_deadline_s + 1.0)
+
+    return hook
+
+
+def make_pool_hook(injector: FaultInjector, clock: VirtualClock, pool):
+    """The decision-pool fault seam: the pool calls the hook with the
+    routed ``(replica, group)`` at serve entry — after routing, before
+    the delta fan-out and the launch, which is "mid-decide" from the
+    tenant's side (its cycle is already frozen on this epoch).
+
+    * ``replica_kill`` — the named replica's process state dies
+      (resident packs dropped, restart counted).  If it IS the routed
+      replica the in-flight group fails with the pool's reroute signal
+      and must be served by another replica; either way the rejoined
+      replica re-seeds per tenant on its next serve (hitless).
+    * ``replica_partition`` — the named replica loses its link to the
+      group's tenant for N pool cycles: no fan-out, no routing; a heal
+      leaves a stale base that must force a full re-seed.
+    * ``replica_slow`` — the routed replica burns virtual time, feeding
+      the tenants' latency rings (the SLO-burn shedding input).
+    """
+    from ..rpc.pool import _ReplicaLost
+
+    def hook(replica, group) -> None:
+        spec = injector.peek("replica_kill")
+        if spec is not None:
+            injector.consume(spec)
+            target = int(spec.param("replica", 0)) % len(pool.replicas)
+            pool.kill_replica(target)
+            if target == replica.index:
+                raise _ReplicaLost(target)
+        spec = injector.peek("replica_partition")
+        if spec is not None:
+            injector.consume(spec)
+            target = int(spec.param("replica", 0)) % len(pool.replicas)
+            for req in group:
+                pool.partition(
+                    target, req.tenant, cycles=int(spec.param("cycles", 1))
+                )
+            if target == replica.index:
+                raise _ReplicaLost(target)
+        spec = injector.take("replica_slow")
+        if spec is not None:
+            clock.advance(float(spec.param("ms", 500)) / 1000.0)
 
     return hook
 

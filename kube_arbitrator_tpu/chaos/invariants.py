@@ -32,6 +32,14 @@ Invariants:
   what actually hit the store is worse than none — it would *explain*
   decisions that never happened (the dropped-edge sensitivity canary
   proves this checker actually compares, ``--disable audit-edges``).
+* ``pool_consistency`` — multi-replica runs only (chaos/pool_runner.py):
+  every committed tenant cycle was decided by EXACTLY ONE pool replica,
+  against the tenant's correct epoch (the pool decision log's served
+  entry must carry ``resident == epoch`` — the replica decided on the
+  pack the frontend shipped, not a stale base surviving a partition or
+  restart).  Zero served entries means a committed cycle nobody decided
+  (a log hole — the ``--disable pool-log`` canary's class); two means a
+  double-serve (two replicas each believing they owned the cycle).
 """
 from __future__ import annotations
 
@@ -178,6 +186,38 @@ class InvariantChecker:
                 out, "audit_consistency", cycle,
                 f"audit eviction edge for {uid} without a deletion event",
             )
+        return out
+
+    def check_pool_consistency(
+        self, entries: List[dict], tenant: str, cycle: int, committed: bool
+    ) -> List[Breach]:
+        """``entries`` is the pool decision-log slice for ``(tenant,
+        cycle)`` (rpc/pool.DecisionPool.log_for); ``committed`` marks a
+        settled OK tenant cycle.  Error/shed entries (reroutes after a
+        replica kill, admission drops) are legitimate at any count —
+        only the SERVED set is constrained."""
+        out: List[Breach] = []
+        served = [e for e in entries if e["outcome"] in ("served", "resent")]
+        if committed and not served:
+            self._breach(
+                out, "pool_consistency", cycle,
+                f"tenant {tenant} committed a cycle no replica served "
+                "(decision-log hole)",
+            )
+        if len(served) > 1:
+            self._breach(
+                out, "pool_consistency", cycle,
+                f"tenant {tenant} cycle served by {len(served)} replicas: "
+                f"{sorted(e['replica'] for e in served)}",
+            )
+        for e in served:
+            if e["epoch"] != e["resident"]:
+                self._breach(
+                    out, "pool_consistency", cycle,
+                    f"tenant {tenant} decided against stale epoch "
+                    f"{e['resident']!r} (shipped {e['epoch']!r}) "
+                    f"on {e['replica']}",
+                )
         return out
 
     def check_overcommit(self, api, cycle: int) -> List[Breach]:

@@ -33,7 +33,20 @@ Fault kinds (each lands at one explicit seam, see :mod:`faults`):
 ``arena_corrupt``   one working-arena row is overwritten without a delta
                     emission (a lost-delta bug): the byte-identity
                     verifier must catch it.
+``replica_kill``    a decision-pool replica crashes mid-decide (resident
+                    packs gone); the pool must reroute the in-flight
+                    request and hitlessly re-seed the rejoined replica.
+``replica_partition`` a (replica, tenant) link drops for N pool cycles:
+                    no delta fan-out reaches the replica and routing
+                    skips it; on heal its stale base must force a full
+                    re-seed, never a stale-epoch decide.
+``replica_slow``    the routed replica burns virtual time mid-decide —
+                    the tenant's latency feeds the SLO burn monitor and
+                    can trip per-tenant load shedding.
 ==================  =====================================================
+
+The ``replica_*`` kinds arm only for profiles with ``pool_replicas > 0``
+(the multi-tenant pool runner, :mod:`chaos.pool_runner`).
 """
 from __future__ import annotations
 
@@ -46,7 +59,13 @@ API_SITES = ("bind", "evict", "pg_status", "pod_condition")
 LEASE_PHASES = ("snapshot", "kernel", "decode", "commit")
 LEASE_PHASES_ARENA = ("snapshot", "upload", "kernel", "decode", "commit")
 
-# generation iterates kinds in THIS order (determinism depends on it)
+# generation iterates kinds in THIS order (determinism depends on it).
+# NOTE: generate() draws one rng sample per kind per cycle regardless of
+# rate, so ADDING a kind shifts the Bernoulli stream — the same seed
+# yields a different plan than prior code versions generated.  That is
+# acceptable by design: recorded repro files carry their plan VERBATIM
+# (replay/shrink never regenerate), so only ad-hoc "seed S fails"
+# notes, not repros, go stale across versions.
 FAULT_KINDS = (
     "api_conflict",
     "api_timeout",
@@ -59,6 +78,9 @@ FAULT_KINDS = (
     "rpc_deadline",
     "lease_steal",
     "arena_corrupt",
+    "replica_kill",
+    "replica_partition",
+    "replica_slow",
 )
 
 
@@ -115,6 +137,11 @@ class ChaosProfile:
     # while a frozen epoch's decide is in flight, so the commit gate's
     # revalidate-or-discard (not just the arena) carries correctness
     pipeline: bool = False
+    # decision-pool posture (chaos/pool_runner.py): >0 replicas runs M
+    # tenant worlds (pool_tenants) multiplexed onto N shared replicas,
+    # arming the replica_* fault kinds and the pool_consistency invariant
+    pool_replicas: int = 0
+    pool_tenants: int = 0
     # fault kind -> per-cycle injection probability
     rates: Tuple[Tuple[str, float], ...] = ()
 
@@ -199,6 +226,29 @@ PROFILES: Dict[str, ChaosProfile] = {
             ("lease_steal", 0.15),
         ),
     ),
+    # the fleet: M tenant worlds on N shared decision replicas
+    # (chaos/pool_runner.py) — replica kills/partitions/slowdowns land
+    # mid-decide while the usual apiserver/watch/lease faults keep
+    # hammering each tenant's own loop; pool_consistency (exactly one
+    # replica decided each committed cycle, against the tenant's correct
+    # epoch) joins the per-tenant invariant set
+    "pool": ChaosProfile(
+        name="pool", nodes=8, jobs=6, tasks_per_job=4, queues=2,
+        oversubscribe=1.5, pool_replicas=2, pool_tenants=3,
+        rates=(
+            ("api_conflict", 0.20),
+            ("api_timeout", 0.15),
+            ("api_latency", 0.15),
+            ("watch_dup", 0.20),
+            ("watch_reorder", 0.15),
+            ("watch_truncate", 0.15),
+            ("watch_compact", 0.10),
+            ("lease_steal", 0.10),
+            ("replica_kill", 0.30),
+            ("replica_partition", 0.25),
+            ("replica_slow", 0.20),
+        ),
+    ),
 }
 
 
@@ -277,5 +327,20 @@ class FaultPlan:
                         cycle, kind, field="node_idle",
                         row=rng.randrange(max(1, profile.nodes)),
                         scale=8.0,
+                    ))
+                elif kind == "replica_kill" and profile.pool_replicas:
+                    specs.append(_spec(
+                        cycle, kind,
+                        replica=rng.randrange(profile.pool_replicas),
+                    ))
+                elif kind == "replica_partition" and profile.pool_replicas:
+                    specs.append(_spec(
+                        cycle, kind,
+                        replica=rng.randrange(profile.pool_replicas),
+                        cycles=rng.randint(1, 2),
+                    ))
+                elif kind == "replica_slow" and profile.pool_replicas:
+                    specs.append(_spec(
+                        cycle, kind, ms=rng.choice((100, 500, 2000)),
                     ))
         return cls(seed=seed, specs=tuple(specs))

@@ -1,0 +1,234 @@
+"""Multi-replica pool chaos: M tenant worlds on N shared decision replicas.
+
+The single-world runner (:mod:`.runner`) drives one scheduler loop; this
+runner builds ``profile.pool_tenants`` COMPLETE tenant worlds — each its
+own :class:`ChaosApiServer`, :class:`LiveCache`, :class:`SnapshotArena`,
+leader lease, decision audit log, and :class:`Scheduler` — all deciding
+through ONE shared :class:`rpc.pool.DecisionPool` of
+``profile.pool_replicas`` replicas via per-tenant :class:`PoolClient`
+deciders.  Everything marches on one :class:`VirtualClock` and tenants
+step in a fixed order each cycle, so a run is a pure function of
+``(seed, profile, plan, disabled)`` — byte-identical repro files and
+per-cycle digests, exactly like the single-world runner.
+
+Replica faults (kill / partition / slow) enter through the pool's
+``fault_hook`` seam mid-decide; the usual apiserver / watch / lease
+faults keep hammering whichever tenant's seam runs first.  After every
+cycle each tenant's world is held to the full single-world invariant set
+(no_overcommit, no_double_bind, single_actuator, cache_consistency,
+audit_consistency, gang_atomicity at drain end) PLUS ``pool_consistency``:
+every committed tenant cycle was decided by exactly one replica against
+the tenant's correct epoch.  ``--disable pool-log`` drops served entries
+from the pool's decision log — the sensitivity canary proving the
+checker actually reads it.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+from ..cache.arena import ArenaDivergence, SnapshotArena
+from ..cache.live import LiveCache
+from ..framework.leader import ApiLeaderElector, LeaderLost
+from ..framework.scheduler import Scheduler, classify_cycle_error
+from ..utils.metrics import metrics
+from .clock import VirtualClock
+from .faults import (
+    ChaosApiServer,
+    FaultInjector,
+    apply_arena_corruption,
+    make_phase_hook,
+    make_pool_hook,
+)
+from .invariants import Breach, InvariantChecker
+from .plan import PROFILES, ChaosProfile, FaultPlan
+from .runner import ChaosReport, _digest, seed_world
+
+
+class _Tenant:
+    """One tenant world: its own apiserver, cache, arena, lease, audit,
+    scheduler, and invariant checker (the checker is stateful over the
+    tenant's OWN event stream)."""
+
+    def __init__(self, index, prof, seed, injector, clock, pool, disabled):
+        from ..rpc.pool import PoolClient
+        from ..utils.audit import AuditLog
+
+        self.index = index
+        self.id = f"t{index}"
+        self.api = ChaosApiServer(injector, clock)
+        # per-tenant world seed: same profile shape (so packs are
+        # batch-compatible across tenants), different contents
+        seed_world(self.api, prof, f"{seed}-{self.id}")
+        self.cache = LiveCache(self.api, now_fn=clock.now)
+        self.arena = None
+        if prof.arena:
+            verify_every = 0 if "arena-verify" in disabled else prof.verify_every
+            self.arena = SnapshotArena(self.cache, verify_every=verify_every)
+        self.elector = ApiLeaderElector(
+            self.api, identity=f"chaos-leader-{self.id}",
+            lease_duration_s=15.0, renew_deadline_s=10.0, retry_period_s=2.0,
+            now_fn=clock.now,
+        )
+        self.elector.sleep = clock.sleep
+        self.audit = AuditLog(capacity=4096, now_fn=clock.now)
+        self.audit.drop_first_edge = "audit-edges" in disabled
+        self.sched = Scheduler(
+            self.cache,
+            elector=self.elector,
+            decider=PoolClient(pool, self.id),
+            arena=self.arena,
+            phase_hook=make_phase_hook(injector, clock, self.elector),
+            audit=self.audit,
+        )
+        self.checker = InvariantChecker()
+
+
+def run_pool_chaos(
+    seed: int = 0,
+    cycles: int = 12,
+    profile=None,
+    disabled: Sequence[str] = (),
+    plan: Optional[FaultPlan] = None,
+    out_dir: Optional[str] = None,
+) -> ChaosReport:
+    """One deterministic multi-replica chaos run; see the module
+    docstring.  Returns a :class:`runner.ChaosReport` whose per-cycle
+    ``outcomes`` entries join every tenant's outcome
+    (``"t0:ok|t1:fenced|t2:ok"``) and whose digests cover every tenant's
+    apiserver events."""
+    prof = profile if isinstance(profile, ChaosProfile) else PROFILES[profile or "pool"]
+    if prof.pool_replicas <= 0 or prof.pool_tenants <= 0:
+        raise ValueError(
+            f"profile {prof.name} has no pool posture "
+            f"(pool_replicas={prof.pool_replicas}, pool_tenants={prof.pool_tenants})"
+        )
+    disabled = tuple(sorted(set(disabled)))
+    if plan is None:
+        plan = FaultPlan.generate(seed, cycles, prof)
+    from ..rpc.pool import DecisionPool
+
+    clock = VirtualClock()
+    injector = FaultInjector(plan, clock)
+    pool = DecisionPool(
+        replicas=prof.pool_replicas, threaded=False, now_fn=clock.now,
+    )
+    pool.fault_hook = make_pool_hook(injector, clock, pool)
+    pool.log_drop_served = "pool-log" in disabled
+    tenants = [
+        _Tenant(i, prof, seed, injector, clock, pool, disabled)
+        for i in range(prof.pool_tenants)
+    ]
+    for t in tenants:
+        if not t.elector.acquire_blocking(timeout_s=120.0):
+            raise RuntimeError(f"pool chaos: {t.id} initial acquisition failed")
+    outcomes: List[str] = []
+    digests: List[str] = []
+    detections: List[dict] = []
+    breaches: List[Breach] = []
+
+    def detect(cycle: int, kind: str, **extra) -> None:
+        detections.append({"cycle": cycle, "kind": kind, **extra})
+        metrics().counter_add("chaos_detections_total", labels={"kind": kind})
+
+    total = cycles + prof.drain_cycles
+    for cycle in range(total):
+        injector.begin_cycle(cycle)
+        pool.begin_cycle(cycle)
+        if cycle >= cycles:
+            injector.disarm()  # the fault-free drain window
+        else:
+            for t in tenants:
+                apply_arena_corruption(t.arena, injector)
+        clock.advance(1.0)
+        # phase 1: every tenant runs its cycle with faults armed (the
+        # first tenant whose seam matches an armed spec consumes it —
+        # fixed tenant order keeps that deterministic)
+        rv0s: List[int] = []
+        prev_audits: List[object] = []
+        fenceds: List[bool] = []
+        tenant_outcomes: List[str] = []
+        for t in tenants:
+            rv0s.append(t.api._rv)
+            prev_audits.append(t.audit.last())
+            fenced = False
+            outcome = "ok"
+            if not t.elector.renew():
+                if not t.elector.acquire_blocking(timeout_s=240.0):
+                    raise RuntimeError(
+                        f"pool chaos: {t.id} could not re-acquire leadership"
+                    )
+            try:
+                t.sched.run_once()
+            except LeaderLost:
+                fenced = True
+                outcome = "fenced"
+                detect(cycle, "leader_fence", tenant=t.id)
+            except ArenaDivergence:
+                outcome = "arena_divergence"
+                detect(cycle, "arena_divergence", tenant=t.id)
+            except Exception as err:
+                kind = classify_cycle_error(err)
+                if kind == "retryable":
+                    outcome = f"retryable:{type(err).__name__}"
+                    detect(
+                        cycle, "retryable_error",
+                        tenant=t.id, error=type(err).__name__,
+                    )
+                else:
+                    outcome = f"fatal:{type(err).__name__}"
+                    t.checker._breach(
+                        breaches, "no_unhandled_fatal", cycle,
+                        f"{t.id}: {type(err).__name__}: {err}",
+                    )
+            fenceds.append(fenced)
+            tenant_outcomes.append(outcome)
+        # phase 2: disarm THEN settle+check — the settle sync must be
+        # fault-free (a still-armed watch_truncate would truncate the
+        # settle itself and fail cache_consistency spuriously), exactly
+        # like the single-world runner's disarm-before-sync ordering
+        injector.disarm()
+        cycle_outcomes: List[str] = []
+        cycle_events: List[tuple] = []
+        for t, rv0, prev_audit, fenced, outcome in zip(
+            tenants, rv0s, prev_audits, fenceds, tenant_outcomes
+        ):
+            t.cache.sync()  # settle: deliver every pending event
+            events = [e for e in t.api.event_log if e[0] > rv0]
+            audit_rec = None
+            if outcome == "ok":
+                rec = t.audit.last()
+                if rec is None or rec is prev_audit:
+                    t.checker._breach(
+                        breaches, "audit_consistency", cycle,
+                        f"{t.id}: committed cycle produced no audit record",
+                    )
+                else:
+                    audit_rec = rec.to_dict()
+            breaches += t.checker.after_cycle(
+                t.api, t.cache, cycle, events, fenced=fenced,
+                audit_rec=audit_rec,
+            )
+            # the pool invariant: exactly one replica decided this
+            # committed cycle, against the epoch the frontend shipped
+            breaches += t.checker.check_pool_consistency(
+                pool.log_for(t.id, cycle), t.id, cycle,
+                committed=(outcome == "ok"),
+            )
+            cycle_outcomes.append(f"{t.id}:{outcome}")
+            cycle_events.extend((t.id,) + tuple(e) for e in events)
+        joined = "|".join(cycle_outcomes)
+        outcomes.append(joined)
+        digests.append(_digest(cycle, joined, cycle_events))
+    for t in tenants:
+        breaches += t.checker.final(t.api, t.cache, total)
+    report = ChaosReport(
+        seed=seed, profile=prof, cycles=cycles, disabled=disabled, plan=plan,
+        injected=list(injector.injected), outcomes=outcomes, digests=digests,
+        detections=detections, breaches=breaches,
+    )
+    if out_dir and report.breaches:
+        report.write(
+            os.path.join(out_dir, f"chaos-repro-{prof.name}-{seed}.json")
+        )
+    return report

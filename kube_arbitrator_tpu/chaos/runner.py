@@ -56,8 +56,10 @@ REPRO_VERSION = 1
 # checkers catch the damage the mechanism normally prevents (chaos that
 # only passes clean runs proves nothing).  "audit-edges" drops the first
 # bind row from every non-empty decision-audit record — the
-# audit_consistency reconciler MUST breach.
-DISABLE_CHOICES = ("arena-verify", "audit-edges")
+# audit_consistency reconciler MUST breach.  "pool-log" (pool profiles,
+# chaos/pool_runner.py) drops served entries from the pool decision log
+# — the pool_consistency checker MUST breach.
+DISABLE_CHOICES = ("arena-verify", "audit-edges", "pool-log")
 
 
 def seed_world(api, profile: ChaosProfile, seed: int) -> None:
@@ -430,6 +432,10 @@ def main(argv=None) -> int:
         extra_disabled = disabled - recorded_disabled
         disabled |= recorded_disabled
         seed, cycles = int(rec["seed"]), int(rec["cycles"])
+        run_fn = run_chaos
+        if prof.pool_replicas > 0:
+            # pool profiles replay through the multi-tenant runner
+            from .pool_runner import run_pool_chaos as run_fn
         if args.shrink:
             from .shrink import shrink
 
@@ -446,7 +452,7 @@ def main(argv=None) -> int:
             )
             _print_summary(report, args.json, path)
             return 0 if report.breaches else 1  # a vanished failure is the error
-        report = run_chaos(
+        report = run_fn(
             seed=seed, cycles=cycles, profile=prof, plan=plan, disabled=disabled
         )
         _print_summary(report, args.json, None)
@@ -484,7 +490,12 @@ def main(argv=None) -> int:
             f"(have: {', '.join(sorted(PROFILES))})", file=sys.stderr,
         )
         return 2
-    report = run_chaos(
+    run_fn = run_chaos
+    if prof.pool_replicas > 0:
+        # multi-replica posture: M tenant worlds on N shared decision
+        # replicas (chaos/pool_runner.py), pool_consistency armed
+        from .pool_runner import run_pool_chaos as run_fn
+    report = run_fn(
         seed=args.seed, cycles=args.cycles, profile=prof,
         disabled=disabled, out_dir=args.out_dir,
     )

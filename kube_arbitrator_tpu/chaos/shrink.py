@@ -37,12 +37,17 @@ def shrink(
     all, which the caller should treat as nondeterminism evidence)."""
     from .runner import run_chaos
 
+    run_fn = run_chaos
+    if profile.pool_replicas > 0:
+        # pool profiles shrink through the multi-tenant runner
+        from .pool_runner import run_pool_chaos as run_fn
+
     runs = 0
 
     def attempt(p: FaultPlan, c: int):
         nonlocal runs
         runs += 1
-        rep = run_chaos(
+        rep = run_fn(
             seed=seed, cycles=c, profile=profile, plan=p, disabled=disabled
         )
         return (not rep.ok), rep

@@ -76,6 +76,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="bind address for --obs-port (default 127.0.0.1)",
     )
     p.add_argument(
+        "--replica-id",
+        default="",
+        metavar="ID",
+        help="name this process as a decision-pool replica: stamped into "
+        "/healthz + /readyz and the bound-address log line, so N "
+        "replicas with --obs-port 0 on one host never collide and are "
+        "tellable apart (default: empty = standalone)",
+    )
+    p.add_argument(
         "--flight-dump-dir",
         default="",
         help="flight recorder: dump the last --flight-ring cycles' digests "
@@ -307,9 +316,13 @@ def main(argv=None) -> int:
         server, _thread, url = serve_obs(
             host=args.obs_host, port=args.obs_port,
             flight=flight, status_fn=status_fn, timeseries=sampler,
-            audit=audit,
+            audit=audit, replica_id=args.replica_id,
         )
-        print(f"observability plane on {url}", file=sys.stderr)
+        # the bound address is logged (not just the requested one):
+        # --obs-port 0 binds an ephemeral port per replica, and this
+        # line is how an operator or supervisor finds each replica
+        rid = f" (replica {args.replica_id})" if args.replica_id else ""
+        print(f"observability plane on {url}{rid}", file=sys.stderr)
         return server
 
     if args.sidecar:
@@ -317,7 +330,7 @@ def main(argv=None) -> int:
 
         obs_server = _serve_obs()  # sidecar serves its own plane
         try:
-            sidecar_main(args.sidecar)
+            sidecar_main(args.sidecar, replica_id=args.replica_id)
         finally:
             if obs_server is not None:
                 obs_server.shutdown()

@@ -27,7 +27,16 @@ path                       serves
 ``/debug/audit/<corr>``    one cycle's audit record by trace corr-id —
                            joinable with ``/debug/trace/<corr>`` and the
                            flight ring's per-cycle digests
+``/debug/pool``            decision-pool status (rpc/pool.py): per-replica
+                           inflight/restarts/resident tenants, partitions,
+                           queue depth, per-tenant shed records, decision
+                           log tail
 =========================  ==================================================
+
+Multi-process posture: ``port=0`` binds an ephemeral port (the returned
+base_url carries the real one — callers must log it), and
+``replica_id`` stamps ``/healthz`` + ``/readyz``, so N pool replicas on
+one host never collide on a port and are tellable apart by probe.
 
 Handlers only READ: the registry snapshots under its own lock, the flight
 recorder copies its ring under its lock, and the status callable reads
@@ -124,6 +133,8 @@ class _ObsHandler(BaseHTTPRequestHandler):
         prof: KernelProfiler = self.server.obs_profiler  # type: ignore[attr-defined]
         timeseries = self.server.obs_timeseries  # type: ignore[attr-defined]
         audit = self.server.obs_audit  # type: ignore[attr-defined]
+        pool = self.server.obs_pool  # type: ignore[attr-defined]
+        replica_id = self.server.obs_replica_id  # type: ignore[attr-defined]
         path, _, query = self.path.partition("?")
         path = path.rstrip("/") or "/"
         # fixed route vocabulary for the counter label: a scanner probing
@@ -137,7 +148,7 @@ class _ObsHandler(BaseHTTPRequestHandler):
             route = path
         if route not in ("/", "/metrics", "/healthz", "/readyz",
                          "/debug/cycles", "/debug/trace", "/debug/audit",
-                         "/debug/kernels", "/debug/timeseries"):
+                         "/debug/kernels", "/debug/timeseries", "/debug/pool"):
             route = "other"
         registry.counter_add("obs_requests_total", labels={"path": route})
 
@@ -148,11 +159,27 @@ class _ObsHandler(BaseHTTPRequestHandler):
             )
             return
         if path == "/healthz":
-            self._send_json(200, {"ok": True, **device_info(), **status_fn()})
+            body = {"ok": True, **device_info(), **status_fn()}
+            if replica_id:
+                body["replica"] = replica_id
+            self._send_json(200, body)
             return
         if path == "/readyz":
-            st = status_fn()
+            # the replica id rides the probe body so N pool replicas on
+            # one host are tellable apart by their readiness endpoints
+            st = dict(status_fn())
+            if replica_id:
+                st["replica"] = replica_id
             self._send_json(200 if st.get("ready") else 503, st)
+            return
+        if path == "/debug/pool":
+            if pool is None:
+                self._send_json(200, {
+                    "replicas": [],
+                    "error": "no decision pool wired (pass pool= to serve_obs)",
+                })
+                return
+            self._send_json(200, pool.status())
             return
         if path == "/debug/cycles":
             entries = flight.entries() if flight is not None else []
@@ -229,6 +256,7 @@ class _ObsHandler(BaseHTTPRequestHandler):
                 "/debug/cycles", "/debug/trace/<corr_id>",
                 "/debug/kernels", "/debug/timeseries?window=<s>",
                 "/debug/audit?n=<count>", "/debug/audit/<corr_id>",
+                "/debug/pool",
             ]})
             return
         self._send_json(404, {"error": f"no route {path}"})
@@ -244,15 +272,21 @@ def serve_obs(
     kernel_profiler: Optional[KernelProfiler] = None,
     timeseries=None,
     audit=None,
+    pool=None,
+    replica_id: str = "",
 ) -> Tuple[ThreadingHTTPServer, threading.Thread, str]:
     """Serve the observability plane; returns (server, thread, base_url).
-    ``port=0`` picks a free port; ``server.shutdown()`` stops it.  The
-    defaults bind the process-wide registry/tracer/profiler, so a bare
-    ``serve_obs()`` next to any scheduler run already serves real data.
-    ``timeseries`` takes a :class:`utils.timeseries.CycleSampler` (ring +
-    burn monitor, the Scheduler's ``timeseries=``) or a bare ring;
-    ``audit`` a :class:`utils.audit.AuditLog` (the Scheduler's
-    ``audit=``) for the ``/debug/audit`` routes."""
+    ``port=0`` picks a free port (the returned base_url carries the real
+    one — callers should log it, since two replicas asking for port 0
+    never collide but must be findable); ``server.shutdown()`` stops it.
+    The defaults bind the process-wide registry/tracer/profiler, so a
+    bare ``serve_obs()`` next to any scheduler run already serves real
+    data.  ``timeseries`` takes a :class:`utils.timeseries.CycleSampler`
+    (ring + burn monitor, the Scheduler's ``timeseries=``) or a bare
+    ring; ``audit`` a :class:`utils.audit.AuditLog` (the Scheduler's
+    ``audit=``) for the ``/debug/audit`` routes; ``pool`` a
+    :class:`rpc.pool.DecisionPool` for ``/debug/pool``; ``replica_id``
+    stamps /healthz + /readyz in multi-replica deployments."""
     server = ThreadingHTTPServer((host, port), _ObsHandler)
     server.obs_registry = registry if registry is not None else metrics()  # type: ignore[attr-defined]
     server.obs_flight = flight  # type: ignore[attr-defined]
@@ -261,6 +295,8 @@ def serve_obs(
     server.obs_profiler = kernel_profiler if kernel_profiler is not None else profiler()  # type: ignore[attr-defined]
     server.obs_timeseries = timeseries  # type: ignore[attr-defined]
     server.obs_audit = audit  # type: ignore[attr-defined]
+    server.obs_pool = pool  # type: ignore[attr-defined]
+    server.obs_replica_id = replica_id  # type: ignore[attr-defined]
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     return server, thread, f"http://{host}:{server.server_address[1]}"

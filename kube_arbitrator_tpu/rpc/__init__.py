@@ -11,4 +11,7 @@ __all__ = [
     "RemoteDecider",
     "DecisionService",
     "serve",
+    # fleet serving (imported lazily from .pool to keep the default
+    # scheduler path grpc/protobuf-light): DecisionPool, PoolClient,
+    # TenantAdmission, pack_shape_key live in kube_arbitrator_tpu.rpc.pool
 ]

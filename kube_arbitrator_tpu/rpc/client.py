@@ -21,6 +21,7 @@ from .codec import (
     ARENA_BASE_METADATA_KEY,
     ARENA_EPOCH_METADATA_KEY,
     CORR_ID_METADATA_KEY,
+    TENANT_METADATA_KEY,
     snapshot_request,
     unpack_tensors,
 )
@@ -57,10 +58,16 @@ class RemoteDecider:
         retry_backoff_cap_s: float = 30.0,
         jitter_seed: Optional[int] = None,
         sleep_fn: Callable[[float], None] = time.sleep,
+        tenant: str = "",
     ):
         import grpc
 
         self.target = target
+        # fleet serving: names this frontend's delta stream on a shared
+        # sidecar (rpc/pool.py) — the sidecar keys resident packs by it,
+        # so M frontends on one replica don't evict each other.  "" keeps
+        # the single-frontend behavior (one anonymous tenant slot).
+        self.tenant = tenant
         self.timeout_s = timeout_s
         self.retries = retries
         self.retry_backoff_s = retry_backoff_s
@@ -135,6 +142,8 @@ class RemoteDecider:
         # the sidecar's spans stitch into the SAME trace (utils/tracing.py)
         corr = tr.current_corr_id()
         md = [(CORR_ID_METADATA_KEY, corr)] if corr else []
+        if self.tenant:
+            md.append((TENANT_METADATA_KEY, self.tenant))
         if pack_meta is not None:
             md.append((ARENA_EPOCH_METADATA_KEY, pack_meta.key))
             if delta_base:

@@ -29,6 +29,11 @@ CORR_ID_METADATA_KEY = "kat-corr-id"
 # resident aborts FAILED_PRECONDITION and the client re-sends in full.
 ARENA_EPOCH_METADATA_KEY = "kat-arena-epoch"
 ARENA_BASE_METADATA_KEY = "kat-arena-base"
+# Fleet serving (rpc/pool.py): the tenant scheduler frontend a Decide
+# belongs to.  A sidecar keys its resident packs by tenant, so M
+# frontends multiplexed onto one replica keep independent delta streams
+# instead of evicting each other back to full resends every cycle.
+TENANT_METADATA_KEY = "kat-tenant"
 
 
 def pack_tensors(obj, into, fields=None) -> None:

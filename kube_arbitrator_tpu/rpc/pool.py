@@ -1,0 +1,905 @@
+"""Multi-replica decision pool: batched fleet serving for many tenants.
+
+One sidecar serving one scheduler frontend (rpc/sidecar.py) is the
+single-user deployment shape.  The fleet shape multiplexes **M tenant
+scheduler frontends** — each owning its own cluster state, leader lease,
+and actuation — onto **N shared decision replicas**, the way Gavel
+multiplexes one policy engine across many jobs' round-based demands
+(arxiv 2008.09213) and Tesserae scales placement-policy evaluation out
+across replicas (arxiv 2508.04953).  Three mechanisms make the pool more
+than a load balancer:
+
+* **Request batching** — a bounded-delay batcher stacks *shape-compatible*
+  snapshot packs into ONE XLA launch.  Compatibility is decided by the
+  KAT-CTR symbolic-shape schema (analysis/contracts.py SNAPSHOT_SCHEMA):
+  two packs are stackable iff they resolve the same symbolic axes
+  (T/N/G/J/Q/...), carry the same static fields, the same conf, and the
+  same evictive-routing class — exactly the condition under which the
+  compiled program is shared.  The batched program is a tuple of
+  per-element cycle subgraphs (NOT a vmap), so each tenant's decisions
+  are bit-identical to its own single launch by construction; per-tenant
+  corr-ids ride each request and land in the pool's decision log.
+* **Epoch-keyed arena replication** — every tenant's delta stream
+  (cache/arena.py PackMeta) is fanned out to every reachable replica,
+  each maintaining a per-tenant epoch-keyed resident pack.  Any replica
+  can therefore serve any tenant's next cycle.  A replica that lost a
+  base (restart, join, healed partition) is re-seeded from the full pack
+  in hand — the FAILED_PRECONDITION full-resend path of the single
+  sidecar, generalized into hitless replica restart.
+* **Routing, backpressure, and load-shedding** — least-loaded routing
+  (inflight count, round-robin tiebreak) over alive, non-partitioned
+  replicas; per-tenant admission is driven by the PR 8 SLO burn monitor
+  (utils/timeseries.SloBurnMonitor) over each tenant's recent served
+  latencies: a tenant burning its error budget in BOTH windows is shed
+  (``PoolShed``, a retryable cycle error) until its burn recovers.  The
+  policy is deliberately latency-burn-driven, not load-gated: a tenant
+  whose cycles already blow its SLO gains nothing from being served and
+  only steals launch slots from tenants still inside budget.  Every
+  shed is recorded per tenant in the pool's shed ring (the audit
+  surface served at ``/debug/pool``) and in
+  ``pool_requests_total{tenant,outcome="shed"}``.
+
+The chaos plane drives the pool through the ``fault_hook`` seam
+(chaos/faults.make_pool_hook): replica kill / partition / slow faults
+land at the serve entry, and the ``pool_consistency`` invariant checks
+the decision log — every committed tenant cycle was decided by exactly
+one replica against the tenant's correct epoch.
+
+Thread discipline (KAT-LCK): every lock guards only dict/deque/int ops;
+launches, delta patching of immutable packs, and jax execution run
+outside the critical sections.  In threaded mode there is at most ONE
+in-flight request per tenant (one scheduler loop per tenant), so a
+tenant's delta chain is sequential by construction.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..ops.cycle import schedule_cycle
+from ..utils.metrics import MetricsRegistry, metrics
+
+# pool admission: one (long, short, threshold) burn-window pair scaled to
+# a ~1 s cycle cadence — the long window proves the overload is
+# sustained, the short window proves it is still happening (the PR 8
+# multi-window policy, reused verbatim via SloBurnMonitor)
+POOL_BURN_WINDOWS: Tuple[Tuple[float, float, float], ...] = ((60.0, 10.0, 2.0),)
+
+
+class PoolShed(RuntimeError):
+    """Admission dropped the request: the tenant has been burning its
+    latency error budget in both burn windows (sustained AND still
+    happening).  Retryable — the tenant's loop counts a retryable cycle
+    error and tries again next cycle, by which time the burn may have
+    recovered."""
+
+    retryable = True
+
+
+class PoolUnavailable(RuntimeError):
+    """No alive, non-partitioned replica could serve the request this
+    cycle.  Retryable — replicas restart hitlessly and partitions heal."""
+
+    retryable = True
+
+
+class _ReplicaLost(RuntimeError):
+    """Internal reroute signal: the routed replica died mid-decide (the
+    chaos kill seam); the pool retries the group on another replica."""
+
+    def __init__(self, replica_index: int):
+        super().__init__(f"replica r{replica_index} lost mid-decide")
+        self.replica_index = replica_index
+
+
+def pack_shape_key(st, conf_yaml: str = "", actions=()) -> str:
+    """The batching-compatibility key: the concrete resolution of the
+    KAT-CTR symbolic axes (analysis/contracts.SNAPSHOT_SCHEMA — every
+    field's shape is a function of these axes, so equal axes == equal
+    shapes for the whole pack), the static fields, the conf, and the
+    evictive-routing class (platform.is_evictive feeds decision_route, so
+    packs in one batch must agree on it or batching would change where a
+    pack's program runs).  Same key <=> one compiled program serves both
+    packs."""
+    from ..analysis.contracts import _snapshot_axes
+    from ..platform import is_evictive
+
+    axes = _snapshot_axes(st.tensors if hasattr(st, "tensors") else st)
+    t = st.tensors if hasattr(st, "tensors") else st
+    statics = tuple(
+        (f.name, getattr(t, f.name))
+        for f in dataclasses.fields(type(t))
+        if f.metadata.get("static")
+    )
+    conf_fp = hashlib.sha256(conf_yaml.encode()).hexdigest()[:8]
+    ax = "/".join(f"{k}{v}" for k, v in sorted(axes.items()))
+    ev = int(bool(is_evictive(tuple(actions), t.task_status)))
+    return f"{ax}|{statics}|ev{ev}|conf:{conf_fp}"
+
+
+@dataclasses.dataclass
+class PoolRequest:
+    """One tenant cycle's decide request traveling through the pool."""
+
+    tenant: str
+    st: object                    # full host pack (SnapshotTensors)
+    config: object
+    conf_yaml: str
+    pack_meta: object             # cache/arena.PackMeta or None
+    corr: Optional[str]
+    seq: int                      # per-tenant request sequence
+    shape: str                    # pack_shape_key
+    t_submit: float
+    # resolved by the serving path:
+    event: threading.Event = dataclasses.field(default_factory=threading.Event)
+    decisions: object = None
+    kernel_ms: float = 0.0
+    error: Optional[BaseException] = None
+    replica: Optional[str] = None
+    batch: int = 0
+    reseeded: bool = False
+    # set by a timed-out decide(): a late completion must not record
+    # the wait as a served latency (it would poison the admission ring)
+    abandoned: bool = False
+
+
+class PoolReplica:
+    """One decision replica: per-tenant epoch-keyed resident packs plus
+    the batched launch entry (``decide_batch`` — tests and harnesses
+    override it to fault the serve path).  ``restart()`` models a
+    replica crash/redeploy — the process state (resident packs) is
+    gone, the replica rejoins empty and every tenant's next decide
+    re-seeds it from the full pack in hand (hitless by construction)."""
+
+    def __init__(self, index: int):
+        self.index = index
+        self.id = f"r{index}"
+        self._lock = threading.Lock()
+        # tenant -> (epoch key or None, resident SnapshotTensors)
+        self._packs: Dict[str, Tuple[Optional[str], object]] = {}
+        self.inflight = 0
+        self.restarts = 0
+        self.cycles_served = 0
+
+    def apply_delta(self, tenant: str, st, meta) -> str:
+        """Fan-out replication: patch this replica's resident pack for
+        ``tenant`` with the delta ``meta`` describes, or (re-)seed it
+        whole when the base epoch is not resident — the generalized
+        FAILED_PRECONDITION path.  Returns ``"delta"`` or ``"full"``.
+        The pack objects are immutable (frozen dataclass); only the dict
+        slot is written under the lock."""
+        key = meta.key if meta is not None else None
+        base = meta.base_key if meta is not None else None
+        with self._lock:
+            resident = self._packs.get(tenant)
+        if (
+            meta is None
+            or base is None
+            or resident is None
+            or resident[0] != base
+        ):
+            with self._lock:
+                self._packs[tenant] = (key, st)
+            return "full"
+        patch = {f: getattr(st, f) for f in meta.changed_fields}
+        patched = (
+            dataclasses.replace(resident[1], **patch) if patch else resident[1]
+        )
+        with self._lock:
+            self._packs[tenant] = (key, patched)
+        return "delta"
+
+    def resident(self, tenant: str) -> Tuple[Optional[str], object]:
+        with self._lock:
+            pair = self._packs.get(tenant)
+        if pair is None:
+            raise KeyError(f"replica {self.id} holds no pack for {tenant}")
+        return pair
+
+    def resident_tenants(self) -> List[str]:
+        with self._lock:
+            return sorted(self._packs)
+
+    def restart(self) -> None:
+        with self._lock:
+            self._packs.clear()
+            self.restarts += 1
+
+    def decide_batch(self, packs: Tuple, config) -> Tuple[Tuple, float]:
+        """Run every pack of one shape-compatible group in ONE XLA
+        launch; returns (decisions tuple, launch wall ms).  Routing is
+        resolved once for the group (the compatibility key pins the
+        evictive class, so the group routes exactly like each member
+        would alone).  The tuple is padded up to a power-of-two bucket
+        by repeating the last pack (extra outputs dropped) so arrival
+        jitter doesn't compile one program per odd batch size — the
+        geometric-bucket idiom the arena's dirty-range scatter uses."""
+        from ..platform import decision_route
+
+        n = len(packs)
+        b = 1
+        while b < n:
+            b *= 2
+        padded = packs + (packs[-1],) * (b - n)
+        ctx, _dev, native_ops = decision_route(
+            int(packs[0].task_valid.shape[0]),
+            config.actions,
+            packs[0].task_status,
+        )
+        t0 = time.perf_counter()
+        with ctx:
+            decs = _batched_cycle(
+                padded, tiers=config.tiers, actions=config.actions,
+                native_ops=native_ops,
+            )
+            decs[-1].task_node.block_until_ready()
+        ms = (time.perf_counter() - t0) * 1000
+        with self._lock:
+            self.cycles_served += n
+        return decs[:n], ms
+
+
+def _run_batched(packs, tiers, actions, native_ops):
+    """One XLA launch containing B independent copies of the cycle
+    program — a static unroll over the tuple, NOT a vmap: each element's
+    subgraph is the exact graph its own single launch would compile, so
+    per-tenant decisions are bit-identical to unbatched serving by
+    construction (the pool's parity suite pins this).  jit caches one
+    executable per (shape signature, B, statics)."""
+    return tuple(
+        schedule_cycle(p, tiers=tiers, actions=actions, native_ops=native_ops)
+        for p in packs
+    )
+
+
+_batched_cycle = jax.jit(
+    _run_batched, static_argnames=("tiers", "actions", "native_ops")
+)
+
+
+class TenantAdmission:
+    """Per-tenant load-shedding on the PR 8 SLO burn monitor: each
+    tenant's served latencies land in a :class:`TimeSeriesRing`, and a
+    :class:`SloBurnMonitor` computes the burn (its ``burn_rate`` is the
+    ONE formula — this class only applies the pair policy over it).
+    ``should_shed`` is True while both the long and short windows of any
+    pair burn at or past their threshold (the monitor's own ``>=``
+    firing comparison) — sustained AND still happening — with a
+    ``min_samples`` guard so a cold tenant cannot be shed by its first
+    slow cycle."""
+
+    def __init__(
+        self,
+        slo_ms: float,
+        budget: float = 0.05,
+        windows: Tuple[Tuple[float, float, float], ...] = POOL_BURN_WINDOWS,
+        min_samples: int = 8,
+        now_fn: Optional[Callable[[], float]] = None,
+    ):
+        self.slo_ms = float(slo_ms)
+        self.budget = float(budget)
+        self.windows = tuple(windows)
+        self.min_samples = min_samples
+        self.now = now_fn or time.time
+        self._lock = threading.Lock()
+        self._rings: Dict[str, object] = {}
+        self._monitors: Dict[str, object] = {}
+
+    def _monitor(self, tenant: str):
+        from ..utils.timeseries import SloBurnMonitor, TimeSeriesRing
+
+        with self._lock:
+            mon = self._monitors.get(tenant)
+        if mon is None:
+            ring = TimeSeriesRing(capacity=512, now_fn=self.now)
+            mon = SloBurnMonitor(
+                ring, slo_ms=self.slo_ms, budget=self.budget,
+                windows=self.windows, min_samples=self.min_samples,
+            )
+            with self._lock:
+                self._rings[tenant] = ring
+                self._monitors[tenant] = mon
+        return mon
+
+    def observe(self, tenant: str, latency_ms: float) -> None:
+        self._monitor(tenant)
+        with self._lock:
+            ring = self._rings[tenant]
+        ring.sample({"cycle_ms": float(latency_ms)})
+
+    def burn(self, tenant: str) -> Optional[float]:
+        mon = self._monitor(tenant)
+        return mon.burn_rate(self.windows[0][0], now=self.now())
+
+    def should_shed(self, tenant: str) -> bool:
+        mon = self._monitor(tenant)
+        with self._lock:
+            ring = self._rings[tenant]
+        now = self.now()
+        for long_s, short_s, threshold in self.windows:
+            if len(ring.rows(long_s, now)) < self.min_samples:
+                continue
+            long_burn = mon.burn_rate(long_s, now)
+            short_burn = mon.burn_rate(short_s, now)
+            if (
+                long_burn is not None and long_burn >= threshold
+                and short_burn is not None and short_burn >= threshold
+            ):
+                return True
+        return False
+
+
+class DecisionPool:
+    """N decision replicas serving M tenant frontends; see the module
+    docstring for the three mechanisms.  ``threaded=True`` starts the
+    bounded-delay batcher (a dispatcher thread + one worker per replica)
+    — the production shape; ``threaded=False`` serves each request
+    inline on the calling thread (batch of whatever ``decide_many``
+    hands it), the deterministic shape chaos and the parity tests
+    drive."""
+
+    def __init__(
+        self,
+        replicas: int = 2,
+        max_batch: int = 8,
+        batch_delay_s: float = 0.002,
+        min_fill: int = 1,
+        admission: Optional[TenantAdmission] = None,
+        threaded: bool = False,
+        now_fn: Optional[Callable[[], float]] = None,
+        registry: Optional[MetricsRegistry] = None,
+        log_capacity: int = 4096,
+        fault_hook=None,
+    ):
+        self.replicas = [PoolReplica(i) for i in range(replicas)]
+        self.max_batch = max_batch
+        self.batch_delay_s = batch_delay_s
+        self.min_fill = min_fill
+        self.admission = admission
+        self.now = now_fn or time.time
+        self.registry = registry
+        self.log_capacity = log_capacity
+        # chaos seam: called with (replica, group) at the serve entry;
+        # may kill/partition/slow the pool and may raise _ReplicaLost
+        self.fault_hook = fault_hook
+        self.cycle = 0
+        self._lock = threading.Lock()
+        self._seq: Dict[str, int] = {}
+        # config object -> (config ref, dumped YAML); see _conf_yaml
+        self._confs: Dict[int, Tuple[object, str]] = {}
+        # (replica_index, tenant) -> heal-at pool cycle
+        self._partitions: Dict[Tuple[int, str], int] = {}
+        # the decision log: ground truth for the pool_consistency
+        # invariant — every serve/shed/error lands here, bounded
+        self.decision_log: List[dict] = []
+        self.shed_log: List[dict] = []
+        # sensitivity seam (chaos --disable pool-log): drop served
+        # entries so the pool_consistency checker MUST breach
+        self.log_drop_served = False
+        self._rr = 0
+        self._stop = False
+        self._queue: List[PoolRequest] = []
+        self._cond = threading.Condition(self._lock)
+        self._dispatcher: Optional[threading.Thread] = None
+        self._workers: Optional[List[ThreadPoolExecutor]] = None
+        if threaded:
+            self._workers = [
+                ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix=f"kat-pool-{r.id}"
+                )
+                for r in self.replicas
+            ]
+            self._dispatcher = threading.Thread(
+                target=self._dispatch_loop, name="kat-pool-dispatch",
+                daemon=True,
+            )
+            self._dispatcher.start()
+
+    # ---- metrics ----
+
+    def _metrics(self) -> MetricsRegistry:
+        return self.registry if self.registry is not None else metrics()
+
+    def _count(self, tenant: str, outcome: str) -> None:
+        self._metrics().counter_add(
+            "pool_requests_total", labels={"tenant": tenant, "outcome": outcome}
+        )
+
+    def _gauge_inflight(self, replica: PoolReplica) -> None:
+        self._metrics().gauge_set(
+            "pool_replica_inflight", replica.inflight,
+            labels={"replica": replica.id},
+        )
+
+    # ---- lifecycle / chaos surface ----
+
+    def begin_cycle(self, cycle: int) -> None:
+        """Pool-cycle bookkeeping (the chaos runner's clock): heals
+        partitions whose deadline passed."""
+        self.cycle = cycle
+        with self._lock:
+            healed = [k for k, until in self._partitions.items() if until <= cycle]
+            for k in healed:
+                del self._partitions[k]
+
+    def kill_replica(self, index: int) -> None:
+        """Crash/redeploy replica ``index``: resident packs are gone; the
+        replica rejoins immediately and re-seeds per tenant on its next
+        serve (hitless restart)."""
+        self.replicas[index].restart()
+
+    def partition(self, index: int, tenant: str, cycles: int = 1) -> None:
+        """Partition replica ``index`` from ``tenant`` for ``cycles``
+        pool cycles: no delta fan-out reaches it and routing skips it;
+        on heal its stale base forces a full re-seed."""
+        with self._lock:
+            self._partitions[(index, tenant)] = self.cycle + max(1, cycles)
+
+    def is_partitioned(self, index: int, tenant: str) -> bool:
+        with self._lock:
+            return (index, tenant) in self._partitions
+
+    def status(self) -> dict:
+        """The /debug/pool document."""
+        with self._lock:
+            partitions = [
+                {"replica": f"r{i}", "tenant": t, "heal_at_cycle": until}
+                for (i, t), until in sorted(self._partitions.items())
+            ]
+            queue_depth = len(self._queue)
+            sheds = list(self.shed_log[-64:])
+            log_tail = list(self.decision_log[-64:])
+        return {
+            "replicas": [
+                {
+                    "id": r.id,
+                    "inflight": r.inflight,
+                    "cycles_served": r.cycles_served,
+                    "restarts": r.restarts,
+                    "resident_tenants": r.resident_tenants(),
+                }
+                for r in self.replicas
+            ],
+            "partitions": partitions,
+            "queue_depth": queue_depth,
+            "sheds": sheds,
+            "decision_log_tail": log_tail,
+        }
+
+    # ---- the decider-facing entry ----
+
+    def decide(
+        self, tenant: str, st, config, pack_meta=None, corr: Optional[str] = None
+    ) -> Tuple[object, float]:
+        req = self._request(tenant, st, config, pack_meta, corr)
+        if req.error is not None:  # shed at the door
+            raise req.error
+        if self._dispatcher is not None:
+            with self._cond:
+                if self._stop:
+                    # fail fast: nothing will ever drain the queue of a
+                    # closed pool — a 600 s event wait would just stall
+                    # the tenant's loop on teardown
+                    raise PoolUnavailable(
+                        f"tenant {req.tenant} decide on a closed pool"
+                    )
+                self._queue.append(req)
+                self._cond.notify_all()
+            if not req.event.wait(timeout=600.0):
+                # abandon, atomically against the serve path's claim:
+                # pull the request back OUT of the queue (a stalled
+                # dispatcher must not serve it later and record a
+                # success the tenant counted as an error) and flag an
+                # in-flight one so its late completion is logged
+                # "abandoned", not "served".  If the serve won the race
+                # (event set under the lock first), use its result.
+                with self._cond:
+                    done = req.event.is_set()
+                    if not done:
+                        if req in self._queue:
+                            self._queue.remove(req)
+                        req.abandoned = True
+                if not done:
+                    req.error = PoolUnavailable(
+                        f"tenant {req.tenant} decide timed out in the pool queue"
+                    )
+        else:
+            self._process([req])
+        if req.error is not None:
+            raise req.error
+        return req.decisions, req.kernel_ms
+
+    def decide_many(self, reqs: List[Tuple[str, object, object, object]]) -> List[PoolRequest]:
+        """Synchronous multi-request entry (tests / deterministic
+        harnesses): builds and serves one flush of requests, returning
+        the resolved PoolRequests (errors stored, not raised)."""
+        built = [
+            self._request(tenant, st, config, meta, corr=None)
+            for tenant, st, config, meta in reqs
+        ]
+        live = [r for r in built if r.error is None]
+        if live:
+            self._process(live)
+        return built
+
+    def _conf_yaml(self, config) -> str:
+        """Config -> YAML, cached per config object: tenants pass the
+        same long-lived SchedulerConfig every cycle, and a full YAML
+        dump per decide is wasted work inside the batching latency
+        budget.  The cache holds the config reference, so an id() can't
+        be recycled while its entry lives."""
+        key = id(config)
+        with self._lock:
+            hit = self._confs.get(key)
+        if hit is not None and hit[0] is config:
+            return hit[1]
+        from ..framework.conf import dump_conf
+
+        yaml = dump_conf(config)
+        with self._lock:
+            self._confs[key] = (config, yaml)
+            # bounded: a frontend minting a fresh config per cycle must
+            # not grow (and pin) an unbounded dict for the pool's life
+            while len(self._confs) > 64:
+                self._confs.pop(next(iter(self._confs)))
+        return yaml
+
+    def _request(self, tenant, st, config, pack_meta, corr) -> PoolRequest:
+        from ..utils.tracing import tracer
+
+        conf_yaml = self._conf_yaml(config)
+        with self._lock:
+            seq = self._seq.get(tenant, 0) + 1
+            self._seq[tenant] = seq
+        req = PoolRequest(
+            tenant=tenant,
+            st=st,
+            config=config,
+            conf_yaml=conf_yaml,
+            pack_meta=pack_meta,
+            corr=corr if corr is not None else tracer().current_corr_id(),
+            seq=seq,
+            shape=pack_shape_key(st, conf_yaml, config.actions),
+            t_submit=self.now(),
+        )
+        if self.admission is not None and self.admission.should_shed(tenant):
+            burn = self.admission.burn(tenant)
+            entry = {
+                "tenant": tenant,
+                "seq": seq,
+                "cycle": self.cycle,
+                "corr": req.corr,
+                "reason": "slo_burn",
+                "burn": None if burn is None else round(burn, 3),
+            }
+            with self._lock:
+                self.shed_log.append(entry)
+                del self.shed_log[: -self.log_capacity]
+            self._log(req, outcome="shed", replica=None, resident=None)
+            self._count(tenant, "shed")
+            req.error = PoolShed(
+                f"tenant {tenant} shed: sustained latency burn "
+                f"{entry['burn']} over its error budget"
+            )
+        return req
+
+    # ---- serving ----
+
+    def _chunks(self, reqs: List[PoolRequest]) -> List[List[PoolRequest]]:
+        """One flush -> shape-compatible groups of at most ``max_batch``
+        requests, in deterministic (shape-key-sorted) order — the ONE
+        grouping rule both the inline and the threaded path serve."""
+        groups: Dict[str, List[PoolRequest]] = {}
+        for r in reqs:
+            groups.setdefault(r.shape, []).append(r)
+        out: List[List[PoolRequest]] = []
+        for shape in sorted(groups):
+            group = groups[shape]
+            for i in range(0, len(group), self.max_batch):
+                out.append(group[i : i + self.max_batch])
+        return out
+
+    def _process(self, reqs: List[PoolRequest]) -> None:
+        """Group a flush by batching-compatibility key and serve each
+        group (one launch per group)."""
+        for chunk in self._chunks(reqs):
+            self._serve_group(chunk, excluded=set())
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._stop:
+                    self._cond.wait()
+                if self._stop and not self._queue:
+                    return
+                # bounded-delay fill: wait for min_fill same-flush
+                # requests, but never past the delay budget
+                deadline = time.monotonic() + self.batch_delay_s
+                while len(self._queue) < max(self.min_fill, 1):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or self._stop:
+                        break
+                    self._cond.wait(remaining)
+                batch, self._queue = self._queue, []
+            for chunk in self._chunks(batch):
+                replica = self._route(chunk, excluded=set())
+                if replica is None:
+                    # same split-don't-fail contract as the inline path:
+                    # _serve_group splits a cross-partitioned multi-
+                    # tenant group per tenant (rare, so running it on
+                    # one worker is fine)
+                    self._workers[0].submit(self._serve_split, chunk)
+                    continue
+                with self._lock:
+                    replica.inflight += len(chunk)
+                self._gauge_inflight(replica)
+                self._workers[replica.index].submit(
+                    self._serve_routed, replica, chunk
+                )
+
+    def _serve_split(self, group: List[PoolRequest]) -> None:
+        """Worker entry for an unroutable group: _serve_group handles
+        the per-tenant split (or the terminal failure); any escape
+        resolves the requests like _serve_routed."""
+        try:
+            self._serve_group(group, excluded=set())
+        except Exception as err:
+            self._resolve_error(group, err)
+
+    def _serve_routed(self, replica: PoolReplica, group: List[PoolRequest]) -> None:
+        """Replica-worker entry: serve the pre-routed group, rerouting on
+        a mid-decide replica loss; inflight bookkeeping wraps the whole
+        attempt chain.  ANY escape resolves the group's unresolved
+        requests — a worker future nobody reads must never strand a
+        tenant on its event wait with the real error lost."""
+        try:
+            self._serve_on(replica, group, excluded=set())
+        except Exception as err:
+            self._resolve_error(group, err)
+        finally:
+            with self._lock:
+                replica.inflight -= len(group)
+            self._gauge_inflight(replica)
+
+    def _route(
+        self, group: List[PoolRequest], excluded: set
+    ) -> Optional[PoolReplica]:
+        """Least-loaded over alive, non-partitioned replicas; round-robin
+        tiebreak keeps the spread deterministic when idle."""
+        tenants = {r.tenant for r in group}
+        with self._lock:
+            rr = self._rr
+            self._rr += 1
+            eligible = [
+                r
+                for r in self.replicas
+                if r.index not in excluded
+                and not any(
+                    (r.index, t) in self._partitions for t in tenants
+                )
+            ]
+            if not eligible:
+                return None
+            return min(
+                eligible,
+                key=lambda r: (r.inflight, (r.index - rr) % len(self.replicas)),
+            )
+
+    def _fail_group(self, group: List[PoolRequest]) -> None:
+        for req in group:
+            req.error = PoolUnavailable(
+                f"no replica can serve tenant {req.tenant} "
+                f"(partitions/exclusions cover the pool)"
+            )
+            self._log(req, outcome="error", replica=None, resident=None)
+            self._count(req.tenant, "error")
+            req.event.set()
+
+    def _resolve_error(self, group: List[PoolRequest], err: BaseException) -> None:
+        """A serve attempt died (launch error, resident lost to a
+        concurrent kill): resolve every still-unresolved request with
+        the REAL error so decide() re-raises it (classify_cycle_error
+        decides retryability) instead of a blind event-wait timeout."""
+        for req in group:
+            if req.event.is_set():
+                continue
+            req.error = err
+            self._log(req, outcome="error", replica=None, resident=None)
+            self._count(req.tenant, "error")
+            req.event.set()
+
+    def _serve_group(self, group: List[PoolRequest], excluded: set) -> None:
+        replica = self._route(group, excluded)
+        if replica is None:
+            # a multi-tenant group can be cross-partitioned (r0 cut from
+            # tenant A, r1 from tenant B) while every tenant still has a
+            # serveable replica alone — give up batching, not service
+            tenants = sorted({r.tenant for r in group})
+            if len(tenants) > 1:
+                for t in tenants:
+                    self._serve_group(
+                        [r for r in group if r.tenant == t], set(excluded)
+                    )
+                return
+            self._fail_group(group)
+            return
+        with self._lock:
+            replica.inflight += len(group)
+        self._gauge_inflight(replica)
+        try:
+            self._serve_on(replica, group, excluded)
+        except Exception as err:
+            self._resolve_error(group, err)
+        finally:
+            with self._lock:
+                replica.inflight -= len(group)
+            self._gauge_inflight(replica)
+
+    def _serve_on(
+        self, replica: PoolReplica, group: List[PoolRequest], excluded: set
+    ) -> None:
+        """Serve one shape-compatible group on ``replica``: chaos seam,
+        delta fan-out to the whole fleet, one batched launch, de-stack.
+        A mid-decide replica loss reroutes the group (full re-seed on the
+        new replica is automatic — its base may be stale)."""
+        if self.fault_hook is not None:
+            try:
+                self.fault_hook(replica, group)
+            except _ReplicaLost as lost:
+                excluded.add(lost.replica_index)
+                self._serve_group(group, excluded)
+                return
+        # fan-out replication: every reachable replica patches every
+        # tenant's resident pack, so the NEXT cycle can route anywhere
+        seeded: Dict[str, str] = {}
+        for req in group:
+            for r in self.replicas:
+                if self.is_partitioned(r.index, req.tenant):
+                    continue
+                mode = r.apply_delta(req.tenant, req.st, req.pack_meta)
+                if r is replica:
+                    seeded[req.tenant] = mode
+                if mode == "full" and req.pack_meta is not None and req.pack_meta.base_key is not None:
+                    # the delta's base was not resident here: the
+                    # generalized FAILED_PRECONDITION re-seed
+                    self._metrics().counter_add(
+                        "pool_pack_reseeds_total", labels={"replica": r.id}
+                    )
+        packs = []
+        residents = []
+        try:
+            for req in group:
+                key, pack = replica.resident(req.tenant)
+                residents.append(key)
+                packs.append(pack)
+        except KeyError:
+            # a concurrent kill_replica() cleared the packs between the
+            # fan-out and this read: treat it exactly like the chaos
+            # kill seam — the replica is lost to THIS group, reroute
+            # (the public kill path must be as hitless as the hook's)
+            excluded.add(replica.index)
+            self._serve_group(group, excluded)
+            return
+        decs, launch_ms = replica.decide_batch(tuple(packs), group[0].config)
+        self._metrics().observe("pool_batch_size", float(len(group)))
+        for req, dec, resident_key in zip(group, decs, residents):
+            req.decisions = dec
+            req.kernel_ms = launch_ms
+            req.replica = replica.id
+            req.batch = len(group)
+            req.reseeded = (
+                seeded.get(req.tenant) == "full"
+                and req.pack_meta is not None
+                and req.pack_meta.base_key is not None
+            )
+            # claim the request atomically against a timing-out decide():
+            # whoever moves first under the lock wins — the serve sets
+            # the event (decide() returns this result), or the abandon
+            # already landed and this completion is logged "abandoned"
+            with self._lock:
+                late = req.abandoned
+                if not late:
+                    req.event.set()
+            if late:
+                # the tenant already timed out and counted this cycle as
+                # an error: a late completion must NOT enter the log as
+                # served (the pool_consistency ground truth would then
+                # claim a cycle the tenant never committed) nor feed the
+                # admission ring a ~timeout-long latency sample
+                self._log(
+                    req, outcome="abandoned",
+                    replica=replica.id, resident=resident_key,
+                )
+                self._count(req.tenant, "error")
+                req.event.set()
+                continue
+            latency_ms = max((self.now() - req.t_submit) * 1000, 0.0)
+            if self.admission is not None:
+                self.admission.observe(req.tenant, latency_ms)
+            outcome = "resent" if req.reseeded else "served"
+            self._log(req, outcome=outcome, replica=replica.id, resident=resident_key)
+            self._count(req.tenant, outcome)
+
+    def _log(
+        self, req: PoolRequest, outcome: str, replica: Optional[str],
+        resident: Optional[str],
+    ) -> None:
+        if self.log_drop_served and outcome in ("served", "resent"):
+            return  # sensitivity seam: pool_consistency MUST breach
+        entry = {
+            "tenant": req.tenant,
+            "seq": req.seq,
+            "cycle": self.cycle,
+            "corr": req.corr,
+            "replica": replica,
+            "outcome": outcome,
+            "batch": req.batch,
+            "epoch": req.pack_meta.key if req.pack_meta is not None else None,
+            "resident": resident,
+        }
+        with self._lock:
+            self.decision_log.append(entry)
+            del self.decision_log[: -self.log_capacity]
+
+    def log_for(self, tenant: str, cycle: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            return [
+                e
+                for e in self.decision_log
+                if e["tenant"] == tenant
+                and (cycle is None or e["cycle"] == cycle)
+            ]
+
+    def close(self) -> None:
+        if self._dispatcher is not None:
+            with self._cond:
+                self._stop = True
+                self._cond.notify_all()
+            self._dispatcher.join(timeout=10.0)
+            for w in self._workers or ():
+                w.shutdown(wait=True)
+
+
+class PoolClient:
+    """The per-tenant decider facade: a Scheduler/Session decider whose
+    decide() routes through a shared :class:`DecisionPool`.  Like
+    RemoteDecider it consumes the HOST pack + PackMeta (the pool fans
+    the delta out itself), and like it there is one decide in flight per
+    tenant at a time (one scheduler loop per tenant — the pipelined
+    executor's single worker included)."""
+
+    wants_device_pack = False
+
+    def __init__(self, pool: DecisionPool, tenant: str):
+        self.pool = pool
+        self.tenant = tenant
+        self.last_action_ms: Dict[str, float] = {}
+        self.last_action_rounds: Dict[str, int] = {}
+        self.last_kernel_ms = 0.0
+
+    def decide(self, st, config, pack_meta=None) -> Tuple[object, float]:
+        dec, kernel_ms = self.pool.decide(
+            self.tenant, st, config, pack_meta=pack_meta
+        )
+        self.last_kernel_ms = kernel_ms
+        return dec, kernel_ms
+
+    def close(self) -> None:
+        pass
+
+
+def np_equal_decisions(a, b) -> bool:
+    """Bit-equality of two CycleDecisions (parity suites)."""
+    for f in dataclasses.fields(type(a)):
+        if not np.array_equal(
+            np.asarray(getattr(a, f.name)), np.asarray(getattr(b, f.name))
+        ):
+            return False
+    return True

@@ -29,6 +29,7 @@ from .codec import (
     ARENA_BASE_METADATA_KEY,
     ARENA_EPOCH_METADATA_KEY,
     CORR_ID_METADATA_KEY,
+    TENANT_METADATA_KEY,
     decide_reply,
     unpack_fields,
     unpack_tensors,
@@ -50,7 +51,12 @@ CHANNEL_OPTIONS = [
 class DecisionService:
     """Implements DecisionPlane against the local jax backend."""
 
-    def __init__(self, decider_factory=None):
+    # fleet serving: resident packs are kept per TENANT (the kat-tenant
+    # request metadata), bounded — beyond this many tenants the
+    # least-recently-decided tenant's pack is evicted back to full sends
+    MAX_TENANT_PACKS = 64
+
+    def __init__(self, decider_factory=None, replica_id: str = ""):
         # grpc.server runs handlers on a ThreadPoolExecutor, so Decide and
         # Health race: the counter and the conf cache are shared state and
         # every access takes _lock (KAT-LCK discipline: the lock guards
@@ -61,16 +67,20 @@ class DecisionService:
         # fault-wrapped decider so the client's retry path runs against a
         # REAL gRPC server failing on schedule (None = LocalDecider)
         self._decider_factory = decider_factory
+        # pool posture: the replica identity this service reports in logs
+        # and the obs plane's /readyz (N replicas on one host must be
+        # tellable apart); "" = standalone single-sidecar deployment
+        self.replica_id = replica_id
         self.cycles_served = 0
         # conf YAML -> parsed SchedulerConfig; jax caches the compiled
         # program per (conf, shape-bucket) under its own jit cache
         self._conf_cache: Dict[str, object] = {}
-        # arena pack reuse (cache/arena.py protocol): the most recent
-        # epoch-keyed pack, so a delta Decide ships only changed fields
-        # and patches this resident copy.  One slot — competing clients
-        # simply evict each other back to full sends (still correct).
-        self._pack_key: Optional[str] = None
-        self._pack: Optional[object] = None
+        # arena pack reuse (cache/arena.py protocol), keyed by TENANT:
+        # each frontend's delta stream patches its own epoch-keyed
+        # resident pack, so M frontends multiplexed onto this replica
+        # never evict each other back to full sends (the pre-pool single
+        # slot did exactly that).  Insertion order doubles as the LRU.
+        self._packs: Dict[str, Tuple[str, object]] = {}
 
     def _config(self, conf_yaml: str):
         with self._lock:
@@ -93,7 +103,7 @@ class DecisionService:
         # metadata (rpc/codec.py CORR_ID_METADATA_KEY); re-activating it
         # here stitches this handler's spans into the SAME trace the
         # scheduler process opened — one remote cycle, one trace.
-        corr = epoch_key = base_key = ""
+        corr = epoch_key = base_key = tenant = ""
         for k, v in context.invocation_metadata() or ():
             if k == CORR_ID_METADATA_KEY:
                 corr = v
@@ -101,6 +111,8 @@ class DecisionService:
                 epoch_key = v
             elif k == ARENA_BASE_METADATA_KEY:
                 base_key = v
+            elif k == TENANT_METADATA_KEY:
+                tenant = v
         tr = tracer()
         t_req = time.perf_counter()
         with tr.activate(corr or None, component="sidecar"):
@@ -114,10 +126,14 @@ class DecisionService:
                 # routing exists to avoid.  The decider moves the arrays
                 # onto the routed device itself.
                 with tr.span("unpack", delta=bool(base_key)):
-                    st = self._unpack_request(request, base_key, context)
+                    st = self._unpack_request(request, base_key, tenant, context)
                 if epoch_key:
                     with self._lock:
-                        self._pack_key, self._pack = epoch_key, st
+                        # re-insertion moves the tenant to the LRU tail
+                        self._packs.pop(tenant, None)
+                        self._packs[tenant] = (epoch_key, st)
+                        while len(self._packs) > self.MAX_TENANT_PACKS:
+                            self._packs.pop(next(iter(self._packs)))
                 # LocalDecider applies the same backend crossover as the
                 # in-process path (platform.decision_route): small and
                 # EVICTIVE cycles run on the host CPU even when this
@@ -156,17 +172,19 @@ class DecisionService:
             self.cycles_served += 1
         return rep
 
-    def _unpack_request(self, request, base_key: str, context):
+    def _unpack_request(self, request, base_key: str, tenant: str, context):
         """Full request -> fresh pack; delta request (base_key set) ->
-        patch the resident pack with the shipped fields.  A missing or
-        mismatched base aborts FAILED_PRECONDITION so the client re-sends
-        the pack in full (sidecar restarts / competing clients)."""
+        patch the TENANT's resident pack with the shipped fields.  A
+        missing or mismatched base aborts FAILED_PRECONDITION so the
+        client re-sends the pack in full (replica restarts, pack
+        evicted past MAX_TENANT_PACKS, healed partitions)."""
         from ..cache.snapshot import SnapshotTensors
 
         if not base_key:
             return unpack_tensors(SnapshotTensors, request.tensors)
         with self._lock:
-            cached = self._pack if self._pack_key == base_key else None
+            pair = self._packs.get(tenant)
+            cached = pair[1] if pair is not None and pair[0] == base_key else None
         if cached is None:
             import grpc
 
@@ -178,6 +196,16 @@ class DecisionService:
         metrics().counter_add("rpc_pack_reuse_total")
         patch = unpack_fields(SnapshotTensors, request.tensors)
         return dataclasses.replace(cached, **patch) if patch else cached
+
+    def drop_resident_packs(self) -> None:
+        """Forget every tenant's resident pack — the replica-restart seam
+        (a redeployed replica process rejoins with no state).  Clients in
+        the middle of a delta stream hit FAILED_PRECONDITION on their
+        next Decide and transparently re-send in full, so the restart is
+        hitless; the pool's chaos plane and the pipelined full-resend
+        regression test drive exactly this."""
+        with self._lock:
+            self._packs.clear()
 
     def Health(self, request: "pb.HealthRequest", context) -> "pb.HealthReply":
         import jax
@@ -215,12 +243,14 @@ def serve(
     bind: str = "127.0.0.1:0",
     max_workers: int = 4,
     service: Optional[DecisionService] = None,
+    replica_id: str = "",
 ):
     """Start the sidecar.  Returns (grpc server, bound port).  The caller
-    owns shutdown (``server.stop``)."""
+    owns shutdown (``server.stop``).  ``replica_id`` names this replica
+    in logs/obs when N pool replicas share a host."""
     import grpc
 
-    service = service or DecisionService()
+    service = service or DecisionService(replica_id=replica_id)
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers), options=CHANNEL_OPTIONS
     )
@@ -229,12 +259,16 @@ def serve(
     if port == 0:
         raise RuntimeError(f"failed to bind {bind}")
     server.start()
-    log.info("decision sidecar serving on port %d", port)
+    log.info(
+        "decision sidecar%s serving on port %d",
+        f" replica {service.replica_id}" if service.replica_id else "", port,
+    )
     return server, port
 
 
-def main(bind: str = "0.0.0.0:8686") -> None:
+def main(bind: str = "0.0.0.0:8686", replica_id: str = "") -> None:
     """Blocking entry point for ``python -m kube_arbitrator_tpu sidecar``."""
-    server, port = serve(bind)
-    print(f"decision sidecar listening on {port}", flush=True)
+    server, port = serve(bind, replica_id=replica_id)
+    rid = f" (replica {replica_id})" if replica_id else ""
+    print(f"decision sidecar listening on {port}{rid}", flush=True)
     server.wait_for_termination()

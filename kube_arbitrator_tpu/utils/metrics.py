@@ -61,6 +61,11 @@ METRIC_HELP: Dict[str, str] = {
     "pipeline_stage_occupancy": "Fraction of the last effective cycle period each stage was busy (stage label).",
     "pipeline_discards_total": "Speculative decisions dropped by commit-time revalidation (reason label).",
     "pipeline_backpressure_total": "Decide-wait windows where ingest hit its pump cap and blocked (ingest outran decide).",
+    # decision pool / fleet serving (rpc/pool.py)
+    "pool_requests_total": "Tenant decide requests through the decision pool (tenant + outcome label: served / resent [served after a full pack re-seed] / shed [admission dropped] / error).",
+    "pool_batch_size": "Same-shape snapshot packs stacked into one XLA launch by the pool batcher.",
+    "pool_replica_inflight": "Requests currently in flight on a pool replica (replica label; the least-loaded routing input).",
+    "pool_pack_reseeds_total": "Per-replica full pack re-seeds after a lost delta base (replica restart/join/healed partition — the generalized FAILED_PRECONDITION path).",
     # chaos plane (kube_arbitrator_tpu/chaos)
     "chaos_faults_injected_total": "Faults injected by the chaos plane (kind label).",
     "chaos_invariant_breaches_total": "Cluster-level invariant breaches the chaos plane detected (invariant label).",

@@ -289,3 +289,35 @@ def test_obs_plane_end_to_end(obs_e2e):
     # the index route lists the endpoint catalog
     status, body = _get(url + "/")
     assert status == 200 and "/metrics" in json.loads(body)["endpoints"]
+
+
+def test_replica_id_and_pool_route_multi_process_posture():
+    """Fleet posture (rpc/pool.py satellite): port=0 binds an ephemeral
+    port per replica (two servers never collide), /healthz + /readyz
+    report the replica id so probes can tell N same-host replicas
+    apart, and /debug/pool serves the pool status document."""
+    import json
+
+    from kube_arbitrator_tpu.rpc.pool import DecisionPool
+
+    pool = DecisionPool(replicas=2, threaded=False)
+    a_srv, _t, a_url = serve_obs(port=0, replica_id="r0", pool=pool)
+    b_srv, _t, b_url = serve_obs(port=0, replica_id="r1")
+    try:
+        assert a_url != b_url  # ephemeral ports: no collision
+        for url, rid in ((a_url, "r0"), (b_url, "r1")):
+            _status, body = _get(url + "/healthz")
+            assert json.loads(body)["replica"] == rid
+            _status, body = _get(url + "/readyz")
+            assert json.loads(body)["replica"] == rid
+        _status, body = _get(a_url + "/debug/pool")
+        doc = json.loads(body)
+        assert [r["id"] for r in doc["replicas"]] == ["r0", "r1"]
+        # no pool wired: the route answers with the wiring hint, not 404
+        _status, body = _get(b_url + "/debug/pool")
+        assert "error" in json.loads(body)
+        _status, body = _get(a_url + "/")
+        assert "/debug/pool" in json.loads(body)["endpoints"]
+    finally:
+        a_srv.shutdown()
+        b_srv.shutdown()

@@ -1,0 +1,362 @@
+"""Decision pool (rpc/pool.py): batching parity, replication, routing,
+shedding, metrics conformance, and the multi-replica chaos matrix.
+
+The load-bearing property is DECISION BIT-IDENTITY: a pool run where the
+batcher stacks same-shape packs into one XLA launch must place exactly
+what independent single-sidecar runs place, per tenant — batching is a
+throughput mechanism, never a semantics change.  World sizes are kept on
+one snapshot shape bucket so the batched programs compile once per batch
+size across the whole module.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from kube_arbitrator_tpu.cache import build_snapshot, generate_cluster
+from kube_arbitrator_tpu.framework import Scheduler
+from kube_arbitrator_tpu.framework.conf import SchedulerConfig, dump_conf
+from kube_arbitrator_tpu.framework.decider import LocalDecider
+from kube_arbitrator_tpu.rpc.pool import (
+    DecisionPool,
+    PoolClient,
+    PoolShed,
+    PoolUnavailable,
+    TenantAdmission,
+    np_equal_decisions,
+    pack_shape_key,
+)
+from kube_arbitrator_tpu.utils.metrics import MetricsRegistry, metrics
+
+
+def _world(seed, running_fraction=0.0):
+    return generate_cluster(
+        num_nodes=16, num_jobs=4, tasks_per_job=4, num_queues=2,
+        seed=seed, running_fraction=running_fraction,
+    )
+
+
+def _bound(sim):
+    return {
+        t.uid: t.node_name
+        for j in sim.cluster.jobs.values()
+        for t in j.tasks.values()
+    }
+
+
+# ---- batching compatibility (the KAT-CTR symbolic-shape rule) ----
+
+
+def test_shape_key_groups_compatible_packs():
+    cfg = SchedulerConfig.default()
+    yaml = dump_conf(cfg)
+    a = build_snapshot(_world(1).cluster).tensors
+    b = build_snapshot(_world(2).cluster).tensors
+    assert pack_shape_key(a, yaml, cfg.actions) == pack_shape_key(b, yaml, cfg.actions)
+    # a different world size resolves different symbolic axes
+    big = build_snapshot(
+        generate_cluster(num_nodes=200, num_jobs=16, tasks_per_job=4,
+                         num_queues=2, seed=3).cluster
+    ).tensors
+    assert pack_shape_key(big, yaml, cfg.actions) != pack_shape_key(a, yaml, cfg.actions)
+    # a different conf compiles a different program: never stackable
+    assert pack_shape_key(a, yaml + "# v2", cfg.actions) != pack_shape_key(
+        a, yaml, cfg.actions
+    )
+    # the evictive routing class is part of the key (decision_route would
+    # place the programs on different devices on accelerator hosts)
+    ev = build_snapshot(_world(1, running_fraction=0.5).cluster).tensors
+    assert pack_shape_key(ev, yaml, ("allocate", "preempt", "reclaim", "backfill")) != (
+        pack_shape_key(a, yaml, ("allocate", "preempt", "reclaim", "backfill"))
+    )
+
+
+def test_batched_launch_bit_identical_to_single():
+    """One launch of B stacked packs == B single launches, bit for bit,
+    on every CycleDecisions field."""
+    cfg = SchedulerConfig.default()
+    packs = [build_snapshot(_world(s).cluster).tensors for s in (11, 12, 13)]
+    pool = DecisionPool(replicas=1, threaded=False)
+    reqs = pool.decide_many([(f"t{i}", p, cfg, None) for i, p in enumerate(packs)])
+    assert all(r.error is None for r in reqs)
+    assert {r.batch for r in reqs} == {3}
+    ld = LocalDecider()
+    for r, p in zip(reqs, packs):
+        dec, _ = ld.decide(p, cfg)
+        assert np_equal_decisions(r.decisions, dec), f"{r.tenant} diverged"
+
+
+def test_incompatible_shapes_split_into_separate_launches():
+    cfg = SchedulerConfig.default()
+    small = build_snapshot(_world(21).cluster).tensors
+    big = build_snapshot(
+        generate_cluster(num_nodes=200, num_jobs=16, tasks_per_job=4,
+                         num_queues=2, seed=22).cluster
+    ).tensors
+    pool = DecisionPool(replicas=1, threaded=False)
+    reqs = pool.decide_many([("a", small, cfg, None), ("b", big, cfg, None)])
+    assert all(r.error is None for r in reqs)
+    assert all(r.batch == 1 for r in reqs), "incompatible packs were stacked"
+
+
+# ---- the 2-replica x 4-frontend acceptance run ----
+
+
+def test_pool_2x4_batched_matches_independent_runs():
+    """2 replicas x 4 tenant frontends on threads, min_fill forcing the
+    batcher to stack: per-tenant decisions must equal 4 independent
+    single-scheduler runs, and at least one launch must have stacked
+    >= 2 same-shape packs."""
+    pool = DecisionPool(
+        replicas=2, threaded=True, min_fill=4, batch_delay_s=0.25, max_batch=8,
+    )
+    sims = [_world(100 + i) for i in range(4)]
+    scheds = [
+        Scheduler(s, decider=PoolClient(pool, f"t{i}"), arena=True)
+        for i, s in enumerate(sims)
+    ]
+    threads = [
+        threading.Thread(target=lambda s=s: s.run(max_cycles=3, until_idle=False))
+        for s in scheds
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    pool.close()
+    refs = [_world(100 + i) for i in range(4)]
+    for r in refs:
+        Scheduler(r, arena=True).run(max_cycles=3, until_idle=False)
+    for sim, ref in zip(sims, refs):
+        assert _bound(sim) == _bound(ref), "pooled tenant diverged"
+    sizes = [
+        e["batch"] for e in pool.decision_log
+        if e["outcome"] in ("served", "resent")
+    ]
+    assert max(sizes) >= 2, f"batching never stacked: {sizes}"
+    assert sum(s.binds for sc in scheds for s in sc.history) > 0
+
+
+# ---- epoch-keyed replication: restart, partition, epoch correctness ----
+
+
+def test_delta_fanout_hitless_replica_restart():
+    pool = DecisionPool(replicas=2, threaded=False)
+    sims = [_world(40 + i, running_fraction=0.2) for i in range(2)]
+    scheds = [
+        Scheduler(s, decider=PoolClient(pool, f"t{i}"), arena=True)
+        for i, s in enumerate(sims)
+    ]
+    for cycle in range(4):
+        if cycle == 2:
+            pool.kill_replica(0)  # packs gone; rejoin empty
+        for s in scheds:
+            s.run(max_cycles=1, until_idle=False)
+    refs = [_world(40 + i, running_fraction=0.2) for i in range(2)]
+    for r in refs:
+        Scheduler(r, arena=True).run(max_cycles=4, until_idle=False)
+    for sim, ref in zip(sims, refs):
+        assert _bound(sim) == _bound(ref), "restart changed decisions"
+    log = pool.log_for("t0")
+    assert any(e["outcome"] == "resent" for e in log), log
+    # the pool invariant locally: every serve decided the shipped epoch
+    for e in log:
+        if e["outcome"] in ("served", "resent"):
+            assert e["epoch"] == e["resident"], e
+
+
+def test_partition_forces_full_reseed_on_heal():
+    pool = DecisionPool(replicas=2, threaded=False)
+    sim = _world(55)
+    sched = Scheduler(sim, decider=PoolClient(pool, "tp"), arena=True)
+    sched.run(max_cycles=1, until_idle=False)
+    # r1 loses the tenant for one pool cycle: fan-out skips it
+    pool.begin_cycle(1)
+    pool.partition(1, "tp", cycles=1)
+    sched.run(max_cycles=1, until_idle=False)
+    assert pool.log_for("tp")[-1]["replica"] == "r0"
+    # heal, then force routing onto the stale replica
+    pool.begin_cycle(3)
+    assert not pool.is_partitioned(1, "tp")
+    pool.partition(0, "tp", cycles=1)
+    sched.run(max_cycles=1, until_idle=False)
+    last = pool.log_for("tp")[-1]
+    assert last["replica"] == "r1"
+    assert last["outcome"] == "resent", last  # stale base -> full re-seed
+    assert last["epoch"] == last["resident"], last
+
+
+def test_all_replicas_partitioned_is_retryable_unavailable():
+    pool = DecisionPool(replicas=2, threaded=False)
+    sim = _world(66)
+    sched = Scheduler(sim, decider=PoolClient(pool, "tu"), arena=True)
+    sched.run(max_cycles=1, until_idle=False)
+    pool.partition(0, "tu", cycles=2)
+    pool.partition(1, "tu", cycles=2)
+    st = build_snapshot(sim.cluster).tensors
+    with pytest.raises(PoolUnavailable) as err:
+        pool.decide("tu", st, SchedulerConfig.default())
+    assert getattr(err.value, "retryable", False) is True
+
+
+# ---- admission / load shedding ----
+
+
+def test_admission_sheds_on_sustained_burn_and_recovers():
+    clock = [0.0]
+    adm = TenantAdmission(
+        slo_ms=100.0, budget=0.5, windows=((20.0, 5.0, 1.0),),
+        min_samples=4, now_fn=lambda: clock[0],
+    )
+    pool = DecisionPool(replicas=1, threaded=False, admission=adm,
+                        now_fn=lambda: clock[0])
+    cfg = SchedulerConfig.default()
+    st = build_snapshot(_world(77).cluster).tensors
+    # sustained breach: every served cycle over the SLO
+    for _ in range(6):
+        clock[0] += 1.0
+        adm.observe("hot", 500.0)
+    assert adm.should_shed("hot")
+    with pytest.raises(PoolShed) as err:
+        pool.decide("hot", st, cfg)
+    assert getattr(err.value, "retryable", False) is True
+    assert pool.shed_log and pool.shed_log[-1]["tenant"] == "hot"
+    assert pool.log_for("hot")[-1]["outcome"] == "shed"
+    # a quiet tenant is untouched
+    assert not adm.should_shed("cold")
+    dec, _ = pool.decide("cold", st, cfg)
+    assert dec is not None
+    # recovery: the breach rows age out of the windows
+    clock[0] += 60.0
+    assert not adm.should_shed("hot")
+    dec, _ = pool.decide("hot", st, cfg)
+    assert dec is not None
+
+
+# ---- metrics ----
+
+
+def test_pool_metrics_promtext_conformance():
+    from tests.test_obs import check_promtext
+
+    reg = MetricsRegistry()
+    clock = [0.0]
+    adm = TenantAdmission(
+        slo_ms=50.0, budget=0.5, windows=((20.0, 5.0, 1.0),),
+        min_samples=2, now_fn=lambda: clock[0],
+    )
+    pool = DecisionPool(replicas=2, threaded=False, admission=adm,
+                        registry=reg, now_fn=lambda: clock[0])
+    cfg = SchedulerConfig.default()
+    packs = [build_snapshot(_world(81 + i).cluster).tensors for i in range(2)]
+    pool.decide_many([("m0", packs[0], cfg, None), ("m1", packs[1], cfg, None)])
+    for _ in range(4):
+        adm.observe("m0", 500.0)
+    reqs = pool.decide_many([("m0", packs[0], cfg, None)])
+    assert isinstance(reqs[0].error, PoolShed)
+    text = reg.render()
+    check_promtext(text)
+    assert 'pool_requests_total{outcome="served",tenant="m0"}' in text
+    assert 'pool_requests_total{outcome="shed",tenant="m0"}' in text
+    assert "pool_batch_size_bucket" in text
+    assert 'pool_replica_inflight{replica="r0"}' in text
+
+
+# ---- pipelined frontend through the pool ----
+
+
+def test_pipelined_frontend_through_pool_matches_sequential():
+    pool = DecisionPool(replicas=2, threaded=False)
+    sim_a = _world(91)
+    sim_b = _world(91)
+    seq = Scheduler(sim_a, decider=PoolClient(pool, "sq"), arena=True)
+    pipe = Scheduler(sim_b, decider=PoolClient(pool, "pp"), arena=True)
+    seq.run(max_cycles=3, until_idle=False)
+    pipe.run_pipelined(max_cycles=3, until_idle=False)
+    assert _bound(sim_a) == _bound(sim_b)
+    assert sum(s.binds for s in seq.history) == sum(s.binds for s in pipe.history) > 0
+
+
+# ---- multi-replica chaos ----
+
+
+def test_pool_chaos_clean_seeds_and_determinism():
+    from kube_arbitrator_tpu.chaos import run_pool_chaos
+
+    a = run_pool_chaos(seed=1, cycles=6, profile="pool")
+    assert a.ok, a.breaches
+    kinds = {i["kind"] for i in a.injected}
+    assert kinds & {"replica_kill", "replica_partition", "replica_slow"}, kinds
+    b = run_pool_chaos(seed=1, cycles=6, profile="pool")
+    assert a.digests == b.digests
+    assert a.repro_json() == b.repro_json()
+
+
+def test_pool_log_sensitivity_canary_breaches():
+    """--disable pool-log drops served entries: the pool_consistency
+    checker MUST breach — proof it actually reads the decision log."""
+    from kube_arbitrator_tpu.chaos import run_pool_chaos
+
+    rep = run_pool_chaos(seed=0, cycles=4, profile="pool", disabled=("pool-log",))
+    assert not rep.ok
+    assert {b.invariant for b in rep.breaches} == {"pool_consistency"}
+
+
+def test_serve_path_error_resolves_requests_with_the_real_error():
+    """A failed batched launch must resolve every request in the group
+    with the actual exception — never strand a tenant on its event wait
+    (threaded path) or swallow the error (inline path)."""
+    boom = RuntimeError("launch exploded")
+    cfg = SchedulerConfig.default()
+    st = build_snapshot(_world(71).cluster).tensors
+    # inline: decide_many stores the error per request (decide_batch is
+    # the replica's documented override seam)
+    pool = DecisionPool(replicas=1, threaded=False)
+    pool.replicas[0].decide_batch = lambda packs, config: (_ for _ in ()).throw(boom)
+    reqs = pool.decide_many([("e0", st, cfg, None)])
+    assert reqs[0].error is boom
+    assert pool.log_for("e0")[-1]["outcome"] == "error"
+    # threaded: decide() re-raises promptly instead of timing out
+    pool2 = DecisionPool(replicas=1, threaded=True, batch_delay_s=0.01)
+    pool2.replicas[0].decide_batch = lambda packs, config: (_ for _ in ()).throw(boom)
+    with pytest.raises(RuntimeError, match="launch exploded"):
+        pool2.decide("e1", st, cfg)
+    pool2.close()
+
+
+def test_cross_partitioned_batch_splits_per_tenant():
+    """r0 cut from tenant A and r1 from tenant B must not fail a batch
+    holding both — the pool gives up batching, not service."""
+    cfg = SchedulerConfig.default()
+    pa = build_snapshot(_world(72).cluster).tensors
+    pb = build_snapshot(_world(73).cluster).tensors
+    pool = DecisionPool(replicas=2, threaded=False)
+    pool.partition(0, "A", cycles=5)
+    pool.partition(1, "B", cycles=5)
+    reqs = pool.decide_many([("A", pa, cfg, None), ("B", pb, cfg, None)])
+    assert all(r.error is None for r in reqs), [r.error for r in reqs]
+    by_tenant = {r.tenant: r for r in reqs}
+    assert by_tenant["A"].replica == "r1" and by_tenant["B"].replica == "r0"
+    assert all(r.batch == 1 for r in reqs)  # split, not stacked
+
+
+def test_concurrent_kill_between_fanout_and_resident_reroutes():
+    """kill_replica() racing a serve (packs cleared after fan-out) must
+    reroute like the chaos kill seam, never surface a fatal KeyError."""
+    cfg = SchedulerConfig.default()
+    st = build_snapshot(_world(74).cluster).tensors
+    pool = DecisionPool(replicas=2, threaded=False)
+    state = {"raised": False}
+    for rep in pool.replicas:
+        orig = rep.resident
+
+        def flaky(tenant, _orig=orig):
+            if not state["raised"]:
+                state["raised"] = True
+                raise KeyError(tenant)  # the cleared-packs race window
+            return _orig(tenant)
+
+        rep.resident = flaky
+    dec, _ = pool.decide("rk", st, cfg)
+    assert dec is not None and state["raised"]
+    assert pool.log_for("rk")[-1]["outcome"] in ("served", "resent")

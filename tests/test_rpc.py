@@ -165,6 +165,85 @@ def test_remote_decider_retry_uses_injected_sleep_and_schedule():
     d.close()
 
 
+def test_sidecar_multi_tenant_pack_isolation(sidecar):
+    """Fleet serving: two frontends with distinct tenant ids interleaved
+    on ONE sidecar must keep independent delta streams — before the
+    per-tenant resident packs they evicted each other back to a full
+    resend every cycle."""
+    from kube_arbitrator_tpu.utils.metrics import metrics
+
+    sims = [
+        generate_cluster(num_nodes=16, num_jobs=5, tasks_per_job=4,
+                         num_queues=2, seed=31 + i, running_fraction=0.2)
+        for i in range(2)
+    ]
+    scheds = [
+        Scheduler(s, decider=RemoteDecider(sidecar, tenant=f"iso-t{i}"), arena=True)
+        for i, s in enumerate(sims)
+    ]
+    resend0 = metrics().counter_value("rpc_pack_resend_total")
+    reuse0 = metrics().counter_value("rpc_pack_reuse_total")
+    try:
+        for _cycle in range(3):
+            for s in scheds:
+                s.run(max_cycles=1, until_idle=False)
+    finally:
+        for s in scheds:
+            s.decider.close()
+    assert metrics().counter_value("rpc_pack_resend_total") == resend0, (
+        "interleaved tenants evicted each other's resident packs"
+    )
+    # both tenants' cycles 2..3 patched their own resident pack
+    assert metrics().counter_value("rpc_pack_reuse_total") - reuse0 >= 4
+
+
+def test_pipelined_full_resend_after_sidecar_restart(sidecar):
+    """The FAILED_PRECONDITION full-resend path under the PIPELINED
+    RemoteDecider (only the sequential path was covered): the sidecar
+    restarts (resident packs dropped) while a delta decide is in flight
+    on the executor's worker; the frontend must transparently re-send
+    the pack in full and the run must place exactly what a
+    never-restarted run places."""
+    from kube_arbitrator_tpu.pipeline import PipelinedExecutor
+    from kube_arbitrator_tpu.rpc.sidecar import DecisionService
+    from kube_arbitrator_tpu.utils.metrics import metrics
+
+    # a dedicated sidecar so drop_resident_packs cannot race the
+    # module-scoped fixture's other tests
+    svc = DecisionService()
+    server, port = serve("127.0.0.1:0", service=svc)
+    target = f"127.0.0.1:{port}"
+    mk = lambda: generate_cluster(  # noqa: E731
+        num_nodes=24, num_jobs=5, tasks_per_job=6, num_queues=2, seed=47,
+        running_fraction=0.2,
+    )
+    sim_r, sim_ref = mk(), mk()
+    sched = Scheduler(sim_r, decider=RemoteDecider(target, tenant="pipe"), arena=True)
+    executor = PipelinedExecutor(
+        sched,
+        # the restart lands THROUGH the mid-flight seam: ingest_fn runs
+        # on the main thread while the worker's decide (carrying a delta
+        # keyed to the now-dropped base) is in flight
+        ingest_fn=lambda: (svc.drop_resident_packs(), 0)[1],
+    )
+    resend0 = metrics().counter_value("rpc_pack_resend_total")
+    try:
+        for _ in range(4):
+            executor.step()
+    finally:
+        executor.close()
+        sched.decider.close()
+        server.stop(grace=None)
+    ref = Scheduler(sim_ref, arena=True)
+    ref.run(max_cycles=4, until_idle=False)
+    bound_r = {t.uid: t.node_name for j in sim_r.cluster.jobs.values() for t in j.tasks.values()}
+    bound_ref = {t.uid: t.node_name for j in sim_ref.cluster.jobs.values() for t in j.tasks.values()}
+    assert bound_r == bound_ref, "restart under pipelining changed decisions"
+    assert metrics().counter_value("rpc_pack_resend_total") > resend0, (
+        "the full-resend path never fired"
+    )
+
+
 def test_pipelined_remote_matches_sequential_remote(sidecar):
     """Overlap through the wire: run_pipelined with a RemoteDecider (the
     epoch-keyed delta protocol under the frozen-pack discipline) places
