@@ -122,8 +122,10 @@ fi
 # when a safety mechanism (the arena byte-identity verifier) is off.
 rc_chaos=0
 if [ "${CHAOS:-0}" = "1" ]; then
+  # KAT_DECODE_PARITY=1: every compact ints-out decode in the matrix is
+  # cross-checked against the dense-mask oracle per cycle
   for seed in 0 1 2 3 4 5 6 7; do
-    env JAX_PLATFORMS=cpu python -m kube_arbitrator_tpu.chaos \
+    env JAX_PLATFORMS=cpu KAT_DECODE_PARITY=1 python -m kube_arbitrator_tpu.chaos \
       --seed "${seed}" --cycles 10 --profile smoke --out-dir /tmp \
       || rc_chaos=$?
   done
@@ -211,6 +213,12 @@ rc_perf=0
 if [ "${PERF_SMOKE:-0}" = "1" ]; then
   env JAX_PLATFORMS=cpu python -m pytest -q tests/test_batched_turns.py \
     || rc_perf=$?
+  # decode-parity leg: the ints-out compact lists vs the dense-mask
+  # oracle — empty/storm/overflow shapes, the 3-seed x q{8,64,512}
+  # matrix, and the pipelined/RPC/pool serving paths (with the
+  # per-cycle oracle cross-check armed)
+  env JAX_PLATFORMS=cpu KAT_DECODE_PARITY=1 python -m pytest -q \
+    tests/test_decode_parity.py || rc_perf=$?
   # rounds-x-turns smoke on a live run: the batched engines must finish
   # the q512 contention world in a handful of rounds and leave decisions
   # identical to the sequential engines, with the round gate on AND off
@@ -259,11 +267,22 @@ EOF
   python -m kube_arbitrator_tpu.analysis --rules KAT-LCK,KAT-DTY \
     kube_arbitrator_tpu/ops/preempt.py \
     kube_arbitrator_tpu/ops/allocate.py \
+    kube_arbitrator_tpu/ops/cycle.py \
+    kube_arbitrator_tpu/cache/decode.py \
     kube_arbitrator_tpu/ops/native/segsum.py || rc_perf=$?
+  # regression sentinel compare on the standard rung, in the SAME run as
+  # the decode-parity leg: a decode-path change that regresses the cycle
+  # must fail this lane, not just the nightly (no-baseline pass on
+  # foreign host classes; the real gate on recorded ones)
+  if [ -f BENCH_HISTORY.jsonl ]; then
+    env JAX_PLATFORMS=cpu python -m kube_arbitrator_tpu.sentinel measure \
+      --rung 2000x200 --reps 3 --history BENCH_HISTORY.jsonl --compare \
+      || rc_perf=$?
+  fi
   if [ "${rc_perf}" -ne 0 ]; then
     echo "perf smoke job: FAILED (exit ${rc_perf})" >&2
   else
-    echo "perf smoke job: ok (parity soak + turn bound + reclaim/gate smoke + kat-lint)"
+    echo "perf smoke job: ok (parity soak + decode parity + turn bound + reclaim/gate smoke + sentinel compare + kat-lint)"
   fi
 fi
 

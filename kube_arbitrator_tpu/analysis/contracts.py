@@ -66,6 +66,13 @@ Sub-ids:
   decision path reads them, so this pass (plus the runtime decode twin,
   which holds the full DECISIONS_SCHEMA including this subset) is the
   only drift detector.
+- ``KAT-CTR-011``: the ints-out DECODE-LIST contract — ``commit_cycle``'s
+  compact bind/evict index lists (``bind_idx``/``bind_node``/
+  ``evict_idx`` + counts, cumsum-compacted in-graph) drift from the
+  declared :data:`DECODE_LISTS_SCHEMA` (with the ``B``/``E`` axes
+  resolved live from ``ops/cycle.decode_caps``).  cache/decode.py
+  gathers these host-side into the actuated intents, so a drift here
+  corrupts the bind stream itself.
 
 The harness takes the schemas as parameters so the regression tests can
 seed one mutated dtype and assert the checker reports exactly the
@@ -207,6 +214,7 @@ STATE_SCHEMA: Dict[str, Tuple[Tuple[str, ...], str]] = {
     "progress": ((), "bool"),
     "rounds": ((), "int32"),
     "rounds_gated": ((), "int32"),
+    "claim_conflicts": ((), "int32"),
 }
 
 SESSION_SCHEMA: Dict[str, Tuple[Tuple[str, ...], str]] = {
@@ -258,8 +266,24 @@ AUDIT_AUX_SCHEMA: Dict[str, Tuple[Tuple[str, ...], str]] = {
     "queue_alloc": (("Q", "R"), "float32"),
 }
 
+#: The ints-out decode lists (KAT-CTR-011): the compact bind/evict index
+#: lists ``commit_cycle`` compacts in-graph and
+#: cache/decode.decode_decisions_compact consumes host-side (they ride
+#: the RPC reply pack by name, like the audit aux).  The ``B``/``E``
+#: axes are a STATIC function of ``T`` (ops/cycle.decode_caps) — the
+#: passes resolve them via :func:`decode_axes` so the schema cannot
+#: drift from the caps formula.
+DECODE_LISTS_SCHEMA: Dict[str, Tuple[Tuple[str, ...], str]] = {
+    "bind_idx": (("B",), "int32"),
+    "bind_node": (("B",), "int32"),
+    "evict_idx": (("E",), "int32"),
+    "bind_count": ((), "int32"),
+    "evict_count": ((), "int32"),
+}
+
 #: What framework/session.py's actuation decode consumes (the audit aux
-#: rides the same CycleDecisions pack — see AUDIT_AUX_SCHEMA).
+#: and the compact decode lists ride the same CycleDecisions pack — see
+#: AUDIT_AUX_SCHEMA / DECODE_LISTS_SCHEMA).
 DECISIONS_SCHEMA: Dict[str, Tuple[Tuple[str, ...], str]] = {
     "task_node": (("T",), "int32"),
     "task_status": (("T",), "int32"),
@@ -271,7 +295,19 @@ DECISIONS_SCHEMA: Dict[str, Tuple[Tuple[str, ...], str]] = {
     "node_num_tasks": (("N",), "int32"),
     "node_ports": (("N", "W"), "int32"),
     **AUDIT_AUX_SCHEMA,
+    **DECODE_LISTS_SCHEMA,
 }
+
+
+def decode_axes(axes: Mapping[str, int]) -> Dict[str, int]:
+    """``axes`` extended with the decode-list axes ``B``/``E`` resolved
+    from the caps formula at the axes' own ``T`` — every pass that
+    touches DECISIONS_SCHEMA resolves through here, so the contract
+    tracks ops/cycle.decode_caps by construction."""
+    from ..ops.cycle import decode_caps
+
+    b, e = decode_caps(axes["T"])
+    return {**axes, "B": b, "E": e}
 
 
 def mutated(
@@ -633,7 +669,8 @@ def check_kernels(
             ))
         else:
             findings += _check_fields(
-                dec, DECISIONS_SCHEMA, axes, "KAT-CTR-006", path, line,
+                dec, DECISIONS_SCHEMA, decode_axes(axes), "KAT-CTR-006",
+                path, line,
                 stage="schedule_cycle → CycleDecisions",
                 hint="framework/session.py decodes these tensors for "
                 "actuation; drift here corrupts binds/evicts host-side",
@@ -836,6 +873,59 @@ def check_audit_aux(
     return findings
 
 
+def check_decode_lists(
+    schema: Optional[Mapping[str, Tuple[Tuple[str, ...], str]]] = None,
+    axes: Optional[Mapping[str, int]] = None,
+    lists_schema: Optional[Mapping[str, Tuple[Tuple[str, ...], str]]] = None,
+) -> List[Finding]:
+    """KAT-CTR-011: the ints-out decode-list contract.  Abstract-evaluate
+    the commit tail (``commit_cycle``) and verify the compact bind/evict
+    index lists — ``bind_idx``/``bind_node``/``evict_idx`` + counts —
+    against :data:`DECODE_LISTS_SCHEMA` with the ``B``/``E`` axes
+    resolved from the live caps formula (:func:`decode_axes`).
+    cache/decode.py gathers these host-side for actuation and they cross
+    the RPC reply pack by name; a drifted dtype/shape here corrupts the
+    BIND STREAM itself (not just an audit trail), silently when the
+    runtime dtype twin is bypassed by an in-process decode.  Seeding a
+    mutated ``lists_schema`` must make this pass report the drifted
+    field (regression-tested)."""
+    import jax
+
+    from ..ops import cycle as cyc
+
+    axes = decode_axes(axes or DEFAULT_AXES)
+    lists_schema = lists_schema or DECODE_LISTS_SCHEMA
+    findings: List[Finding] = []
+    path, line = _anchor(cyc.commit_cycle)
+    st = snapshot_struct(schema, axes)
+    state = _state_struct(STATE_SCHEMA, axes)
+    sess = _session_struct(axes)
+    with jax.default_device(jax.devices("cpu")[0]):
+        try:
+            dec = jax.eval_shape(cyc.commit_cycle, st, sess, state)
+        except Exception as err:
+            return [Finding(
+                "KAT-CTR-011", "error", path, line,
+                f"commit_cycle failed abstract evaluation against the "
+                f"declared session/state contract: "
+                f"{type(err).__name__}: {err}",
+                hint="the commit tail no longer composes over the "
+                "declared AllocState/SessionCtx — the decode lists "
+                "cannot be checked until it does",
+            )]
+        findings += _check_fields(
+            dec, lists_schema, axes, "KAT-CTR-011", path, line,
+            stage="commit_cycle → ints-out decode lists (CycleDecisions)",
+            hint="cache/decode.decode_decisions_compact gathers these "
+            "host-side into the actuated bind/evict intents and they "
+            "cross the RPC reply pack by name; a drifted dtype/shape "
+            "corrupts actuation — fix commit_cycle/_compact_indices or "
+            "DECODE_LISTS_SCHEMA (and decode_caps) if the contract "
+            "legitimately changed",
+        )
+    return findings
+
+
 def _state_struct(state_schema, axes):
     import jax
     import numpy as np
@@ -877,5 +967,6 @@ def check_contracts(
     findings += check_batched_turns(schema, turn_schema=turn_schema)
     findings += check_reclaim_turns(schema)
     findings += check_audit_aux(schema)
+    findings += check_decode_lists(schema)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings

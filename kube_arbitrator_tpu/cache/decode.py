@@ -1,7 +1,24 @@
-"""Decode device decisions back into host-side intents (actuation plane)."""
+"""Decode device decisions back into host-side intents (actuation plane).
+
+Two paths produce the SAME intent stream:
+
+* :func:`decode_decisions_compact` — the fast path: the kernel's commit
+  tail (ops/cycle.commit_cycle) ships compact, length-prefixed bind/evict
+  index lists (``bind_idx``/``bind_node``/``evict_idx`` + counts)
+  compacted in-graph, so the host pays one bounded gather + batched
+  ``.tolist()`` over O(decisions) elements — never an O(T) mask transfer
+  or a ``np.nonzero`` scan.  Counts exceeding the list caps mean the
+  cycle overflowed (``None`` return; the caller falls back dense and
+  counts ``decode_overflow_total``).
+* :func:`decode_decisions` — the dense-mask path, kept as the PARITY
+  ORACLE: batched gathers over ``np.nonzero`` of the [T] masks.  The
+  compact path's entries are emitted in the same ascending task-ordinal
+  order, so the two paths are intent-identical whenever the lists fit
+  (pinned by tests/test_decode_parity.py).
+"""
 from __future__ import annotations
 
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -9,27 +26,82 @@ from .sim import BindIntent, EvictIntent
 from .snapshot import Snapshot
 
 
-def decode_decisions(snap: Snapshot, decisions) -> Tuple[List[BindIntent], List[EvictIntent]]:
-    """CycleDecisions tensors -> bind/evict intents keyed by task uid.
-
-    Works with both index flavors: the object-model SnapshotIndex
-    (``.tasks``/``.nodes`` lists) and the native cache's ordinal-lookup
-    index (``.task_uid()``/``.node_name()`` methods).
-    """
-    index = snap.index
+def _uid_lookup(index):
+    """uid/name accessors for both index flavors: the object-model
+    SnapshotIndex (``.tasks``/``.nodes`` lists) and the native cache's
+    ordinal-lookup index (``.task_uid()``/``.node_name()`` methods)."""
     if hasattr(index, "tasks"):
-        task_uid = lambda i: index.tasks[i].uid
-        node_name = lambda n: index.nodes[n].name
-    else:
-        task_uid = index.task_uid
-        node_name = index.node_name
+        tasks, nodes = index.tasks, index.nodes
+        return (lambda i: tasks[i].uid), (lambda n: nodes[n].name)
+    return index.task_uid, index.node_name
+
+
+def _build_intents(
+    index, bind_rows, bind_nodes, evict_rows
+) -> Tuple[List[BindIntent], List[EvictIntent]]:
+    """Intent objects from host-side python lists of ordinals — the ONE
+    assembly both decode paths share, so their output cannot diverge in
+    anything but how the ordinal lists were obtained."""
+    task_uid, node_name = _uid_lookup(index)
+    binds = [
+        BindIntent(task_uid=task_uid(i), node_name=node_name(n))
+        for i, n in zip(bind_rows, bind_nodes)
+    ]
+    evicts = [EvictIntent(task_uid=task_uid(i)) for i in evict_rows]
+    return binds, evicts
+
+
+def decode_decisions(snap: Snapshot, decisions) -> Tuple[List[BindIntent], List[EvictIntent]]:
+    """CycleDecisions tensors -> bind/evict intents keyed by task uid —
+    the dense-mask parity oracle.  Vectorized: ``np.nonzero`` over each
+    mask, then batched gathers + ONE ``.tolist()`` per field instead of
+    per-row python indexing (the audit plane's record-assembly idiom)."""
     bind_mask = np.asarray(decisions.bind_mask)
     evict_mask = np.asarray(decisions.evict_mask)
-    task_node = np.asarray(decisions.task_node)
-    binds: List[BindIntent] = []
-    evicts: List[EvictIntent] = []
-    for i in np.nonzero(bind_mask)[0]:
-        binds.append(BindIntent(task_uid=task_uid(i), node_name=node_name(task_node[i])))
-    for i in np.nonzero(evict_mask)[0]:
-        evicts.append(EvictIntent(task_uid=task_uid(i)))
-    return binds, evicts
+    bind_rows = np.nonzero(bind_mask)[0]
+    bind_nodes = np.asarray(decisions.task_node)[bind_rows].tolist()
+    evict_rows = np.nonzero(evict_mask)[0].tolist()
+    return _build_intents(snap.index, bind_rows.tolist(), bind_nodes, evict_rows)
+
+
+DECODE_LIST_FIELDS = (
+    "bind_idx", "bind_node", "evict_idx", "bind_count", "evict_count",
+)
+
+
+def decode_lists_present(decisions) -> bool:
+    """True iff the compact decode lists are ALL present.  They are
+    optional on the wire as a unit: a partial set (a skewed or buggy
+    peer omitting only some) is treated exactly like full absence —
+    dense fallback, never a crash on a None count mid-decode."""
+    return all(
+        getattr(decisions, n, None) is not None for n in DECODE_LIST_FIELDS
+    )
+
+
+def decode_decisions_compact(
+    snap: Snapshot, decisions
+) -> Optional[Tuple[List[BindIntent], List[EvictIntent]]]:
+    """Intents from the kernel's compact index lists, or ``None`` when
+    the path is unavailable for this decisions pack:
+
+    * any of the lists is absent (a pre-ints-out peer across the RPC
+      boundary omitted them — :func:`decode_lists_present`), or
+    * either count exceeds its list cap — the overflow case; the caller
+      must decode the dense masks instead (and count the overflow).
+
+    Cost: two scalar reads + three bounded [count] gathers; the [T]
+    masks are never touched.
+    """
+    if not decode_lists_present(decisions):
+        return None
+    bind_idx = decisions.bind_idx
+    evict_idx = decisions.evict_idx
+    n_bind = int(decisions.bind_count)
+    n_evict = int(decisions.evict_count)
+    if n_bind > bind_idx.shape[0] or n_evict > evict_idx.shape[0]:
+        return None  # overflowed the caps: dense fallback decodes it
+    bind_rows = np.asarray(bind_idx)[:n_bind].tolist()
+    bind_nodes = np.asarray(decisions.bind_node)[:n_bind].tolist()
+    evict_rows = np.asarray(evict_idx)[:n_evict].tolist()
+    return _build_intents(snap.index, bind_rows, bind_nodes, evict_rows)

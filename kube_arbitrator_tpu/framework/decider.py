@@ -71,7 +71,7 @@ class LocalDecider:
             # previous complete dict or this one, never a dict mid-fill
             action_ms = {}
             action_rounds = {}
-            for stage, ts, ms, rounds, rounds_gated in stages:
+            for stage, ts, ms, rounds, rounds_gated, conflicts in stages:
                 action_ms[stage] = ms
                 if rounds is not None:
                     action_rounds[stage] = rounds
@@ -80,6 +80,11 @@ class LocalDecider:
                     # kernel_rounds_total{action}
                     if rounds_gated:
                         action_rounds[f"{stage}:gated"] = rounds_gated
+                    # ":conflicts" likewise: optimistic-reclaim claims
+                    # discarded at the in-round commit gate, emitted as
+                    # pipeline_discards_total{reason="claim_conflict"}
+                    if conflicts:
+                        action_rounds[f"{stage}:conflicts"] = conflicts
                 tr.record_span(f"kernel.{stage}", ts, ms / 1000)
             self.last_action_ms = action_ms
             self.last_action_rounds = action_rounds

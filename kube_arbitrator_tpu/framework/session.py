@@ -10,15 +10,22 @@ that ended the cycle gang-unready (``gang.go:169-190`` OnSessionClose).
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 import uuid
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..api.info import ClusterInfo, JobInfo
-from ..api.types import COND_UNSCHEDULABLE, PodGroupPhase, TaskStatus, is_allocated_status
-from ..cache.decode import decode_decisions
+from ..api.info import ClusterInfo
+from ..api.types import (
+    COND_UNSCHEDULABLE,
+    PodGroupPhase,
+    TaskStatus,
+    counts_as_ready,
+    is_allocated_status,
+)
+from ..cache.decode import decode_decisions, decode_decisions_compact
 from ..cache.sim import BindIntent, EvictIntent
 from ..cache.snapshot import Snapshot, build_snapshot
 from ..ops.cycle import CycleDecisions
@@ -29,6 +36,14 @@ from ..ops.diagnostics import HostView, explain_job
 # cost on pathologically saturated clusters).
 MAX_EXPLAINED_JOBS = 100
 from .conf import SchedulerConfig
+
+def _decode_parity_armed() -> bool:
+    """KAT_DECODE_PARITY=1: every compact decode is cross-checked
+    against the dense-mask oracle (an O(T) pass per cycle — test/chaos
+    posture only).  Read per call, not at import: harnesses arm it
+    AFTER this module loads (pytest monkeypatch.setenv, the chaos
+    lane's env prefix) and must not be silently ignored."""
+    return os.environ.get("KAT_DECODE_PARITY", "") == "1"
 
 # The process-wide default decider: Sessions constructed without one all
 # share this LocalDecider, so back-to-back cycles keep one routing/jit
@@ -54,10 +69,19 @@ def _assert_decision_dtypes(dec: CycleDecisions) -> None:
     decision-audit aux subset, AUDIT_AUX_SCHEMA/KAT-CTR-010, so a
     drifted attribution or ledger tensor out of the RPC codec is caught
     here before utils/audit.py decodes it).  ~14 dtype compares/cycle."""
-    from ..analysis.contracts import DECISIONS_SCHEMA  # lazy: no cycle
+    from ..analysis.contracts import (  # lazy: no cycle
+        DECODE_LISTS_SCHEMA,
+        DECISIONS_SCHEMA,
+    )
 
     for name, (_shape, dtype) in DECISIONS_SCHEMA.items():
-        got = np.dtype(getattr(dec, name).dtype)
+        arr = getattr(dec, name, None)
+        if arr is None and name in DECODE_LISTS_SCHEMA:
+            # the decode lists are optional on the wire (a pre-ints-out
+            # peer omits them; decode_phase falls back to the dense
+            # masks) — absent is legal, present-but-drifted is not
+            continue
+        got = np.dtype(arr.dtype)
         if got != np.dtype(dtype):
             raise TypeError(
                 f"decision contract violation: {name} arrived as {got}, "
@@ -226,10 +250,43 @@ class Session:
         return dec, kernel_ms, max(wall_ms - kernel_ms, 0.0)
 
     def decode_phase(self, snap: Snapshot, dec: CycleDecisions):
+        """Ints-out fast path first: the kernel's compact index lists
+        (one bounded gather, O(decisions)); the dense [T]-mask decode
+        remains the fallback for overflowed caps or a pre-ints-out peer
+        across the RPC boundary, and the parity ORACLE the fast path is
+        held to (``KAT_DECODE_PARITY=1`` cross-checks every cycle — the
+        decode parity suite and the chaos plane run with it set)."""
+        from ..utils.metrics import metrics
         from ..utils.tracing import tracer
 
         with tracer().span("decode"):
-            binds, evicts = decode_decisions(snap, dec)
+            out = decode_decisions_compact(snap, dec)
+            if out is not None:
+                binds, evicts = out
+                metrics().counter_add(
+                    "decode_path_total", labels={"path": "compact"}
+                )
+                if _decode_parity_armed():
+                    ref_b, ref_e = decode_decisions(snap, dec)
+                    if binds != ref_b or evicts != ref_e:
+                        raise AssertionError(
+                            "decode contract violation: compact ints-out "
+                            "intents diverged from the dense-mask oracle "
+                            f"({len(binds)}/{len(ref_b)} binds, "
+                            f"{len(evicts)}/{len(ref_e)} evicts)"
+                        )
+            else:
+                from ..cache.decode import decode_lists_present
+
+                if decode_lists_present(dec):
+                    # lists fully present but a count exceeded its cap:
+                    # the bounded-list contract overflowed this cycle
+                    # (a PARTIAL set is absence, not overflow)
+                    metrics().counter_add("decode_overflow_total")
+                metrics().counter_add(
+                    "decode_path_total", labels={"path": "dense"}
+                )
+                binds, evicts = decode_decisions(snap, dec)
         if self.phase_hook is not None:
             self.phase_hook("decode")
         return binds, evicts
@@ -276,6 +333,13 @@ class Session:
     # ---- CloseSession ----
 
     def _close(self, snap: Snapshot, dec: CycleDecisions) -> Dict[str, PodGroupStatus]:
+        """Close-side status census — a pure function of the PACK
+        (snapshot tensors + decisions) plus the index's immutable
+        identities (job uid/ordinal).  It deliberately never reads live
+        task objects (``job.tasks`` / ``job.ready_task_num()``), so the
+        pipelined executor can run it on the decide worker while the
+        ingest thread mutates the model underneath (the off-GIL commit
+        tail)."""
         job_ready = np.asarray(dec.job_ready)
         task_status = np.asarray(dec.task_status)
         statuses: Dict[str, PodGroupStatus] = {}
@@ -290,7 +354,9 @@ class Session:
         n_real = len(snap.index.tasks)
         n_jobs = len(snap.index.jobs)
         ts = task_status[:n_real]
+        ts0 = np.asarray(snap.tensors.task_status)[:n_real]
         tj = np.asarray(snap.tensors.task_job)[:n_real]
+        job_min_avail = np.asarray(snap.tensors.job_min_available)
 
         def _cnt(mask: np.ndarray) -> np.ndarray:
             return np.bincount(tj[mask], minlength=n_jobs)
@@ -304,16 +370,25 @@ class Session:
                 [int(s) for s in TaskStatus if is_allocated_status(s)]
             )
             n_allocated = _cnt(np.isin(ts, alloc_vals))
+            # gang message inputs from SNAPSHOT statuses (what the live
+            # walk's job.ready_task_num()/len(job.tasks) read, frozen)
+            ready_vals = np.array(
+                [int(s) for s in TaskStatus if counts_as_ready(s)]
+            )
+            n_ready0 = _cnt(np.isin(ts0, ready_vals))
+            n_tasks = np.bincount(tj, minlength=n_jobs)
         else:
             n_running = n_succeeded = n_failed = n_allocated = zeros
+            n_ready0 = n_tasks = zeros
         for job in snap.index.jobs:
             unsched_cond = None
-            if not job_ready[job.ordinal] and job.min_available > 0:
+            min_avail = int(job_min_avail[job.ordinal])
+            if not job_ready[job.ordinal] and min_avail > 0:
                 # gang.go:169-190: stamp Unschedulable for unready gangs,
                 # with the FitError-style per-node reason histogram
                 # (job_info.go:329-358) appended
-                missing = job.min_available - job.ready_task_num()
-                msg = f"{missing}/{len(job.tasks)} tasks in gang unschedulable"
+                missing = min_avail - int(n_ready0[job.ordinal])
+                msg = f"{missing}/{int(n_tasks[job.ordinal])} tasks in gang unschedulable"
                 if explained < MAX_EXPLAINED_JOBS:
                     if host is None:
                         host = HostView.build(snap, dec)
@@ -330,30 +405,32 @@ class Session:
                     last_transition=now,
                 )
             statuses[job.uid] = self._job_status(
-                job,
                 unsched_cond,
                 running=int(n_running[job.ordinal]),
                 allocated=int(n_allocated[job.ordinal]),
                 succeeded=int(n_succeeded[job.ordinal]),
                 failed=int(n_failed[job.ordinal]),
+                min_available=min_avail,
             )
         return statuses
 
     def _job_status(
         self,
-        job: JobInfo,
         unsched: Optional[PodGroupCondition],
         running: int,
         allocated: int,
         succeeded: int,
         failed: int,
+        min_available: int,
     ) -> PodGroupStatus:
         """session.go:159-197 jobStatus semantics (incl. the strict '>'
         on minMember).  Counts come from the SESSION-side statuses
         (``dec.task_status``): the reference's jobStatus reads the
         session's TaskStatusIndex, which includes this cycle's Allocated/
         Pipelined transitions (ssn.Allocate's UpdateTaskStatus) — not the
-        pre-actuation cache state.  ``_close`` computes them vectorized."""
+        pre-actuation cache state.  ``_close`` computes them vectorized,
+        ``min_available`` included (the pack's row, not the live
+        object's, so the whole census is worker-thread-safe)."""
         st = PodGroupStatus()
         if unsched is not None:
             st.conditions.append(unsched)
@@ -362,7 +439,7 @@ class Session:
         else:
             st.phase = (
                 PodGroupPhase.RUNNING
-                if allocated > job.min_available
+                if allocated > min_available
                 else PodGroupPhase.PENDING
             )
         st.running = running

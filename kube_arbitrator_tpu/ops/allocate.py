@@ -120,6 +120,15 @@ class AllocState:
     # fully-thin batched rounds both count here — the `gated` variant of
     # kernel_rounds_total{action}.  Always <= rounds; 0 for allocate.
     rounds_gated: jax.Array  # i32 scalar
+    # Speculative claims the OPTIMISTIC reclaim engine discarded at its
+    # in-round commit gate (ops/preempt._reclaim_canon_optimistic): a
+    # claim computed in parallel from window-start state whose inputs an
+    # earlier accepted claim invalidated.  Discarded claims are
+    # re-derived live in the continuation window, so decisions stay
+    # identical to the sequential canon walk; the count surfaces as
+    # ``pipeline_discards_total{reason="claim_conflict"}``.  0 for every
+    # non-optimistic engine.
+    claim_conflicts: jax.Array  # i32 scalar
 
 
 @jax.tree_util.register_dataclass
@@ -663,6 +672,7 @@ def _process_queue(
         progress=state.progress | (placed_total > 0) | unfit_now,
         rounds=state.rounds,
         rounds_gated=state.rounds_gated,
+        claim_conflicts=state.claim_conflicts,
     )
     return new_state
 
@@ -1173,6 +1183,7 @@ def allocate_action(
         progress=jnp.array(True),
         rounds=jnp.int32(0),
         rounds_gated=jnp.int32(0),
+        claim_conflicts=jnp.int32(0),
         group_unfit=jnp.zeros_like(state.group_unfit),
     )
     if not defer:

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -47,11 +47,41 @@ from .preempt import (
 # jax.eval_shape against the declared snapshot/state schemas
 # (analysis/contracts.py) — a registered kernel must accept the previous
 # stage's AllocState and return exactly the contract the next one reads.
+def _reclaim_optimistic_action(
+    st, sess, state, tiers, s_max: int = 4096, max_rounds: int = 100_000,
+    native_ops: bool = False,
+):
+    """Reclaim with the OPT-IN optimistic engine (speculative parallel
+    cross-queue claims, revalidated-or-discarded at an in-window commit
+    gate — ops/preempt._reclaim_canon_optimistic), selectable from the
+    YAML conf as ``actions: "reclaim_optimistic, allocate, ..."`` for
+    postures where speculation beats the serial claim walk (burn-heavy
+    wide-Q rounds commit in one parallel pass; accelerator dispatch
+    amortization).  Decisions are pinned identical to ``reclaim``.
+
+    Packs the engine is illegal for (missing canon pack, pod affinity,
+    segment-key overflow — a pure function of static pack shape + tiers)
+    degrade to the decision-identical default dispatch (the sequential
+    canon walk, or the sorted-space kernel when the canon layout itself
+    is unavailable) instead of failing the cycle; the staged runner's
+    fallback recorder emits
+    ``turn_batch_fallback_total{action="reclaim_optimistic"}`` so the
+    silent de-optimization stays visible."""
+    from .preempt import reclaim_engine_fallback_reason
+
+    legal = reclaim_engine_fallback_reason(st, tiers) is None
+    return reclaim_action(
+        st, sess, state, tiers, s_max=s_max, max_rounds=max_rounds,
+        native_ops=native_ops, turn_batch="optimistic" if legal else None,
+    )
+
+
 ACTION_KERNELS = {
     "allocate": allocate_action,
     "backfill": backfill_action,
     "preempt": preempt_action,
     "reclaim": reclaim_action,
+    "reclaim_optimistic": _reclaim_optimistic_action,
 }
 
 _READY_STATUSES = (
@@ -105,10 +135,76 @@ class CycleDecisions:
     # entitlement accounting, arxiv 2008.09213).
     queue_deserved: jax.Array  # f32[Q, R]
     queue_alloc: jax.Array    # f32[Q, R]
+    # ---- ints-out decode lists (cache/decode.decode_decisions_compact) ----
+    # Compact, length-prefixed bind/evict index lists computed in-graph by
+    # cumsum-compaction, so the host actuation decode is one bounded
+    # gather + batched .tolist() over O(decisions) elements instead of
+    # np.nonzero + per-row work over the [T] masks.  Slots are -1-padded;
+    # entries appear in ascending task-ordinal order (the dense decode's
+    # np.nonzero order, which keeps the two paths intent-identical).  The
+    # counts are the FULL mask populations: count > list length means the
+    # cycle overflowed its cap and the host must fall back to the dense
+    # mask decode (counted in ``decode_overflow_total``).  Caps are a
+    # static function of T (:func:`decode_caps`), so the lists ride the
+    # RPC reply pack with bounded wire cost.
+    # Defaults make the fields OPTIONAL on the wire: a DecideReply from
+    # a pre-ints-out peer omits them, the codec falls back to the
+    # defaults (rpc/codec.unpack_tensors), and the host decodes the
+    # dense masks instead — degraded, never fatal.  commit_cycle always
+    # fills them, so in-process decisions always carry arrays.
+    bind_idx: Optional[jax.Array] = None    # i32[B] bind task ordinals
+    bind_node: Optional[jax.Array] = None   # i32[B] node ordinal per slot
+    evict_idx: Optional[jax.Array] = None   # i32[E] evict task ordinals
+    bind_count: Optional[jax.Array] = None  # i32[] full bind population
+    evict_count: Optional[jax.Array] = None  # i32[] full evict population
 
 
 def _plugin_enabled(tiers: Tiers, name: str) -> bool:
     return any(p.name == name for tier in tiers for p in tier.plugins)
+
+
+def decode_caps(num_tasks: int) -> Tuple[int, int]:
+    """(bind_cap, evict_cap) — static sizes of the compact decode lists
+    for a ``T``-task pack.  Sized so real scheduling cycles fit — the
+    evictive bench rungs commit 30-40% of all rows as binds in one
+    cycle, hence T/2 — while a mass-bind storm touching over HALF of
+    all task rows (e.g. the first cycle over a 100k-pending backlog,
+    where binds ≈ T) is the overflow case: visible in
+    ``decode_overflow_total``, served by the dense fallback.  The lists
+    cost ~2.5 extra i32[T/2]-class tensors on the reply pack — minor
+    next to its existing [T] tensors."""
+    t = int(num_tasks)
+    return min(t, max(1024, t // 2)), min(t, max(512, t // 8))
+
+
+def _compact_indices(mask, cap: int, native_ops: bool):
+    """(idx i32[cap], count i32[]) — the ordinals where bool[T] ``mask``
+    is set, compacted into a -1-padded prefix in ascending order via
+    cumsum positions + one scatter (the native ``kat_scatter_set_i32``
+    FFI kernel on host-CPU programs — XLA:CPU's scatter is a serial
+    dimension-general loop — the fused jnp scatter otherwise; both write
+    identical slots).  ``count`` is the FULL population: entries past
+    ``cap`` are dropped here and the host detects the overflow by
+    ``count > cap``."""
+    T = mask.shape[0]
+    mi = mask.astype(jnp.int32)
+    pos = jnp.cumsum(mi) - 1          # exclusive rank of each set row
+    count = jnp.sum(mi)
+    write = mask & (pos < cap)
+    iota = jnp.arange(T, dtype=jnp.int32)
+    if native_ops:
+        from .native import scatter_set_i32
+
+        idx = scatter_set_i32(
+            jnp.full((cap,), -1, jnp.int32), write, pos, iota
+        )
+    else:
+        idx = (
+            jnp.full((cap,), -1, jnp.int32)
+            .at[jnp.where(write, pos, cap)]
+            .set(iota, mode="drop")
+        )
+    return idx, count
 
 
 def open_session(st: SnapshotTensors, tiers: Tiers) -> Tuple[SessionCtx, AllocState]:
@@ -213,6 +309,7 @@ def open_session(st: SnapshotTensors, tiers: Tiers) -> Tuple[SessionCtx, AllocSt
         progress=jnp.array(False),
         rounds=jnp.int32(0),
         rounds_gated=jnp.int32(0),
+        claim_conflicts=jnp.int32(0),
     )
     return sess, state
 
@@ -248,15 +345,25 @@ def schedule_cycle(
             s_max=s_max, max_rounds=max_rounds, native_ops=native_ops,
         )
 
-    return commit_cycle(st, sess, state)
+    return commit_cycle(st, sess, state, native_ops=native_ops)
 
 
 def commit_cycle(
-    st: SnapshotTensors, sess: "SessionCtx", state: "AllocState"
+    st: SnapshotTensors,
+    sess: "SessionCtx",
+    state: "AllocState",
+    native_ops: bool = False,
+    bind_cap: int = None,
+    evict_cap: int = None,
 ) -> CycleDecisions:
     """The commit tail of the cycle: gang-masked bind/evict commit +
     close-side readiness, shared by the fused program above and the
-    per-action staged runner below."""
+    per-action staged runner below.  Also compacts the committed masks
+    into the ints-out decode lists (``bind_idx``/``bind_node``/
+    ``evict_idx`` + counts) so the host decode is bounded by the decision
+    count, not T.  ``bind_cap``/``evict_cap`` (static) override the
+    :func:`decode_caps` defaults — the overflow regression tests shrink
+    them to force the dense-fallback path on small packs."""
     job_ready = state.job_ready_cnt >= sess.min_avail
     # eviction commit: unconditional (-2) or claimant-job-ready (>=0);
     # commit decisions use the raw post-action readiness
@@ -275,6 +382,18 @@ def commit_cycle(
     was_pending = (st.task_status == int(TaskStatus.PENDING)) & st.task_valid
     newly_alloc = was_pending & (state.task_status == int(TaskStatus.ALLOCATED))
     bind_mask = newly_alloc & job_ready_status[st.task_job]
+    auto_b, auto_e = decode_caps(st.num_tasks)
+    bind_idx, bind_count = _compact_indices(
+        bind_mask, auto_b if bind_cap is None else bind_cap, native_ops
+    )
+    evict_idx, evict_count = _compact_indices(
+        evict_mask, auto_e if evict_cap is None else evict_cap, native_ops
+    )
+    # per-slot node gather: -1 padding slots read row 0 harmlessly and
+    # are re-masked, so the gather never indexes out of range
+    bind_node = jnp.where(
+        bind_idx >= 0, state.task_node[jnp.clip(bind_idx, 0, None)], -1
+    )
     return CycleDecisions(
         task_node=state.task_node,
         task_status=state.task_status,
@@ -290,6 +409,11 @@ def commit_cycle(
         evict_round=state.evict_round,
         queue_deserved=sess.deserved,
         queue_alloc=state.queue_alloc,
+        bind_idx=bind_idx,
+        bind_node=bind_node,
+        evict_idx=evict_idx,
+        bind_count=bind_count,
+        evict_count=evict_count,
     )
 
 
@@ -319,7 +443,9 @@ def _run_stage(
 
 
 _open_session_jit = jax.jit(open_session, static_argnames=("tiers",))
-_commit_jit = jax.jit(commit_cycle)
+_commit_jit = jax.jit(
+    commit_cycle, static_argnames=("native_ops", "bind_cap", "evict_cap")
+)
 
 
 def schedule_cycle_staged(
@@ -335,7 +461,8 @@ def schedule_cycle_staged(
     stages, so each action's wall time is honestly measurable.
 
     Returns ``(CycleDecisions,
-    [(stage, wall_ts, dur_ms, rounds, rounds_gated), ...])`` where stage
+    [(stage, wall_ts, dur_ms, rounds, rounds_gated, claim_conflicts),
+    ...])`` where stage
     is ``open_session`` / each action name / ``commit`` and ``rounds``
     is the action's round count (``AllocState.rounds`` after the stage —
     every action kernel resets it at entry; preempt's two phases
@@ -381,9 +508,10 @@ def schedule_cycle_staged(
         if rounds_of is not None:
             rounds = int(rounds_of(out).rounds)
             gated = int(rounds_of(out).rounds_gated)
+            conflicts = int(rounds_of(out).claim_conflicts)
         else:
-            rounds = gated = None
-        timings.append((stage, ts, ms, rounds, gated))
+            rounds = gated = conflicts = None
+        timings.append((stage, ts, ms, rounds, gated, conflicts))
         return out
 
     _record_fallback_reasons(st, tiers, actions)
@@ -400,7 +528,7 @@ def schedule_cycle_staged(
             action=action, tiers=tiers, s_max=s_max, max_rounds=max_rounds,
             native_ops=native_ops, rounds_of=lambda s: s,
         )
-    dec = _timed("commit", _commit_jit, st, sess, state)
+    dec = _timed("commit", _commit_jit, st, sess, state, native_ops=native_ops)
     if prof.enabled:
         key = profiling.shape_key(st)
         prof.record_cycle(key, timings)
@@ -434,12 +562,22 @@ def _record_fallback_reasons(st, tiers, actions) -> None:
     gate would fall back to its sequential engine for this pack — silent
     de-optimization made visible in /metrics and the time-series ring."""
     from ..utils.metrics import metrics
-    from .preempt import reclaim_batch_fallback_reason, turn_batch_fallback_reason
+    from .preempt import (
+        reclaim_batch_fallback_reason,
+        reclaim_engine_fallback_reason,
+        turn_batch_fallback_reason,
+    )
 
     for action, reason_fn, fell_to in (
         ("preempt", turn_batch_fallback_reason, "sequential turn loop"),
         ("reclaim", reclaim_batch_fallback_reason,
          "sorted-space _reclaim_fast kernel"),
+        # the degraded engine matches reclaim_action's own dispatch:
+        # only segment_key_overflow still has the canon pack to walk;
+        # no_canon_pack / pod_affinity land on the sorted-space kernel
+        ("reclaim_optimistic", reclaim_engine_fallback_reason,
+         "default reclaim dispatch (sequential canon walk or "
+         "sorted-space _reclaim_fast)"),
     ):
         if action not in actions:
             continue

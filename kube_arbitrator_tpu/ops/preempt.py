@@ -799,6 +799,7 @@ def _apply_claim(
         | (has_grp & (placed_pre < budget)),
         rounds=state.rounds,
         rounds_gated=state.rounds_gated,
+        claim_conflicts=state.claim_conflicts,
     )
 
 
@@ -1282,6 +1283,24 @@ def reclaim_batch_fallback_reason(st: SnapshotTensors, tiers: Tiers):
     return None
 
 
+def reclaim_engine_fallback_reason(st: SnapshotTensors, tiers: Tiers):
+    """Why the OPT-IN reclaim engines (round-batched / optimistic) are
+    illegal for this pack — the conf-selected ``reclaim_optimistic``
+    action's auto gate: the canon conditions above PLUS the (node,
+    queue) segment-key int32 bound the thin own-queue subtraction needs.
+    Same contract as :func:`turn_batch_fallback_reason` (None = legal);
+    a non-None reason degrades to the decision-identical sequential
+    canon walk instead of raising, with
+    ``turn_batch_fallback_total{action="reclaim_optimistic"}``
+    visibility."""
+    reason = reclaim_batch_fallback_reason(st, tiers)
+    if reason is not None:
+        return reason
+    if (st.num_nodes + 1) * (st.num_queues + 1) >= 2**31:
+        return "segment_key_overflow"
+    return None
+
+
 def preempt_action(
     st: SnapshotTensors,
     sess: SessionCtx,
@@ -1357,7 +1376,8 @@ def preempt_action(
     # (kernel_rounds_total attribution reads it at stage boundaries);
     # rounds_gated counts the rounds the incremental gate served
     state = dataclasses.replace(
-        state, rounds=jnp.int32(0), rounds_gated=jnp.int32(0)
+        state, rounds=jnp.int32(0), rounds_gated=jnp.int32(0),
+        claim_conflicts=jnp.int32(0),
     )
 
     def run_phases(view, state):
@@ -1826,6 +1846,7 @@ def _reclaim_fast(
             progress=state.progress | pop,
             rounds=state.rounds,
             rounds_gated=state.rounds_gated,
+            claim_conflicts=state.claim_conflicts,
         )
         return (state, q_entries, job_consumed, perm, cand, e_nj,
                 log_g, log_n, log_r, n_claims)
@@ -1867,6 +1888,7 @@ def _reclaim_fast(
     state = dataclasses.replace(
         state, progress=jnp.array(True), rounds=jnp.int32(0),
         rounds_gated=jnp.int32(0),
+        claim_conflicts=jnp.int32(0),
     )
     e_nj0 = jnp.zeros(T, jnp.int32)
     log0 = (
@@ -2033,6 +2055,28 @@ def _canon_per_node(st, ctx, mask_v, native_ops):
     return jnp.zeros((N, R + 1)).at[ctx.cnode].add(stat, mode="drop")
 
 
+def _fit_feasible(st, state, preds_on, g, has_grp, req, pop, vic_cnt, vic_res):
+    """bool[N] first-fit feasibility of one reclaim claim: predicate
+    class/ports/pod-count screens + the weak ``allRes.Less`` victim
+    screen over the per-node victim sums.  The single definition behind
+    :func:`_canon_fit_commit`'s node choice AND the optimistic engine's
+    speculative claim detection — the two must agree bit-for-bit or the
+    optimistic commit gate would accept a claim its own tail rejects."""
+    if preds_on:
+        node_ok = (
+            st.class_fit[st.group_klass[g], st.node_klass]
+            & st.node_valid
+            & ~st.node_unsched
+        )
+        g_ports = st.group_ports[g]
+        node_ok = node_ok & jnp.all((g_ports[None, :] & state.node_ports) == 0, axis=-1)
+        node_ok = node_ok & (st.node_max_tasks - state.node_num_tasks > 0)
+    else:
+        node_ok = st.node_valid
+    weak_ok = ~jnp.all(vic_res < req[None, :], axis=-1)
+    return node_ok & (vic_cnt > 0) & weak_ok & pop & has_grp
+
+
 def _canon_fit_commit(
     st, sess, tiers, ctx, preds_on, use_gang, use_prop,
     state, q_entries, job_consumed, cand, evicted_c, rank_nj, cum_nq,
@@ -2055,20 +2099,11 @@ def _canon_fit_commit(
     W = st.rv_window
     bstart = st.rv_block_start
 
-    # ---- first-fit node choice ----
-    if preds_on:
-        node_ok = (
-            st.class_fit[st.group_klass[g], st.node_klass]
-            & st.node_valid
-            & ~st.node_unsched
-        )
-        g_ports = st.group_ports[g]
-        node_ok = node_ok & jnp.all((g_ports[None, :] & state.node_ports) == 0, axis=-1)
-        node_ok = node_ok & (st.node_max_tasks - state.node_num_tasks > 0)
-    else:
-        node_ok = st.node_valid
-    weak_ok = ~jnp.all(vic_res < req[None, :], axis=-1)
-    feas = node_ok & (vic_cnt > 0) & weak_ok & pop & has_grp
+    # ---- first-fit node choice (ONE feasibility definition, shared
+    # with the optimistic engine's speculative phase) ----
+    feas = _fit_feasible(
+        st, state, preds_on, g, has_grp, req, pop, vic_cnt, vic_res
+    )
     has_node = jnp.any(feas)
     n_star = jnp.argmin(jnp.where(feas, jnp.arange(N), N)).astype(jnp.int32)
     claimed = pop & has_grp & has_node
@@ -2178,6 +2213,7 @@ def _canon_fit_commit(
         progress=state.progress | pop,
         rounds=state.rounds,
         rounds_gated=state.rounds_gated,
+        claim_conflicts=state.claim_conflicts,
     )
     return (state, q_entries, job_consumed, cand, evicted_c, rank_nj, cum_nq,
             log_g, log_n, log_r, n_claims), claimed
@@ -2334,6 +2370,7 @@ def _reclaim_canon(
     state = dataclasses.replace(
         state, progress=jnp.array(True), rounds=jnp.int32(0),
         rounds_gated=jnp.int32(0),
+        claim_conflicts=jnp.int32(0),
     )
     cand0, rank_nj0, cum_nq0, q_entries0, log0 = _canon_seed(st, state, ctx)
     state, _, _, _, evicted_c, _, _, log = jax.lax.while_loop(
@@ -2430,32 +2467,17 @@ def _reclaim_canon_batched(
         q_panel = jax.lax.dynamic_slice(perm, (0,), (RP,))
 
         def products_of(state, cand, rank_nj, cum_nq):
-            """[Vp]-wide round products from CURRENT state: union victim
-            eligibility + per-node sums + the (node, queue) segmented
-            scan.  Computed once at round start and once more at the
-            first turn after each claiming turn (the only mutations
-            that invalidate them)."""
-            elig = _canon_elig(
-                sess, state, ctx, cand, rank_nj, cum_nq, use_gang, use_prop
+            """Round products from CURRENT state (:func:`_round_products`
+            — shared with the optimistic engine).  Computed once at
+            round start and once more at the first turn after each
+            claiming turn (the only mutations that invalidate them).
+            The segmented scan's per-(node, queue) totals are read per
+            turn at each segment's LAST slot (trailing non-candidate
+            slots contribute zero, so that slot holds the full total)."""
+            return _round_products(
+                st, sess, ctx, use_gang, use_prop, native_ops,
+                state, cand, rank_nj, cum_nq,
             )
-            pn = _canon_per_node(st, ctx, elig, native_ops)
-            # (node, queue) segment totals of the union mask: one
-            # segmented scan, read per turn at each segment's LAST slot
-            # (trailing non-candidate slots of a segment contribute
-            # zero, so the last slot carrying the segment key holds the
-            # full total)
-            stat = jnp.concatenate(
-                [elig.astype(jnp.float32)[:, None],
-                 jnp.where(elig[:, None], ctx.cres, 0.0)],
-                axis=1,
-            )
-            if native_ops:
-                from .native import seg_cumsum_f32
-
-                segcum = seg_cumsum_f32(stat, st.rv_nq_start)
-            else:
-                segcum = seg_cumsum(stat, st.rv_nq_start)
-            return elig, pn, segcum
 
         def pop_live(qi, inner):
             """One live single-queue pop — what the sequential engine
@@ -2474,13 +2496,9 @@ def _reclaim_canon_batched(
             elig0, pn_all, segcum = prods
             j, g, has_grp, req, pop, burn_now = popsel
             q = perm[qi]
-            keys = nd_keys + q  # [N]
-            pos = jnp.searchsorted(ctx.skey, keys, side="right") - 1
-            posc = jnp.clip(pos, 0, Vp - 1)
-            hit = (pos >= 0) & (ctx.skey[posc] == keys)
-            own = jnp.where(hit[:, None], segcum[posc], 0.0)  # [N, R+1]
-            vic_cnt = pn_all[:, 0] - own[:, 0]
-            vic_res = pn_all[:, 1:] - own[:, 1:]
+            vic_cnt, vic_res = _union_minus_own(
+                ctx, nd_keys, segcum, pn_all, q, Vp
+            )
 
             def wmask(start):
                 e_w = jax.lax.dynamic_slice(elig0, (start,), (W,))
@@ -2550,6 +2568,7 @@ def _reclaim_canon_batched(
     state = dataclasses.replace(
         state, progress=jnp.array(True), rounds=jnp.int32(0),
         rounds_gated=jnp.int32(0),
+        claim_conflicts=jnp.int32(0),
     )
     cand0, rank_nj0, cum_nq0, q_entries0, log0 = _canon_seed(st, state, ctx)
     state, _, _, _, evicted_c, _, _, log = jax.lax.while_loop(
@@ -2558,6 +2577,248 @@ def _reclaim_canon_batched(
          rank_nj0, cum_nq0, log0),
     )
     return _canon_writeback(st, state, evicted_c, log)
+
+
+def _round_products(
+    st, sess, ctx, use_gang, use_prop, native_ops, state, cand, rank_nj, cum_nq
+):
+    """The [Vp]-wide round/window products from CURRENT state: union
+    victim eligibility, per-node sums, and the (node, queue) segmented
+    scan whose per-segment totals the thin own-queue subtraction reads.
+    ONE definition shared by the round-batched and optimistic engines —
+    the bit-identity pin on both rests on these three tensors, so a
+    divergent copy would silently split the engines."""
+    elig = _canon_elig(
+        sess, state, ctx, cand, rank_nj, cum_nq, use_gang, use_prop
+    )
+    pn = _canon_per_node(st, ctx, elig, native_ops)
+    stat = jnp.concatenate(
+        [elig.astype(jnp.float32)[:, None],
+         jnp.where(elig[:, None], ctx.cres, 0.0)],
+        axis=1,
+    )
+    if native_ops:
+        from .native import seg_cumsum_f32
+
+        segcum = seg_cumsum_f32(stat, st.rv_nq_start)
+    else:
+        segcum = seg_cumsum(stat, st.rv_nq_start)
+    return elig, pn, segcum
+
+
+def _union_minus_own(ctx, nd_keys, segcum, pn_all, q, Vp):
+    """(vic_cnt f32[N], vic_res f32[N, R]) for one queue's turn: the
+    union per-node victim sums minus the queue's own (node, queue)
+    segment totals, read off the round-level segmented scan via the
+    ascending ``skey`` binary search — the thin-turn subtraction shared
+    by the round-batched and optimistic engines."""
+    keys = nd_keys + q  # [N]
+    pos = jnp.searchsorted(ctx.skey, keys, side="right") - 1
+    posc = jnp.clip(pos, 0, Vp - 1)
+    hit = (pos >= 0) & (ctx.skey[posc] == keys)
+    own = jnp.where(hit[:, None], segcum[posc], 0.0)  # [N, R+1]
+    return pn_all[:, 0] - own[:, 0], pn_all[:, 1:] - own[:, 1:]
+
+
+def _reclaim_canon_optimistic(
+    st: SnapshotTensors,
+    sess: SessionCtx,
+    state: AllocState,
+    tiers: Tiers,
+    max_rounds: int,
+    native_ops: bool = False,
+) -> AllocState:
+    """The OPTIMISTIC canon reclaim engine: speculative parallel
+    cross-queue claims, revalidated-or-discarded at an in-window commit
+    gate — the pipeline plane's revalidate idiom (pipeline/revalidate.py)
+    applied to reclaim's irreducibly-serial claim chain.
+
+    Per speculation window (a contiguous run of turns of the current
+    round's queue order), every panel queue's pop AND first-fit claim
+    feasibility are computed in PARALLEL from window-start state: one
+    vmapped selection (``reclaim_select_turns``) + one vmapped
+    feasibility screen over the shared round products — no serial turn
+    tail at all.  The commit gate then resolves the window in canon
+    queue order, vectorized:
+
+    * the burn/fail prefix before the first speculative CLAIM commits
+      wholesale — a burn/fail touches only its own queue's entry budget
+      and its own jobs' consumed marks, state no other turn in the
+      window reads, so the window-start speculation is EXACT for every
+      turn in the prefix;
+    * the first claim commits through the same :func:`_canon_fit_commit`
+      tail the sequential engine runs (valid: only burns preceded it in
+      the window);
+    * every LATER speculative claim in the window is a **conflict** — an
+      accepted claim mutates state later selections read (victim queues'
+      alloc, victim jobs' ready counts, the candidate mask, the per-node
+      sums) — and is DISCARDED, counted in ``AllocState.claim_conflicts``
+      and surfaced as ``pipeline_discards_total{reason="claim_conflict"}``.
+      The next window resumes at the SAME position of the SAME queue
+      order and re-derives those turns live from post-claim state, so a
+      discarded claim costs wasted speculation, never a changed
+      decision: the committed turn stream is identical to the
+      sequential canon walk whether conflicts occur or not (the parity
+      matrix pins it; the float caveat on the thin subtraction is the
+      round-batched engine's, documented there).
+
+    Burn-heavy regimes (wide-Q worlds popping and failing for rounds)
+    commit whole rounds in ONE parallel pass (counted into
+    ``rounds_gated``); claim-dense regimes degrade to one claim per
+    window — sequential-identical decisions at extra speculation cost —
+    which is why the engine ships opt-in posture
+    (``turn_batch="optimistic"``), like the round-batched one."""
+    Q, N, J = st.num_queues, st.num_nodes, st.num_jobs
+    Vp = st.rv_idx.shape[0]
+    W = st.rv_window
+    verdict_names = _reclaim_verdict_names(tiers)
+    preds_on = _plugin_on(tiers, "predicates", "predicate_disabled")
+    use_gang = "gang" in verdict_names
+    use_prop = "proportion" in verdict_names
+    ctx = _canon_ctx(st, sess)
+    RP = min(Q, max(TURN_PANEL,
+                    TURN_BATCH_MAX_CELLS // max(J, st.num_groups, 1)))
+    nd_keys = jnp.arange(N, dtype=jnp.int32) * (Q + 1)
+    w_iota = jnp.arange(RP, dtype=jnp.int32)
+
+    def products_of(state, cand, rank_nj, cum_nq):
+        """Window products (:func:`_round_products` — the same trio the
+        batched engine computes, from the same shared definition)."""
+        return _round_products(
+            st, sess, ctx, use_gang, use_prop, native_ops,
+            state, cand, rank_nj, cum_nq,
+        )
+
+    def window_body(carry):
+        (state, q_entries, job_consumed, cand, evicted_c, rank_nj, cum_nq,
+         log, perm, trip, start_qi) = carry
+        log_g, log_n, log_r, n_claims = log
+        at_start = start_qi == 0
+        # a fresh round re-derives order + progress; a continuation
+        # window keeps BOTH (sequential semantics: perm is fixed for the
+        # round, progress accumulates across its turns)
+        state = dataclasses.replace(
+            state, progress=jnp.where(at_start, False, state.progress)
+        )
+        # order is fixed for the round: recompute ONLY at round start
+        # (a continuation window keeps the carried perm/trip — and,
+        # under lax.cond, skips the [Q]-scale ordering work entirely)
+        trip, perm = jax.lax.cond(
+            at_start,
+            lambda c: _canon_round_order(st, sess, tiers, *c)[1:],
+            lambda c: (trip, perm),
+            (state, q_entries, job_consumed),
+        )
+        pos_ids = start_qi + w_iota
+        in_window = pos_ids < trip
+        q_panel = perm[jnp.minimum(pos_ids, Q - 1)]
+
+        # ---- speculative phase: every window turn in parallel ----
+        shared = _reclaim_shared(st, sess, state, tiers, job_consumed)
+        jp, gp, hgp, reqp, popp, burnp = reclaim_select_turns(
+            st, sess, state, tiers, shared, q_panel, q_entries
+        )
+        elig, pn_all, segcum = products_of(state, cand, rank_nj, cum_nq)
+
+        def spec_one(q, g, hg, rq, pp):
+            vic_cnt, vic_res = _union_minus_own(
+                ctx, nd_keys, segcum, pn_all, q, Vp
+            )
+            return jnp.any(
+                _fit_feasible(
+                    st, state, preds_on, g, hg, rq, pp, vic_cnt, vic_res
+                )
+            )
+
+        claimed_spec = jax.vmap(spec_one)(
+            q_panel, gp, hgp, reqp, popp & in_window
+        )
+
+        # ---- commit gate: burn/fail prefix + first claim ----
+        has_claim = jnp.any(claimed_spec)
+        first = jnp.where(
+            has_claim, jnp.argmax(claimed_spec).astype(jnp.int32),
+            jnp.int32(RP),
+        )
+        commit_mask = in_window & (w_iota < first)
+        burn_or_fail = commit_mask & (burnp | popp)
+        q_entries = q_entries.at[
+            jnp.where(burn_or_fail, q_panel, Q)
+        ].add(-1, mode="drop")
+        job_consumed = job_consumed.at[
+            jnp.where(commit_mask & popp, jp, J)
+        ].set(True, mode="drop")
+        state = dataclasses.replace(
+            state, progress=state.progress | jnp.any(commit_mask & popp)
+        )
+        n_committed = jnp.sum(commit_mask.astype(jnp.int32))
+
+        def do_claim(inner):
+            (state, q_entries, job_consumed, cand, evicted_c, rank_nj,
+             cum_nq, log_g, log_n, log_r, n_claims) = inner
+            s = jnp.minimum(first, RP - 1)
+            q = q_panel[s]
+            vic_cnt, vic_res = _union_minus_own(
+                ctx, nd_keys, segcum, pn_all, q, Vp
+            )
+
+            def wmask(start):
+                e_w = jax.lax.dynamic_slice(elig, (start,), (W,))
+                q_w = jax.lax.dynamic_slice(ctx.cq, (start,), (W,))
+                return e_w & (q_w != q)
+
+            committed, _cl = _canon_fit_commit(
+                st, sess, tiers, ctx, preds_on, use_gang, use_prop,
+                state, q_entries, job_consumed, cand, evicted_c, rank_nj,
+                cum_nq, log_g, log_n, log_r, n_claims,
+                q, jp[s], gp[s], hgp[s], reqp[s], popp[s], burnp[s],
+                vic_cnt, vic_res, wmask,
+            )
+            return committed
+
+        inner = (state, q_entries, job_consumed, cand, evicted_c, rank_nj,
+                 cum_nq, log_g, log_n, log_r, n_claims)
+        inner = jax.lax.cond(has_claim, do_claim, lambda x: x, inner)
+        (state, q_entries, job_consumed, cand, evicted_c, rank_nj, cum_nq,
+         log_g, log_n, log_r, n_claims) = inner
+
+        # conflicts: speculative claims past the accepted one, discarded
+        conflicts = jnp.sum(
+            (claimed_spec & (w_iota > first)).astype(jnp.int32)
+        )
+        advance = n_committed + has_claim.astype(jnp.int32)
+        start_next = start_qi + advance
+        round_done = start_next >= trip
+        gated = round_done & at_start & ~has_claim
+        state = dataclasses.replace(
+            state,
+            rounds=state.rounds + round_done.astype(jnp.int32),
+            rounds_gated=state.rounds_gated + gated.astype(jnp.int32),
+            claim_conflicts=state.claim_conflicts + conflicts,
+        )
+        start_qi = jnp.where(round_done, jnp.int32(0), start_next)
+        return (state, q_entries, job_consumed, cand, evicted_c, rank_nj,
+                cum_nq, (log_g, log_n, log_r, n_claims), perm, trip,
+                start_qi)
+
+    def cond(carry):
+        state, start_qi = carry[0], carry[10]
+        # mid-round continuation windows always run; round boundaries
+        # apply the sequential engine's progress/max_rounds gate
+        return (start_qi > 0) | (state.progress & (state.rounds < max_rounds))
+
+    state = dataclasses.replace(
+        state, progress=jnp.array(True), rounds=jnp.int32(0),
+        rounds_gated=jnp.int32(0), claim_conflicts=jnp.int32(0),
+    )
+    cand0, rank_nj0, cum_nq0, q_entries0, log0 = _canon_seed(st, state, ctx)
+    carry0 = (
+        state, q_entries0, jnp.zeros(J, bool), cand0, jnp.zeros(Vp, bool),
+        rank_nj0, cum_nq0, log0, jnp.arange(Q, dtype=jnp.int32),
+        jnp.int32(0), jnp.int32(0),
+    )
+    out = jax.lax.while_loop(cond, window_body, carry0)
+    return _canon_writeback(st, out[0], out[4], out[7])
 
 
 def reclaim_action(
@@ -2589,10 +2850,14 @@ def reclaim_action(
     and one fused round beats hundreds of tiny launches).  True forces
     the round-batched kernel (:func:`_reclaim_canon_batched`; raises at
     trace time if illegal — the parity suite pins it bit-identical);
-    False forces the sequential canon engine explicitly.
-    ``native_ops`` (static, set by the device-selection seam for
-    host-CPU programs) swaps per-node victim sums and the round-level
-    segmented scan for the C++ FFI kernels."""
+    ``"optimistic"`` forces the speculative-parallel engine
+    (:func:`_reclaim_canon_optimistic` — parallel claims revalidated-or-
+    discarded at an in-window commit gate, conflicts counted into
+    ``AllocState.claim_conflicts``; same legality conditions, same
+    bit-identity pin); False forces the sequential canon engine
+    explicitly.  ``native_ops`` (static, set by the device-selection
+    seam for host-CPU programs) swaps per-node victim sums and the
+    round-level segmented scan for the C++ FFI kernels."""
     del s_max
     preds_on = _plugin_on(tiers, "predicates", "predicate_disabled")
     pack_ok = (
@@ -2607,9 +2872,14 @@ def reclaim_action(
         turn_batch = False
     elif turn_batch and not batch_ok:
         raise ValueError(
-            "turn_batch=True but the round-batched reclaim engine is not "
-            "legal for this snapshot/tiers (missing canon pack, pod "
-            "affinity, or the (node, queue) segment key overflows int32)"
+            f"turn_batch={turn_batch!r} but the round-batched/optimistic "
+            "reclaim engines are not legal for this snapshot/tiers "
+            "(missing canon pack, pod affinity, or the (node, queue) "
+            "segment key overflows int32)"
+        )
+    if turn_batch == "optimistic":
+        return _reclaim_canon_optimistic(
+            st, sess, state, tiers, max_rounds, native_ops
         )
     if turn_batch:
         return _reclaim_canon_batched(

@@ -169,8 +169,12 @@ class SequentialScheduler:
                 self._allocate(best_effort=True)
             elif action == "preempt":
                 self._preempt()
-            elif action == "reclaim":
+            elif action in ("reclaim", "reclaim_optimistic"):
+                # the optimistic engine is pinned decision-identical to
+                # sequential reclaim, so one oracle walk serves both
                 self._reclaim()
+            else:
+                raise ValueError(f"oracle: unknown action {action!r}")
 
         # --- close: gang-masked commit ---
         job_ready = {j.uid: self.job_ready_cnt[j.uid] >= self.min_avail[j.uid] for j in self.jobs}

@@ -214,6 +214,15 @@ class PipelinedExecutor:
                         ep.snap, dec
                     )
                 t3 = time.perf_counter()
+                # the close-side status census (session._close) is a pure
+                # function of the frozen pack + decisions since the
+                # ints-out refactor — run it HERE so the ingest thread's
+                # post-commit work shrinks to the write-back alone (the
+                # off-GIL commit tail; numpy bincounts release nothing,
+                # but they now overlap the NEXT epoch's freeze instead of
+                # serializing after actuation)
+                job_status = ep.session.close_phase(ep.snap, dec)
+                t4 = time.perf_counter()
         # per-action timings captured HERE (same thread as the decide
         # that produced them) so pipelined cycles keep run_once's
         # kernel_action_duration_seconds / flight action_ms parity
@@ -223,11 +232,12 @@ class PipelinedExecutor:
         action_rounds = dict(
             getattr(ep.session._decider(), "last_action_rounds", None) or {}
         )
-        return dec, binds, evicts, (conditions, reasons), (action_ms, action_rounds), {
+        return dec, binds, evicts, (conditions, reasons, job_status), (action_ms, action_rounds), {
             "kernel_ms": kernel_ms,
             "transport_ms": transport_ms,
             "decode_ms": (t2 - t1) * 1000,
-            "decide_wall_ms": (t3 - t0) * 1000,
+            "close_ms": (t4 - t3) * 1000,
+            "decide_wall_ms": (t4 - t0) * 1000,
         }
 
     def _wait(self, ep: _Epoch) -> float:
@@ -280,7 +290,7 @@ class PipelinedExecutor:
         ep = self._inflight
         try:
             ingest_ms = self._wait(ep)
-            dec, binds0, evicts0, (conditions, reasons), (action_ms, action_rounds), t = (
+            dec, binds0, evicts0, (conditions, reasons, job_status), (action_ms, action_rounds), t = (
                 ep.future.result()
             )
         except BaseException as err:
@@ -338,8 +348,11 @@ class PipelinedExecutor:
                 freeze_err = err
         with tr.activate(ep.corr):
             t_close0 = time.perf_counter()
+            # the status census already ran on the decide worker (the
+            # off-GIL commit tail); only the write-back — the part that
+            # MUST mutate the model from the single-writer ingest thread
+            # — remains on the commit path
             with tr.span("pipeline.close", seq=ep.seq):
-                job_status = ep.session.close_phase(ep.snap, dec)
                 result = CycleResult(
                     session_uid=ep.session.uid,
                     snapshot=ep.snap,
@@ -360,7 +373,9 @@ class PipelinedExecutor:
                     result, task_conditions=conditions, pending_reasons=reasons
                 )
             t_end = time.perf_counter()
-        result.close_ms = (t_end - t_close0) * 1000
+        # close_ms keeps its CycleStats meaning (the census cost, now
+        # paid off-path on the worker) + the residual write-back wall
+        result.close_ms = t["close_ms"] + (t_end - t_close0) * 1000
         # effective cadence: commit-to-commit, the number pipelining
         # moves (the first step reports its fill time instead)
         period_ms = (
@@ -401,7 +416,9 @@ class PipelinedExecutor:
                 "decide": t["decide_wall_ms"],
                 "revalidate": (t_reval - t0) * 1000,
                 "actuate": (t_act - t_reval) * 1000,
-                "close": result.close_ms,
+                # the ingest thread's share only (the census rides the
+                # decide worker now and is inside the decide stage)
+                "close": (t_end - t_close0) * 1000,
             },
         )
         self.last_period_ms = period_ms

@@ -27,6 +27,14 @@ Discard reasons:
                      or the pod-count cap is exhausted.
 ``not_evictable``    the evict victim is no longer in an evictable
                      state (already Releasing/terminal).
+``claim_conflict``   NOT emitted by this gate: the optimistic reclaim
+                     engine's in-round commit gate
+                     (ops/preempt._reclaim_canon_optimistic) discards a
+                     speculative cross-queue claim whose inputs an
+                     earlier accepted claim invalidated; the count rides
+                     the same ``pipeline_discards_total{reason=...}``
+                     family so both speculation gates share one
+                     vocabulary and one dashboard query.
 ==================  =====================================================
 
 The journal bounds the work: untouched tasks/nodes committed against
@@ -52,6 +60,8 @@ DISCARD_REASONS = (
     "node_unsched",
     "capacity_shrunk",
     "not_evictable",
+    # optimistic-reclaim speculation discarded in-kernel (see table)
+    "claim_conflict",
 )
 
 # states an eviction still makes sense against: the victim occupies (or

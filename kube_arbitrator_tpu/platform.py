@@ -78,7 +78,9 @@ def is_evictive(actions, task_status) -> bool:
 
     from .api.types import TaskStatus
 
-    return bool(set(actions) & {"reclaim", "preempt"}) and bool(
+    return bool(
+        set(actions) & {"reclaim", "reclaim_optimistic", "preempt"}
+    ) and bool(
         (np.asarray(task_status) == int(TaskStatus.RUNNING)).any()
     )
 

@@ -45,7 +45,13 @@ def pack_tensors(obj, into, fields=None) -> None:
     for f in dataclasses.fields(obj):
         if fields is not None and f.name not in fields:
             continue
-        arr = np.asarray(getattr(obj, f.name))
+        val = getattr(obj, f.name)
+        if val is None:
+            # optional field absent (e.g. the ints-out decode lists on
+            # decisions relayed from a pre-ints-out peer): omit it from
+            # the wire; the receiver's default restores the absence
+            continue
+        arr = np.asarray(val)
         # ascontiguousarray promotes 0-d to (1,); restore the true shape
         arr = np.ascontiguousarray(arr).reshape(arr.shape)
         t = into.add()
@@ -119,6 +125,12 @@ def snapshot_request(
 
 
 def decide_reply(decisions, cycle: int, kernel_ms: float) -> "pb.DecideReply":
+    """Every CycleDecisions field serializes by name — the audit aux AND
+    the compact ints-out decode lists (bind_idx/bind_node/evict_idx +
+    counts) ride the reply pack with no codec-side special casing, so a
+    remote cycle's host decode takes the same bounded-gather fast path
+    an in-process one does (epoch/tenant keying is a REQUEST-side
+    concern; replies are per-decide)."""
     rep = pb.DecideReply(cycle=cycle, kernel_ms=kernel_ms)
     pack_tensors(decisions, rep.tensors)
     return rep

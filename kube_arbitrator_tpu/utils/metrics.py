@@ -35,6 +35,8 @@ METRIC_HELP: Dict[str, str] = {
     "turn_batch_fallback_total": "Staged cycles whose auto turn_batch gate fell back to a sequential engine (action + reason; silent de-optimization visibility).",
     "binds_total": "Committed bind intents.",
     "evicts_total": "Committed evict intents.",
+    "decode_overflow_total": "Cycles whose compact ints-out decode lists overflowed their caps (host fell back to the dense mask decode).",
+    "decode_path_total": "Host actuation decodes by path (path label: compact / dense [overflow or lists absent]).",
     "pending_tasks": "Pending tasks observed at cycle start.",
     "cycles_total": "Scheduling cycles completed.",
     "cycle_errors_total": "Cycles that died with an error (class label: retryable/fatal).",
@@ -328,6 +330,16 @@ def record_kernel_rounds(registry: MetricsRegistry, action_rounds) -> None:
                 "kernel_rounds_total", rounds,
                 labels={"action": action[: -len(":gated")],
                         "variant": "gated"},
+            )
+        elif action.endswith(":conflicts"):
+            # optimistic-reclaim speculative claims discarded at the
+            # in-round commit gate: the same revalidate-or-discard
+            # vocabulary as the pipeline plane (revalidate.DISCARD_REASONS
+            # carries "claim_conflict"), so one dashboard query covers
+            # both speculation gates
+            registry.counter_add(
+                "pipeline_discards_total", rounds,
+                labels={"reason": "claim_conflict"},
             )
         else:
             registry.counter_add(

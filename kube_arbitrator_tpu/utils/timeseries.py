@@ -215,6 +215,10 @@ class CycleSampler:
         # silent de-optimization: staged cycles whose auto turn_batch
         # gate fell back to a sequential evictive engine
         "turn_batch_fallbacks": "turn_batch_fallback_total",
+        # ints-out decode cycles that overflowed their compact-list caps
+        # and fell back to the dense [T]-mask decode — the tail this
+        # plane exists to watch growing back
+        "decode_overflows": "decode_overflow_total",
     }
     OCCUPANCY_GAUGE = "pipeline_stage_occupancy"
 
@@ -262,6 +266,10 @@ class CycleSampler:
             "decode_ms": stats.decode_ms,
             "close_ms": stats.close_ms,
             "actuate_ms": stats.actuate_ms,
+            # decide-wall minus device time (~0 in-process, RPC overhead
+            # remote) — without it the grafana board can't tell a decode
+            # tail from a transport tail
+            "transport_ms": stats.transport_ms,
         }
         for stage, ms in (action_ms or {}).items():
             values[f"kernel_{stage}_ms"] = ms

@@ -53,6 +53,7 @@ def _engines():
     from kube_arbitrator_tpu.ops.preempt import (
         _reclaim_canon,
         _reclaim_canon_batched,
+        _reclaim_canon_optimistic,
         _reclaim_fast,
         preempt_action,
     )
@@ -69,6 +70,9 @@ def _engines():
         ),
         "reclaim_batched": jax.jit(
             lambda st, se, s: _reclaim_canon_batched(st, se, s, tiers, 100_000)
+        ),
+        "reclaim_optimistic": jax.jit(
+            lambda st, se, s: _reclaim_canon_optimistic(st, se, s, tiers, 100_000)
         ),
         "preempt_gate_on": jax.jit(
             lambda st, se, s: preempt_action(
@@ -137,9 +141,22 @@ def test_sequential_vs_batched_decision_soak(q, seed):
     canon = eng["reclaim_canon"](st, sess, state)
     fast = eng["reclaim_fast"](st, sess, state)
     rbatched = eng["reclaim_batched"](st, sess, state)
+    roptim = eng["reclaim_optimistic"](st, sess, state)
     _assert_state_equal(canon, fast, f"reclaim q={q} seed={seed}")
     _assert_state_equal(
         canon, rbatched, f"reclaim-batched q={q} seed={seed}"
+    )
+    # the OPTIMISTIC engine: speculative parallel claims revalidated-or-
+    # discarded at its in-window commit gate must leave decisions AND
+    # round counts identical to the sequential canon walk — conflicts
+    # only ever discard speculation, never change a committed claim
+    _assert_state_equal(
+        canon, roptim, f"reclaim-optimistic q={q} seed={seed}"
+    )
+    assert int(roptim.rounds_gated) <= int(roptim.rounds)
+    assert int(roptim.claim_conflicts) >= 0
+    assert int(canon.claim_conflicts) == 0, (
+        "only the optimistic engine may count claim conflicts"
     )
     # the batched result is threaded forward (the production path)
     state = rbatched
@@ -245,6 +262,123 @@ def test_two_queues_contending_for_same_victim_matches_oracle():
         lambda st, se, s: reclaim_action(st, se, s, tiers, turn_batch=False)
     )(snap.tensors, sess, state)
     _assert_state_equal(bat, seq, "two-queue same-victim reclaim")
+    # the optimistic engine sees BOTH queues claim in its first window:
+    # the second claim is the canonical conflict — discarded, counted,
+    # and re-derived in the continuation window, leaving decisions
+    # identical and exactly one conflict on the books
+    opt = jax.jit(
+        lambda st, se, s: reclaim_action(
+            st, se, s, tiers, turn_batch="optimistic"
+        )
+    )(snap.tensors, sess, state)
+    _assert_state_equal(opt, seq, "two-queue same-victim reclaim (optimistic)")
+    assert int(opt.claim_conflicts) >= 1, (
+        "the contending second claim must be discarded as a conflict"
+    )
+
+
+def test_optimistic_action_degrades_when_engine_illegal():
+    """A conf-selected ``reclaim_optimistic`` on a pack the engine is
+    illegal for (pod affinity with predicates on) must degrade to the
+    decision-identical default reclaim path, never raise — the
+    previously test-only turn_batch ValueError is reachable from YAML
+    now, so the registered action carries its own auto gate."""
+    from kube_arbitrator_tpu.api import PodAffinityTerm, TaskStatus
+    from kube_arbitrator_tpu.framework import Scheduler
+    from kube_arbitrator_tpu.framework.conf import load_conf
+    from kube_arbitrator_tpu.ops.preempt import reclaim_engine_fallback_reason
+
+    tiers_yaml = (
+        "tiers:\n"
+        "- plugins:\n"
+        "  - name: priority\n"
+        "  - name: gang\n"
+        "- plugins:\n"
+        "  - name: drf\n"
+        "  - name: predicates\n"
+        "  - name: proportion\n"
+    )
+
+    def mk():
+        sim = SimCluster()
+        sim.add_queue("q", weight=1)
+        for i in range(2):
+            sim.add_node(f"n{i}", cpu_milli=4000, memory=8 * GB,
+                         labels={"zone": f"z{i}"})
+        j0 = sim.add_job("leader", queue="q")
+        sim.add_task(j0, 100, 0, name="lead", status=TaskStatus.RUNNING,
+                     node="n0", labels={"app": "store"})
+        j1 = sim.add_job("follower", queue="q")
+        sim.add_task(
+            j1, 100, 0, name="f1",
+            affinity=[PodAffinityTerm(match_labels=(("app", "store"),),
+                                      topology_key="zone")],
+        )
+        return sim
+
+    sim = mk()
+    conf = load_conf(
+        'actions: "reclaim_optimistic, allocate, backfill"\n' + tiers_yaml
+    )
+    st = build_snapshot(sim.cluster).tensors
+    assert reclaim_engine_fallback_reason(st, conf.tiers) == "pod_affinity"
+    Scheduler(sim, config=conf).run(max_cycles=2, until_idle=False)
+    ref = mk()
+    ref_conf = load_conf(
+        'actions: "reclaim, allocate, backfill"\n' + tiers_yaml
+    )
+    Scheduler(ref, config=ref_conf).run(max_cycles=2, until_idle=False)
+    bound = lambda s: {
+        t.uid: t.node_name
+        for j in s.cluster.jobs.values() for t in j.tasks.values()
+    }
+    assert bound(sim) == bound(ref)
+
+
+@pytest.mark.slow  # tier-1 keeps the kernel-level soak; the PERF_SMOKE
+# lane runs this full-loop matrix (deploy/check.sh runs the file unfiltered)
+def test_optimistic_reclaim_loop_matches_default_over_seed_matrix():
+    """End-to-end opt-in: a conf selecting ``reclaim_optimistic`` runs
+    the full scheduler loop over an 8-seed matrix of evictive worlds and
+    must produce the SAME bind/evict stream as the default conf — the
+    optimistic commit gate discards conflicted speculation, it never
+    changes a committed decision (and the model-level invariants — one
+    node per task, no double bind — hold because the streams are
+    equal)."""
+    from kube_arbitrator_tpu.framework import Scheduler
+    from kube_arbitrator_tpu.framework.conf import load_conf
+
+    conf = lambda action: load_conf(
+        f'actions: "{action}, allocate, backfill, preempt"\n'
+        "tiers:\n"
+        "- plugins:\n"
+        "  - name: priority\n"
+        "  - name: gang\n"
+        "- plugins:\n"
+        "  - name: drf\n"
+        "  - name: predicates\n"
+        "  - name: proportion\n"
+    )
+    mk = lambda seed: generate_cluster(
+        num_nodes=16, num_jobs=12, tasks_per_job=3, num_queues=4,
+        seed=seed, node_cpu_milli=4000, node_memory=8 * GB,
+        running_fraction=0.6,
+    )
+    bound = lambda sim: {
+        t.uid: (t.node_name, t.status)
+        for j in sim.cluster.jobs.values()
+        for t in j.tasks.values()
+    }
+    evicted_any = False
+    for seed in range(8):
+        sim_opt, sim_ref = mk(seed), mk(seed)
+        s_opt = Scheduler(sim_opt, config=conf("reclaim_optimistic"))
+        s_ref = Scheduler(sim_ref, config=conf("reclaim"))
+        s_opt.run(max_cycles=3, until_idle=False)
+        s_ref.run(max_cycles=3, until_idle=False)
+        assert bound(sim_opt) == bound(sim_ref), f"seed {seed} diverged"
+        evicted_any = evicted_any or any(s.evicts for s in s_ref.history)
+    assert evicted_any, "vacuous matrix: no seed exercised reclaim/preempt"
 
 
 def test_q512_preempt_turn_bound_is_active_count():

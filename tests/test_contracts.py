@@ -45,7 +45,9 @@ def test_mutated_snapshot_dtype_reports_exactly_the_consuming_kernel():
     findings = contracts.check_kernels(seeded)
     assert findings, "seeded dtype violation went undetected"
     assert {f.rule for f in findings} == {"KAT-CTR-004"}
-    assert kernels_named(findings) == ["reclaim"]
+    # BOTH reclaim flavors consume the canon pack — the optimistic
+    # engine is a registered kernel and must be caught too
+    assert kernels_named(findings) == ["reclaim", "reclaim_optimistic"]
     assert all("rv_block_start" in f.message or "reclaim" in f.message for f in findings)
 
 
@@ -143,6 +145,31 @@ def test_mutated_reclaim_turn_schema_reports_exactly_that_field():
 
 def test_audit_aux_clean_on_real_tree():
     assert contracts.check_audit_aux() == []
+
+
+def test_decode_lists_pass_is_clean_and_axes_track_caps():
+    # KAT-CTR-011 green on the real commit tail, with the B/E axes
+    # resolved live from the caps formula (drift between decode_caps and
+    # the schema would fail here first)
+    assert contracts.check_decode_lists() == []
+    from kube_arbitrator_tpu.ops.cycle import decode_caps
+
+    axes = contracts.decode_axes(contracts.DEFAULT_AXES)
+    assert (axes["B"], axes["E"]) == decode_caps(contracts.DEFAULT_AXES["T"])
+
+
+def test_mutated_decode_lists_schema_reports_exactly_that_field():
+    # KAT-CTR-011: declare bind_idx as float32 — the real commit tail
+    # (correctly) emits int32 ordinals, and cache/decode.py gathers them
+    # host-side into the actuated bind stream, so the analyzer must flag
+    # exactly this field
+    seeded = contracts.mutated(
+        contracts.DECODE_LISTS_SCHEMA, "bind_idx", "float32"
+    )
+    findings = contracts.check_decode_lists(lists_schema=seeded)
+    assert len(findings) == 1
+    assert findings[0].rule == "KAT-CTR-011"
+    assert "`bind_idx`" in findings[0].message
 
 
 def test_mutated_audit_aux_schema_reports_exactly_that_field():

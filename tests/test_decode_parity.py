@@ -1,0 +1,318 @@
+"""Ints-out decode parity: the compact index lists vs the dense oracle.
+
+The kernel's commit tail ships compact, length-prefixed bind/evict index
+lists (ops/cycle.commit_cycle) and the host decodes them with one
+bounded gather (cache/decode.decode_decisions_compact).  The dense-mask
+decode (``decode_decisions``) stays the PARITY ORACLE: the suite pins
+
+* bit-identical intents (same sets, same order) across the 3-seed x
+  q{8, 64, 512} full-action matrix, including the pipelined executor,
+  the RPC codec round-trip, and the decision-pool serving path;
+* the degenerate shapes: empty masks, and an all-T bind storm;
+* the overflow contract: counts past the caps force the dense fallback
+  (never a truncated intent stream) and count ``decode_overflow_total``.
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+from kube_arbitrator_tpu.cache import build_snapshot, generate_cluster
+from kube_arbitrator_tpu.cache.decode import (
+    decode_decisions,
+    decode_decisions_compact,
+)
+from kube_arbitrator_tpu.cache.sim import SimCluster
+from kube_arbitrator_tpu.framework.conf import load_conf
+from kube_arbitrator_tpu.ops.cycle import decode_caps, schedule_cycle
+
+GB = 1024**3
+
+FULL_CONF = load_conf(
+    'actions: "reclaim, allocate, backfill, preempt"\n'
+    "tiers:\n"
+    "- plugins:\n"
+    "  - name: priority\n"
+    "  - name: gang\n"
+    "- plugins:\n"
+    "  - name: drf\n"
+    "  - name: predicates\n"
+    "  - name: proportion\n"
+)
+
+
+def _world(q, seed):
+    return generate_cluster(
+        num_nodes=48,
+        num_jobs=max(12, q + q // 8),
+        tasks_per_job=4,
+        num_queues=q,
+        seed=seed,
+        node_cpu_milli=4000,
+        node_memory=8 * GB,
+        running_fraction=0.5,
+    )
+
+
+def _assert_intents_equal(compact, dense, ctx):
+    assert compact is not None, f"{ctx}: compact path unexpectedly unavailable"
+    cb, ce = compact
+    db, de = dense
+    assert cb == db, f"{ctx}: bind intents diverged ({len(cb)} vs {len(db)})"
+    assert ce == de, f"{ctx}: evict intents diverged ({len(ce)} vs {len(de)})"
+
+
+@pytest.mark.parametrize("q", [8, 64, 512])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_compact_vs_dense_full_actions(q, seed):
+    """The core matrix: full-action cycles over loaded worlds must decode
+    identically through both paths — same intent sets, same order."""
+    sim = _world(q, seed)
+    snap = build_snapshot(sim.cluster)
+    dec = schedule_cycle(
+        snap.tensors, tiers=FULL_CONF.tiers, actions=FULL_CONF.actions
+    )
+    _assert_intents_equal(
+        decode_decisions_compact(snap, dec),
+        decode_decisions(snap, dec),
+        f"q={q} seed={seed}",
+    )
+    n_bind = int(dec.bind_count)
+    n_evict = int(dec.evict_count)
+    assert n_bind == int(np.asarray(dec.bind_mask).sum())
+    assert n_evict == int(np.asarray(dec.evict_mask).sum())
+    assert n_bind + n_evict > 0, "vacuous parity: the cycle decided nothing"
+
+
+def test_empty_masks_decode_to_empty_intents():
+    """A cycle with nothing to do: zero counts, empty lists, both paths
+    empty and equal."""
+    sim = SimCluster()
+    sim.add_queue("default", weight=1)
+    sim.add_node("n1", cpu_milli=4000, memory=8 * GB)
+    snap = build_snapshot(sim.cluster)
+    dec = schedule_cycle(snap.tensors)
+    assert int(dec.bind_count) == 0 and int(dec.evict_count) == 0
+    assert (np.asarray(dec.bind_idx) == -1).all()
+    assert (np.asarray(dec.evict_idx) == -1).all()
+    out = decode_decisions_compact(snap, dec)
+    assert out == ([], [])
+    assert decode_decisions(snap, dec) == ([], [])
+
+
+def test_all_tasks_bind_storm():
+    """Every task binds in one cycle (the mass-bind shape the decode
+    tail is worst at): the compact list carries every row, in the dense
+    decode's ascending order."""
+    sim = SimCluster()
+    sim.add_queue("default", weight=1)
+    for i in range(8):
+        sim.add_node(f"n{i}", cpu_milli=64_000, memory=512 * GB)
+    for j in range(8):
+        job = sim.add_job(f"j{j}", queue="default", min_available=1)
+        for _ in range(8):
+            sim.add_task(job, 100, GB // 8)
+    snap = build_snapshot(sim.cluster)
+    dec = schedule_cycle(snap.tensors)
+    n_real = len(snap.index.tasks)
+    assert int(dec.bind_count) == n_real, "storm did not bind every task"
+    _assert_intents_equal(
+        decode_decisions_compact(snap, dec),
+        decode_decisions(snap, dec),
+        "bind storm",
+    )
+    binds, _ = decode_decisions_compact(snap, dec)
+    assert [b.task_uid for b in binds] == [
+        snap.index.tasks[i].uid for i in range(n_real)
+    ]
+
+
+def test_overflow_falls_back_to_dense_decode():
+    """Counts past the caps (forced via commit_cycle's static cap
+    override) mean the compact path must refuse — never truncate — and
+    Session.decode_phase must decode dense and count the overflow."""
+    import jax
+
+    from kube_arbitrator_tpu.ops.cycle import (
+        _commit_jit,
+        _run_stage,
+        open_session,
+    )
+
+    sim = _world(8, 0)
+    snap = build_snapshot(sim.cluster)
+    st = snap.tensors
+    tiers, actions = FULL_CONF.tiers, FULL_CONF.actions
+    sess, state = jax.jit(lambda s: open_session(s, tiers))(st)
+    for action in actions:
+        state = _run_stage(
+            st, sess, state, action=action, tiers=tiers, s_max=4096,
+            max_rounds=100_000, native_ops=False,
+        )
+    dec = _commit_jit(st, sess, state, bind_cap=2, evict_cap=1)
+    assert int(dec.bind_count) > 2, "world too small to overflow bind_cap=2"
+    assert np.asarray(dec.bind_idx).shape == (2,)
+    assert decode_decisions_compact(snap, dec) is None
+    # the truncated prefix still matches the dense head (the caps drop
+    # the tail, they never reorder)
+    head = np.nonzero(np.asarray(dec.bind_mask))[0][:2]
+    assert (np.asarray(dec.bind_idx) == head).all()
+
+    # Session.decode_phase: dense fallback + decode_overflow_total
+    from kube_arbitrator_tpu.framework.session import Session
+    from kube_arbitrator_tpu.utils.metrics import metrics
+
+    session = Session(sim.cluster, FULL_CONF)
+    before = metrics().counter_total("decode_overflow_total")
+    binds, evicts = session.decode_phase(snap, dec)
+    after = metrics().counter_total("decode_overflow_total")
+    assert after == before + 1
+    _assert_intents_equal((binds, evicts), decode_decisions(snap, dec),
+                          "overflow fallback")
+
+
+def test_decode_caps_formula():
+    """The caps are a static function of T — the contract the B/E schema
+    axes (analysis/contracts.decode_axes) and the wire cost both rest
+    on."""
+    assert decode_caps(8) == (8, 8)
+    assert decode_caps(1024) == (1024, 512)
+    assert decode_caps(50_000) == (25_000, 6_250)
+    b, e = decode_caps(200_000)
+    assert b == 100_000 and e == 25_000
+
+
+def test_rpc_codec_roundtrip_preserves_compact_lists():
+    """The reply pack: decisions crossing the codec must decode through
+    the compact path on the far side, bit-identically."""
+    from kube_arbitrator_tpu.rpc import codec
+    from kube_arbitrator_tpu.rpc.codec import decide_reply, unpack_tensors
+
+    sim = _world(8, 1)
+    snap = build_snapshot(sim.cluster)
+    dec = schedule_cycle(
+        snap.tensors, tiers=FULL_CONF.tiers, actions=FULL_CONF.actions
+    )
+    rep = decide_reply(dec, cycle=1, kernel_ms=0.0)
+    back = unpack_tensors(type(dec), rep.tensors)
+    for f in dataclasses.fields(type(dec)):
+        assert np.array_equal(
+            np.asarray(getattr(dec, f.name)), np.asarray(getattr(back, f.name))
+        ), f"codec round-trip drifted {f.name}"
+    _assert_intents_equal(
+        decode_decisions_compact(snap, back),
+        decode_decisions(snap, dec),
+        "rpc codec round-trip",
+    )
+
+
+def test_pre_ints_out_peer_reply_falls_back_dense():
+    """Mixed-version rollout: a DecideReply from a peer one release
+    behind omits the five list tensors.  The codec must rebuild the
+    decisions on the fields' None defaults, the dtype twin must accept
+    the absence, and decode must serve the dense path — degraded, never
+    fatal — withOUT counting an overflow (absent is not overflow)."""
+    from kube_arbitrator_tpu.framework.session import (
+        Session,
+        _assert_decision_dtypes,
+    )
+    from kube_arbitrator_tpu.rpc.codec import decide_reply, unpack_tensors
+    from kube_arbitrator_tpu.utils.metrics import metrics
+
+    sim = _world(8, 2)
+    snap = build_snapshot(sim.cluster)
+    dec = schedule_cycle(
+        snap.tensors, tiers=FULL_CONF.tiers, actions=FULL_CONF.actions
+    )
+    rep = decide_reply(dec, cycle=1, kernel_ms=0.0)
+    omitted = [
+        t for t in rep.tensors
+        if t.name not in ("bind_idx", "bind_node", "evict_idx",
+                          "bind_count", "evict_count")
+    ]
+    back = unpack_tensors(type(dec), omitted)
+    assert back.bind_idx is None and back.bind_count is None
+    _assert_decision_dtypes(back)  # absence of the OPTIONAL subset is legal
+    assert decode_decisions_compact(snap, back) is None
+    session = Session(sim.cluster, FULL_CONF)
+    overflow_before = metrics().counter_total("decode_overflow_total")
+    binds, evicts = session.decode_phase(snap, back)
+    assert metrics().counter_total("decode_overflow_total") == overflow_before
+    _assert_intents_equal((binds, evicts), decode_decisions(snap, dec),
+                          "old-peer dense fallback")
+
+
+def test_partial_list_pack_is_absence_not_overflow():
+    """A skewed peer shipping only SOME of the five list fields: the
+    compact path must refuse as absence (dense fallback, no crash on a
+    None count) and the session must NOT count it as an overflow."""
+    from kube_arbitrator_tpu.framework.session import Session
+    from kube_arbitrator_tpu.utils.metrics import metrics
+
+    sim = _world(8, 3)
+    snap = build_snapshot(sim.cluster)
+    dec = schedule_cycle(
+        snap.tensors, tiers=FULL_CONF.tiers, actions=FULL_CONF.actions
+    )
+    partial = dataclasses.replace(dec, bind_count=None, bind_node=None)
+    assert decode_decisions_compact(snap, partial) is None
+    session = Session(sim.cluster, FULL_CONF)
+    overflow_before = metrics().counter_total("decode_overflow_total")
+    binds, evicts = session.decode_phase(snap, partial)
+    assert metrics().counter_total("decode_overflow_total") == overflow_before
+    _assert_intents_equal((binds, evicts), decode_decisions(snap, dec),
+                          "partial-pack dense fallback")
+
+
+def test_pool_served_decisions_decode_compact():
+    """Pool-served decisions (the batched fleet path) carry the lists
+    and decode identically to a solo launch's."""
+    from kube_arbitrator_tpu.rpc.pool import DecisionPool
+
+    pool = DecisionPool(replicas=1, threaded=False)
+    reqs = []
+    snaps = {}
+    for i in range(2):
+        sim = _world(8, 10 + i)
+        snap = build_snapshot(sim.cluster)
+        tenant = f"t{i}"
+        snaps[tenant] = snap
+        reqs.append((tenant, snap.tensors, FULL_CONF, None))
+    served = pool.decide_many(reqs)
+    for req in served:
+        assert req.error is None
+        snap = snaps[req.tenant]
+        solo = schedule_cycle(
+            snap.tensors, tiers=FULL_CONF.tiers, actions=FULL_CONF.actions
+        )
+        _assert_intents_equal(
+            decode_decisions_compact(snap, req.decisions),
+            decode_decisions(snap, solo),
+            f"pool tenant {req.tenant}",
+        )
+
+
+def test_pipelined_loop_decodes_compact_with_parity_check(monkeypatch):
+    """A pipelined multi-cycle run with the per-cycle oracle cross-check
+    armed: every committed cycle decodes through the compact path, the
+    bind/evict stream equals a sequential run's, and the decode-path
+    counter shows the fast path served."""
+    from kube_arbitrator_tpu.framework.scheduler import Scheduler
+    from kube_arbitrator_tpu.utils.metrics import metrics
+
+    monkeypatch.setenv("KAT_DECODE_PARITY", "1")
+    mk = lambda: generate_cluster(
+        num_nodes=16, num_jobs=8, tasks_per_job=4, num_queues=4, seed=77
+    )
+    sim_pipe, sim_seq = mk(), mk()
+    before = metrics().counter_total("decode_path_total")
+    Scheduler(sim_pipe, arena=True).run_pipelined(max_cycles=4, until_idle=False)
+    after = metrics().counter_total("decode_path_total")
+    assert after > before, "no decode path recorded"
+    Scheduler(sim_seq).run(max_cycles=4, until_idle=False)
+    bound = lambda sim: {
+        t.uid: t.node_name
+        for j in sim.cluster.jobs.values()
+        for t in j.tasks.values()
+    }
+    assert bound(sim_pipe) == bound(sim_seq)
