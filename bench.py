@@ -296,6 +296,21 @@ def _arena_probe(sim, canon_snap, dec0):
 
 
 def main() -> None:
+    # BENCH_SHARD_DEVICES: virtual host-device count for the sharded
+    # plane mode — must land in XLA_FLAGS before the backend initializes,
+    # so it is stamped here (parent AND child inherit it; a caller who
+    # already set the flag wins)
+    devs = os.environ.get("BENCH_SHARD_DEVICES")
+    if (
+        os.environ.get("BENCH_SHARD") == "1"
+        and devs
+        and "xla_force_host_platform_device_count"
+        not in os.environ.get("XLA_FLAGS", "")
+    ):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={devs}"
+        ).strip()
     # the parent/child wedge containment wraps EVERY mode, the pipeline
     # cadence mode included: a wedged accelerator mid-leg must still
     # yield the contract line from the spilled rows within BENCH_TIMEOUT_S
@@ -305,7 +320,164 @@ def main() -> None:
         sys.exit(_pipeline_main())
     if os.environ.get("BENCH_POOL") == "1":
         sys.exit(_pool_main())
+    if os.environ.get("BENCH_SHARD") == "1":
+        sys.exit(_shard_main())
     _measure_main()
+
+
+# ---------------------------------------------------------------------------
+# sharded cluster plane mode (BENCH_SHARD=1)
+
+
+def _shard_main() -> int:
+    """The sharded-plane scale artifact (ROADMAP item 1, the 10× jump):
+    per rung, an O(T)-vectorized synthetic world (cache/synth.py — the
+    object-model builders don't survive 1M pods) decided over the
+    node-sharded mesh, with a sharded-vs-dense bit-identity gate run
+    FIRST so a rung number can never come from a divergent program.
+
+    Env: BENCH_SHARD_RUNGS ("TxN,TxN", default the 1M×100k jump rung),
+    BENCH_SHARD_DEVICES (virtual host devices — sets
+    --xla_force_host_platform_device_count when the caller didn't),
+    BENCH_SHARD_REPS, BENCH_SHARD_QUEUES, BENCH_SHARD_TPJ (tasks/job),
+    BENCH_SHARD_DENSE=0 to skip the dense comparison leg.  On a
+    1-device host the mesh is a single shard — the row is then the
+    honest "sharding overhead only" number the README quotes."""
+    from kube_arbitrator_tpu.platform import (
+        enable_persistent_cache,
+        ensure_jax_backend,
+    )
+
+    ensure_jax_backend()
+    enable_persistent_cache()
+    import jax
+
+    from kube_arbitrator_tpu.cache.synth import build_synthetic_snapshot
+    from kube_arbitrator_tpu.ops import schedule_cycle
+    from kube_arbitrator_tpu.parallel import make_mesh, shard_snapshot
+
+    rungs = []
+    for part in os.environ.get("BENCH_SHARD_RUNGS", "1000000x100000").split(","):
+        t, n = part.strip().lower().split("x")
+        rungs.append((int(t), int(n)))
+    reps = int(os.environ.get("BENCH_SHARD_REPS", 3))
+    queues = int(os.environ.get("BENCH_SHARD_QUEUES", 8))
+    # 10k-task jobs by default: at the 1M×100k rung this keeps the group
+    # count low enough (~100) that the deferred batched round stays legal
+    # (G·N under allocate's DEFER_MAX_CELLS) — 1k-task jobs push the rung
+    # onto the immediate per-turn path, ~1000 [T]-sized turns per cycle
+    tpj = int(os.environ.get("BENCH_SHARD_TPJ", 10_000))
+    dense_leg = os.environ.get("BENCH_SHARD_DENSE", "1") != "0"
+    mesh = make_mesh()
+    S = len(jax.devices())
+
+    def run_leg(instances, sharded: bool):
+        """(median cycle s, median binds, rep ms list): warmup on the
+        first instance, then one timed rep per DISTINCT-content variant
+        (the ladder's anti-memoization rule; synthetic builds are cheap
+        enough to mint one world per rep)."""
+        def prep(snap):
+            return shard_snapshot(snap.tensors, mesh) if sharded else snap.tensors
+
+        ctx = mesh if sharded else _NullCtx()
+        with ctx:
+            d0 = schedule_cycle(prep(instances[0]))
+            np.asarray(d0.bind_mask)  # compile + settle
+            times, binds = [], []
+            for snap in instances[1:]:
+                st = prep(snap)
+                jax.block_until_ready(jax.tree.leaves(st))
+                t0 = time.perf_counter()
+                dec = schedule_cycle(st)
+                mask = np.asarray(dec.bind_mask)
+                times.append(time.perf_counter() - t0)
+                binds.append(int(mask.sum()))
+        med = int(np.argsort(times)[len(times) // 2])
+        return times[med], binds[med], [round(t * 1000, 1) for t in times]
+
+    # ---- bit-identity gate (a rung number from a divergent sharded
+    # program is worthless): small rung, full comparison ----
+    gate = build_synthetic_snapshot(
+        20_000, 2_000, num_queues=queues, tasks_per_job=100, seed=7,
+        running_fraction=0.3, fit_fraction=1.2,
+    )
+    with mesh:
+        dsh = schedule_cycle(shard_snapshot(gate.tensors, mesh))
+        np.asarray(dsh.bind_mask)
+    dref = schedule_cycle(gate.tensors)
+    for f in ("task_node", "task_status", "bind_mask", "evict_mask"):
+        if not np.array_equal(
+            np.asarray(getattr(dref, f)), np.asarray(getattr(dsh, f))
+        ):
+            _emit({
+                "metric": "shard_parity_gate",
+                "value": None,
+                "error": f"sharded cycle diverged from dense on {f}",
+            })
+            return 1
+    print(f"# shard parity gate ok ({S} devices, 20000x2000)", file=sys.stderr)
+
+    rows = []
+    for T, N in rungs:
+        t0 = time.perf_counter()
+        instances = [
+            build_synthetic_snapshot(
+                T, N, num_queues=queues, tasks_per_job=tpj, seed=42 + i,
+                running_fraction=0.0, fit_fraction=1.2,
+            )
+            for i in range(reps + 1)
+        ]
+        gen_ms = (time.perf_counter() - t0) * 1000
+        # block size from the RE-PADDED axis (shard_snapshot pads when
+        # the device count doesn't divide the 128-bucketed node axis)
+        n_nodes = instances[0].tensors.num_nodes
+        padded = n_nodes + (-n_nodes) % S
+        sh_s, sh_binds, sh_reps = run_leg(instances, sharded=True)
+        row = {
+            "metric": f"shard_cycle@{T}x{N}",
+            "value": round(sh_binds / sh_s, 1) if sh_s > 0 else 0.0,
+            "unit": "pods/s",
+            "cycle_ms": round(sh_s * 1000, 1),
+            "rep_ms": sh_reps,
+            "binds": sh_binds,
+            "devices": S,
+            "shard_block_nodes": padded // S,
+            "world_gen_ms": round(gen_ms / (reps + 1), 1),
+            "provenance": "median rep's own binds / its time; each rep a "
+            "distinct-seed O(T) synthetic world; parity gate ran first",
+            "cadence_contract_s": 1.0,
+        }
+        if dense_leg:
+            d_s, d_binds, d_reps = run_leg(instances, sharded=False)
+            row["dense_cycle_ms"] = round(d_s * 1000, 1)
+            row["dense_rep_ms"] = d_reps
+            row["dense_value"] = round(d_binds / d_s, 1) if d_s > 0 else 0.0
+            row["shard_vs_dense"] = (
+                round(d_s / sh_s, 2) if sh_s > 0 else None
+            )
+        rows.append(row)
+        _emit(row, stream=sys.stderr)
+        _spill(row)
+    summary = {
+        "metric": "shard_plane",
+        "value": rows[-1]["value"] if rows else None,
+        "unit": "pods/s",
+        "note": f"sharded decision cycle over {S} host devices, last rung",
+        "rungs": rows,
+        "devices": _device_desc(),
+    }
+    _emit(summary)
+    _spill({"primary": summary, "final": True})
+    _history_append(rows)
+    return 0
+
+
+class _NullCtx:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
 
 
 # ---------------------------------------------------------------------------

@@ -357,6 +357,45 @@ EOF
   fi
 fi
 
+# SHARD_SMOKE=1: the sharded cluster plane — the FULL sharded-vs-dense
+# parity soak (3 seeds x q{8,64,512} x shard counts {1,2,8}, full
+# actions, whole reply pack bit-identical; the slow matrix tier-1 only
+# samples), the shard_map building-block twins + the sharded arena
+# suite (per-shard uploads / per-shard verify blame), the mesh re-pad +
+# KAT-CTR-012 shard-layout-contract tests, an 8-seed chaos matrix with
+# sharding ON (ShardedDecider over the 8-virtual-device mesh +
+# per-shard arena resident uploads; no_double_bind / single_actuator /
+# audit_consistency must hold and digests stay deterministic), and
+# kat-lint KAT-DTY/KAT-LCK over parallel/ + the arena + the synthetic
+# world generator.
+rc_shard=0
+if [ "${SHARD_SMOKE:-0}" = "1" ]; then
+  # the whole file INCLUDING the slow full soak matrix (this lane is
+  # where the acceptance soak actually runs)
+  env JAX_PLATFORMS=cpu python -m pytest -q \
+    tests/test_shard_parity.py tests/test_parallel.py || rc_shard=$?
+  # the shard profile needs the 8-virtual-device mesh the tests get from
+  # conftest — the chaos CLI initializes its own backend
+  for seed in 0 1 2 3 4 5 6 7; do
+    env JAX_PLATFORMS=cpu KAT_DECODE_PARITY=1 \
+      XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      python -m kube_arbitrator_tpu.chaos \
+      --seed "${seed}" --cycles 8 --profile shard --out-dir /tmp \
+      || rc_shard=$?
+  done
+  python -m kube_arbitrator_tpu.analysis --rules KAT-LCK,KAT-DTY \
+    kube_arbitrator_tpu/parallel/mesh.py \
+    kube_arbitrator_tpu/parallel/shard.py \
+    kube_arbitrator_tpu/parallel/multihost.py \
+    kube_arbitrator_tpu/cache/arena.py \
+    kube_arbitrator_tpu/cache/synth.py || rc_shard=$?
+  if [ "${rc_shard}" -ne 0 ]; then
+    echo "shard smoke job: FAILED (exit ${rc_shard})" >&2
+  else
+    echo "shard smoke job: ok (full parity soak + 8-seed sharded chaos + kat-lint)"
+  fi
+fi
+
 # PERF_SENTINEL=1: the perf-regression gate — the profiling/timeseries/
 # sentinel suites, then the sentinel's sensitivity canaries against the
 # committed BENCH_HISTORY.jsonl: a seeded synthetic 2x slowdown MUST
@@ -416,6 +455,7 @@ if [ "${LINT_ONLY:-0}" = "1" ]; then
   if [ "${rc_perf}" -ne 0 ]; then exit "${rc_perf}"; fi
   if [ "${rc_sentinel}" -ne 0 ]; then exit "${rc_sentinel}"; fi
   if [ "${rc_pool}" -ne 0 ]; then exit "${rc_pool}"; fi
+  if [ "${rc_shard}" -ne 0 ]; then exit "${rc_shard}"; fi
   exit "${rc_pipe}"
 fi
 
@@ -435,4 +475,5 @@ if [ "${rc_pipe}" -ne 0 ]; then exit "${rc_pipe}"; fi
 if [ "${rc_perf}" -ne 0 ]; then exit "${rc_perf}"; fi
 if [ "${rc_sentinel}" -ne 0 ]; then exit "${rc_sentinel}"; fi
 if [ "${rc_pool}" -ne 0 ]; then exit "${rc_pool}"; fi
+if [ "${rc_shard}" -ne 0 ]; then exit "${rc_shard}"; fi
 exit "${rc_test}"

@@ -73,6 +73,17 @@ Sub-ids:
   resolved live from ``ops/cycle.decode_caps``).  cache/decode.py
   gathers these host-side into the actuated intents, so a drift here
   corrupts the bind stream itself.
+- ``KAT-CTR-012``: the SHARD-LAYOUT contract — every snapshot field
+  whose declared shape carries the node axis ``N`` must be declared in
+  the partition tables of ``parallel/mesh.py`` (leading axis →
+  ``_NODE_SHARDED_FIELDS``, second axis → ``_NODE_AXIS1_FIELDS``), and
+  every declared entry must actually have ``N`` at that axis.  Without
+  this, a NEW node-axis snapshot field silently lands REPLICATED on the
+  sharded plane: decisions stay correct (replication is semantically
+  neutral) but every delta re-ships the field whole to every shard —
+  exactly the silent-performance class this pass exists for.
+  ``rv_block_start`` ([N+1] canon block extents) is the one declared
+  replication exception (:data:`SHARD_REPLICATED_OK`).
 
 The harness takes the schemas as parameters so the regression tests can
 seed one mutated dtype and assert the checker reports exactly the
@@ -297,6 +308,13 @@ DECISIONS_SCHEMA: Dict[str, Tuple[Tuple[str, ...], str]] = {
     **AUDIT_AUX_SCHEMA,
     **DECODE_LISTS_SCHEMA,
 }
+
+
+#: Node-axis-shaped fields that stay REPLICATED on the sharded plane by
+#: design.  rv_block_start is [N+1]: per-node canon block extents whose
+#: +1 sentinel makes even row-splitting impossible, and every shard's
+#: claim chain reads arbitrary blocks — replication is the layout.
+SHARD_REPLICATED_OK: Tuple[str, ...] = ("rv_block_start",)
 
 
 def decode_axes(axes: Mapping[str, int]) -> Dict[str, int]:
@@ -950,6 +968,75 @@ def _session_struct(axes):
     })
 
 
+def check_shard_layout(
+    schema: Optional[Mapping[str, Tuple[Tuple[str, ...], str]]] = None,
+) -> List[Finding]:
+    """KAT-CTR-012: the shard-layout contract — the partition tables of
+    ``parallel/mesh.py`` must cover exactly the schema's node-axis
+    fields (see the module docstring's sub-id list).  Abstract: no
+    arrays are built; the check is a pure set/axis comparison between
+    the declared :data:`SNAPSHOT_SCHEMA` shapes and the mesh module's
+    ``_NODE_SHARDED_FIELDS`` / ``_NODE_AXIS1_FIELDS``."""
+    from ..parallel import mesh as meshmod
+
+    schema = schema or SNAPSHOT_SCHEMA
+    path, line = _anchor(meshmod.snapshot_shardings)
+    hint = (
+        "declare the field's node axis in parallel/mesh.py "
+        "(_NODE_SHARDED_FIELDS for a leading N, _NODE_AXIS1_FIELDS for a "
+        "second-axis N) or add it to SHARD_REPLICATED_OK with a rationale"
+    )
+    findings: List[Finding] = []
+    for name, (shape, _dtype) in schema.items():
+        ax0 = len(shape) > 0 and shape[0] == "N"
+        ax1 = len(shape) > 1 and shape[1] == "N"
+        in0 = name in meshmod._NODE_SHARDED_FIELDS
+        in1 = name in meshmod._NODE_AXIS1_FIELDS
+        if name in SHARD_REPLICATED_OK:
+            if in0 or in1:
+                findings.append(Finding(
+                    "KAT-CTR-012", "error", path, line,
+                    f"`{name}` is listed replicated-by-design "
+                    "(SHARD_REPLICATED_OK) but also declared in a mesh "
+                    "partition table — pick one",
+                    hint=hint,
+                ))
+            continue
+        if ax0 and not in0:
+            findings.append(Finding(
+                "KAT-CTR-012", "error", path, line,
+                f"`{name}` has node-axis shape {shape} but is missing from "
+                "_NODE_SHARDED_FIELDS — it silently lands REPLICATED on "
+                "the sharded plane (full re-ship to every shard per delta)",
+                hint=hint,
+            ))
+        if ax1 and not in1:
+            findings.append(Finding(
+                "KAT-CTR-012", "error", path, line,
+                f"`{name}` has second-axis node shape {shape} but is "
+                "missing from _NODE_AXIS1_FIELDS — it silently lands "
+                "REPLICATED on the sharded plane",
+                hint=hint,
+            ))
+        if in0 and not ax0:
+            findings.append(Finding(
+                "KAT-CTR-012", "error", path, line,
+                f"`{name}` is declared node-sharded (axis 0) but the "
+                f"schema shape is {shape} — the sharded plane would split "
+                "a non-node axis",
+                hint=hint,
+            ))
+        if in1 and not ax1:
+            findings.append(Finding(
+                "KAT-CTR-012", "error", path, line,
+                f"`{name}` is declared node-sharded (axis 1) but the "
+                f"schema shape is {shape} — the sharded plane would split "
+                "a non-node axis",
+                hint=hint,
+            ))
+    return findings
+
+
 def check_contracts(
     schema: Optional[Mapping[str, Tuple[Tuple[str, ...], str]]] = None,
     state_schema: Optional[Mapping[str, Tuple[Tuple[str, ...], str]]] = None,
@@ -968,5 +1055,6 @@ def check_contracts(
     findings += check_reclaim_turns(schema)
     findings += check_audit_aux(schema)
     findings += check_decode_lists(schema)
+    findings += check_shard_layout(schema)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings

@@ -102,11 +102,20 @@ class ArenaDivergence(RuntimeError):
 class PackMeta:
     """What a transport needs to ship this pack incrementally: the pack's
     epoch key, the epoch it was diffed against (None = no usable base —
-    ship everything), and which fields changed since that base."""
+    ship everything), and which fields changed since that base.
+
+    ``decode_caps`` is the tenant's OWN (bind_cap, evict_cap) for the
+    compact ints-out decode lists, or None for the global
+    ``ops.cycle.decode_caps`` formula — pool tenants with mixed fleet
+    shapes carry their per-conf caps here so a small tenant batched next
+    to a large one is not forced to the large tenant's list widths (and
+    a tenant that knows its cycles run bind-storm-heavy can oversize its
+    caps instead of paying the dense fallback every cycle)."""
 
     key: str
     base_key: Optional[str]
     changed_fields: Tuple[str, ...]
+    decode_caps: Optional[Tuple[int, int]] = None
 
 
 _ARRAY_FIELDS: Tuple[str, ...] = tuple(
@@ -241,6 +250,121 @@ class _DeviceResident:
         return SnapshotTensors(**arrays, **self.statics)
 
 
+class _ShardedResident:
+    """The sharded-plane twin of :class:`_DeviceResident`: node-sharded
+    fields live as PER-SHARD single-device buffers assembled into one
+    global array (``jax.make_array_from_single_device_arrays``), so a
+    delta touching one partition re-uploads ONLY that shard's row block
+    — the other shards' buffers are reused outright.  Replicated and
+    axis-1 node fields re-place whole when changed (they are small or
+    change structurally).  Epochs stay GLOBAL: the reuse/patch keying is
+    the same arena epoch key the single-device resident uses."""
+
+    def __init__(self):
+        self._devs: Tuple = ()
+        self.key: Optional[str] = None
+        self.blocks: Dict[str, list] = {}
+        self.arrays: Optional[Dict[str, object]] = None
+        self.statics: Dict[str, object] = {}
+        self.last_upload_bytes = 0
+        self.last_mode = "none"
+        self.last_shard_uploads = 0
+
+    def update(
+        self,
+        host: Dict[str, np.ndarray],
+        statics: Dict[str, object],
+        key: str,
+        base_key: Optional[str],
+        changed: Dict[str, object],
+        mesh,
+    ) -> SnapshotTensors:
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.mesh import (
+            _NODE_AXIS1_FIELDS,
+            _NODE_SHARDED_FIELDS,
+            NODE_AXIS,
+        )
+        from ..parallel.shard import ShardLayout
+
+        devs = tuple(mesh.devices.flat)
+        layout = ShardLayout.for_mesh(mesh, host["node_valid"].shape[0])
+        if self.arrays is not None and self.key == key and self._devs == devs:
+            self.last_upload_bytes, self.last_mode = 0, "reuse"
+            self.last_shard_uploads = 0
+            return SnapshotTensors(**self.arrays, **self.statics)
+        full = (
+            self.arrays is None
+            or self._devs != devs
+            or self.statics != statics
+            or base_key is None
+            or self.key != base_key
+        )
+        uploaded = 0
+        shard_uploads = 0
+        blocks = {} if full else {k: list(v) for k, v in self.blocks.items()}
+        arrays: Dict[str, object] = {} if full else dict(self.arrays)
+        m = metrics()
+        blk = layout.block
+        for name in _ARRAY_FIELDS:
+            arr = host[name]
+            rows = None if full else changed.get(name)
+            if rows is None and not full:
+                continue  # resident buffers still current
+            node_sharded = (
+                name in _NODE_SHARDED_FIELDS
+                and arr.ndim >= 1
+                and arr.shape[0] == layout.padded_nodes
+            )
+            if node_sharded:
+                cur = blocks.get(name)
+                if (
+                    full
+                    or cur is None
+                    or len(cur) != layout.n_shards
+                    or isinstance(rows, str)
+                ):
+                    dirty = set(range(layout.n_shards))
+                    cur = [None] * layout.n_shards
+                else:
+                    dirty = set(layout.rows_by_shard(rows))
+                newb = []
+                for s in range(layout.n_shards):
+                    if s in dirty or cur[s] is None:
+                        b = jax.device_put(arr[s * blk:(s + 1) * blk], devs[s])
+                        uploaded += arr[s * blk:(s + 1) * blk].nbytes
+                        shard_uploads += 1
+                        m.counter_add(
+                            "shard_uploads_total", labels={"shard": str(s)}
+                        )
+                    else:
+                        b = cur[s]
+                    newb.append(b)
+                blocks[name] = newb
+                arrays[name] = jax.make_array_from_single_device_arrays(
+                    arr.shape, NamedSharding(mesh, P(NODE_AXIS)), newb
+                )
+            else:
+                axis1 = (
+                    name in _NODE_AXIS1_FIELDS
+                    and arr.ndim >= 2
+                    and arr.shape[1] == layout.padded_nodes
+                )
+                spec = P(None, NODE_AXIS) if axis1 else P()
+                arrays[name] = jax.device_put(arr, NamedSharding(mesh, spec))
+                uploaded += arr.nbytes
+        jax.block_until_ready(list(arrays.values()))
+        self._devs, self.key, self.arrays, self.blocks, self.statics = (
+            devs, key, arrays, blocks, dict(statics),
+        )
+        self.last_upload_bytes = uploaded
+        self.last_shard_uploads = shard_uploads
+        self.last_mode = "full" if full else "shard_delta"
+        return SnapshotTensors(**arrays, **self.statics)
+
+
 # ---------------------------------------------------------------------------
 # the arena
 
@@ -254,12 +378,20 @@ class SnapshotArena:
     byte-identity (0 disables the periodic check; :meth:`verify` is
     always available)."""
 
-    def __init__(self, backend, verify_every: int = 64):
+    def __init__(
+        self,
+        backend,
+        verify_every: int = 64,
+        decode_caps: Optional[Tuple[int, int]] = None,
+    ):
         self.backend = backend
         self.verify_every = verify_every
         backend.delta_sink = self
         self.uid = uuid.uuid4().hex[:8]
         self.epoch = 0
+        # per-tenant compact-decode caps carried on every PackMeta (None
+        # = the global ops.cycle.decode_caps formula); see PackMeta
+        self.decode_caps = decode_caps
         # speculation-window tee (pipeline plane): when attached, every
         # sink call below is mirrored into the journal BEFORE the arena's
         # own guards — the commit gate needs deltas even when the arena
@@ -296,6 +428,7 @@ class SnapshotArena:
         self._universe: List[int] = []
         self._aff_trivial = True
         self._resident = _DeviceResident()
+        self._sharded_resident = _ShardedResident()
 
     @property
     def cluster(self):
@@ -399,7 +532,8 @@ class SnapshotArena:
         self._changed = changed
         self.last_delta_rows = delta_rows
         self.pack_meta = PackMeta(
-            key=key, base_key=base_key, changed_fields=tuple(sorted(changed))
+            key=key, base_key=base_key, changed_fields=tuple(sorted(changed)),
+            decode_caps=self.decode_caps,
         )
         m.gauge_set("snapshot_delta_rows", float(delta_rows))
         tensors = SnapshotTensors(**shipped, **self._statics)
@@ -438,6 +572,7 @@ class SnapshotArena:
                 bad.append(
                     f"{f.name}: arena {a.dtype}{list(a.shape)} != rebuild "
                     f"{b.dtype}{list(b.shape)} ({n} cells differ)"
+                    + self._shard_blame(f.name, a, b)
                 )
         if bad:
             self._structural = "divergence"
@@ -447,6 +582,31 @@ class SnapshotArena:
                 + "; ".join(bad[:5])
                 + (f" (+{len(bad) - 5} more fields)" if len(bad) > 5 else "")
             )
+
+    def _shard_blame(self, name: str, a: np.ndarray, b: np.ndarray) -> str:
+        """Per-shard attribution for a diverged NODE-axis field: which
+        partitions hold differing rows.  The verifier itself runs per
+        shard this way — a lost delta in one partition names exactly
+        that partition, so a partitioned deployment knows which owner to
+        resync.  Empty string when no shard layout is active or the
+        field is not node-sharded."""
+        devs = self._sharded_resident._devs
+        if len(devs) <= 1 or a.shape != b.shape or a.ndim == 0:
+            return ""
+        from ..parallel.mesh import _NODE_SHARDED_FIELDS
+        from ..parallel.shard import ShardLayout
+
+        if name not in _NODE_SHARDED_FIELDS:
+            return ""
+        try:
+            layout = ShardLayout(len(devs), a.shape[0])
+        except ValueError:
+            return ""
+        d = a != b
+        if d.ndim > 1:
+            d = d.any(axis=tuple(range(1, d.ndim)))
+        shards = sorted(layout.rows_by_shard(np.nonzero(d)[0]))
+        return f" [shards {shards}]"
 
     # ---- device plane ----
 
@@ -474,6 +634,62 @@ class SnapshotArena:
             labels={"mode": self._resident.last_mode},
         )
         return st
+
+    def mesh_divides(self, mesh) -> bool:
+        """True when the current pack's node axis splits evenly over
+        ``mesh`` — the per-shard resident's precondition.  Callers
+        (Session.upload_phase) fall back to handing the decider the host
+        pack (which re-pads via shard_snapshot) when it doesn't."""
+        n = self._shipped["node_valid"].shape[0] if self._shipped else 0
+        return n > 0 and n % len(mesh.devices.flat) == 0
+
+    def device_pack_sharded(self, mesh) -> SnapshotTensors:
+        """The sharded-plane view of the current pack: node-sharded
+        fields resident as per-shard buffers over ``mesh``, re-uploading
+        ONLY the shards whose rows this epoch's diff touched (epoch
+        advances stay global — one key covers every shard).  Emits the
+        per-shard dirty-row gauge and the upload counters; consumed by
+        ``framework.Session.upload_phase`` when the decider carries a
+        mesh (parallel.shard.ShardedDecider)."""
+        from ..parallel.shard import ShardLayout, record_shard_metrics
+
+        meta = self.pack_meta
+        st = self._sharded_resident.update(
+            self._shipped, self._statics, meta.key if meta else "",
+            meta.base_key if meta else None, self._changed, mesh,
+        )
+        m = metrics()
+        m.counter_add(
+            "device_upload_bytes_total",
+            self._sharded_resident.last_upload_bytes,
+            labels={"mode": self._sharded_resident.last_mode},
+        )
+        layout = ShardLayout.for_mesh(mesh, self._shipped["node_valid"].shape[0])
+        record_shard_metrics(layout, self._shipped["node_valid"])
+        for s, n in self.shard_dirty_rows(layout).items():
+            m.gauge_set(
+                "snapshot_shard_delta_rows", float(n), labels={"shard": str(s)}
+            )
+        return st
+
+    def shard_dirty_rows(self, layout) -> Dict[int, int]:
+        """Per-shard changed NODE-axis row counts of the last diff — the
+        partition-local delta view (a delta touching one partition shows
+        exactly one nonzero shard here)."""
+        from ..parallel.mesh import _NODE_SHARDED_FIELDS
+
+        out: Dict[int, int] = {s: 0 for s in range(layout.n_shards)}
+        for name in _NODE_SHARDED_FIELDS:
+            rows = self._changed.get(name)
+            if rows is None:
+                continue
+            if isinstance(rows, str):  # shape move: every shard dirty
+                for s in out:
+                    out[s] += layout.block
+                continue
+            for s, r in layout.rows_by_shard(rows).items():
+                out[s] += len(r)
+        return out
 
     # ---- chaos seam (chaos/faults.py) ----
 

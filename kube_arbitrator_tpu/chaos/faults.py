@@ -246,6 +246,17 @@ class ChaosDecider:
         return getattr(self.inner, "wants_device_pack", True)
 
     @property
+    def mesh(self):
+        """Proxy the inner decider's mesh (parallel.shard.ShardedDecider)
+        so Session.upload_phase routes arena cycles through the
+        per-shard resident upload under chaos too."""
+        return getattr(self.inner, "mesh", None)
+
+    @property
+    def supports_decode_caps(self) -> bool:
+        return getattr(self.inner, "supports_decode_caps", False)
+
+    @property
     def last_action_ms(self) -> Dict[str, float]:
         return getattr(self.inner, "last_action_ms", None) or {}
 

@@ -142,6 +142,13 @@ class ChaosProfile:
     # arming the replica_* fault kinds and the pool_consistency invariant
     pool_replicas: int = 0
     pool_tenants: int = 0
+    # sharded cluster plane (parallel/shard.py): >0 runs every decide
+    # through a ShardedDecider over this many virtual devices — the
+    # arena's per-shard resident uploads included — with decisions
+    # pinned bit-identical to the dense program, so the same invariants
+    # (no double bind, single actuator, audit consistency) must hold
+    # under sharding and the digests stay deterministic
+    shard: int = 0
     # fault kind -> per-cycle injection probability
     rates: Tuple[Tuple[str, float], ...] = ()
 
@@ -224,6 +231,18 @@ PROFILES: Dict[str, ChaosProfile] = {
             ("rpc_fail", 0.15),
             ("rpc_deadline", 0.05),
             ("lease_steal", 0.15),
+        ),
+    ),
+    # the sharded cluster plane: every decide runs over the 8-virtual-
+    # device node-partitioned mesh (per-shard arena uploads included)
+    # while the usual apiserver/watch/lease/arena faults land — the
+    # invariant set must hold with sharding on, and because sharded
+    # decisions are bit-identical, the digest determinism check too
+    "shard": ChaosProfile(
+        name="shard", nodes=12, jobs=10, tasks_per_job=5, queues=3,
+        shard=8, verify_every=1,
+        rates=tuple(
+            {**dict(_MIXED_RATES), "arena_corrupt": 0.3}.items()
         ),
     ),
     # the fleet: M tenant worlds on N shared decision replicas

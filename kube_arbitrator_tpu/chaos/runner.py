@@ -221,7 +221,17 @@ def run_chaos(
         now_fn=clock.now,
     )
     elector.sleep = clock.sleep
-    decider = ChaosDecider(LocalDecider(), injector, clock, jitter_seed=seed)
+    if prof.shard > 0:
+        # the sharded cluster plane under fault: decisions run over the
+        # node-partitioned mesh (and arena cycles take the per-shard
+        # resident upload path through Session.upload_phase) — pinned
+        # bit-identical to the dense program, so digests stay plan-pure
+        from ..parallel.shard import ShardedDecider
+
+        base_decider = ShardedDecider(shards=prof.shard)
+    else:
+        base_decider = LocalDecider()
+    decider = ChaosDecider(base_decider, injector, clock, jitter_seed=seed)
     # decision audit on the virtual clock: every committed cycle's record
     # is reconciled against the apiserver's actuation events below
     # (audit_consistency); "audit-edges" seeds the dropped-edge mutation

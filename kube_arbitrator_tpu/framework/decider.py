@@ -30,6 +30,10 @@ class LocalDecider:
     # arena cycles: the Session pre-places the pack on the routed device
     # (dirty-range upload) because this decider consumes it in-process
     wants_device_pack = True
+    # per-tenant compact-decode caps (PackMeta.decode_caps) are honored:
+    # deciders WITHOUT this flag silently run the global caps formula,
+    # and Session.decide_phase surfaces that (decode_caps_ignored_total)
+    supports_decode_caps = True
 
     def __init__(self):
         # stage -> wall ms of the most recent decide (staged runs only)
@@ -39,10 +43,13 @@ class LocalDecider:
         self.last_action_rounds: Dict[str, int] = {}
 
     def decide(self, st, config, pack_meta=None) -> Tuple[object, float]:
-        # pack_meta is the arena's delta descriptor — a transport concern;
-        # the in-process path takes the resident device arrays instead
+        # pack_meta's delta descriptor is a transport concern (the
+        # in-process path takes the resident device arrays instead), but
+        # its per-tenant decode caps ARE consumed here
         from ..ops.cycle import schedule_cycle, schedule_cycle_staged
         from ..platform import decision_route
+
+        caps = getattr(pack_meta, "decode_caps", None)
 
         # backend crossover (shared seam, platform.decision_route): small
         # snapshots run on the host CPU even when an accelerator is
@@ -62,7 +69,7 @@ class LocalDecider:
             with ctx:
                 dec, stages = schedule_cycle_staged(
                     st, tiers=config.tiers, actions=config.actions,
-                    native_ops=native_ops,
+                    native_ops=native_ops, decode_caps=caps,
                 )
             # built locally, published in ONE reference assignment: a
             # concurrent reader (another loop sharing this decider — e.g.
@@ -94,7 +101,7 @@ class LocalDecider:
         with ctx:
             dec = schedule_cycle(
                 st, tiers=config.tiers, actions=config.actions,
-                native_ops=native_ops,
+                native_ops=native_ops, decode_caps=caps,
             )
             dec.task_node.block_until_ready()  # time the device program honestly
         return dec, (time.perf_counter() - t0) * 1000

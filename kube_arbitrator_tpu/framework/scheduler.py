@@ -161,6 +161,9 @@ class Scheduler:
         self.audit = audit
         self._consecutive_cycle_errors = 0
         self.job_status: Dict[str, PodGroupStatus] = {}
+        # delta write-back signatures (Session.status_cache): lets quiet
+        # steady-state cycles skip per-job status object construction
+        self._status_cache: Dict[str, tuple] = {}
         self.history: List[CycleStats] = []
         self.last_cycle_ts: Optional[float] = None  # /readyz freshness
         self._last_event_msg: Dict[tuple, str] = {}
@@ -439,6 +442,7 @@ class Scheduler:
         session = Session(
             self.sim.cluster, self.config, decider=self.decider,
             arena=self.arena, phase_hook=self.phase_hook,
+            status_cache=self._status_cache,
         )
         result = session.run()
         if pending is None:  # arena cycle: census from the pack instead

@@ -45,6 +45,9 @@ def _decode_parity_armed() -> bool:
     lane's env prefix) and must not be silently ignored."""
     return os.environ.get("KAT_DECODE_PARITY", "") == "1"
 
+# once-per-process warning latch for decode_caps-ignoring deciders
+_CAPS_WARNED = False
+
 # The process-wide default decider: Sessions constructed without one all
 # share this LocalDecider, so back-to-back cycles keep one routing/jit
 # identity instead of re-resolving per cycle.  Decide calls are
@@ -170,12 +173,22 @@ class Session:
         decider=None,
         arena=None,
         phase_hook=None,
+        status_cache: Optional[Dict[str, tuple]] = None,
     ):
         self.cluster = cluster
         self.config = config or SchedulerConfig.default()
         self.decider = decider
         self.arena = arena
         self.phase_hook = phase_hook
+        # Delta write-back seam (the Scheduler passes its own dict, kept
+        # across cycles): uid -> packed status signature of the last
+        # PodGroupStatus built for the job.  On a QUIET cycle (no binds,
+        # no evicts — nothing in the pack moved) jobs whose signature is
+        # unchanged skip object construction entirely, so a saturated
+        # steady-state cycle allocates ZERO per-job status objects.
+        # None (Sessions built directly, the pipelined executor's close
+        # worker) keeps the build-everything behavior.
+        self.status_cache = status_cache
         self.uid = str(uuid.uuid4())
 
     def _decider(self):
@@ -211,12 +224,34 @@ class Session:
         arena = self.arena
         st, pack_meta = snap.tensors, None
         if arena is not None:
+            mesh = getattr(self._decider(), "mesh", None)
+            if mesh is not None:
+                if arena.mesh_divides(mesh):
+                    # sharded decider (parallel.shard.ShardedDecider):
+                    # the per-shard dirty-range upload — only partitions
+                    # whose rows this epoch's diff touched re-ship
+                    with tracer().span("upload"):
+                        st = arena.device_pack_sharded(mesh)
+                else:
+                    # mesh size doesn't divide the pack's 128-bucketed
+                    # node axis: hand the HOST pack over — the decider
+                    # re-pads and shards it itself (shard_snapshot /
+                    # pad_nodes), exactly like the no-arena path.  The
+                    # per-shard resident is unavailable, not an error.
+                    st = snap.tensors
+                if self.phase_hook is not None:
+                    self.phase_hook("upload")
+                return st, arena.pack_meta
             if getattr(self._decider(), "wants_device_pack", True):
                 # dirty-range upload onto the routed device; the decider's
                 # own decision_route resolves to the same device, so the
-                # jit consumes the resident buffers without a transfer
+                # jit consumes the resident buffers without a transfer.
+                # pack_meta rides along for its per-tenant decode caps
+                # (LocalDecider consumes them; the delta descriptor half
+                # is ignored in-process)
                 with tracer().span("upload"):
                     st = arena.device_pack(self.config.actions)
+                pack_meta = arena.pack_meta
             else:
                 # remote decider: ship the delta, keyed by arena epoch
                 pack_meta = arena.pack_meta
@@ -232,6 +267,30 @@ class Session:
         from ..utils.tracing import tracer
 
         decider = self._decider()
+        if (
+            getattr(pack_meta, "decode_caps", None) is not None
+            and not getattr(decider, "supports_decode_caps", False)
+        ):
+            # a tenant that configured per-conf caps is being served by a
+            # decider that runs the global caps formula instead (e.g. the
+            # RPC sidecar's wire protocol doesn't carry caps yet) — the
+            # cycle is still correct (overflow falls back dense), but the
+            # tenant's sizing intent is silently void: surface it
+            from ..utils.metrics import metrics
+
+            metrics().counter_add("decode_caps_ignored_total")
+            global _CAPS_WARNED
+            if not _CAPS_WARNED:
+                _CAPS_WARNED = True
+                import sys
+
+                print(
+                    "# kat: PackMeta.decode_caps set but this decider "
+                    f"({type(decider).__name__}) does not support "
+                    "per-tenant caps; the global decode_caps formula "
+                    "applies (overflow serves the dense fallback)",
+                    file=sys.stderr,
+                )
         t0 = time.perf_counter()
         with tracer().span("decide", tasks=int(snap.tensors.num_tasks)):
             if pack_meta is not None:
@@ -380,19 +439,65 @@ class Session:
         else:
             n_running = n_succeeded = n_failed = n_allocated = zeros
             n_ready0 = n_tasks = zeros
+        # Batched ``.tolist()`` gathers (the PR 10 audit-record assembly
+        # idiom): one host conversion per COLUMN, so the per-job loop
+        # below reads plain Python ints instead of minting a numpy
+        # scalar object per (job, column) cell.
+        ready_l = job_ready.tolist()
+        min_l = job_min_avail.tolist()
+        run_l = n_running.tolist()
+        alloc_l = n_allocated.tolist()
+        succ_l = n_succeeded.tolist()
+        fail_l = n_failed.tolist()
+        ready0_l = n_ready0.tolist()
+        ntasks_l = n_tasks.tolist()
+        cache = self.status_cache
+        # A quiet cycle moved nothing the statuses can observe: no binds,
+        # no evicts, AND the node-side state the explain messages read is
+        # byte-identical to the last cycle's (externally-driven changes —
+        # a cordon, a drain, capacity drift via the watch — change node
+        # state WITHOUT binds/evicts, and a gang's Unschedulable message
+        # embeds the per-node reason histogram).  The node digest closes
+        # that hole: one blake2b over the consulted node arrays (~O(N·R)
+        # hash, microseconds at the 50k rung).
+        quiet = cache is not None and not (
+            bool(np.asarray(dec.bind_mask).any())
+            or bool(np.asarray(dec.evict_mask).any())
+        )
+        if quiet:
+            import hashlib
+
+            h = hashlib.blake2b(digest_size=16)
+            t = snap.tensors
+            for arr in (
+                dec.node_idle, dec.node_num_tasks, dec.node_ports,
+                t.node_unsched, t.node_valid, t.node_max_tasks,
+                t.node_klass, t.class_fit,
+            ):
+                h.update(np.asarray(arr).tobytes())
+            node_sig = h.hexdigest()
+            quiet = cache.get("__node_sig__") == node_sig
+            cache["__node_sig__"] = node_sig
         for job in snap.index.jobs:
+            o = job.ordinal
+            sig = (
+                ready_l[o], min_l[o], run_l[o], alloc_l[o], succ_l[o],
+                fail_l[o], ready0_l[o], ntasks_l[o],
+            )
+            if quiet and cache.get(job.uid) == sig:
+                continue  # unchanged: zero objects constructed
             unsched_cond = None
-            min_avail = int(job_min_avail[job.ordinal])
-            if not job_ready[job.ordinal] and min_avail > 0:
+            min_avail = min_l[o]
+            if not ready_l[o] and min_avail > 0:
                 # gang.go:169-190: stamp Unschedulable for unready gangs,
                 # with the FitError-style per-node reason histogram
                 # (job_info.go:329-358) appended
-                missing = min_avail - int(n_ready0[job.ordinal])
-                msg = f"{missing}/{int(n_tasks[job.ordinal])} tasks in gang unschedulable"
+                missing = min_avail - ready0_l[o]
+                msg = f"{missing}/{ntasks_l[o]} tasks in gang unschedulable"
                 if explained < MAX_EXPLAINED_JOBS:
                     if host is None:
                         host = HostView.build(snap, dec)
-                    why = explain_job(snap, dec, job.ordinal, host=host)
+                    why = explain_job(snap, dec, o, host=host)
                     explained += 1
                     if why:
                         msg = f"{msg}: {why}"
@@ -406,12 +511,14 @@ class Session:
                 )
             statuses[job.uid] = self._job_status(
                 unsched_cond,
-                running=int(n_running[job.ordinal]),
-                allocated=int(n_allocated[job.ordinal]),
-                succeeded=int(n_succeeded[job.ordinal]),
-                failed=int(n_failed[job.ordinal]),
+                running=run_l[o],
+                allocated=alloc_l[o],
+                succeeded=succ_l[o],
+                failed=fail_l[o],
                 min_available=min_avail,
             )
+            if cache is not None:
+                cache[job.uid] = sig
         return statuses
 
     def _job_status(

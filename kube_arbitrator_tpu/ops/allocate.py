@@ -382,6 +382,50 @@ def _use_deferred_decode(st: SnapshotTensors, tiers: Tiers) -> bool:
 PRUNE_FLOOR = 256
 
 
+def _class_minreq(st):
+    """f32[K, R]: per predicate class, the elementwise MIN per-task
+    request over the class's resource-requesting valid groups (BIG where
+    the class has none) — the node-independent half of the feasibility
+    pre-pruning, split out so the sharded plane (parallel/shard.py) can
+    compute it once replicated and feed the shard-local cell pass."""
+    K = st.class_fit.shape[0]
+    gmask = st.group_valid & ~st.group_best_effort
+    return jnp.full((K, st.task_resreq.shape[1]), BIG, jnp.float32).at[
+        jnp.where(gmask, st.group_klass, K)
+    ].min(jnp.where(gmask[:, None], st.group_resreq, BIG), mode="drop")
+
+
+def _feasible_cells(
+    class_fit, node_klass, node_valid, node_unsched, preds_on, minreq, basis
+):
+    """bool[K, n]: the per-node half of the feasibility panel, written
+    over EXPLICIT node-axis arrays so it runs unchanged on the full [N]
+    axis (:func:`_prune_feasible`) or on one shard's local block inside a
+    ``shard_map`` body (parallel/shard.shard_feasible_panel) — one
+    definition, so the sharded panel cannot drift from the dense one.
+    ``minreq``/``basis`` are None on the backfill pass (predicates
+    only)."""
+    K = class_fit.shape[0]
+    n = node_klass.shape[0]
+    if preds_on:
+        feas = (
+            class_fit[:, node_klass]
+            & node_valid[None, :]
+            & ~node_unsched[None, :]
+        )
+    else:
+        feas = jnp.broadcast_to(node_valid[None, :], (K, n))
+    if minreq is not None:
+        never = jnp.any(
+            (minreq[:, None, :] > 0)
+            & (minreq[:, None, :] < BIG / 2)
+            & (basis[None, :, :] < minreq[:, None, :] - EPS),
+            axis=-1,
+        )  # bool[K, n]
+        feas = feas & ~never
+    return feas
+
+
 def _prune_feasible(st, state, tiers, best_effort_pass):
     """bool[K, N]: once-per-action node x request-class feasibility.
     A False cell is a node that can NEVER grant a copy to any group of
@@ -398,31 +442,16 @@ def _prune_feasible(st, state, tiers, best_effort_pass):
       the class (req_g >= minreq elementwise), idle or releasing path
       alike.  Backfill places without a resource constraint
       (backfill.go:40-71), so its mask carries predicates only."""
-    K = st.class_fit.shape[0]
-    N = st.num_nodes
     preds_on = plugin_on(tiers, "predicates", "predicate_disabled")
-    if preds_on:
-        feas = (
-            st.class_fit[:, st.node_klass]
-            & st.node_valid[None, :]
-            & ~st.node_unsched[None, :]
-        )
+    if best_effort_pass:
+        minreq = basis = None
     else:
-        feas = jnp.broadcast_to(st.node_valid[None, :], (K, N))
-    if not best_effort_pass:
-        gmask = st.group_valid & ~st.group_best_effort
-        minreq = jnp.full((K, st.task_resreq.shape[1]), BIG, jnp.float32).at[
-            jnp.where(gmask, st.group_klass, K)
-        ].min(jnp.where(gmask[:, None], st.group_resreq, BIG), mode="drop")
+        minreq = _class_minreq(st)
         basis = jnp.maximum(state.node_idle, state.node_releasing)  # f32[N, R]
-        never = jnp.any(
-            (minreq[:, None, :] > 0)
-            & (minreq[:, None, :] < BIG / 2)
-            & (basis[None, :, :] < minreq[:, None, :] - EPS),
-            axis=-1,
-        )  # bool[K, N]
-        feas = feas & ~never
-    return feas
+    return _feasible_cells(
+        st.class_fit, st.node_klass, st.node_valid, st.node_unsched,
+        preds_on, minreq, basis,
+    )
 
 
 def _compact_rows(feas, NC: int):

@@ -316,7 +316,9 @@ def open_session(st: SnapshotTensors, tiers: Tiers) -> Tuple[SessionCtx, AllocSt
 
 @partial(
     jax.jit,
-    static_argnames=("tiers", "actions", "s_max", "max_rounds", "native_ops"),
+    static_argnames=(
+        "tiers", "actions", "s_max", "max_rounds", "native_ops", "decode_caps",
+    ),
 )
 def schedule_cycle(
     st: SnapshotTensors,
@@ -325,6 +327,7 @@ def schedule_cycle(
     s_max: int = 4096,
     max_rounds: int = 100_000,
     native_ops: bool = False,
+    decode_caps: Optional[Tuple[int, int]] = None,
 ) -> CycleDecisions:
     """One full scheduling cycle as a single jitted program.
 
@@ -332,7 +335,12 @@ def schedule_cycle(
     are only legal in programs lowered FOR THE HOST CPU — set it from the
     device-selection seam (framework/decider.py / bench.py) when the
     cycle runs on CPU and ops.native.available() is True, never from a
-    trace-time backend guess."""
+    trace-time backend guess.
+
+    ``decode_caps`` (static) overrides the :func:`decode_caps` formula
+    for the compact decode lists — the per-tenant cap channel: a pool
+    tenant whose PackMeta carries its own (bind_cap, evict_cap) gets a
+    reply pack sized to ITS caps, not the global T formula's."""
     sess, state = open_session(st, tiers)
 
     for action in actions:  # static unroll — the conf's ordered action list
@@ -345,7 +353,11 @@ def schedule_cycle(
             s_max=s_max, max_rounds=max_rounds, native_ops=native_ops,
         )
 
-    return commit_cycle(st, sess, state, native_ops=native_ops)
+    bind_cap, evict_cap = decode_caps if decode_caps is not None else (None, None)
+    return commit_cycle(
+        st, sess, state, native_ops=native_ops,
+        bind_cap=bind_cap, evict_cap=evict_cap,
+    )
 
 
 def commit_cycle(
@@ -455,6 +467,7 @@ def schedule_cycle_staged(
     s_max: int = 4096,
     max_rounds: int = 100_000,
     native_ops: bool = False,
+    decode_caps: Optional[Tuple[int, int]] = None,
 ):
     """The same cycle as :func:`schedule_cycle`, run as one XLA program
     PER STAGE (open → each action → commit) with a device sync between
@@ -528,7 +541,11 @@ def schedule_cycle_staged(
             action=action, tiers=tiers, s_max=s_max, max_rounds=max_rounds,
             native_ops=native_ops, rounds_of=lambda s: s,
         )
-    dec = _timed("commit", _commit_jit, st, sess, state, native_ops=native_ops)
+    bind_cap, evict_cap = decode_caps if decode_caps is not None else (None, None)
+    dec = _timed(
+        "commit", _commit_jit, st, sess, state, native_ops=native_ops,
+        bind_cap=bind_cap, evict_cap=evict_cap,
+    )
     if prof.enabled:
         key = profiling.shape_key(st)
         prof.record_cycle(key, timings)

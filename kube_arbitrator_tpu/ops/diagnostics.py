@@ -258,12 +258,25 @@ def explain_pending_tasks_with_reasons(
                 dominant_reason(reasons) if int(fit) == 0 else "gang not ready"
             )
 
-    out: Dict[str, str] = {}
+    # Per-pod write-back residue, batched (the PR 10 audit-record
+    # assembly idiom): one np.nonzero + one searchsorted + one
+    # ``.tolist()`` per column, and the reason histogram is a bincount
+    # over per-group member counts — no per-pod numpy scalar indexing,
+    # no per-pod dict lookups on numpy objects.
+    rows = np.nonzero(unplaced & (task_group >= 0))[0]
+    gs = task_group[rows]
+    pos = np.searchsorted(group_ids, gs)  # group_ids is sorted-unique
+    tasks = snap.index.tasks
+    gid_l = group_ids.tolist()
+    msg_of = [group_msg[g] for g in gid_l]
+    reason_of = [group_reason[g] for g in gid_l]
+    pos_l = pos.tolist()
+    out = {
+        tasks[i].uid: msg_of[p] for i, p in zip(rows.tolist(), pos_l)
+    }
+    counts = np.bincount(pos, minlength=len(group_ids)).tolist()
     reason_counts: Dict[str, int] = {}
-    for i in np.nonzero(unplaced)[0]:
-        g = int(task_group[i])
-        if g in group_msg:
-            out[snap.index.tasks[i].uid] = group_msg[g]
-            r = group_reason[g]
-            reason_counts[r] = reason_counts.get(r, 0) + 1
+    for r, c in zip(reason_of, counts):
+        if c:
+            reason_counts[r] = reason_counts.get(r, 0) + c
     return out, reason_counts

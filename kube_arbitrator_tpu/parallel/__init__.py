@@ -1,19 +1,46 @@
-"""Mesh/sharding layer: scale the cycle over TPU chips along the node axis."""
-from .mesh import NODE_AXIS, make_mesh, shard_snapshot, snapshot_shardings
+"""Sharded cluster plane: node-partition ownership, shard_map decision
+kernels, and mesh/sharding placement for scaling the cycle along the node
+axis."""
+from .mesh import NODE_AXIS, make_mesh, pad_nodes, shard_snapshot, snapshot_shardings
 from .multihost import (
     global_mesh,
     initialize_multihost,
     process_info,
     shard_snapshot_global,
 )
+from .shard import (
+    MAX_SHARDABLE_NODES,
+    ShardLayout,
+    ShardedDecider,
+    record_shard_metrics,
+    shard_feasible_panel,
+    shard_fit_panel,
+    sharded_argmin_node,
+    sharded_node_capacity,
+    sharded_prefix_fill,
+    sharded_schedule_cycle,
+    sharded_victim_panels,
+)
 
 __all__ = [
     "NODE_AXIS",
     "make_mesh",
+    "pad_nodes",
     "shard_snapshot",
     "snapshot_shardings",
     "initialize_multihost",
     "global_mesh",
     "shard_snapshot_global",
     "process_info",
+    "MAX_SHARDABLE_NODES",
+    "ShardLayout",
+    "ShardedDecider",
+    "record_shard_metrics",
+    "shard_feasible_panel",
+    "shard_fit_panel",
+    "sharded_argmin_node",
+    "sharded_node_capacity",
+    "sharded_prefix_fill",
+    "sharded_schedule_cycle",
+    "sharded_victim_panels",
 ]

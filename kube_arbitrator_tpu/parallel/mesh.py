@@ -89,6 +89,15 @@ def pad_nodes(st: SnapshotTensors, multiple: int) -> SnapshotTensors:
         # node_dom uses -1 = "no domain"; boolean/int masks pad with 0
         fill = -1 if name == "node_dom" else 0
         upd[name] = np.pad(a, widths, constant_values=fill)
+    # rv_block_start is [N+1] (replicated, not sharded) but its LENGTH
+    # tracks the node axis: extend with the last extent repeated, so the
+    # padding nodes own empty canon blocks and the reclaim canon engine
+    # stays legal (its shape guard is rv_block_start.shape[0] == N+1;
+    # without this the re-padded pack silently fell to the sorted-space
+    # kernel)
+    bs = np.asarray(st.rv_block_start)
+    if bs.shape[0] == n + 1:
+        upd["rv_block_start"] = np.pad(bs, (0, pad), mode="edge")
     return dataclasses.replace(st, **upd)
 
 

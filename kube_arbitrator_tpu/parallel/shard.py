@@ -1,0 +1,427 @@
+"""Sharded cluster plane: node-partition ownership + shard_map kernels.
+
+The NamedSharding veneer (parallel/mesh.py) tells XLA's SPMD partitioner
+*where the data lives*; this module makes the partitioning a first-class
+contract:
+
+* :class:`ShardLayout` — explicit node-partition ownership: shard ``s``
+  owns the contiguous global node-ordinal range ``[s*block, (s+1)*block)``
+  of the padded node axis.  The incremental arena keys its per-shard
+  dirty-row diffs and per-shard device uploads on this layout
+  (cache/arena.py ``device_pack_sharded``), and the per-shard
+  byte-identity verifier reports divergence by owning shard.
+* **shard_map decision kernels** — the node-capacity math of allocate/
+  backfill re-expressed as ``shard_map`` programs over ``Mesh(("nodes",))``
+  with the only cross-shard channels as EXPLICIT collectives:
+
+  - :func:`shard_feasible_panel` / :func:`shard_fit_panel` — the
+    feasibility/fit panels of the PR 9 pruning (``_feasible_cells`` /
+    ``_compact_rows`` — literally the same functions, applied to each
+    shard's local block), no collectives: panels are shard-local by
+    construction.
+  - :func:`sharded_node_capacity` — per-node copy capacity, shard-local
+    elementwise (``_node_capacity`` on the local block).
+  - :func:`sharded_prefix_fill` — allocate's prefix-sum admission
+    ``p_n = clip(B - cum_before, 0, k_n)``: shard-local cumsum plus ONE
+    ``all_gather`` of per-shard totals for the exclusive cross-shard
+    offsets (integer adds — bit-identical to the dense ``jnp.cumsum``).
+  - :func:`sharded_argmin_node` — global lexicographic node selection:
+    shard-local ``lex_argmin`` winners, one ``all_gather`` of (key
+    vector, global ordinal), replicated final pick with the GLOBAL node
+    ordinal as the last tiebreak key — the same winner the dense
+    ``lex_argmin``'s first-set-index rule picks (exact while the padded
+    node count stays under 2**24; the f32 ordinal key is integral there).
+  - :func:`sharded_victim_panels` — the evictive actions' shard-local
+    victim eligibility/sum panels (per-node running-victim counts and
+    resource sums): tasks are replicated, each shard folds only the
+    victims whose node it owns.  The cross-queue claim chain itself
+    stays sequential (PR 9's honest negative result); these panels are
+    its node-side inputs.
+
+* :func:`sharded_schedule_cycle` / :class:`ShardedDecider` — the
+  production entry: shard the pack (or consume the arena's per-shard
+  resident upload), run the decision program over the mesh, and emit
+  shard occupancy/skew metrics.  Decisions are pinned BIT-IDENTICAL to
+  the dense program (same global-node-ordinal tiebreaks) by the
+  sharded-vs-dense parity soak (tests/test_shard_parity.py) and by the
+  chaos ``shard`` profile, whose invariants (no_double_bind,
+  single_actuator, audit_consistency) run with sharding on.
+
+Metrics: ``shard_valid_nodes{shard=}``, ``shard_skew`` (max/mean - 1 of
+valid-node occupancy), and the arena side's per-shard upload counters.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..api.types import TaskStatus
+from .mesh import NODE_AXIS, make_mesh, shard_snapshot
+
+# NOTE: ops.common is imported lazily inside the kernels below — its
+# module-level jnp constants execute a JAX computation at import, and
+# this package must stay importable BEFORE jax.distributed.initialize()
+# (parallel/multihost.py workers import us first).
+
+# The f32 ordinal tiebreak key of sharded_argmin_node is exact only while
+# ordinals are integral in float32.
+MAX_SHARDABLE_NODES = 1 << 24
+
+
+# ---------------------------------------------------------------------------
+# partition ownership
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardLayout:
+    """Contiguous node-partition ownership over the PADDED node axis.
+
+    Shard ``s`` owns global ordinals ``[s*block, (s+1)*block)``.
+    Contiguity is what keeps sharded decisions bit-identical for free:
+    concatenating shard-local results in shard order IS global node
+    order, so every "first fitting node" / prefix-fill rule reads the
+    same order the dense program scans."""
+
+    n_shards: int
+    padded_nodes: int
+
+    def __post_init__(self):
+        if self.n_shards <= 0:
+            raise ValueError(f"n_shards must be positive, got {self.n_shards}")
+        if self.padded_nodes % self.n_shards != 0:
+            raise ValueError(
+                f"node axis {self.padded_nodes} not divisible by "
+                f"{self.n_shards} shards — re-pad first (parallel.mesh.pad_nodes)"
+            )
+
+    @property
+    def block(self) -> int:
+        return self.padded_nodes // self.n_shards
+
+    def shard_range(self, s: int) -> Tuple[int, int]:
+        return s * self.block, (s + 1) * self.block
+
+    def shard_of_row(self, row: int) -> int:
+        return int(row) // self.block
+
+    def rows_by_shard(self, rows: np.ndarray) -> Dict[int, np.ndarray]:
+        """Bucket changed global node rows by owning shard — the arena's
+        per-shard dirty-row diff."""
+        rows = np.asarray(rows)
+        if rows.size == 0:
+            return {}
+        shards = rows // self.block
+        return {int(s): rows[shards == s] for s in np.unique(shards)}
+
+    def occupancy(self, node_valid: np.ndarray) -> List[int]:
+        """Valid (real, non-padding) nodes owned per shard."""
+        nv = np.asarray(node_valid)
+        return [
+            int(nv[s * self.block:(s + 1) * self.block].sum())
+            for s in range(self.n_shards)
+        ]
+
+    def skew(self, node_valid: np.ndarray) -> float:
+        """max/mean - 1 over per-shard valid-node counts (0 = perfectly
+        balanced; padding-heavy tail shards show up here)."""
+        occ = self.occupancy(node_valid)
+        mean = sum(occ) / max(len(occ), 1)
+        return (max(occ) / mean - 1.0) if mean > 0 else 0.0
+
+    @classmethod
+    def for_mesh(cls, mesh, padded_nodes: int) -> "ShardLayout":
+        return cls(
+            n_shards=len(mesh.devices.flat), padded_nodes=int(padded_nodes)
+        )
+
+
+def record_shard_metrics(layout: ShardLayout, node_valid) -> None:
+    """Shard occupancy/skew gauges — the obs plane's view of partition
+    balance (a snapshot whose valid nodes pile into few shards loses the
+    parallelism sharding paid for)."""
+    from ..utils.metrics import metrics
+
+    m = metrics()
+    nv = np.asarray(node_valid)
+    for s, c in enumerate(layout.occupancy(nv)):
+        m.gauge_set("shard_valid_nodes", float(c), labels={"shard": str(s)})
+    m.gauge_set("shard_skew", float(layout.skew(nv)))
+
+
+# ---------------------------------------------------------------------------
+# shard_map kernels (each body reuses the dense kernel's own math on the
+# shard's local block; cross-shard channels are explicit collectives)
+
+
+def _smap(mesh, body, in_specs, out_specs):
+    return shard_map(
+        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+
+
+def shard_feasible_panel(
+    mesh, class_fit, node_klass, node_valid, node_unsched,
+    preds_on: bool, minreq=None, basis=None,
+):
+    """bool[K, N] (node axis sharded): the allocate feasibility panel,
+    computed shard-locally by the SAME ``_feasible_cells`` the dense
+    ``_prune_feasible`` runs (ops/allocate.py) — no collectives; class
+    tables and the group-min request matrix are replicated inputs."""
+    from ..ops.allocate import _feasible_cells
+
+    if minreq is None:
+        def body(cf, nk, nv, nu):
+            return _feasible_cells(cf, nk, nv, nu, preds_on, None, None)
+
+        return _smap(
+            mesh, body,
+            in_specs=(P(), P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS)),
+            out_specs=P(None, NODE_AXIS),
+        )(class_fit, node_klass, node_valid, node_unsched)
+
+    def body(cf, nk, nv, nu, mr, bs):
+        return _feasible_cells(cf, nk, nv, nu, preds_on, mr, bs)
+
+    return _smap(
+        mesh, body,
+        in_specs=(
+            P(), P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS), P(),
+            P(NODE_AXIS, None),
+        ),
+        out_specs=P(None, NODE_AXIS),
+    )(class_fit, node_klass, node_valid, node_unsched, minreq, basis)
+
+
+def shard_fit_panel(mesh, feas, nc: int):
+    """i32[K, S*nc] (second axis sharded): PER-SHARD compacted candidate
+    panels — each shard's first ``nc`` feasible nodes per class, in
+    GLOBAL node ordinals (padding slots hold the padded node count).
+    The compaction is PR 9's ``_compact_rows`` applied to the shard's
+    local columns; converting local ids to global is one offset add."""
+    from ..ops.allocate import _compact_rows
+
+    n_total = feas.shape[1]
+
+    def body(f_local):
+        n_local = f_local.shape[1]
+        idx_local = _compact_rows(f_local, nc)
+        start = jax.lax.axis_index(NODE_AXIS) * n_local
+        return jnp.where(idx_local < n_local, idx_local + start, n_total)
+
+    return _smap(
+        mesh, body, in_specs=(P(None, NODE_AXIS),),
+        out_specs=P(None, NODE_AXIS),
+    )(feas)
+
+
+def sharded_node_capacity(mesh, avail, req, ok, pods_head, single_per_node):
+    """i32[N] (sharded): copies of ``req`` placeable per node — the
+    dense ``_node_capacity`` run on each shard's local block (pure
+    elementwise: no collectives)."""
+    from ..ops.allocate import _node_capacity
+
+    def body(av, rq, okk, ph, single):
+        return _node_capacity(av, rq, okk, ph, single)
+
+    return _smap(
+        mesh, body,
+        in_specs=(P(NODE_AXIS, None), P(), P(NODE_AXIS), P(NODE_AXIS), P()),
+        out_specs=P(NODE_AXIS),
+    )(avail, req, ok, pods_head, single_per_node)
+
+
+def sharded_prefix_fill(mesh, k, budget):
+    """Allocate's closed-form multi-placement admission over a sharded
+    copy-capacity vector: ``(p i32[N] sharded, placed_total i32
+    replicated)`` with ``p_n = clip(placed_total - cum_before_n, 0, k_n)``.
+
+    The global inclusive prefix sum is shard-local ``cumsum`` plus ONE
+    ``all_gather`` of per-shard totals (the exclusive cross-shard offset
+    — the "queue-share prefix sum" collective channel); integer adds, so
+    the result is bit-identical to the dense ``jnp.cumsum`` fill."""
+
+    def body(k_local, b):
+        local_cum = jnp.cumsum(k_local)
+        tot = local_cum[-1:]
+        tots = jax.lax.all_gather(tot, NODE_AXIS)[:, 0]      # i32[S]
+        s = jax.lax.axis_index(NODE_AXIS)
+        offset = jnp.sum(jnp.where(jnp.arange(tots.shape[0]) < s, tots, 0))
+        cum = local_cum + offset
+        placed = jnp.minimum(b, jnp.sum(tots))
+        p = jnp.clip(placed - (cum - k_local), 0, k_local)
+        return p, placed
+
+    return _smap(
+        mesh, body, in_specs=(P(NODE_AXIS), P()), out_specs=(P(NODE_AXIS), P()),
+    )(k, budget)
+
+
+def sharded_argmin_node(mesh, keys: Sequence, mask):
+    """Global lexicographic-min node selection over sharded key panels:
+    ``(global node ordinal i32, any_valid bool)``, both replicated.
+
+    Shard-local ``lex_argmin`` picks each shard's winner (the shard's
+    lowest-ordinal lex-min, by ``lex_argmin``'s first-set-index rule);
+    one ``all_gather`` ships every shard's (key vector, global ordinal,
+    validity); the replicated final ``lex_argmin`` appends the GLOBAL
+    node ordinal as the last key, so ties across shards break exactly
+    like the dense argmax-first rule — the tiebreak the bit-identity
+    contract names.  Exact while the padded node count is < 2**24 (the
+    f32 ordinal key is integral there; :data:`MAX_SHARDABLE_NODES`)."""
+    from ..ops.common import BIG, lex_argmin
+
+    if int(mask.shape[-1]) > MAX_SHARDABLE_NODES:
+        raise ValueError(
+            f"{mask.shape[-1]} nodes exceeds MAX_SHARDABLE_NODES "
+            f"({MAX_SHARDABLE_NODES}): the f32 ordinal tiebreak key loses "
+            "exactness"
+        )
+
+    def body(m_local, *keys_local):
+        il, anyl = lex_argmin(list(keys_local), m_local)
+        n_local = m_local.shape[0]
+        gidx = jax.lax.axis_index(NODE_AXIS) * n_local + il
+        kv = [
+            jnp.where(anyl, k[il].astype(jnp.float32), BIG)
+            for k in keys_local
+        ]
+        g_any = jax.lax.all_gather(anyl, NODE_AXIS)               # bool[S]
+        g_idx = jax.lax.all_gather(gidx.astype(jnp.int32), NODE_AXIS)
+        g_kv = [jax.lax.all_gather(v, NODE_AXIS) for v in kv]
+        win, any_valid = lex_argmin(
+            g_kv + [g_idx.astype(jnp.float32)], g_any
+        )
+        return g_idx[win], any_valid
+
+    in_specs = (P(NODE_AXIS),) + tuple(P(NODE_AXIS) for _ in keys)
+    return _smap(mesh, body, in_specs=in_specs, out_specs=(P(), P()))(
+        mask, *keys
+    )
+
+
+def sharded_victim_panels(
+    mesh, node_valid, task_node, task_valid, task_status, task_resreq
+):
+    """The evictive actions' shard-local victim panels: per-node
+    running-victim counts (``i32[N]`` sharded) and resource sums
+    (``f32[N, R]`` sharded).  Task arrays are replicated; each shard
+    folds exactly the victims whose node ordinal falls in its owned
+    range, in global task order — so concatenated panels equal the dense
+    single-scatter ones (the reclaim/preempt claim chains stay
+    sequential and read these as inputs)."""
+
+    def body(nv_local, t_node, t_valid, t_status, t_res):
+        n_local = nv_local.shape[0]
+        start = jax.lax.axis_index(NODE_AXIS) * n_local
+        running = (
+            (t_status == int(TaskStatus.RUNNING)) & t_valid & (t_node >= 0)
+        )
+        loc = t_node - start
+        in_shard = running & (loc >= 0) & (loc < n_local)
+        idx = jnp.where(in_shard, loc, n_local)
+        counts = (
+            jnp.zeros(n_local, jnp.int32)
+            .at[idx].add(in_shard.astype(jnp.int32), mode="drop")
+        )
+        sums = (
+            jnp.zeros((n_local, t_res.shape[1]), jnp.float32)
+            .at[idx].add(jnp.where(in_shard[:, None], t_res, 0.0), mode="drop")
+        )
+        return counts, sums
+
+    return _smap(
+        mesh, body,
+        in_specs=(P(NODE_AXIS), P(), P(), P(), P()),
+        out_specs=(P(NODE_AXIS), P(NODE_AXIS)),
+    )(node_valid, task_node, task_valid, task_status, task_resreq)
+
+
+# ---------------------------------------------------------------------------
+# the production entry points
+
+
+def _pack_is_sharded(st) -> bool:
+    """True when the pack's node arrays already carry a mesh sharding
+    (the arena's per-shard resident upload, or a prior shard_snapshot)."""
+    sh = getattr(st.node_idle, "sharding", None)
+    return getattr(sh, "mesh", None) is not None
+
+
+def sharded_schedule_cycle(
+    st, mesh=None, tiers=None, actions=None, s_max: int = 4096,
+    max_rounds: int = 100_000, decode_caps: Optional[Tuple[int, int]] = None,
+):
+    """Run one full decision cycle over the sharded cluster plane.
+
+    The pack is placed with node-axis sharding (re-padding the node axis
+    to the mesh size when needed — parallel/mesh.py) unless it already
+    arrived sharded (the arena's ``device_pack_sharded``), and the fused
+    cycle program runs over the mesh: XLA partitions the per-node
+    capacity math along the declared layout and inserts the cross-shard
+    collectives the shard_map kernels above spell out.  Decisions are
+    bit-identical to the dense program (tests/test_shard_parity.py)."""
+    from ..ops.cycle import schedule_cycle
+    from ..ops.ordering import DEFAULT_ACTIONS, DEFAULT_TIERS
+
+    mesh = mesh if mesh is not None else make_mesh()
+    stg = st if _pack_is_sharded(st) else shard_snapshot(st, mesh)
+    with mesh:
+        return schedule_cycle(
+            stg,
+            tiers=tiers if tiers is not None else DEFAULT_TIERS,
+            actions=actions if actions is not None else DEFAULT_ACTIONS,
+            s_max=s_max,
+            max_rounds=max_rounds,
+            decode_caps=decode_caps,
+        )
+
+
+class ShardedDecider:
+    """The sharded plane's in-process decider: same seam as
+    :class:`framework.decider.LocalDecider`, but the decision program
+    runs over a node-sharded mesh of ``shards`` devices.
+
+    ``wants_device_pack`` is False — Session's upload phase routes arena
+    cycles through ``arena.device_pack_sharded(self.mesh)`` instead (the
+    per-shard dirty-range upload), and non-arena packs are sharded here.
+    ``native_ops`` stays off: the C++ FFI kernels are single-device host
+    programs and do not partition."""
+
+    wants_device_pack = False
+    supports_decode_caps = True  # PackMeta caps feed the sharded program
+
+    def __init__(self, shards: Optional[int] = None, devices=None):
+        devs = list(devices) if devices is not None else jax.devices()
+        if shards is not None:
+            if shards > len(devs):
+                raise ValueError(
+                    f"{shards} shards requested but only {len(devs)} devices"
+                )
+            devs = devs[:shards]
+        self.mesh = make_mesh(devs)
+        self.last_action_ms: Dict[str, float] = {}
+        self.last_action_rounds: Dict[str, int] = {}
+
+    def decide(self, st, config, pack_meta=None):
+        import time
+
+        t0 = time.perf_counter()
+        stg = st if _pack_is_sharded(st) else shard_snapshot(st, self.mesh)
+        layout = ShardLayout.for_mesh(self.mesh, stg.node_valid.shape[0])
+        record_shard_metrics(layout, stg.node_valid)
+        caps = getattr(pack_meta, "decode_caps", None)
+        dec = sharded_schedule_cycle(
+            stg, mesh=self.mesh, tiers=config.tiers, actions=config.actions,
+            decode_caps=caps,
+        )
+        dec.task_node.block_until_ready()
+        self.last_action_ms = {}
+        self.last_action_rounds = {}
+        return dec, (time.perf_counter() - t0) * 1000

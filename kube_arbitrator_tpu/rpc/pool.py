@@ -99,7 +99,7 @@ class _ReplicaLost(RuntimeError):
         self.replica_index = replica_index
 
 
-def pack_shape_key(st, conf_yaml: str = "", actions=()) -> str:
+def pack_shape_key(st, conf_yaml: str = "", actions=(), decode_caps=None) -> str:
     """The batching-compatibility key: the concrete resolution of the
     KAT-CTR symbolic axes (analysis/contracts.SNAPSHOT_SCHEMA — every
     field's shape is a function of these axes, so equal axes == equal
@@ -121,7 +121,11 @@ def pack_shape_key(st, conf_yaml: str = "", actions=()) -> str:
     conf_fp = hashlib.sha256(conf_yaml.encode()).hexdigest()[:8]
     ax = "/".join(f"{k}{v}" for k, v in sorted(axes.items()))
     ev = int(bool(is_evictive(tuple(actions), t.task_status)))
-    return f"{ax}|{statics}|ev{ev}|conf:{conf_fp}"
+    # per-tenant decode caps (PackMeta.decode_caps) size the compact
+    # decode lists, which are part of the compiled program's output
+    # shapes — tenants with different caps must not stack in one batch
+    caps = "" if decode_caps is None else f"|caps{tuple(decode_caps)}"
+    return f"{ax}|{statics}|ev{ev}|conf:{conf_fp}{caps}"
 
 
 @dataclasses.dataclass
@@ -212,7 +216,9 @@ class PoolReplica:
             self._packs.clear()
             self.restarts += 1
 
-    def decide_batch(self, packs: Tuple, config) -> Tuple[Tuple, float]:
+    def decide_batch(
+        self, packs: Tuple, config, decode_caps=None
+    ) -> Tuple[Tuple, float]:
         """Run every pack of one shape-compatible group in ONE XLA
         launch; returns (decisions tuple, launch wall ms).  Routing is
         resolved once for the group (the compatibility key pins the
@@ -238,6 +244,7 @@ class PoolReplica:
             decs = _batched_cycle(
                 padded, tiers=config.tiers, actions=config.actions,
                 native_ops=native_ops,
+                decode_caps=None if decode_caps is None else tuple(decode_caps),
             )
             decs[-1].task_node.block_until_ready()
         ms = (time.perf_counter() - t0) * 1000
@@ -246,21 +253,27 @@ class PoolReplica:
         return decs[:n], ms
 
 
-def _run_batched(packs, tiers, actions, native_ops):
+def _run_batched(packs, tiers, actions, native_ops, decode_caps=None):
     """One XLA launch containing B independent copies of the cycle
     program — a static unroll over the tuple, NOT a vmap: each element's
     subgraph is the exact graph its own single launch would compile, so
     per-tenant decisions are bit-identical to unbatched serving by
     construction (the pool's parity suite pins this).  jit caches one
-    executable per (shape signature, B, statics)."""
+    executable per (shape signature, B, statics).  ``decode_caps``
+    (static) is the group's per-tenant compact-list caps — uniform
+    across the batch, since the caps are part of the shape key."""
     return tuple(
-        schedule_cycle(p, tiers=tiers, actions=actions, native_ops=native_ops)
+        schedule_cycle(
+            p, tiers=tiers, actions=actions, native_ops=native_ops,
+            decode_caps=decode_caps,
+        )
         for p in packs
     )
 
 
 _batched_cycle = jax.jit(
-    _run_batched, static_argnames=("tiers", "actions", "native_ops")
+    _run_batched,
+    static_argnames=("tiers", "actions", "native_ops", "decode_caps"),
 )
 
 
@@ -566,7 +579,10 @@ class DecisionPool:
             pack_meta=pack_meta,
             corr=corr if corr is not None else tracer().current_corr_id(),
             seq=seq,
-            shape=pack_shape_key(st, conf_yaml, config.actions),
+            shape=pack_shape_key(
+                st, conf_yaml, config.actions,
+                decode_caps=getattr(pack_meta, "decode_caps", None),
+            ),
             t_submit=self.now(),
         )
         if self.admission is not None and self.admission.should_shed(tenant):
@@ -787,7 +803,15 @@ class DecisionPool:
             excluded.add(replica.index)
             self._serve_group(group, excluded)
             return
-        decs, launch_ms = replica.decide_batch(tuple(packs), group[0].config)
+        caps = getattr(group[0].pack_meta, "decode_caps", None)
+        # kwarg only when caps are in play: decide_batch(packs, config)
+        # is a documented override seam (tests/chaos fault hooks replace
+        # it with two-arg callables)
+        decs, launch_ms = (
+            replica.decide_batch(tuple(packs), group[0].config, decode_caps=caps)
+            if caps is not None
+            else replica.decide_batch(tuple(packs), group[0].config)
+        )
         self._metrics().observe("pool_batch_size", float(len(group)))
         for req, dec, resident_key in zip(group, decs, residents):
             req.decisions = dec
@@ -876,6 +900,9 @@ class PoolClient:
     executor's single worker included)."""
 
     wants_device_pack = False
+    # PackMeta.decode_caps are honored pool-side (they join the shape key
+    # and thread into the batched launch)
+    supports_decode_caps = True
 
     def __init__(self, pool: DecisionPool, tenant: str):
         self.pool = pool

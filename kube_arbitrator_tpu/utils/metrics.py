@@ -36,6 +36,7 @@ METRIC_HELP: Dict[str, str] = {
     "binds_total": "Committed bind intents.",
     "evicts_total": "Committed evict intents.",
     "decode_overflow_total": "Cycles whose compact ints-out decode lists overflowed their caps (host fell back to the dense mask decode).",
+    "decode_caps_ignored_total": "Decide calls whose PackMeta carried per-tenant decode caps that the serving decider does not support (global caps formula applied instead).",
     "decode_path_total": "Host actuation decodes by path (path label: compact / dense [overflow or lists absent]).",
     "pending_tasks": "Pending tasks observed at cycle start.",
     "cycles_total": "Scheduling cycles completed.",
@@ -43,7 +44,12 @@ METRIC_HELP: Dict[str, str] = {
     # incremental snapshot plane (cache/arena.py)
     "snapshot_delta_rows": "Rows the last arena pack refreshed (changed vs the previously shipped pack).",
     "snapshot_full_rebuilds_total": "Arena full rebuilds (reason label: seed/verify/structural triggers).",
-    "device_upload_bytes_total": "Bytes shipped to the decision device (mode label: full/delta).",
+    "device_upload_bytes_total": "Bytes shipped to the decision device (mode label: full/delta/shard_delta).",
+    # sharded cluster plane (parallel/shard.py + arena device_pack_sharded)
+    "snapshot_shard_delta_rows": "Node-axis rows the last arena diff touched, per owning shard (shard label).",
+    "shard_uploads_total": "Per-shard row-block uploads by the sharded device resident (shard label; unchanged shards reuse their buffers).",
+    "shard_valid_nodes": "Valid (non-padding) nodes owned by each node partition (shard label).",
+    "shard_skew": "Shard occupancy skew: max/mean - 1 of per-shard valid-node counts (0 = balanced).",
     # decision-plane RPC (client + sidecar)
     "rpc_decide_duration_seconds": "Sidecar Decide handler latency (unpack through reply pack).",
     "rpc_pack_reuse_total": "Decide calls served from the sidecar's epoch-keyed resident pack (delta patch).",

@@ -292,6 +292,75 @@ def test_pool_served_decisions_decode_compact():
         )
 
 
+def test_per_tenant_decode_caps_overflow_fallback():
+    """Per-tenant caps (PackMeta.decode_caps): two pool tenants with the
+    same pack shape but different caps — the capped tenant's reply pack
+    carries ITS list widths and overflows to the dense fallback, the
+    uncapped tenant decodes compact; both intent streams equal the
+    dense oracle.  Also pins that differing caps split the batch (the
+    caps are part of the compiled program's output shapes)."""
+    from kube_arbitrator_tpu.cache.arena import PackMeta
+    from kube_arbitrator_tpu.rpc.pool import DecisionPool, pack_shape_key
+
+    pool = DecisionPool(replicas=1, threaded=False)
+    reqs = []
+    snaps = {}
+    metas = {}
+    for i, caps in enumerate([(2, 1), None]):
+        sim = _world(8, 20 + i)
+        snap = build_snapshot(sim.cluster)
+        tenant = f"caps{i}"
+        snaps[tenant] = snap
+        meta = PackMeta(
+            key=f"k{i}:1", base_key=None, changed_fields=(),
+            decode_caps=caps,
+        )
+        metas[tenant] = meta
+        reqs.append((tenant, snap.tensors, FULL_CONF, meta))
+    # caps split the shape key: the two tenants must NOT stack
+    k0 = pack_shape_key(
+        reqs[0][1], "", FULL_CONF.actions, decode_caps=(2, 1)
+    )
+    k1 = pack_shape_key(reqs[1][1], "", FULL_CONF.actions, decode_caps=None)
+    assert k0 != k1
+    served = {r.tenant: r for r in pool.decide_many(reqs)}
+    capped = served["caps0"]
+    assert capped.error is None
+    assert np.asarray(capped.decisions.bind_idx).shape == (2,)
+    assert int(capped.decisions.bind_count) > 2, "world too small to overflow"
+    # overflow: compact refuses, dense fallback serves the same intents
+    assert decode_decisions_compact(snaps["caps0"], capped.decisions) is None
+    dense_ref = schedule_cycle(
+        snaps["caps0"].tensors, tiers=FULL_CONF.tiers, actions=FULL_CONF.actions
+    )
+    _assert_intents_equal(
+        decode_decisions(snaps["caps0"], capped.decisions),
+        decode_decisions(snaps["caps0"], dense_ref),
+        "capped tenant dense fallback",
+    )
+    uncapped = served["caps1"]
+    assert uncapped.error is None
+    solo = schedule_cycle(
+        snaps["caps1"].tensors, tiers=FULL_CONF.tiers, actions=FULL_CONF.actions
+    )
+    _assert_intents_equal(
+        decode_decisions_compact(snaps["caps1"], uncapped.decisions),
+        decode_decisions(snaps["caps1"], solo),
+        "uncapped tenant compact",
+    )
+
+
+def test_arena_carries_per_tenant_caps_on_pack_meta():
+    """An arena constructed with decode_caps stamps them on every
+    PackMeta it ships — the tenant-side half of the channel."""
+    from kube_arbitrator_tpu.cache.arena import SnapshotArena
+
+    sim = _world(8, 30)
+    arena = SnapshotArena(sim, decode_caps=(64, 32))
+    arena.snapshot()
+    assert arena.pack_meta.decode_caps == (64, 32)
+
+
 def test_pipelined_loop_decodes_compact_with_parity_check(monkeypatch):
     """A pipelined multi-cycle run with the per-cycle oracle cross-check
     armed: every committed cycle decodes through the compact path, the

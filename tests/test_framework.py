@@ -339,3 +339,98 @@ def test_phase_hook_fires_at_every_boundary():
     sched2 = Scheduler(sim2, arena=True, phase_hook=phases2.append)
     sched2.run_once()
     assert phases2 == ["snapshot", "upload", "kernel", "decode", "commit"]
+
+
+def test_quiet_cycle_constructs_zero_per_job_status_objects(monkeypatch):
+    """The delta write-back at q512 (ROADMAP item 4 residue): once the
+    world is saturated-steady, a cycle that actuates nothing must build
+    ZERO per-job status objects — the close census is batched-``.tolist``
+    arrays plus a signature compare, and only CHANGED jobs materialize
+    PodGroupStatus/PodGroupCondition instances."""
+    from kube_arbitrator_tpu.framework import session as sess_mod
+
+    sim = generate_cluster(
+        num_nodes=24, num_jobs=576, tasks_per_job=2, num_queues=512, seed=7,
+        node_cpu_milli=4000, node_memory=8 * GB,
+    )
+    sched = Scheduler(sim)
+    # drain to steady state: cycles until a cycle binds/evicts nothing
+    for _ in range(12):
+        res = sched.run_once()
+        if not res.binds and not res.evicts:
+            break
+    assert not res.binds and not res.evicts, "world never went quiet"
+
+    counts = {"status": 0, "cond": 0}
+    real_status, real_cond = sess_mod.PodGroupStatus, sess_mod.PodGroupCondition
+
+    class CountingStatus(real_status):
+        def __init__(self, *a, **k):
+            counts["status"] += 1
+            super().__init__(*a, **k)
+
+    class CountingCond(real_cond):
+        def __init__(self, *a, **k):
+            counts["cond"] += 1
+            super().__init__(*a, **k)
+
+    monkeypatch.setattr(sess_mod, "PodGroupStatus", CountingStatus)
+    monkeypatch.setattr(sess_mod, "PodGroupCondition", CountingCond)
+    res = sched.run_once()
+    assert not res.binds and not res.evicts
+    assert counts == {"status": 0, "cond": 0}, counts
+    assert res.job_status == {}
+    # the accumulated map still holds every job from the active cycles
+    assert len(sched.job_status) == 576
+
+
+def test_status_delta_rebuilds_changed_jobs_only():
+    """Across an active->quiet transition the cache stays correct: a
+    session WITHOUT a cache (direct construction) and the Scheduler's
+    delta path report identical statuses for every job that changed."""
+    mk = lambda: generate_cluster(
+        num_nodes=16, num_jobs=8, tasks_per_job=4, num_queues=4, seed=3,
+        running_fraction=0.3,
+    )
+    sim_d, sim_f = mk(), mk()
+    sched = Scheduler(sim_d)
+    full = Scheduler(sim_f)
+    full._status_cache = None  # force build-everything every cycle
+    for _ in range(3):
+        sched.run_once()
+        full.run_once()
+    assert set(sched.job_status) == set(full.job_status)
+    for uid, st in full.job_status.items():
+        got = sched.job_status[uid]
+        assert (got.phase, got.running, got.succeeded, got.failed) == (
+            st.phase, st.running, st.succeeded, st.failed
+        ), uid
+
+
+def test_external_node_change_refreshes_statuses_on_quiet_cycle():
+    """The quiet-cycle delta skip must NOT survive externally-driven node
+    state changes (a cordon arrives via the watch with no binds/evicts):
+    the node digest breaks the quiet gate and unready gangs get a fresh
+    Unschedulable message naming the cordon."""
+    sim = SimCluster()
+    sim.add_queue("q", weight=1)
+    sim.add_node("n1", cpu_milli=2000, memory=4 * GB)
+    big = sim.add_job("big", queue="q", min_available=4)
+    for _ in range(4):
+        sim.add_task(big, 1500, GB)  # a gang that can never fit together
+    sched = Scheduler(sim)
+    for _ in range(3):
+        res = sched.run_once()
+        if not res.binds and not res.evicts:
+            break
+    assert not res.binds and not res.evicts
+    res_quiet = sched.run_once()  # settled: delta skip active
+    assert res_quiet.job_status == {}
+    # cordon via the live object (watch-delta shape: no binds, no evicts)
+    node = next(iter(sim.cluster.nodes.values()))
+    node.unschedulable = True
+    res2 = sched.run_once()
+    assert not res2.binds and not res2.evicts
+    assert "big" in res2.job_status, "cordon did not refresh the status"
+    msg = res2.job_status["big"].conditions[0].message
+    assert "unschedulable" in msg

@@ -38,6 +38,87 @@ def test_sharded_inputs_are_actually_distributed(mesh):
     assert shards[0].data.shape[0] == 256 // 8
 
 
+def test_pad_nodes_fill_values_and_block_extents():
+    """The re-pad path's semantics: filler nodes are invalid, node_dom
+    pads with -1 (no domain), and rv_block_start extends with EMPTY
+    blocks (edge-repeat) so the reclaim canon engine stays legal on a
+    re-padded pack instead of silently falling to the sorted-space
+    kernel."""
+    from kube_arbitrator_tpu.parallel import pad_nodes
+
+    sim = generate_cluster(
+        num_nodes=50, num_jobs=8, tasks_per_job=6, num_queues=2, seed=7,
+        running_fraction=0.5,
+    )
+    st = build_snapshot(sim.cluster).tensors
+    n = st.node_idle.shape[0]
+    padded = pad_nodes(st, 3)
+    n2 = padded.node_idle.shape[0]
+    assert n2 % 3 == 0 and n2 > n
+    assert not np.asarray(padded.node_valid)[n:].any()
+    assert (np.asarray(padded.node_idle)[n:] == 0).all()
+    assert np.asarray(padded.rv_block_start).shape == (n2 + 1,)
+    bs = np.asarray(padded.rv_block_start)
+    # padding nodes own empty canon blocks: extents repeat the last value
+    assert (bs[n:] == bs[n]).all()
+    # real prefix untouched
+    np.testing.assert_array_equal(bs[: n + 1], np.asarray(st.rv_block_start))
+    if padded.node_dom.shape[0]:
+        assert (np.asarray(padded.node_dom)[:, n:] == -1).all()
+
+
+def test_shard_snapshot_field_specs_complete():
+    """Every SnapshotTensors field whose DECLARED shape carries the node
+    axis must be named in the mesh partition tables — today a new
+    snapshot field silently lands replicated; this (and the KAT-CTR-012
+    contract pass) makes that a hard failure at review time."""
+    from kube_arbitrator_tpu.analysis.contracts import (
+        SHARD_REPLICATED_OK,
+        SNAPSHOT_SCHEMA,
+        check_shard_layout,
+    )
+    from kube_arbitrator_tpu.parallel.mesh import (
+        _NODE_AXIS1_FIELDS,
+        _NODE_SHARDED_FIELDS,
+    )
+
+    for name, (shape, _dtype) in SNAPSHOT_SCHEMA.items():
+        if name in SHARD_REPLICATED_OK:
+            continue
+        if shape and shape[0] == "N":
+            assert name in _NODE_SHARDED_FIELDS, (
+                f"{name} has leading node axis but no partition spec"
+            )
+        if len(shape) > 1 and shape[1] == "N":
+            assert name in _NODE_AXIS1_FIELDS, (
+                f"{name} has second-axis node axis but no partition spec"
+            )
+    # the live pass agrees (KAT-CTR-012 green on the real tables)
+    assert check_shard_layout() == []
+
+
+def test_shard_layout_contract_reports_seeded_drift():
+    """KAT-CTR-012 teeth: a schema with one NEW node-axis field that the
+    mesh tables don't know must be reported — the checker cannot go
+    green silently."""
+    from kube_arbitrator_tpu.analysis.contracts import (
+        SNAPSHOT_SCHEMA,
+        check_shard_layout,
+    )
+
+    seeded = dict(SNAPSHOT_SCHEMA)
+    seeded["node_new_plane"] = (("N", "R"), "float32")
+    findings = check_shard_layout(seeded)
+    assert len(findings) == 1
+    assert "node_new_plane" in findings[0].message
+    assert findings[0].rule == "KAT-CTR-012"
+    # axis mismatch direction too: declared-but-wrong-axis
+    seeded2 = dict(SNAPSHOT_SCHEMA)
+    seeded2["node_idle"] = (("T", "R"), "float32")
+    f2 = check_shard_layout(seeded2)
+    assert any("node_idle" in f.message for f in f2)
+
+
 @pytest.mark.parametrize("ndev", [3, 5, 6])
 def test_mesh_accepts_any_device_count(ndev):
     """Advisor round-2 finding: make_mesh rejected counts not dividing the

@@ -1,0 +1,382 @@
+"""Sharded cluster plane: sharded-vs-dense decision parity + the
+shard_map building blocks' dense twins + the sharded arena plane.
+
+The contract the whole plane rests on: decisions computed over the
+node-partitioned mesh are BIT-IDENTICAL to the dense program — same
+tiebreak key (global node ordinal), same bind/evict streams, same audit
+aux.  The full acceptance soak (3 seeds × q{8,64,512} × shard counts
+{1,2,8}, full actions) is marked slow and runs in the shard-smoke CI
+lane; a 4-point sample of the same matrix runs in tier-1.
+"""
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from kube_arbitrator_tpu.cache import build_snapshot, generate_cluster
+from kube_arbitrator_tpu.framework.conf import load_conf
+from kube_arbitrator_tpu.ops import schedule_cycle
+from kube_arbitrator_tpu.parallel import (
+    ShardLayout,
+    ShardedDecider,
+    make_mesh,
+    shard_snapshot,
+    sharded_argmin_node,
+    sharded_node_capacity,
+    sharded_prefix_fill,
+    sharded_schedule_cycle,
+    sharded_victim_panels,
+    shard_feasible_panel,
+    shard_fit_panel,
+)
+
+GB = 1024**3
+
+FULL_CONF = load_conf(
+    'actions: "reclaim, allocate, backfill, preempt"\n'
+    "tiers:\n"
+    "- plugins:\n"
+    "  - name: priority\n"
+    "  - name: gang\n"
+    "- plugins:\n"
+    "  - name: drf\n"
+    "  - name: predicates\n"
+    "  - name: proportion\n"
+)
+
+# Every decision-bearing AND audit-aux field: the parity bar is the whole
+# reply pack, not just the bind stream.
+DEC_FIELDS = (
+    "task_node", "task_status", "bind_mask", "evict_mask", "job_ready",
+    "unready_alloc", "evict_claimant", "evict_phase", "evict_round",
+    "bind_idx", "bind_node", "evict_idx", "bind_count", "evict_count",
+)
+
+
+def _world(q, seed):
+    return generate_cluster(
+        num_nodes=48,
+        num_jobs=max(12, q + q // 8),
+        tasks_per_job=4,
+        num_queues=q,
+        seed=seed,
+        node_cpu_milli=4000,
+        node_memory=8 * GB,
+        running_fraction=0.5,
+    )
+
+
+def _assert_identical(dense, sharded, ctx):
+    for f in DEC_FIELDS:
+        a, b = np.asarray(getattr(dense, f)), np.asarray(getattr(sharded, f))
+        assert np.array_equal(a, b), f"{ctx}: {f} diverged"
+
+
+def _run_parity(q, seed, shards):
+    sim = _world(q, seed)
+    snap = build_snapshot(sim.cluster)
+    dense = schedule_cycle(
+        snap.tensors, tiers=FULL_CONF.tiers, actions=FULL_CONF.actions
+    )
+    mesh = make_mesh(jax.devices()[:shards])
+    sh = sharded_schedule_cycle(
+        snap.tensors, mesh=mesh, tiers=FULL_CONF.tiers,
+        actions=FULL_CONF.actions,
+    )
+    _assert_identical(dense, sh, f"q={q} seed={seed} shards={shards}")
+    assert int(dense.bind_count) + int(dense.evict_count) > 0, (
+        "vacuous parity: the cycle decided nothing"
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("shards", [1, 2, 8])
+@pytest.mark.parametrize("q", [8, 64, 512])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_parity_soak_full_matrix(q, seed, shards):
+    """The acceptance soak: 3 seeds × q{8,64,512} × shard counts
+    {1,2,8}, full actions, whole reply pack bit-identical."""
+    _run_parity(q, seed, shards)
+
+
+@pytest.mark.parametrize(
+    "q,seed,shards", [(8, 0, 8), (64, 1, 2), (512, 2, 8), (8, 2, 1)]
+)
+def test_parity_sample(q, seed, shards):
+    """Tier-1 sample of the soak matrix (the full matrix is the slow
+    shard-smoke lane's job)."""
+    _run_parity(q, seed, shards)
+
+
+# ---------------------------------------------------------------------------
+# shard_map building blocks vs their dense twins
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == 8, "conftest forces 8 virtual devices"
+    return make_mesh()
+
+
+@pytest.fixture(scope="module")
+def opened():
+    from kube_arbitrator_tpu.ops.cycle import open_session
+
+    sim = generate_cluster(
+        num_nodes=64, num_jobs=12, tasks_per_job=8, num_queues=3, seed=3,
+        running_fraction=0.4,
+    )
+    st = build_snapshot(sim.cluster).tensors
+    sess, state = jax.jit(lambda s: open_session(s, FULL_CONF.tiers))(st)
+    return st, sess, state
+
+
+def test_feasible_panel_matches_dense(mesh, opened):
+    """shard_feasible_panel == _prune_feasible: both run the SAME
+    _feasible_cells, one on shard-local blocks, one full-width."""
+    import jax.numpy as jnp
+
+    from kube_arbitrator_tpu.ops.allocate import _class_minreq, _prune_feasible
+
+    st, sess, state = opened
+    dense = _prune_feasible(st, state, FULL_CONF.tiers, False)
+    stg = shard_snapshot(st, mesh)
+    sh = shard_feasible_panel(
+        mesh, st.class_fit, stg.node_klass, stg.node_valid, stg.node_unsched,
+        True, _class_minreq(st),
+        jax.device_put(np.maximum(
+            np.asarray(state.node_idle), np.asarray(state.node_releasing)
+        )),
+    )
+    np.testing.assert_array_equal(np.asarray(dense), np.asarray(sh))
+
+
+def test_fit_panel_is_per_shard_compaction(mesh, opened):
+    """shard_fit_panel: shard s's panel block == _compact_rows of shard
+    s's feasibility columns, offset into GLOBAL node ordinals."""
+    import jax.numpy as jnp
+
+    from kube_arbitrator_tpu.ops.allocate import _compact_rows, _prune_feasible
+
+    st, sess, state = opened
+    feas = _prune_feasible(st, state, FULL_CONF.tiers, False)
+    N, S, NC = st.num_nodes, 8, 4
+    blk = N // S
+    pan = np.asarray(shard_fit_panel(mesh, jax.device_put(
+        np.asarray(feas),
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(None, "nodes")),
+    ), NC))
+    feas_np = np.asarray(feas)
+    for s in range(S):
+        ref = np.asarray(
+            _compact_rows(jnp.asarray(feas_np[:, s * blk:(s + 1) * blk]), NC)
+        )
+        ref_g = np.where(ref < blk, ref + s * blk, N)
+        np.testing.assert_array_equal(pan[:, s * NC:(s + 1) * NC], ref_g)
+
+
+def test_node_capacity_matches_dense(mesh, opened):
+    import jax.numpy as jnp
+
+    from kube_arbitrator_tpu.ops.allocate import _node_capacity
+
+    st, sess, state = opened
+    req = st.group_resreq[0]
+    ph = st.node_max_tasks - st.node_num_tasks
+    dense = _node_capacity(
+        state.node_idle, req, st.node_valid, ph, jnp.array(False)
+    )
+    sh = sharded_node_capacity(
+        mesh, jax.device_put(np.asarray(state.node_idle)), req,
+        st.node_valid, ph, jnp.array(False),
+    )
+    np.testing.assert_array_equal(np.asarray(dense), np.asarray(sh))
+
+
+def test_prefix_fill_matches_dense_cumsum(mesh, opened):
+    """The collective-offset prefix fill == the dense jnp.cumsum fill for
+    every budget regime (zero, partial, boundary, unbounded)."""
+    import jax.numpy as jnp
+
+    from kube_arbitrator_tpu.ops.allocate import _node_capacity
+
+    st, sess, state = opened
+    req = st.group_resreq[0]
+    ph = st.node_max_tasks - st.node_num_tasks
+    k = np.asarray(
+        _node_capacity(state.node_idle, req, st.node_valid, ph, jnp.array(False))
+    )
+    for budget in (0, 3, 17, int(k.sum()), 10**6):
+        cum = np.cumsum(k)
+        placed = min(budget, int(cum[-1]))
+        p_ref = np.clip(placed - (cum - k), 0, k)
+        p, pl = sharded_prefix_fill(mesh, jnp.asarray(k), jnp.int32(budget))
+        assert int(pl) == placed
+        np.testing.assert_array_equal(np.asarray(p), p_ref)
+
+
+def test_argmin_matches_dense_lex_argmin(mesh):
+    """The cross-shard argmin (shard winners + global-ordinal tiebreak)
+    picks exactly the dense lex_argmin's first-set-index winner."""
+    import jax.numpy as jnp
+
+    from kube_arbitrator_tpu.ops.common import lex_argmin
+
+    N = 128
+    rng = np.random.default_rng(0)
+    for trial in range(8):
+        keys = [
+            jnp.asarray(rng.integers(0, 4, N).astype(np.float32))
+            for _ in range(3)
+        ]
+        mask = jnp.asarray(rng.random(N) < (0.02 if trial < 4 else 0.4))
+        i_ref, any_ref = lex_argmin(keys, mask)
+        i_sh, any_sh = sharded_argmin_node(mesh, keys, mask)
+        assert bool(any_ref) == bool(any_sh)
+        if bool(any_ref):
+            assert int(i_ref) == int(i_sh)
+
+
+def test_victim_panels_match_dense_scatter(mesh, opened):
+    """Shard-local victim eligibility/sum panels == the dense one-scatter
+    panels (counts exact; float sums fold the same contributors in the
+    same task order)."""
+    from kube_arbitrator_tpu.api.types import TaskStatus
+
+    st, sess, state = opened
+    N = st.num_nodes
+    tn, tv = np.asarray(st.task_node), np.asarray(st.task_valid)
+    ts, tr = np.asarray(st.task_status), np.asarray(st.task_resreq)
+    run = (ts == int(TaskStatus.RUNNING)) & tv & (tn >= 0)
+    counts_ref = np.bincount(tn[run], minlength=N)
+    sums_ref = np.zeros((N, tr.shape[1]), np.float32)
+    for i in np.nonzero(run)[0]:
+        sums_ref[tn[i]] += tr[i]
+    c, s = sharded_victim_panels(
+        mesh, st.node_valid, st.task_node, st.task_valid, st.task_status,
+        st.task_resreq,
+    )
+    np.testing.assert_array_equal(np.asarray(c), counts_ref)
+    np.testing.assert_array_equal(np.asarray(s), sums_ref)
+
+
+# ---------------------------------------------------------------------------
+# the sharded arena plane (per-shard diffs / uploads / verify)
+
+
+def test_sharded_arena_loop_matches_dense_and_uploads_per_shard():
+    """A Scheduler loop on arena + ShardedDecider: (a) placements equal
+    the dense loop's; (b) after a small actuation delta, the sharded
+    resident re-uploads ONLY the shards owning dirty node rows; (c) the
+    byte-identity verifier stays clean."""
+    from kube_arbitrator_tpu.framework import Scheduler
+
+    mk = lambda: generate_cluster(
+        num_nodes=32, num_jobs=10, tasks_per_job=6, num_queues=4, seed=11,
+        running_fraction=0.3,
+    )
+    sim_a, sim_b = mk(), mk()
+    sched = Scheduler(sim_a, decider=ShardedDecider(8), arena=True)
+    sched.run(max_cycles=1, until_idle=False)
+    arena = sched.arena
+    sr = arena._sharded_resident
+    assert sr.last_mode == "full" and sr.last_shard_uploads > 0
+    sched.run(max_cycles=1, until_idle=False)
+    # cycle 2's diff carries cycle 1's binds: node rows changed on SOME
+    # shards only -> shard_delta mode with a strict subset re-uploaded
+    layout = ShardLayout(8, arena._shipped["node_valid"].shape[0])
+    dirty = {s for s, n in arena.shard_dirty_rows(layout).items() if n}
+    assert sr.last_mode == "shard_delta", sr.last_mode
+    # every node-sharded field re-uploads at most the dirty shard set
+    n_node_fields = 9  # len(parallel.mesh._NODE_SHARDED_FIELDS)
+    assert sr.last_shard_uploads <= len(dirty) * n_node_fields
+    assert 0 < len(dirty) < 8, dirty
+    Scheduler(sim_b).run(max_cycles=2, until_idle=False)
+    bound = lambda sim: {
+        t.uid: t.node_name
+        for j in sim.cluster.jobs.values()
+        for t in j.tasks.values()
+    }
+    assert bound(sim_a) == bound(sim_b)
+    arena.verify()
+
+
+def test_sharded_verify_blames_owning_shard():
+    """A lost delta (corruption) in one partition: the verifier fires
+    AND names exactly the owning shard."""
+    from kube_arbitrator_tpu.cache.arena import ArenaDivergence
+    from kube_arbitrator_tpu.framework import Scheduler
+
+    sim = generate_cluster(
+        num_nodes=24, num_jobs=6, tasks_per_job=4, num_queues=2, seed=5
+    )
+    sched = Scheduler(sim, decider=ShardedDecider(8), arena=True)
+    sched.run(max_cycles=1, until_idle=False)
+    arena = sched.arena
+    layout = ShardLayout(8, arena._shipped["node_valid"].shape[0])
+    row = 5 * layout.block + 2
+    arena.corrupt(
+        "node_idle", row, np.array([9e6, 9e6, 9e6, 9e6], np.float32)
+    )
+    with pytest.raises(ArenaDivergence, match=r"\[shards \[5\]\]"):
+        arena.verify()
+
+
+def test_sharded_decider_emits_shard_metrics():
+    from kube_arbitrator_tpu.framework import Scheduler
+    from kube_arbitrator_tpu.utils.metrics import metrics
+
+    sim = generate_cluster(
+        num_nodes=16, num_jobs=4, tasks_per_job=4, num_queues=2, seed=1
+    )
+    Scheduler(sim, decider=ShardedDecider(8), arena=True).run(
+        max_cycles=1, until_idle=False
+    )
+    text = metrics().render()
+    assert 'shard_valid_nodes{shard="0"}' in text
+    assert "shard_skew" in text
+    assert 'shard_uploads_total{shard="7"}' in text
+
+
+def test_pack_meta_decode_caps_flow_through_sharded_decider():
+    """Per-tenant decode caps (PackMeta.decode_caps) reach the sharded
+    program: a tiny cap forces the compact lists to that width and the
+    dense decode fallback on overflow."""
+    from kube_arbitrator_tpu.cache.arena import SnapshotArena
+    from kube_arbitrator_tpu.framework import Scheduler
+
+    sim = generate_cluster(
+        num_nodes=16, num_jobs=6, tasks_per_job=4, num_queues=2, seed=9
+    )
+    arena = SnapshotArena(sim, decode_caps=(2, 1))
+    sched = Scheduler(sim, decider=ShardedDecider(8), arena=arena)
+    sched.run(max_cycles=1, until_idle=False)
+    # the run actuated through the dense fallback; the caps sized the lists
+    assert arena.pack_meta.decode_caps == (2, 1)
+
+
+def test_arena_with_non_dividing_mesh_falls_back_to_host_pack():
+    """A mesh whose size doesn't divide the 128-bucketed node axis: the
+    per-shard resident is unavailable, so upload hands the decider the
+    host pack (it re-pads + shards itself) — the loop still runs and
+    matches the dense loop instead of crashing every cycle."""
+    from kube_arbitrator_tpu.framework import Scheduler
+
+    mk = lambda: generate_cluster(
+        num_nodes=20, num_jobs=6, tasks_per_job=4, num_queues=2, seed=13
+    )
+    sim_s, sim_d = mk(), mk()
+    sched = Scheduler(sim_s, decider=ShardedDecider(3), arena=True)
+    sched.run(max_cycles=2, until_idle=False)
+    assert not sched.arena.mesh_divides(
+        __import__("kube_arbitrator_tpu.parallel", fromlist=["make_mesh"])
+        .make_mesh(jax.devices()[:3])
+    )
+    Scheduler(sim_d).run(max_cycles=2, until_idle=False)
+    bound = lambda sim: {
+        t.uid: t.node_name
+        for j in sim.cluster.jobs.values()
+        for t in j.tasks.values()
+    }
+    assert bound(sim_s) == bound(sim_d)
