@@ -98,6 +98,17 @@ def _history_append(rows) -> None:
             sentinel.rows_from_bench(row, host=host) for row in rows
         ) if r is not None]
         if hist:
+            fp = str(host["fingerprint"])
+            if sentinel.fingerprint_changed(sentinel.load_history(path), fp):
+                # a new host class silently starts a fresh sentinel
+                # baseline (BENCH_r08's trap) — say so, and stamp the
+                # rows so the reset is greppable in the history itself
+                print(
+                    f"# sentinel: new host fingerprint {fp}, baseline reset",
+                    file=sys.stderr,
+                )
+                for r in hist:
+                    r["fingerprint_changed"] = True
             sentinel.append_history(path, hist)
     except Exception as e:  # the artifact matters more than the history
         print(f"# bench history append failed: {e}", file=sys.stderr)

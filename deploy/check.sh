@@ -36,6 +36,7 @@ from kube_arbitrator_tpu.cache.sim import generate_cluster
 from kube_arbitrator_tpu.framework import Scheduler
 from kube_arbitrator_tpu.obs import scheduler_status_fn, serve_obs
 from kube_arbitrator_tpu.utils.audit import AuditLog
+from kube_arbitrator_tpu.utils.fleet import FleetPlane
 from kube_arbitrator_tpu.utils.flightrec import FlightRecorder
 from kube_arbitrator_tpu.utils.profiling import profiler
 from kube_arbitrator_tpu.utils.timeseries import CycleSampler
@@ -49,8 +50,13 @@ sampler = CycleSampler(slo_ms=10_000.0, flight=flight)
 audit = AuditLog(capacity=8, flight=flight)
 sched = Scheduler(sim, flight=flight, timeseries=sampler, audit=audit)
 sched.run(max_cycles=2, until_idle=False)
+# the fleet plane joins the audit record into a one-tenant ledger window
+fleet = FleetPlane(flight=flight)
+fleet.observe_tenant("t0", audit.last())
+fleet.note_outcome("t0", "served")
+fleet.close_window()
 server, _t, url = serve_obs(flight=flight, status_fn=scheduler_status_fn(sched),
-                            timeseries=sampler, audit=audit)
+                            timeseries=sampler, audit=audit, fleet=fleet)
 try:
     text = urllib.request.urlopen(url + "/metrics", timeout=10).read().decode()
     for fam in ("e2e_scheduling_duration_seconds",
@@ -72,9 +78,17 @@ try:
     au = json.load(urllib.request.urlopen(url + "/debug/audit?n=8", timeout=10))
     assert au["schema_version"] == 1 and len(au["records"]) == 2, au
     assert au["records"][0]["fairness"], "audit record missing fairness ledger"
+    # the fleet plane: the pool-wide summary and the per-tenant ledger
+    # table must both serve, reconciled with the audit record just fed
+    fl = json.load(urllib.request.urlopen(url + "/debug/fleet", timeout=10))
+    assert fl["windows_closed"] == 1 and fl["window"]["conservation"]["ok"], fl
+    ft = json.load(urllib.request.urlopen(url + "/debug/fleet/tenants", timeout=10))
+    assert len(ft["tenants"]) == 1 and ft["tenants"][0]["tenant"] == "t0", ft
+    assert ft["tenants"][0]["served"] == 1, ft
+    assert "fleet_windows_total" in text and "fleet_tenant_share" in text
 finally:
     server.shutdown()
-print("obs smoke: /metrics + /healthz + /debug/kernels + /debug/timeseries + /debug/audit ok")
+print("obs smoke: /metrics + /healthz + /debug/kernels + /debug/timeseries + /debug/audit + /debug/fleet ok")
 EOF
   python -m kube_arbitrator_tpu.analysis --rules KAT-LCK,KAT-DTY \
     kube_arbitrator_tpu/utils/tracing.py \
@@ -83,6 +97,7 @@ EOF
     kube_arbitrator_tpu/utils/profiling.py \
     kube_arbitrator_tpu/utils/timeseries.py \
     kube_arbitrator_tpu/utils/audit.py \
+    kube_arbitrator_tpu/utils/fleet.py \
     kube_arbitrator_tpu/obs.py || rc_obs=$?
   if [ "${rc_obs}" -ne 0 ]; then
     echo "obs smoke job: FAILED (exit ${rc_obs})" >&2
@@ -326,7 +341,8 @@ binds = sum(s.binds for sc in scheds for s in sc.history)
 print(f"pool smoke: 2 replicas x 4 frontends, max batch {max(sizes)}, "
       f"{binds} binds, decisions == independent runs")
 EOF
-  env JAX_PLATFORMS=cpu python -m pytest -q tests/test_pool.py || rc_pool=$?
+  env JAX_PLATFORMS=cpu python -m pytest -q tests/test_pool.py tests/test_fleet.py \
+    || rc_pool=$?
   # 8-seed multi-replica chaos matrix: replica kills/partitions/slowdowns
   # mid-decide must leave pool_consistency + every per-tenant invariant
   # intact (exit nonzero on any breach)
@@ -345,15 +361,28 @@ EOF
     echo "pool-log sensitivity canary did not breach (exit ${rc_canary})" >&2
     rc_pool=1
   fi
+  # fleet-ledger sensitivity canary: a dropped tenant row in the fleet
+  # accounting window MUST breach fleet_ledger_consistency — exit code
+  # exactly 1 (the cross-tenant ledger must not be able to silently
+  # drop a tenant from the fairness view)
+  env JAX_PLATFORMS=cpu python -m kube_arbitrator_tpu.chaos \
+    --seed 0 --cycles 6 --profile pool --disable fleet-ledger \
+    --out-dir /tmp >/dev/null
+  rc_canary=$?
+  if [ "${rc_canary}" -ne 1 ]; then
+    echo "fleet-ledger sensitivity canary did not breach (exit ${rc_canary})" >&2
+    rc_pool=1
+  fi
   python -m kube_arbitrator_tpu.analysis --rules KAT-LCK,KAT-DTY \
     kube_arbitrator_tpu/rpc/pool.py \
     kube_arbitrator_tpu/rpc/sidecar.py \
     kube_arbitrator_tpu/rpc/client.py \
+    kube_arbitrator_tpu/utils/fleet.py \
     kube_arbitrator_tpu/chaos/pool_runner.py || rc_pool=$?
   if [ "${rc_pool}" -ne 0 ]; then
     echo "pool smoke job: FAILED (exit ${rc_pool})" >&2
   else
-    echo "pool smoke job: ok (2x4 live run + suite + 8-seed chaos + canary + kat-lint)"
+    echo "pool smoke job: ok (2x4 live run + suite + 8-seed chaos + pool-log + fleet-ledger canaries + kat-lint)"
   fi
 fi
 

@@ -334,10 +334,15 @@ class _ShardedResident:
                 for s in range(layout.n_shards):
                     if s in dirty or cur[s] is None:
                         b = jax.device_put(arr[s * blk:(s + 1) * blk], devs[s])
-                        uploaded += arr[s * blk:(s + 1) * blk].nbytes
+                        nbytes = arr[s * blk:(s + 1) * blk].nbytes
+                        uploaded += nbytes
                         shard_uploads += 1
                         m.counter_add(
                             "shard_uploads_total", labels={"shard": str(s)}
+                        )
+                        m.counter_add(
+                            "shard_upload_bytes_total", float(nbytes),
+                            labels={"shard": str(s)},
                         )
                     else:
                         b = cur[s]

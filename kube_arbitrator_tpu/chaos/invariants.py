@@ -32,6 +32,15 @@ Invariants:
   what actually hit the store is worse than none — it would *explain*
   decisions that never happened (the dropped-edge sensitivity canary
   proves this checker actually compares, ``--disable audit-edges``).
+* ``fleet_ledger_consistency`` — multi-replica runs only: after a
+  settled cycle, the fleet plane's closed accounting window
+  (utils/fleet.py) must carry a ledger row for every tenant the pool
+  touched, and that row's served/shed counts must reconcile 1:1 against
+  BOTH the tenant world's committed cycle (a committed cycle = exactly
+  one serve) and the pool decision log's entries for that (tenant,
+  cycle).  A fleet ledger that drops or miscounts tenants would report
+  fleet fairness over accounting fiction (the ``--disable fleet-ledger``
+  canary's class).
 * ``pool_consistency`` — multi-replica runs only (chaos/pool_runner.py):
   every committed tenant cycle was decided by EXACTLY ONE pool replica,
   against the tenant's correct epoch (the pool decision log's served
@@ -218,6 +227,53 @@ class InvariantChecker:
                     f"{e['resident']!r} (shipped {e['epoch']!r}) "
                     f"on {e['replica']}",
                 )
+        return out
+
+    def check_fleet_ledger(
+        self, window, tenant: str, cycle: int, committed: bool,
+        pool_entries: List[dict],
+    ) -> List[Breach]:
+        """``window`` is the fleet plane's closed window for this pool
+        cycle (utils/fleet.FleetWindow or its dict form); ``committed``
+        marks a settled OK tenant cycle; ``pool_entries`` the decision-
+        log slice for (tenant, cycle).  The ledger's per-tenant
+        served/shed counts must reconcile 1:1 with both."""
+        out: List[Breach] = []
+        win = window.to_dict() if hasattr(window, "to_dict") else dict(window or {})
+        rows = {r["tenant"]: r for r in win.get("tenants", ())}
+        served_log = sum(
+            1 for e in pool_entries if e["outcome"] in ("served", "resent")
+        )
+        shed_log = sum(1 for e in pool_entries if e["outcome"] == "shed")
+        row = rows.get(tenant)
+        if row is None:
+            if committed or served_log or shed_log:
+                self._breach(
+                    out, "fleet_ledger_consistency", cycle,
+                    f"tenant {tenant} has no fleet ledger row "
+                    f"(committed={committed}, {served_log} served / "
+                    f"{shed_log} shed in the pool log)",
+                )
+            return out
+        served_row = int(row.get("served", 0)) + int(row.get("resent", 0))
+        if served_row != served_log:
+            self._breach(
+                out, "fleet_ledger_consistency", cycle,
+                f"tenant {tenant} fleet ledger counts {served_row} served, "
+                f"pool decision log has {served_log}",
+            )
+        if committed and served_row != 1:
+            self._breach(
+                out, "fleet_ledger_consistency", cycle,
+                f"tenant {tenant} committed a cycle but the fleet ledger "
+                f"counts {served_row} serves (expected exactly 1)",
+            )
+        if int(row.get("shed", 0)) != shed_log:
+            self._breach(
+                out, "fleet_ledger_consistency", cycle,
+                f"tenant {tenant} fleet ledger counts {row.get('shed', 0)} "
+                f"shed, pool decision log has {shed_log}",
+            )
         return out
 
     def check_overcommit(self, api, cycle: int) -> List[Breach]:

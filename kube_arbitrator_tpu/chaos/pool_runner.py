@@ -21,6 +21,13 @@ every committed tenant cycle was decided by exactly one replica against
 the tenant's correct epoch.  ``--disable pool-log`` drops served entries
 from the pool's decision log — the sensitivity canary proving the
 checker actually reads it.
+
+The fleet observability plane (utils/fleet.py) rides every run: one
+cross-tenant accounting window per pool cycle, closed after the settle,
+and held to ``fleet_ledger_consistency`` — each tenant's window row's
+served/shed counts reconcile 1:1 against the tenant world's committed
+cycle and the pool decision log.  ``--disable fleet-ledger`` drops the
+first tenant's row from every closed window; that canary MUST breach.
 """
 from __future__ import annotations
 
@@ -107,11 +114,18 @@ def run_pool_chaos(
     if plan is None:
         plan = FaultPlan.generate(seed, cycles, prof)
     from ..rpc.pool import DecisionPool
+    from ..utils.fleet import FleetPlane
 
     clock = VirtualClock()
     injector = FaultInjector(plan, clock)
+    # the fleet observability plane marches on the same virtual clock;
+    # one accounting window per pool cycle, closed after the settle so
+    # the fleet_ledger_consistency reconciliation sees final counts
+    fleet = FleetPlane(now_fn=clock.now)
+    fleet.drop_tenant_rows = "fleet-ledger" in disabled
     pool = DecisionPool(
         replicas=prof.pool_replicas, threaded=False, now_fn=clock.now,
+        fleet=fleet,
     )
     pool.fault_hook = make_pool_hook(injector, clock, pool)
     pool.log_drop_served = "pool-log" in disabled
@@ -190,6 +204,7 @@ def run_pool_chaos(
         injector.disarm()
         cycle_outcomes: List[str] = []
         cycle_events: List[tuple] = []
+        settled: List[tuple] = []
         for t, rv0, prev_audit, fenced, outcome in zip(
             tenants, rv0s, prev_audits, fenceds, tenant_outcomes
         ):
@@ -205,15 +220,30 @@ def run_pool_chaos(
                     )
                 else:
                     audit_rec = rec.to_dict()
+                    # feed the cross-tenant ledger: this tenant's settled
+                    # cycle is its contribution to the closing window
+                    fleet.observe_tenant(t.id, audit_rec)
+            settled.append((t, events, audit_rec, fenced, outcome))
+        # close the fleet accounting window AFTER every tenant settled —
+        # the reconciliation below reads the window's final counts
+        window = fleet.close_window(cycle)
+        for t, events, audit_rec, fenced, outcome in settled:
             breaches += t.checker.after_cycle(
                 t.api, t.cache, cycle, events, fenced=fenced,
                 audit_rec=audit_rec,
             )
             # the pool invariant: exactly one replica decided this
             # committed cycle, against the epoch the frontend shipped
+            pool_entries = pool.log_for(t.id, cycle)
             breaches += t.checker.check_pool_consistency(
-                pool.log_for(t.id, cycle), t.id, cycle,
-                committed=(outcome == "ok"),
+                pool_entries, t.id, cycle, committed=(outcome == "ok"),
+            )
+            # the fleet invariant: the closed window's ledger row for
+            # this tenant reconciles 1:1 with the committed cycle and
+            # the pool decision log
+            breaches += t.checker.check_fleet_ledger(
+                window, t.id, cycle, committed=(outcome == "ok"),
+                pool_entries=pool_entries,
             )
             cycle_outcomes.append(f"{t.id}:{outcome}")
             cycle_events.extend((t.id,) + tuple(e) for e in events)

@@ -58,8 +58,11 @@ REPRO_VERSION = 1
 # bind row from every non-empty decision-audit record — the
 # audit_consistency reconciler MUST breach.  "pool-log" (pool profiles,
 # chaos/pool_runner.py) drops served entries from the pool decision log
-# — the pool_consistency checker MUST breach.
-DISABLE_CHOICES = ("arena-verify", "audit-edges", "pool-log")
+# — the pool_consistency checker MUST breach.  "fleet-ledger" (pool
+# profiles) drops the first tenant's row from every closed fleet
+# accounting window — the fleet_ledger_consistency reconciler MUST
+# breach.
+DISABLE_CHOICES = ("arena-verify", "audit-edges", "pool-log", "fleet-ledger")
 
 
 def seed_world(api, profile: ChaosProfile, seed: int) -> None:

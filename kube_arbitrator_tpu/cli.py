@@ -296,6 +296,12 @@ def main(argv=None) -> int:
         sampler = CycleSampler(
             slo_ms=args.cycle_slo_ms or None, flight=flight
         )
+        # shard-skew burn alerts over the same ring: dormant (no
+        # samples -> no burn) until a sharded run populates the
+        # shard_skew column, so wiring it unconditionally costs nothing
+        from .utils.fleet import SkewBurnMonitor
+
+        sampler.skew_monitor = SkewBurnMonitor(sampler.ring, flight=flight)
         # decision audit: ring (+ optional JSONL) per committed cycle
         audit = AuditLog(
             capacity=args.audit_ring,

@@ -165,6 +165,9 @@ class Scheduler:
         # steady-state cycles skip per-job status object construction
         self._status_cache: Dict[str, tuple] = {}
         self.history: List[CycleStats] = []
+        # per-outcome pool_requests_total totals at the last digest (the
+        # flight digest records per-cycle DELTAS for this tenant)
+        self._pool_outcomes_prev: Dict[str, float] = {}
         self.last_cycle_ts: Optional[float] = None  # /readyz freshness
         self._last_event_msg: Dict[tuple, str] = {}
         self._cycle_seq = 0
@@ -221,6 +224,28 @@ class Scheduler:
 
         return fairness_top_of(rec.fairness)
 
+    def _pool_outcomes_digest(self) -> Dict[str, int]:
+        """Per-cycle ``pool_requests_total`` outcome deltas for THIS
+        scheduler's tenant (PoolClient deciders only; {} otherwise) — a
+        ``slo_burn``/``fleet_imbalance`` flight dump must show whether
+        the failing cycles were being served, re-seeded, or shed."""
+        pool = getattr(self.decider, "pool", None)
+        tenant = getattr(self.decider, "tenant", None)
+        if pool is None or tenant is None:
+            return {}
+        out: Dict[str, int] = {}
+        registry = pool._metrics()
+        for outcome in ("served", "resent", "shed", "error"):
+            total = registry.counter_value(
+                "pool_requests_total",
+                labels={"tenant": tenant, "outcome": outcome},
+            )
+            prev = self._pool_outcomes_prev.get(outcome, 0.0)
+            self._pool_outcomes_prev[outcome] = total
+            if total or prev:
+                out[outcome] = int(total - prev)
+        return out
+
     def _flight_success(
         self, seq: int, corr: Optional[str], cycle_ts: float,
         stats: CycleStats, result: CycleResult,
@@ -259,6 +284,13 @@ class Scheduler:
                     # O(T) ledger pass exactly once.
                     "evict_edges": evict_edge_counts(result.decisions),
                     "fairness_top": self._fairness_digest(),
+                    # fleet state at this cycle: the tenant's pool
+                    # outcome deltas (PoolClient runs; {} in-process)
+                    # and the sharded plane's occupancy skew (None when
+                    # never sharded) — a slo_burn/fleet_imbalance dump
+                    # must show the fleet posture of the failing cycle
+                    "pool_outcomes": self._pool_outcomes_digest(),
+                    "shard_skew": metrics().gauge_value("shard_skew"),
                 },
                 spans=[s.to_dict() for s in tracer().spans(corr)] if corr else [],
             )

@@ -31,6 +31,14 @@ path                       serves
                            inflight/restarts/resident tenants, partitions,
                            queue depth, per-tenant shed records, decision
                            log tail
+``/debug/fleet``           fleet observability plane (utils/fleet.py):
+                           latest cross-tenant accounting window, live
+                           outcome counts, recent batch-launch rows
+``/debug/fleet/tenants``   the cross-tenant fairness ledger table: one
+                           deserved-vs-realized row per tenant (entitled
+                           water-fill, realized share, starvation clock,
+                           shed/served attribution) + the conservation
+                           verdict
 =========================  ==================================================
 
 Multi-process posture: ``port=0`` binds an ephemeral port (the returned
@@ -134,6 +142,7 @@ class _ObsHandler(BaseHTTPRequestHandler):
         timeseries = self.server.obs_timeseries  # type: ignore[attr-defined]
         audit = self.server.obs_audit  # type: ignore[attr-defined]
         pool = self.server.obs_pool  # type: ignore[attr-defined]
+        fleet = self.server.obs_fleet  # type: ignore[attr-defined]
         replica_id = self.server.obs_replica_id  # type: ignore[attr-defined]
         path, _, query = self.path.partition("?")
         path = path.rstrip("/") or "/"
@@ -148,7 +157,8 @@ class _ObsHandler(BaseHTTPRequestHandler):
             route = path
         if route not in ("/", "/metrics", "/healthz", "/readyz",
                          "/debug/cycles", "/debug/trace", "/debug/audit",
-                         "/debug/kernels", "/debug/timeseries", "/debug/pool"):
+                         "/debug/kernels", "/debug/timeseries", "/debug/pool",
+                         "/debug/fleet", "/debug/fleet/tenants"):
             route = "other"
         registry.counter_add("obs_requests_total", labels={"path": route})
 
@@ -180,6 +190,19 @@ class _ObsHandler(BaseHTTPRequestHandler):
                 })
                 return
             self._send_json(200, pool.status())
+            return
+        if path in ("/debug/fleet", "/debug/fleet/tenants"):
+            if fleet is None:
+                self._send_json(200, {
+                    "window": None, "tenants": [],
+                    "error": "no fleet plane wired (pass fleet= to serve_obs)",
+                })
+                return
+            body = (
+                fleet.tenants_table() if path.endswith("/tenants")
+                else fleet.status()
+            )
+            self._send_json(200, body)
             return
         if path == "/debug/cycles":
             entries = flight.entries() if flight is not None else []
@@ -256,7 +279,7 @@ class _ObsHandler(BaseHTTPRequestHandler):
                 "/debug/cycles", "/debug/trace/<corr_id>",
                 "/debug/kernels", "/debug/timeseries?window=<s>",
                 "/debug/audit?n=<count>", "/debug/audit/<corr_id>",
-                "/debug/pool",
+                "/debug/pool", "/debug/fleet", "/debug/fleet/tenants",
             ]})
             return
         self._send_json(404, {"error": f"no route {path}"})
@@ -273,6 +296,7 @@ def serve_obs(
     timeseries=None,
     audit=None,
     pool=None,
+    fleet=None,
     replica_id: str = "",
 ) -> Tuple[ThreadingHTTPServer, threading.Thread, str]:
     """Serve the observability plane; returns (server, thread, base_url).
@@ -285,7 +309,9 @@ def serve_obs(
     (ring + burn monitor, the Scheduler's ``timeseries=``) or a bare
     ring; ``audit`` a :class:`utils.audit.AuditLog` (the Scheduler's
     ``audit=``) for the ``/debug/audit`` routes; ``pool`` a
-    :class:`rpc.pool.DecisionPool` for ``/debug/pool``; ``replica_id``
+    :class:`rpc.pool.DecisionPool` for ``/debug/pool``; ``fleet`` a
+    :class:`utils.fleet.FleetPlane` for ``/debug/fleet`` +
+    ``/debug/fleet/tenants``; ``replica_id``
     stamps /healthz + /readyz in multi-replica deployments."""
     server = ThreadingHTTPServer((host, port), _ObsHandler)
     server.obs_registry = registry if registry is not None else metrics()  # type: ignore[attr-defined]
@@ -296,6 +322,7 @@ def serve_obs(
     server.obs_timeseries = timeseries  # type: ignore[attr-defined]
     server.obs_audit = audit  # type: ignore[attr-defined]
     server.obs_pool = pool  # type: ignore[attr-defined]
+    server.obs_fleet = fleet  # type: ignore[attr-defined]
     server.obs_replica_id = replica_id  # type: ignore[attr-defined]
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()

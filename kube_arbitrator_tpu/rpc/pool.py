@@ -73,6 +73,18 @@ from ..utils.metrics import MetricsRegistry, metrics
 POOL_BURN_WINDOWS: Tuple[Tuple[float, float, float], ...] = ((60.0, 10.0, 2.0),)
 
 
+def _pad_bucket(n: int) -> int:
+    """The ONE padding policy: a batch of ``n`` packs launches at the
+    next power-of-two bucket (repeat-last-pack padding, outputs
+    dropped).  ``decide_batch`` pads with it and ``_record_batch``
+    attributes occupancy/compile-reuse by it — one definition, so the
+    reported bucket can never diverge from the launched one."""
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
 class PoolShed(RuntimeError):
     """Admission dropped the request: the tenant has been burning its
     latency error budget in both burn windows (sustained AND still
@@ -148,6 +160,7 @@ class PoolRequest:
     error: Optional[BaseException] = None
     replica: Optional[str] = None
     batch: int = 0
+    batch_id: Optional[str] = None  # the shared launch's trace/join id
     reseeded: bool = False
     # set by a timed-out decide(): a late completion must not record
     # the wait as a served latency (it would poison the admission ring)
@@ -230,9 +243,7 @@ class PoolReplica:
         from ..platform import decision_route
 
         n = len(packs)
-        b = 1
-        while b < n:
-            b *= 2
+        b = _pad_bucket(n)
         padded = packs + (packs[-1],) * (b - n)
         ctx, _dev, native_ops = decision_route(
             int(packs[0].task_valid.shape[0]),
@@ -370,6 +381,7 @@ class DecisionPool:
         registry: Optional[MetricsRegistry] = None,
         log_capacity: int = 4096,
         fault_hook=None,
+        fleet=None,
     ):
         self.replicas = [PoolReplica(i) for i in range(replicas)]
         self.max_batch = max_batch
@@ -382,6 +394,10 @@ class DecisionPool:
         # chaos seam: called with (replica, group) at the serve entry;
         # may kill/partition/slow the pool and may raise _ReplicaLost
         self.fault_hook = fault_hook
+        # fleet observability plane (utils/fleet.FleetPlane): per-window
+        # outcome attribution + per-launch batch occupancy; None costs
+        # nothing
+        self.fleet = fleet
         self.cycle = 0
         self._lock = threading.Lock()
         self._seq: Dict[str, int] = {}
@@ -397,6 +413,11 @@ class DecisionPool:
         # entries so the pool_consistency checker MUST breach
         self.log_drop_served = False
         self._rr = 0
+        # batch-stitching state: launch ordinal (the batch_id mint) and
+        # the (shape, bucket) keys already launched once (compile-vs-
+        # reuse attribution on the shared batch span)
+        self._batch_seq = 0
+        self._warm_buckets: set = set()
         self._stop = False
         self._queue: List[PoolRequest] = []
         self._cond = threading.Condition(self._lock)
@@ -424,6 +445,11 @@ class DecisionPool:
         self._metrics().counter_add(
             "pool_requests_total", labels={"tenant": tenant, "outcome": outcome}
         )
+        if self.fleet is not None:
+            # the fleet ledger's shed-vs-served attribution rides the
+            # same event as the pool_requests_total increment — exact
+            # per-window counts without registry-delta bookkeeping
+            self.fleet.note_outcome(tenant, outcome)
 
     def _gauge_inflight(self, replica: PoolReplica) -> None:
         self._metrics().gauge_set(
@@ -529,13 +555,15 @@ class DecisionPool:
             raise req.error
         return req.decisions, req.kernel_ms
 
-    def decide_many(self, reqs: List[Tuple[str, object, object, object]]) -> List[PoolRequest]:
+    def decide_many(self, reqs: List[Tuple]) -> List[PoolRequest]:
         """Synchronous multi-request entry (tests / deterministic
         harnesses): builds and serves one flush of requests, returning
-        the resolved PoolRequests (errors stored, not raised)."""
+        the resolved PoolRequests (errors stored, not raised).  Each
+        request is ``(tenant, st, config, meta)`` or, with an explicit
+        trace correlation id, ``(tenant, st, config, meta, corr)``."""
         built = [
-            self._request(tenant, st, config, meta, corr=None)
-            for tenant, st, config, meta in reqs
+            self._request(*(r if len(r) == 5 else (*r, None)))
+            for r in reqs
         ]
         live = [r for r in built if r.error is None]
         if live:
@@ -813,11 +841,13 @@ class DecisionPool:
             else replica.decide_batch(tuple(packs), group[0].config)
         )
         self._metrics().observe("pool_batch_size", float(len(group)))
+        batch_id = self._record_batch(replica, group, launch_ms)
         for req, dec, resident_key in zip(group, decs, residents):
             req.decisions = dec
             req.kernel_ms = launch_ms
             req.replica = replica.id
             req.batch = len(group)
+            req.batch_id = batch_id
             req.reseeded = (
                 seeded.get(req.tenant) == "full"
                 and req.pack_meta is not None
@@ -851,6 +881,56 @@ class DecisionPool:
             self._log(req, outcome=outcome, replica=replica.id, resident=resident_key)
             self._count(req.tenant, outcome)
 
+    def _record_batch(
+        self, replica: PoolReplica, group: List[PoolRequest], launch_ms: float
+    ) -> str:
+        """Batch-trace stitching + fleet accounting for one served
+        launch.  Mints the ``batch_id``, records ONE shared
+        ``pool_batch`` span under it (bucket, size, replica, compile-vs-
+        reuse), links every traced tenant's corr-id to it (so
+        ``/debug/trace/<corr>`` renders the shared launch next to the
+        tenant's own cycle spans), and reports the launch to the fleet
+        plane's per-bucket occupancy/padding accounting."""
+        from ..utils.tracing import tracer
+
+        n = len(group)
+        bucket = _pad_bucket(n)
+        with self._lock:
+            self._batch_seq += 1
+            batch_id = f"batch-{self._batch_seq:06d}"
+            warm_key = (group[0].shape, bucket)
+            compiled = warm_key not in self._warm_buckets
+            self._warm_buckets.add(warm_key)
+        tenants = [r.tenant for r in group]
+        tr = tracer()
+        if tr.enabled:
+            ts = time.time() - launch_ms / 1000.0
+            args = {
+                "batch_id": batch_id,
+                "bucket": bucket,
+                "size": n,
+                "replica": replica.id,
+                "compile": "compile" if compiled else "reuse",
+                "tenants": tenants,
+            }
+            tr.record_span(
+                "pool_batch", ts, launch_ms / 1000.0, corr_id=batch_id,
+                component="pool", depth=0, **args,
+            )
+            for req in group:
+                if req.corr:
+                    tr.record_span(
+                        "pool_batch_link", ts, launch_ms / 1000.0,
+                        corr_id=req.corr, component="pool", depth=0, **args,
+                    )
+                    tr.link(req.corr, batch_id)
+        if self.fleet is not None:
+            self.fleet.observe_batch(
+                batch_id, bucket, n, replica.id, compiled, launch_ms,
+                tenants=tenants,
+            )
+        return batch_id
+
     def _log(
         self, req: PoolRequest, outcome: str, replica: Optional[str],
         resident: Optional[str],
@@ -865,6 +945,7 @@ class DecisionPool:
             "replica": replica,
             "outcome": outcome,
             "batch": req.batch,
+            "batch_id": req.batch_id,
             "epoch": req.pack_meta.key if req.pack_meta is not None else None,
             "resident": resident,
         }

@@ -129,6 +129,20 @@ def load_history(path: str) -> List[Dict[str, object]]:
     return rows
 
 
+def fingerprint_changed(
+    history: List[Dict[str, object]], fingerprint: str
+) -> bool:
+    """True when ``history`` is non-empty but holds NO row of this host
+    class — the next append silently starts a fresh sentinel baseline
+    (exactly what happened in BENCH_r08: a new host class made every
+    cross-round delta host variance, unnoticed).  bench.py warns on this
+    and stamps ``fingerprint_changed: true`` into the rows it appends,
+    so a baseline reset is a greppable fact, not an inference."""
+    return bool(history) and all(
+        r.get("fingerprint") != fingerprint for r in history
+    )
+
+
 def append_history(path: str, rows: List[Dict[str, object]]) -> None:
     with open(path, "a") as f:
         for row in rows:

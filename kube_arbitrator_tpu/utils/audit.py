@@ -216,6 +216,18 @@ def eviction_edges(snap, dec, actuated: Optional[set] = None) -> List[dict]:
     return edges
 
 
+def cluster_fair_total(snap) -> List[float]:
+    """The cluster's aggregate allocatable over the fair resource dims
+    (valid nodes only) — the per-tenant capacity vector the fleet plane
+    (utils/fleet.py) sums into the pool-wide conservation check."""
+    t = snap.tensors
+    F = _fair_dims()
+    node_alloc = np.asarray(t.node_alloc)[:, :F].astype(float)
+    node_valid = np.asarray(t.node_valid)
+    total = node_alloc[node_valid].sum(axis=0) if node_valid.any() else np.zeros(F)
+    return [round(float(x), 3) for x in total]
+
+
 def fairness_ledger(snap, dec) -> List[dict]:
     """Per-queue entitlement accounting rows (valid queues only).  A
     deserved entry past the BIG sentinel (proportion plugin disabled)
@@ -334,6 +346,10 @@ class AuditRecord:
     evictions: List[dict] = dataclasses.field(default_factory=list)
     fairness: List[dict] = dataclasses.field(default_factory=list)
     gangs: dict = dataclasses.field(default_factory=dict)
+    # aggregate allocatable over the fair dims (schema-additive in v1:
+    # the fleet plane's join key for cross-tenant conservation; absent/
+    # empty in pre-fleet records, which fleet joins in share units)
+    cluster_total: List[float] = dataclasses.field(default_factory=list)
     version: int = AUDIT_SCHEMA_VERSION
 
     def to_dict(self) -> dict:
@@ -357,6 +373,7 @@ def build_audit_record(seq: int, corr: Optional[str], ts: float, result) -> Audi
         evictions=eviction_edges(snap, dec, actuated=actuated_evicts),
         fairness=fairness_ledger(snap, dec),
         gangs=gang_verdicts(snap, dec),
+        cluster_total=cluster_fair_total(snap),
     )
 
 

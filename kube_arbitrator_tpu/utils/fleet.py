@@ -1,0 +1,674 @@
+"""Fleet observability plane: cross-tenant accounting over the pool.
+
+PR 11 (decision pool) and PR 14 (sharded plane) turned the single
+scheduler into a fleet — M tenants on N replicas over S shards — but the
+per-process planes (tracing, flight, audit, timeseries) each see only
+one tenant's slice.  This module is the join: the layer where Gavel's
+deserved-vs-realized accounting (arxiv 2008.09213) becomes actionable,
+because only at the fleet level do tenants contend for the same replica
+capacity, and where Tesserae-style placement/skew telemetry (arxiv
+2508.04953) makes scale-out behavior debuggable.
+
+Three surfaces, one :class:`FleetPlane`:
+
+* **Cross-tenant fairness ledger** — per batching window, every tenant's
+  latest PR 10 :class:`~.audit.AuditRecord` ledger rows are joined into
+  one pool-wide deserved-vs-realized view: per tenant, the per-queue
+  deserved/allocated resource vectors are summed, scalarized as dominant
+  shares of the AGGREGATE capacity the pool serves (Σ tenant cluster
+  fair totals), and the raw demands are water-filled against that
+  capacity with the tenant weights tilting the fill level —
+  ``entitled_t = min(demand_t, λ·w_t)`` at the unique level λ where
+  entitlements exhaust min(capacity, total demand); a weight can never
+  entitle a tenant past its own demand.  Each tenant
+  row carries a starvation clock (runs only while the tenant is pending
+  AND under its fleet entitlement — Gavel's queuing-vs-starving
+  distinction, one level up from the per-queue clock) and the window's
+  shed-vs-served attribution from ``pool_requests_total`` outcomes.  The
+  **conservation check** closes the loop: for every fair resource
+  dimension, Σ tenant allocations must stay within the aggregate
+  capacity — per-tenant ledgers can never legitimately sum past what
+  exists, so a violation is ledger corruption (a dropped/mutated record,
+  a double-counted tenant) and fires the flight anomaly kind
+  ``fleet_imbalance``.
+* **Pool-batch accounting** — every batched XLA launch the pool serves
+  reports in (:meth:`FleetPlane.observe_batch`): bucket (padded
+  power-of-two size), real size, replica, compile-vs-reuse.  Per-bucket
+  occupancy and padding waste land in ``pool_batch_occupancy{bucket}`` /
+  ``pool_batch_padding_total{bucket}`` and in the plane's own
+  :class:`~.timeseries.TimeSeriesRing` (one row per launch), so a fleet
+  whose arrival jitter keeps half-filling 8-buckets is visible as a
+  number, not a hunch.  The trace side of the same launch (the shared
+  ``pool_batch`` span + per-tenant links) is recorded by the pool
+  itself (rpc/pool.py) — this module only aggregates.
+* **Shard telemetry rollups** — :func:`shard_rollup_values` folds the
+  sharded plane's gauges (``shard_skew``, ``shard_valid_nodes{shard}``,
+  ``snapshot_shard_delta_rows{shard}``) into per-cycle TimeSeriesRing
+  columns, and :class:`SkewBurnMonitor` runs an SLO-burn-style
+  multi-window alert over the ``shard_skew`` column (the PR 8 burn
+  policy, retargeted: the long window proves the imbalance is
+  sustained, the short window proves it is still happening), firing the
+  flight anomaly kind ``shard_skew``.
+
+Served at ``/debug/fleet`` (pool-wide summary) and
+``/debug/fleet/tenants`` (the ledger table), joined to the trace /
+flight / audit planes by corr-id and batch_id.
+
+Thread discipline (KAT-LCK): one lock guards the window state, outcome
+counts, and rings; only dict/list/float ops run under it.  Record
+joining and water-filling run outside the lock on snapshots.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .metrics import MetricsRegistry, metrics
+from .timeseries import BurnPairMonitor, TimeSeriesRing
+
+#: Bump when a served window/tenant-row field changes meaning or type.
+FLEET_SCHEMA_VERSION = 1
+
+#: Relative slack for the conservation check: ledger vectors travel
+#: through f32 device units and per-row rounding (audit rows round to
+#: 3 decimals), so exact sums must not flag representation noise.
+CONSERVATION_EPS = 1e-3
+
+#: Request outcomes the per-window attribution tracks (the
+#: ``pool_requests_total`` outcome vocabulary; "resent" is a serve that
+#: needed a full pack re-seed first, so it counts toward service).
+OUTCOMES = ("served", "resent", "shed", "error")
+
+#: (long_s, short_s, threshold) burn-window pairs for the shard-skew
+#: alert, scaled like the pool admission windows (~1 s cycle cadence).
+SKEW_BURN_WINDOWS: Tuple[Tuple[float, float, float], ...] = ((120.0, 20.0, 2.0),)
+
+#: A tenant with no record update and no request outcome for this many
+#: consecutive windows is evicted from the plane's state — long-lived
+#: pools with tenant churn must not grow per-window ledger rows (and
+#: the join cost) without bound.
+TENANT_IDLE_EVICT_WINDOWS = 64
+
+
+def water_fill(
+    demands: Sequence[float],
+    weights: Sequence[float],
+    capacity: float,
+) -> List[float]:
+    """Weighted water-filling: entitlements ``e_i = min(d_i, λ·w_i)``
+    at the unique level λ where ``Σ e_i == min(capacity, Σ d_i)`` — the
+    proportion plugin's deserved computation, applied across tenants
+    instead of queues.  A tenant never receives past its demand; spare
+    capacity freed by small-demand tenants raises the level for the
+    rest.  Zero-weight tenants are entitled to nothing."""
+    d = [max(float(x), 0.0) for x in demands]
+    w = [max(float(x), 0.0) for x in weights]
+    target = min(max(capacity, 0.0), sum(d))
+    if target <= 0.0 or not d:
+        return [0.0] * len(d)
+    # iterate: tenants capped at their demand drop out, the rest split
+    # the remainder by weight — converges in <= len(d) passes
+    entitled = [0.0] * len(d)
+    active = [i for i in range(len(d)) if w[i] > 0.0]
+    remaining = target
+    while active and remaining > 1e-12:
+        wsum = sum(w[i] for i in active)
+        if wsum <= 0.0:
+            break
+        level = remaining / wsum
+        capped = [i for i in active if d[i] - entitled[i] <= level * w[i]]
+        if not capped:
+            for i in active:
+                entitled[i] += level * w[i]
+            break
+        for i in capped:
+            remaining -= d[i] - entitled[i]
+            entitled[i] = d[i]
+        active = [i for i in active if i not in set(capped)]
+    return entitled
+
+
+def _tenant_vectors(rec) -> Tuple[List[float], List[float], List[float], int, int, bool]:
+    """(deserved_vec, alloc_vec, total_vec, pending, queues, exact)
+    summed over one tenant's audit-record ledger rows.  ``rec`` is an
+    AuditRecord or its dict form.  Uncapped deserved entries (proportion
+    disabled, BIG sentinel) clamp to the cluster total — entitled to
+    everything it owns, never to phantom capacity.  Records without
+    ``cluster_total`` (pre-fleet producers) are NOT exact: they fall
+    back to share units of their OWN cluster (summed per-queue dominant
+    shares), which are not resource-unit comparable — the join keeps
+    such tenants visible but excludes them from the resource-unit
+    capacity aggregate and the conservation sum (a sum of per-queue
+    dominant shares can legitimately exceed 1 when queues dominate
+    different dimensions, so treating it as a resource total would fire
+    phantom ``fleet_imbalance`` corruption alarms)."""
+    get = rec.get if isinstance(rec, dict) else lambda k, d=None: getattr(rec, k, d)
+    rows = get("fairness", []) or []
+    total = [float(x) for x in (get("cluster_total", None) or [])]
+    if total and any(t > 0 for t in total):
+        F = len(total)
+        des = [0.0] * F
+        alloc = [0.0] * F
+        for r in rows:
+            for f in range(min(F, len(r.get("deserved", ())))):
+                des[f] += min(float(r["deserved"][f]), total[f])
+            for f in range(min(F, len(r.get("allocated", ())))):
+                alloc[f] += float(r["allocated"][f])
+        pending = sum(int(r.get("pending", 0)) for r in rows)
+        return des, alloc, total, pending, len(rows), True
+    des_s = min(sum(float(r.get("share_deserved", 0.0)) for r in rows), 1.0)
+    alloc_s = sum(float(r.get("share_allocated", 0.0)) for r in rows)
+    pending = sum(int(r.get("pending", 0)) for r in rows)
+    return [des_s], [alloc_s], [1.0], pending, len(rows), False
+
+
+def _dominant(vec: Sequence[float], total: Sequence[float]) -> float:
+    """max over dims of vec/total (dims with total<=0 excluded)."""
+    best = 0.0
+    for v, t in zip(vec, total):
+        if t > 0:
+            best = max(best, float(v) / float(t))
+    return best
+
+
+def shard_rollup_values(registry: MetricsRegistry) -> Dict[str, float]:
+    """The sharded plane's gauges as TimeSeriesRing columns: ``shard_skew``
+    plus per-shard ``shard_valid_s<k>`` / ``shard_dirty_s<k>``.  Runs
+    that never sharded contribute nothing (no columns, no cost) — the
+    gauge families simply don't exist."""
+    out: Dict[str, float] = {}
+    skew = registry.gauge_value("shard_skew")
+    if skew is not None:
+        out["shard_skew"] = round(float(skew), 4)
+    for family, col in (
+        ("shard_valid_nodes", "shard_valid_s{}"),
+        ("snapshot_shard_delta_rows", "shard_dirty_s{}"),
+    ):
+        for labels, v in registry.gauge_values(family).items():
+            shard = dict(labels).get("shard", "")
+            if shard != "":
+                out[col.format(shard)] = float(v)
+    return out
+
+
+class SkewBurnMonitor(BurnPairMonitor):
+    """SLO-burn-style alerting over a ring's ``shard_skew`` column (a
+    sample breaches when the skew exceeds ``skew_slo``) — the
+    :class:`~.timeseries.BurnPairMonitor` policy, retargeted: the long
+    window proves the imbalance is sustained, the short window proves it
+    is still happening, once per episode with hysteresis.  Fires the
+    flight anomaly kind ``shard_skew`` and counts
+    ``shard_skew_alerts_total{window}``."""
+
+    column = "shard_skew"
+
+    def __init__(
+        self,
+        ring: TimeSeriesRing,
+        skew_slo: float = 0.5,
+        budget: float = 0.05,
+        windows: Tuple[Tuple[float, float, float], ...] = SKEW_BURN_WINDOWS,
+        registry: Optional[MetricsRegistry] = None,
+        flight=None,
+        min_samples: int = 8,
+    ):
+        if skew_slo < 0:
+            raise ValueError(f"skew_slo must be >= 0, got {skew_slo}")
+        super().__init__(ring, budget, windows, min_samples)
+        self.skew_slo = float(skew_slo)
+        self.registry = registry if registry is not None else metrics()
+        self.flight = flight
+
+    def _breaches(self, v: float) -> bool:
+        return v > self.skew_slo
+
+    def _on_fire(self, key: str, pair: Dict[str, float]) -> None:
+        self.registry.counter_add(
+            "shard_skew_alerts_total", labels={"window": key}
+        )
+        if self.flight is not None:
+            self.flight.anomaly(
+                "shard_skew",
+                detail=(
+                    f"shard skew burn {pair['burn']:.1f}x over "
+                    f"{pair['window_s']:g}s (short {pair['short_burn']:.1f}x "
+                    f"/ {pair['short_s']:g}s, slo {self.skew_slo:g}, "
+                    f"budget {self.budget:g})"
+                ),
+            )
+
+    def status(self, now: Optional[float] = None) -> Dict[str, object]:
+        return {"skew_slo": self.skew_slo, "budget": self.budget,
+                "pairs": self._pair_status(now)}
+
+
+@dataclasses.dataclass
+class FleetWindow:
+    """One closed batching window's pool-wide accounting, JSON-ready."""
+
+    seq: int                      # window ordinal (1-based)
+    cycle: Optional[int]          # pool cycle at close (chaos clock) or None
+    ts: float                     # close time (now_fn)
+    tenants: List[dict] = dataclasses.field(default_factory=list)
+    totals: dict = dataclasses.field(default_factory=dict)
+    batches: dict = dataclasses.field(default_factory=dict)
+    conservation: dict = dataclasses.field(default_factory=dict)
+    version: int = FLEET_SCHEMA_VERSION
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class FleetPlane:
+    """The pool-wide accounting state: tenant records + outcome counts
+    accumulate between :meth:`close_window` calls; closed windows land in
+    a bounded ring served at ``/debug/fleet`` / ``/debug/fleet/tenants``.
+
+    ``drop_tenant_rows`` is the chaos sensitivity seam (``--disable
+    fleet-ledger``): it drops the first tenant's row from every closed
+    window, so the ``fleet_ledger_consistency`` invariant MUST breach —
+    proof the reconciler actually reads the ledger."""
+
+    def __init__(
+        self,
+        registry: Optional[MetricsRegistry] = None,
+        flight=None,
+        weights: Optional[Dict[str, float]] = None,
+        default_weight: float = 1.0,
+        starvation_slo_s: Optional[float] = None,
+        now_fn: Optional[Callable[[], float]] = None,
+        window_capacity: int = 256,
+        batch_ring_capacity: int = 1024,
+    ):
+        self.registry = registry
+        self.flight = flight
+        self.weights = dict(weights or {})
+        self.default_weight = float(default_weight)
+        self.starvation_slo_s = starvation_slo_s
+        self.now = now_fn or time.time
+        self.drop_tenant_rows = False
+        self._lock = threading.Lock()
+        # tenant -> latest audit record dict observed this window
+        self._records: Dict[str, dict] = {}
+        # tenant -> {outcome: count} accumulated this window
+        self._outcomes: Dict[str, Dict[str, int]] = {}
+        # churn bookkeeping: tenants with a fresh record since the last
+        # close, and per-tenant consecutive idle-window counts (eviction)
+        self._fresh: set = set()
+        self._idle: Dict[str, int] = {}
+        # per-window batch aggregates: bucket -> [launches, padded slots,
+        # occupancy sum]; plus the plane-lifetime launch counter
+        self._batch_agg: Dict[int, List[float]] = {}
+        self._windows: List[FleetWindow] = []
+        self._window_capacity = window_capacity
+        self._window_seq = 0
+        # starvation state: tenant -> last progress ts / firing flag
+        self._last_progress: Dict[str, float] = {}
+        self._starving: set = set()
+        self.batch_ring = TimeSeriesRing(
+            capacity=batch_ring_capacity, now_fn=self.now
+        )
+
+    # ---- metrics ----
+
+    def _metrics(self) -> MetricsRegistry:
+        return self.registry if self.registry is not None else metrics()
+
+    # ---- feeding (pool + tenants) ----
+
+    def weight_of(self, tenant: str) -> float:
+        return float(self.weights.get(tenant, self.default_weight))
+
+    def observe_tenant(self, tenant: str, record) -> None:
+        """Latest committed-cycle audit record for ``tenant`` this
+        window (an :class:`~.audit.AuditRecord` or its dict form); a
+        tenant observed twice in one window keeps the newest.  Only the
+        ledger slice is kept — a full ``to_dict()`` would deep-copy the
+        record's bind rows (thousands on a mass-bind cycle) for nothing."""
+        get = (
+            record.get if isinstance(record, dict)
+            else lambda k, d=None: getattr(record, k, d)
+        )
+        rec = {
+            "seq": get("seq"),
+            "corr_id": get("corr_id"),
+            # row dicts are never mutated after record assembly, so a
+            # shallow list copy is enough
+            "fairness": list(get("fairness", ()) or ()),
+            "cluster_total": list(get("cluster_total", ()) or ()),
+        }
+        with self._lock:
+            self._records[tenant] = rec
+            self._fresh.add(tenant)
+            self._outcomes.setdefault(tenant, {})
+
+    def note_outcome(self, tenant: str, outcome: str) -> None:
+        """One request outcome (the pool calls this next to its
+        ``pool_requests_total`` increment — same event, exact per-window
+        attribution without registry-delta bookkeeping)."""
+        with self._lock:
+            per = self._outcomes.setdefault(tenant, {})
+            per[outcome] = per.get(outcome, 0) + 1
+
+    def observe_batch(
+        self,
+        batch_id: str,
+        bucket: int,
+        size: int,
+        replica: str,
+        compiled: bool,
+        launch_ms: float,
+        tenants: Sequence[str] = (),
+    ) -> None:
+        """One batched XLA launch: per-bucket occupancy/padding metrics,
+        one batch-ring row, window aggregates."""
+        bucket = max(int(bucket), 1)
+        size = max(int(size), 0)
+        occupancy = size / bucket
+        padding = bucket - size
+        m = self._metrics()
+        m.gauge_set(
+            "pool_batch_occupancy", round(occupancy, 4),
+            labels={"bucket": str(bucket)},
+        )
+        if padding:
+            m.counter_add(
+                "pool_batch_padding_total", padding,
+                labels={"bucket": str(bucket)},
+            )
+        m.counter_add(
+            "pool_batch_launches_total",
+            labels={"bucket": str(bucket),
+                    "compile": "compile" if compiled else "reuse"},
+        )
+        self.batch_ring.sample({
+            "bucket": float(bucket),
+            "size": float(size),
+            "occupancy": round(occupancy, 4),
+            "padding": float(padding),
+            "launch_ms": round(float(launch_ms), 3),
+            "compiled": 1.0 if compiled else 0.0,
+        })
+        with self._lock:
+            agg = self._batch_agg.setdefault(bucket, [0, 0, 0.0])
+            agg[0] += 1
+            agg[1] += padding
+            agg[2] += occupancy
+
+    # ---- window close: the join ----
+
+    def _ledger_rows(
+        self, records: Dict[str, dict], outcomes: Dict[str, Dict[str, int]],
+        now: float,
+    ) -> Tuple[List[dict], dict, dict]:
+        """Join the window's tenant records into the pool-wide ledger;
+        returns (rows, totals, conservation verdict)."""
+        tenants = sorted(set(records) | set(outcomes))
+        vecs = {t: _tenant_vectors(records[t]) for t in tenants if t in records}
+        # aggregate capacity: Σ EXACT tenants' cluster fair totals —
+        # share-unit fallback tenants have no resource-unit vectors and
+        # must not pollute the aggregate (a 1.0-share "total" added to a
+        # millicore dimension would make the fallback tenant invisible
+        # and skew everyone else's shares)
+        exact = {t: v for t, v in vecs.items() if v[5]}
+        F = max((len(v[2]) for v in exact.values()), default=0)
+        cap = [0.0] * F
+        for des, alloc, total, _p, _q, _e in exact.values():
+            for f in range(len(total)):
+                cap[f] += total[f]
+        # per-tenant demand/realized: exact tenants as dominant shares
+        # of the aggregate; fallback tenants in shares of their OWN
+        # cluster (each in [0, ~1] — visible and monotone, though two
+        # unit systems meet in the water-fill when producers are mixed).
+        # Demands are RAW (unweighted): the weight enters exactly once,
+        # as the water-fill level multiplier — pre-multiplying here too
+        # would entitle a weighted tenant past its own demand and run
+        # its starvation clock while it is served everything it asked.
+        demands: List[float] = []
+        realized: List[float] = []
+        weights: List[float] = []
+        for t in tenants:
+            if t in exact:
+                des, alloc, _total, _p, _q, _e = vecs[t]
+                demands.append(_dominant(des, cap))
+                realized.append(_dominant(alloc, cap))
+            elif t in vecs:
+                des, alloc, total, _p, _q, _e = vecs[t]
+                demands.append(_dominant(des, total))
+                realized.append(_dominant(alloc, total))
+            else:
+                demands.append(0.0)
+                realized.append(0.0)
+            weights.append(self.weight_of(t))
+        entitled = water_fill(demands, weights, capacity=1.0)
+        rows: List[dict] = []
+        for i, t in enumerate(tenants):
+            per = outcomes.get(t, {})
+            pending = vecs[t][3] if t in vecs else 0
+            delta = realized[i] - entitled[i]
+            row = {
+                "tenant": t,
+                "weight": round(weights[i], 3),
+                "demand": round(demands[i], 6),
+                "entitled": round(entitled[i], 6),
+                "realized": round(realized[i], 6),
+                # > 0: over its fleet entitlement; < 0: under (starving side)
+                "delta": round(delta, 6),
+                "pending": pending,
+                "queues": vecs[t][4] if t in vecs else 0,
+                "seq": (records[t].get("seq") if t in vecs else None),
+                "corr": (records[t].get("corr_id") if t in vecs else None),
+                "starvation_s": 0.0,
+                **{o: int(per.get(o, 0)) for o in OUTCOMES},
+            }
+            rows.append(row)
+        # conservation: per fair dimension, Σ tenant allocations must
+        # not exceed the aggregate capacity — per-tenant ledgers cannot
+        # legitimately sum past what exists, so a violation is ledger
+        # corruption, not contention.  Exact tenants only: share-unit
+        # rows are not resource units and would alarm spuriously.
+        alloc_sum = [0.0] * F
+        for des, alloc, _total, _p, _q, _e in exact.values():
+            for f in range(min(F, len(alloc))):
+                alloc_sum[f] += alloc[f]
+        violations = [
+            {"dim": f, "allocated": round(alloc_sum[f], 3),
+             "capacity": round(cap[f], 3)}
+            for f in range(F)
+            if alloc_sum[f] > cap[f] * (1.0 + CONSERVATION_EPS) + CONSERVATION_EPS
+        ]
+        totals = {
+            "tenants": len(tenants),
+            "capacity": [round(c, 3) for c in cap],
+            "allocated": [round(a, 3) for a in alloc_sum],
+            "demand": round(sum(demands), 6),
+            "entitled": round(sum(entitled), 6),
+            "realized": round(sum(realized), 6),
+            "pending": sum(r["pending"] for r in rows),
+            **{o: sum(r[o] for r in rows) for o in OUTCOMES},
+        }
+        conservation = {"ok": not violations, "violations": violations}
+        return rows, totals, conservation
+
+    def _starvation(self, rows: List[dict], now: float) -> List[str]:
+        """Advance the per-tenant starvation clocks over the closed
+        window's rows (mutates ``starvation_s`` in place); returns the
+        anomaly details for newly-starving tenants."""
+        anomalies: List[str] = []
+        with self._lock:
+            for row in rows:
+                t = row["tenant"]
+                # a tenant shed (or erroring) on every request this
+                # window never commits a cycle, so it has no record, no
+                # pending count, and delta 0 — but it is the MOST
+                # under-served tenant there is; denial of service keeps
+                # the clock running too
+                denied = (
+                    row["shed"] + row["error"] > 0
+                    and row["served"] + row["resent"] == 0
+                )
+                # at or over its fleet entitlement = not starving, clock
+                # resets (Gavel's queuing-vs-starving distinction: a
+                # backlogged tenant being served its full share is
+                # queuing, not starving)
+                if not denied and (row["pending"] <= 0 or row["delta"] >= 0):
+                    self._last_progress[t] = now
+                    self._starving.discard(t)
+                    continue
+                since = self._last_progress.setdefault(t, now)
+                starv = max(now - since, 0.0)
+                if denied or row["delta"] < 0:
+                    row["starvation_s"] = round(starv, 3)
+                    if (
+                        self.starvation_slo_s is not None
+                        and starv > self.starvation_slo_s
+                        and t not in self._starving
+                    ):
+                        self._starving.add(t)
+                        why = (
+                            f"{row['shed']} shed / {row['error']} errors, "
+                            "0 served this window"
+                            if denied else
+                            f"realized {row['realized']:.3f} < entitled "
+                            f"{row['entitled']:.3f}, "
+                            f"{row['pending']} pending"
+                        )
+                        anomalies.append(
+                            f"tenant {t} starving: {starv:.1f}s under its "
+                            f"fleet entitlement ({why})"
+                        )
+        return anomalies
+
+    def close_window(self, cycle: Optional[int] = None) -> FleetWindow:
+        """Close the current batching window: join the tenant records,
+        water-fill entitlements, run the conservation check, emit
+        metrics, and append the window to the ring.  The accumulators
+        reset; observed tenant records carry over (a tenant idle this
+        window keeps its last ledger view, with zero outcome counts)."""
+        now = self.now()
+        with self._lock:
+            records = dict(self._records)
+            outcomes = {t: dict(c) for t, c in self._outcomes.items()}
+            batch_agg = {b: list(a) for b, a in self._batch_agg.items()}
+            fresh = set(self._fresh)
+            self._fresh.clear()
+            # idle-tenant eviction: no fresh record AND no outcome for
+            # TENANT_IDLE_EVICT_WINDOWS consecutive windows drops the
+            # tenant from the plane's state (this window still carries
+            # its final row — assembled from the snapshots above)
+            for t in set(self._records) | set(self._outcomes):
+                if t in fresh or any(outcomes.get(t, {}).values()):
+                    self._idle[t] = 0
+                elif self._idle.get(t, 0) + 1 >= TENANT_IDLE_EVICT_WINDOWS:
+                    self._records.pop(t, None)
+                    self._outcomes.pop(t, None)
+                    self._last_progress.pop(t, None)
+                    self._starving.discard(t)
+                    self._idle.pop(t, None)
+                else:
+                    self._idle[t] = self._idle.get(t, 0) + 1
+            self._outcomes = {t: {} for t in self._outcomes}
+            self._batch_agg = {}
+            self._window_seq += 1
+            seq = self._window_seq
+        rows, totals, conservation = self._ledger_rows(records, outcomes, now)
+        starve_anomalies = self._starvation(rows, now)
+        if self.drop_tenant_rows and rows:
+            # sensitivity seam: the fleet_ledger_consistency reconciler
+            # MUST notice the missing tenant
+            del rows[0]
+        batches = {
+            "launches": int(sum(a[0] for a in batch_agg.values())),
+            "padded_slots": int(sum(a[1] for a in batch_agg.values())),
+            "by_bucket": {
+                str(b): {
+                    "launches": int(a[0]),
+                    "padded_slots": int(a[1]),
+                    "mean_occupancy": round(a[2] / a[0], 4) if a[0] else 0.0,
+                }
+                for b, a in sorted(batch_agg.items())
+            },
+        }
+        window = FleetWindow(
+            seq=seq, cycle=cycle, ts=now, tenants=rows, totals=totals,
+            batches=batches, conservation=conservation,
+        )
+        with self._lock:
+            self._windows.append(window)
+            del self._windows[: -self._window_capacity]
+        m = self._metrics()
+        m.counter_add("fleet_windows_total")
+        for row in rows:
+            m.gauge_set(
+                "fleet_tenant_share", row["entitled"],
+                labels={"tenant": row["tenant"], "kind": "entitled"},
+            )
+            m.gauge_set(
+                "fleet_tenant_share", row["realized"],
+                labels={"tenant": row["tenant"], "kind": "realized"},
+            )
+            m.gauge_set(
+                "fleet_starvation_seconds", row["starvation_s"],
+                labels={"tenant": row["tenant"]},
+            )
+        if not conservation["ok"]:
+            m.counter_add("fleet_conservation_breaches_total")
+            if self.flight is not None:
+                v = conservation["violations"][0]
+                self.flight.anomaly(
+                    "fleet_imbalance",
+                    detail=(
+                        f"fleet ledger conservation violated: dim {v['dim']} "
+                        f"allocated {v['allocated']:g} > aggregate capacity "
+                        f"{v['capacity']:g} across {totals['tenants']} tenants "
+                        f"(window {seq})"
+                    ),
+                )
+        if self.flight is not None:
+            for detail in starve_anomalies:
+                self.flight.anomaly("fleet_starvation", detail=detail)
+        return window
+
+    # ---- reading (obs server) ----
+
+    def last_window(self) -> Optional[FleetWindow]:
+        with self._lock:
+            return self._windows[-1] if self._windows else None
+
+    def windows(self, n: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            snapshot = list(self._windows)
+        if n is not None:
+            snapshot = snapshot[-n:] if n > 0 else []
+        return [w.to_dict() for w in snapshot]
+
+    def status(self) -> dict:
+        """The ``/debug/fleet`` document: schema version, the latest
+        closed window's summary, live (unclosed) outcome counts, and the
+        recent batch-ring rows."""
+        with self._lock:
+            live = {t: dict(c) for t, c in self._outcomes.items()}
+            windows = len(self._windows)
+            last = self._windows[-1] if self._windows else None
+        return {
+            "schema_version": FLEET_SCHEMA_VERSION,
+            "windows_closed": windows,
+            "window": last.to_dict() if last is not None else None,
+            "live_outcomes": live,
+            "batch_tail": self.batch_ring.rows()[-32:],
+        }
+
+    def tenants_table(self) -> dict:
+        """The ``/debug/fleet/tenants`` document: the latest window's
+        per-tenant ledger rows (the deserved-vs-realized table)."""
+        last = self.last_window()
+        return {
+            "schema_version": FLEET_SCHEMA_VERSION,
+            "window_seq": last.seq if last is not None else None,
+            "cycle": last.cycle if last is not None else None,
+            "tenants": list(last.tenants) if last is not None else [],
+            "totals": dict(last.totals) if last is not None else {},
+            "conservation": dict(last.conservation) if last is not None else {},
+        }

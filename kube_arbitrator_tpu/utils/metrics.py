@@ -50,6 +50,8 @@ METRIC_HELP: Dict[str, str] = {
     "shard_uploads_total": "Per-shard row-block uploads by the sharded device resident (shard label; unchanged shards reuse their buffers).",
     "shard_valid_nodes": "Valid (non-padding) nodes owned by each node partition (shard label).",
     "shard_skew": "Shard occupancy skew: max/mean - 1 of per-shard valid-node counts (0 = balanced).",
+    "shard_upload_bytes_total": "Bytes uploaded per shard by the sharded device resident (shard label; the per-shard split of device_upload_bytes_total{mode=shard_delta}).",
+    "shard_skew_alerts_total": "Multi-window shard-skew burn alerts fired (window label; one per episode — utils/fleet.SkewBurnMonitor).",
     # decision-plane RPC (client + sidecar)
     "rpc_decide_duration_seconds": "Sidecar Decide handler latency (unpack through reply pack).",
     "rpc_pack_reuse_total": "Decide calls served from the sidecar's epoch-keyed resident pack (delta patch).",
@@ -74,6 +76,14 @@ METRIC_HELP: Dict[str, str] = {
     "pool_batch_size": "Same-shape snapshot packs stacked into one XLA launch by the pool batcher.",
     "pool_replica_inflight": "Requests currently in flight on a pool replica (replica label; the least-loaded routing input).",
     "pool_pack_reseeds_total": "Per-replica full pack re-seeds after a lost delta base (replica restart/join/healed partition — the generalized FAILED_PRECONDITION path).",
+    # fleet observability plane (utils/fleet.py)
+    "fleet_windows_total": "Fleet accounting windows closed (one cross-tenant ledger join each).",
+    "fleet_tenant_share": "Per-tenant fleet share (tenant + kind label: entitled = weighted water-fill of demand vs aggregate capacity, realized = dominant share of aggregate capacity allocated).",
+    "fleet_starvation_seconds": "Seconds a pending, under-entitled tenant has run below its fleet entitlement (tenant label; 0 when at or over entitlement).",
+    "fleet_conservation_breaches_total": "Fleet ledger windows whose per-tenant allocations summed past the aggregate capacity (ledger corruption; fires the fleet_imbalance flight anomaly).",
+    "pool_batch_occupancy": "Fill fraction of the last batched XLA launch per padded bucket size (bucket label; size / bucket).",
+    "pool_batch_padding_total": "Padded (wasted) launch slots per bucket size (bucket label; the cost of power-of-two bucketing under arrival jitter).",
+    "pool_batch_launches_total": "Batched XLA launches by bucket and compile-vs-reuse (bucket + compile label).",
     # chaos plane (kube_arbitrator_tpu/chaos)
     "chaos_faults_injected_total": "Faults injected by the chaos plane (kind label).",
     "chaos_invariant_breaches_total": "Cluster-level invariant breaches the chaos plane detected (invariant label).",

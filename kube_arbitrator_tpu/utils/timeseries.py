@@ -84,8 +84,112 @@ class TimeSeriesRing:
         return [(r["ts"], r[key]) for r in self.rows(window_s) if key in r]
 
 
-class SloBurnMonitor:
-    """Multi-window burn-rate alerts over a ring's ``cycle_ms`` series."""
+class BurnPairMonitor:
+    """The multi-window burn machinery, policy-free: per pair, the burn
+    of a window is ``(fraction of samples breaching) / budget``; a pair
+    fires when BOTH its long and short windows burn at or past the
+    threshold (sustained AND still happening), once per episode
+    (hysteresis: re-armed when the short window recovers below burn
+    1.0), gated on ``min_samples`` in the long window so one bad warmup
+    sample of a 1-sample window cannot page.  Subclasses fix the ring
+    column (``column``), the per-sample breach predicate
+    (:meth:`_breaches`), and the firing side effects (:meth:`_on_fire`,
+    :meth:`_observe_burn`) — the cycle-SLO monitor below and the fleet
+    plane's shard-skew monitor (utils/fleet.SkewBurnMonitor) share ONE
+    copy of the policy."""
+
+    column = "cycle_ms"
+
+    def __init__(
+        self,
+        ring: TimeSeriesRing,
+        budget: float,
+        windows: Tuple[Tuple[float, float, float], ...],
+        min_samples: int,
+    ):
+        if not 0 < budget < 1:
+            raise ValueError(f"budget must be in (0, 1), got {budget}")
+        self.ring = ring
+        self.budget = float(budget)
+        self.windows = tuple(windows)
+        self.min_samples = min_samples
+        # per-pair firing state (hysteresis): long-window key -> active
+        self._active: Dict[str, bool] = {}
+
+    def _breaches(self, v: float) -> bool:
+        raise NotImplementedError
+
+    def _observe_burn(self, key: str, burn: Optional[float]) -> None:
+        """Per-check hook with the long-window burn (None: no samples)."""
+
+    def _on_fire(self, key: str, pair: Dict[str, float]) -> None:
+        """A pair newly fired (once per episode)."""
+
+    def _window_vals(self, window_s: float,
+                     now: Optional[float] = None) -> List[float]:
+        return [
+            r[self.column] for r in self.ring.rows(window_s, now)
+            if r.get(self.column) is not None
+        ]
+
+    def _burn_of(self, vals: List[float]) -> Optional[float]:
+        """Budget-burn multiple of a window's samples (None: no samples):
+        ``(breach fraction) / budget`` — the ONE formula every caller
+        shares."""
+        if not vals:
+            return None
+        return sum(1 for v in vals if self._breaches(v)) / len(vals) / self.budget
+
+    def burn_rate(self, window_s: float,
+                  now: Optional[float] = None) -> Optional[float]:
+        return self._burn_of(self._window_vals(window_s, now))
+
+    def _pair_status(self, now: Optional[float] = None) -> List[Dict[str, object]]:
+        return [
+            {
+                "long_s": long_s,
+                "short_s": short_s,
+                "threshold": threshold,
+                "long_burn": self.burn_rate(long_s, now),
+                "short_burn": self.burn_rate(short_s, now),
+                "firing": self._active.get(f"{long_s:g}s", False),
+            }
+            for long_s, short_s, threshold in self.windows
+        ]
+
+    def check(self, now: Optional[float] = None) -> List[Dict[str, float]]:
+        """Evaluate every window pair; returns the pairs that NEWLY
+        fired (an already-firing pair stays silent until its short
+        window recovers below burn 1.0)."""
+        fired = []
+        for long_s, short_s, threshold in self.windows:
+            key = f"{long_s:g}s"
+            long_vals = self._window_vals(long_s, now)
+            long_burn = self._burn_of(long_vals)
+            short_burn = self.burn_rate(short_s, now)
+            self._observe_burn(key, long_burn)
+            if long_burn is None or short_burn is None:
+                continue
+            if len(long_vals) < self.min_samples:
+                continue
+            if long_burn >= threshold and short_burn >= threshold:
+                if not self._active.get(key):
+                    self._active[key] = True
+                    pair = {
+                        "window_s": long_s, "short_s": short_s,
+                        "burn": long_burn, "short_burn": short_burn,
+                        "threshold": threshold,
+                    }
+                    self._on_fire(key, pair)
+                    fired.append(pair)
+            elif short_burn < 1.0:
+                self._active[key] = False
+        return fired
+
+
+class SloBurnMonitor(BurnPairMonitor):
+    """Multi-window burn-rate alerts over a ring's ``cycle_ms`` series
+    (a sample breaches when it exceeds the cycle-latency SLO)."""
 
     def __init__(
         self,
@@ -98,34 +202,25 @@ class SloBurnMonitor:
     ):
         if slo_ms <= 0:
             raise ValueError(f"slo_ms must be positive, got {slo_ms}")
-        if not 0 < budget < 1:
-            raise ValueError(f"budget must be in (0, 1), got {budget}")
-        self.ring = ring
+        super().__init__(ring, budget, windows, min_samples)
         self.slo_ms = float(slo_ms)
-        self.budget = float(budget)
-        self.windows = tuple(windows)
         self.registry = registry if registry is not None else metrics()
-        # a pair may only fire once its long window holds this many
-        # samples: one slow warmup cycle is 100% breach of a 1-sample
-        # window — a page at process start, not a signal
-        self.min_samples = min_samples
-        # per-pair firing state (hysteresis): long-window key -> active
-        self._active: Dict[str, bool] = {}
 
-    def _window_vals(self, window_s: float,
-                     now: Optional[float] = None) -> List[float]:
-        return [
-            r["cycle_ms"] for r in self.ring.rows(window_s, now)
-            if r.get("cycle_ms") is not None
-        ]
+    def _breaches(self, v: float) -> bool:
+        return v > self.slo_ms
 
-    def _burn_of(self, vals: List[float]) -> Optional[float]:
-        """Budget-burn multiple of a window's samples (None: no samples):
-        ``(breach fraction) / budget`` — the ONE formula every caller
-        shares."""
-        if not vals:
-            return None
-        return sum(1 for v in vals if v > self.slo_ms) / len(vals) / self.budget
+    def _observe_burn(self, key: str, burn: Optional[float]) -> None:
+        # long-window burn rates land in the gauge every check, firing
+        # or not — the dashboard's leading indicator
+        if burn is not None:
+            self.registry.gauge_set(
+                "slo_burn_rate", burn, labels={"window": key}
+            )
+
+    def _on_fire(self, key: str, pair: Dict[str, float]) -> None:
+        self.registry.counter_add(
+            "slo_burn_alerts_total", labels={"window": key}
+        )
 
     def breach_fraction(self, window_s: float,
                         now: Optional[float] = None) -> Optional[float]:
@@ -135,59 +230,11 @@ class SloBurnMonitor:
             return None
         return sum(1 for v in vals if v > self.slo_ms) / len(vals)
 
-    def burn_rate(self, window_s: float,
-                  now: Optional[float] = None) -> Optional[float]:
-        return self._burn_of(self._window_vals(window_s, now))
-
     def status(self, now: Optional[float] = None) -> Dict[str, object]:
         """The /debug/timeseries burn block: per-pair long/short burn
         rates, thresholds, and firing state."""
-        pairs = []
-        for long_s, short_s, threshold in self.windows:
-            pairs.append({
-                "long_s": long_s,
-                "short_s": short_s,
-                "threshold": threshold,
-                "long_burn": self.burn_rate(long_s, now),
-                "short_burn": self.burn_rate(short_s, now),
-                "firing": self._active.get(f"{long_s:g}s", False),
-            })
-        return {"slo_ms": self.slo_ms, "budget": self.budget, "pairs": pairs}
-
-    def check(self, now: Optional[float] = None) -> List[Dict[str, float]]:
-        """Evaluate every window pair; returns the pairs that NEWLY fired
-        (one anomaly per episode — an already-firing pair stays silent
-        until its short window recovers below burn 1.0).  Long-window
-        burn rates land in the ``slo_burn_rate{window=...}`` gauge every
-        call, firing or not."""
-        fired = []
-        for long_s, short_s, threshold in self.windows:
-            key = f"{long_s:g}s"
-            long_vals = self._window_vals(long_s, now)
-            long_burn = self._burn_of(long_vals)
-            short_burn = self.burn_rate(short_s, now)
-            if long_burn is not None:
-                self.registry.gauge_set(
-                    "slo_burn_rate", long_burn, labels={"window": key}
-                )
-            if long_burn is None or short_burn is None:
-                continue
-            if len(long_vals) < self.min_samples:
-                continue
-            if long_burn >= threshold and short_burn >= threshold:
-                if not self._active.get(key):
-                    self._active[key] = True
-                    self.registry.counter_add(
-                        "slo_burn_alerts_total", labels={"window": key}
-                    )
-                    fired.append({
-                        "window_s": long_s, "short_s": short_s,
-                        "burn": long_burn, "short_burn": short_burn,
-                        "threshold": threshold,
-                    })
-            elif short_burn < 1.0:
-                self._active[key] = False
-        return fired
+        return {"slo_ms": self.slo_ms, "budget": self.budget,
+                "pairs": self._pair_status(now)}
 
 
 class CycleSampler:
@@ -219,6 +266,10 @@ class CycleSampler:
         # and fell back to the dense [T]-mask decode — the tail this
         # plane exists to watch growing back
         "decode_overflows": "decode_overflow_total",
+        # sharded-plane rollups: per-shard row-block uploads and bytes
+        # (summed over shards; the per-shard split stays in the gauges)
+        "shard_uploads": "shard_uploads_total",
+        "shard_upload_bytes": "shard_upload_bytes_total",
     }
     OCCUPANCY_GAUGE = "pipeline_stage_occupancy"
 
@@ -231,6 +282,7 @@ class CycleSampler:
         windows: Tuple[Tuple[float, float, float], ...] = DEFAULT_BURN_WINDOWS,
         flight=None,
         now_fn: Optional[Callable[[], float]] = None,
+        skew_monitor=None,
     ):
         # `is not None`, not truthiness: an EMPTY ring is len()==0 falsy
         # and `ring or default` would silently replace the injected one
@@ -241,6 +293,9 @@ class CycleSampler:
             SloBurnMonitor(self.ring, slo_ms, budget, windows, self.registry)
             if slo_ms else None
         )
+        # utils/fleet.SkewBurnMonitor over this ring's shard_skew column
+        # (it raises its own flight anomaly); None costs nothing
+        self.skew_monitor = skew_monitor
         self._prev_counters: Dict[str, float] = {}
 
     def set_now_fn(self, now_fn: Callable[[], float]) -> None:
@@ -292,7 +347,14 @@ class CycleSampler:
             stage = dict(labels).get("stage", "")
             if stage:
                 values[f"occ_{stage}"] = round(v, 4)
+        # sharded-plane rollups (utils/fleet.py): shard_skew + per-shard
+        # valid-node/dirty-row columns; non-sharded runs contribute none
+        from .fleet import shard_rollup_values
+
+        values.update(shard_rollup_values(self.registry))
         self.ring.sample(values, ts=ts)
+        if self.skew_monitor is not None:
+            self.skew_monitor.check(ts)
         if self.burn is None:
             return []
         fired = self.burn.check(ts)

@@ -80,6 +80,9 @@ class Tracer:
         self._lock = threading.Lock()
         # corr id -> completed spans, insertion-ordered for eviction
         self._traces: Dict[str, List[Span]] = {}
+        # corr id -> linked corr ids (e.g. a tenant cycle -> the shared
+        # pool-batch launch trace); bounded with the trace store
+        self._links: Dict[str, List[str]] = {}
         self._tls = threading.local()
 
     # ---- enablement / identity ----
@@ -90,6 +93,7 @@ class Tracer:
     def reset(self) -> None:
         with self._lock:
             self._traces.clear()
+            self._links.clear()
 
     @staticmethod
     def new_corr_id(seq: Optional[int] = None) -> str:
@@ -191,8 +195,28 @@ class Tracer:
                 bucket = self._traces[span.corr_id] = []
                 while len(self._traces) > self.max_traces:
                     # evict oldest corr id (insertion order)
-                    self._traces.pop(next(iter(self._traces)))
+                    evicted = next(iter(self._traces))
+                    self._traces.pop(evicted)
+                    self._links.pop(evicted, None)
             bucket.append(span)
+
+    # ---- trace links (cross-trace joins, e.g. pool batch stitching) ----
+
+    def link(self, corr_id: str, other: str) -> None:
+        """Join ``corr_id`` to ``other``: exports of ``corr_id`` include
+        the linked trace's spans (the pool links every batched tenant
+        cycle to the shared ``pool_batch`` launch trace this way).  A
+        no-op when disabled; bounded by the trace store's own cap."""
+        if not self.enabled or corr_id is None or other is None:
+            return
+        with self._lock:
+            linked = self._links.setdefault(corr_id, [])
+            if other not in linked:
+                linked.append(other)
+
+    def links(self, corr_id: str) -> List[str]:
+        with self._lock:
+            return list(self._links.get(corr_id, ()))
 
     # ---- retrieval / export ----
 
@@ -204,11 +228,17 @@ class Tracer:
         with self._lock:
             return list(self._traces.get(corr_id, ()))
 
-    def export_chrome(self, corr_id: str) -> Dict[str, object]:
+    def export_chrome(self, corr_id: str, follow_links: bool = True) -> Dict[str, object]:
         """One trace as Chrome-trace JSON (the Perfetto legacy format):
         complete ('X') events with microsecond timestamps, one virtual
-        thread per component, correlation id in every event's args."""
+        thread per component, correlation id in every event's args.
+        ``follow_links`` (default) also renders the spans of linked
+        traces (:meth:`link`) — a batched tenant cycle's export shows
+        the shared ``pool_batch`` launch on its own component thread."""
         spans = self.spans(corr_id)
+        if follow_links:
+            for other in self.links(corr_id):
+                spans = spans + self.spans(other)
         tids: Dict[str, int] = {}
         events: List[Dict[str, object]] = []
         for s in spans:

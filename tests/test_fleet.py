@@ -1,0 +1,639 @@
+"""Fleet observability plane (utils/fleet.py): cross-tenant ledger math,
+conservation, starvation clocks, batch occupancy accounting, pool-batch
+trace stitching, shard rollups, and the 2x4 threaded e2e reconciliation
+against the per-tenant audit ledgers.
+
+The load-bearing property is RECONCILIATION: the fleet view is a join of
+planes that already exist (PR 10 audit records, the pool decision log,
+pool_requests_total outcomes) — every fleet number must be derivable
+from, and checked against, its sources.  A fleet ledger that can drift
+from them silently would report fairness over fiction, which is why the
+chaos canary (``--disable fleet-ledger``) must breach.
+"""
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from kube_arbitrator_tpu.cache import build_snapshot, generate_cluster
+from kube_arbitrator_tpu.framework import Scheduler
+from kube_arbitrator_tpu.framework.conf import SchedulerConfig
+from kube_arbitrator_tpu.obs import serve_obs
+from kube_arbitrator_tpu.rpc.pool import DecisionPool, PoolClient
+from kube_arbitrator_tpu.utils.audit import AuditLog
+from kube_arbitrator_tpu.utils.fleet import (
+    FleetPlane,
+    SkewBurnMonitor,
+    shard_rollup_values,
+    water_fill,
+)
+from kube_arbitrator_tpu.utils.flightrec import FlightRecorder
+from kube_arbitrator_tpu.utils.metrics import MetricsRegistry
+from kube_arbitrator_tpu.utils.timeseries import CycleSampler, TimeSeriesRing
+from tests.test_obs import check_promtext
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class _Flight:
+    """Anomaly-recording flight stub (no global metrics side effects)."""
+
+    def __init__(self):
+        self.anomalies = []
+
+    def anomaly(self, kind, detail=""):
+        self.anomalies.append((kind, detail))
+
+
+def _record(seq=1, corr="c000001-ab", fairness=(), cluster_total=(10.0, 10.0, 10.0)):
+    return {
+        "seq": seq, "corr_id": corr, "ts": 0.0,
+        "fairness": list(fairness), "cluster_total": list(cluster_total),
+        "binds": [], "evictions": [], "gangs": {},
+    }
+
+
+def _qrow(deserved, allocated, pending=0, share_des=0.0, share_alloc=0.0):
+    return {
+        "queue": "q", "deserved": list(deserved), "allocated": list(allocated),
+        "share_deserved": share_des, "share_allocated": share_alloc,
+        "pending": pending,
+    }
+
+
+# ---- water-fill ----
+
+
+def test_water_fill_clamps_to_demand_and_capacity():
+    # spare capacity: everyone gets their demand
+    assert water_fill([0.2, 0.3], [1, 1], 1.0) == [0.2, 0.3]
+    # contention splits evenly, small demands capped then spare re-filled
+    assert water_fill([0.5, 0.9, 0.1], [1, 1, 1], 1.0) == [0.45, 0.45, 0.1]
+    # weights tilt the level
+    assert water_fill([0.9, 0.9], [2, 1], 0.9) == pytest.approx([0.6, 0.3])
+    # zero weight = entitled to nothing; zero capacity = nothing at all
+    assert water_fill([0.5, 0.5], [0, 1], 1.0) == [0.0, 0.5]
+    assert water_fill([0.5], [1], 0.0) == [0.0]
+    assert water_fill([], [], 1.0) == []
+
+
+def test_water_fill_conserves_capacity():
+    for demands in ([0.9, 0.8, 0.7, 0.2], [0.1, 0.1], [1.0, 1.0, 1.0]):
+        ent = water_fill(demands, [1.0] * len(demands), 1.0)
+        assert sum(ent) <= 1.0 + 1e-9
+        assert all(e <= d + 1e-9 for e, d in zip(ent, demands))
+
+
+# ---- the window join ----
+
+
+def test_window_joins_tenant_records_exactly():
+    """Fleet totals are the sums of the tenant records, and per-tenant
+    realized shares are dominant shares of the aggregate capacity."""
+    clock = _Clock()
+    fleet = FleetPlane(registry=MetricsRegistry(namespace="t"), now_fn=clock)
+    fleet.observe_tenant("t0", _record(fairness=[
+        _qrow([4, 2, 0], [3, 1, 0], pending=2),
+        _qrow([2, 2, 0], [2, 2, 0], pending=0),
+    ]))
+    fleet.observe_tenant("t1", _record(fairness=[
+        _qrow([8, 0, 0], [6, 0, 0], pending=5),
+    ]))
+    for _ in range(3):
+        fleet.note_outcome("t0", "served")
+    fleet.note_outcome("t1", "served")
+    fleet.note_outcome("t1", "shed")
+    w = fleet.close_window(cycle=7)
+    assert w.cycle == 7 and len(w.tenants) == 2
+    # capacity = sum of tenant cluster totals, allocated = sum of rows
+    assert w.totals["capacity"] == [20.0, 20.0, 20.0]
+    assert w.totals["allocated"] == [11.0, 3.0, 0.0]
+    assert w.conservation["ok"]
+    by = {r["tenant"]: r for r in w.tenants}
+    # realized = dominant share of the aggregate: t0 = 5/20, t1 = 6/20
+    assert by["t0"]["realized"] == pytest.approx(0.25)
+    assert by["t1"]["realized"] == pytest.approx(0.30)
+    # demand = dominant share of summed deserved (weight 1)
+    assert by["t0"]["demand"] == pytest.approx(6 / 20)
+    assert by["t1"]["demand"] == pytest.approx(8 / 20)
+    # no contention (sum <= 1): entitled == demand
+    assert by["t0"]["entitled"] == pytest.approx(6 / 20)
+    # outcome attribution
+    assert by["t0"]["served"] == 3 and by["t0"]["shed"] == 0
+    assert by["t1"]["served"] == 1 and by["t1"]["shed"] == 1
+    assert w.totals["served"] == 4 and w.totals["shed"] == 1
+    assert by["t0"]["pending"] == 2 and by["t1"]["pending"] == 5
+    # outcome counts reset per window; records carry over
+    w2 = fleet.close_window()
+    by2 = {r["tenant"]: r for r in w2.tenants}
+    assert by2["t0"]["served"] == 0 and by2["t0"]["realized"] == by["t0"]["realized"]
+
+
+def test_uncapped_deserved_clamps_to_tenant_capacity():
+    fleet = FleetPlane(registry=MetricsRegistry(namespace="t"))
+    fleet.observe_tenant("t0", _record(fairness=[
+        _qrow([1e30, 1e30, 1e30], [5, 0, 0]),
+    ], cluster_total=(10, 10, 10)))
+    w = fleet.close_window()
+    row = w.tenants[0]
+    # entitled to everything it owns (10/10 of the 10/10 aggregate),
+    # never to phantom capacity
+    assert row["demand"] == pytest.approx(1.0)
+
+
+def test_share_unit_fallback_for_records_without_cluster_total():
+    fleet = FleetPlane(registry=MetricsRegistry(namespace="t"))
+    fleet.observe_tenant("t0", {
+        "seq": 1, "corr_id": "", "fairness": [
+            {"queue": "a", "share_deserved": 0.6, "share_allocated": 0.4,
+             "pending": 1},
+        ],
+    })
+    w = fleet.close_window()
+    assert w.tenants[0]["demand"] == pytest.approx(0.6)
+    assert w.tenants[0]["realized"] == pytest.approx(0.4)
+
+
+def test_mixed_producers_fallback_stays_visible_no_phantom_imbalance():
+    """A pre-fleet (share-unit) tenant next to exact producers: its
+    row stays in own-cluster shares (not drowned by the resource-unit
+    aggregate), and its summed dominant shares — which can legitimately
+    exceed 1 across differently-dominant queues — must NOT trip the
+    conservation check."""
+    clock = _Clock()
+    flight = _Flight()
+    fleet = FleetPlane(registry=MetricsRegistry(namespace="t"), flight=flight,
+                       starvation_slo_s=10.0, now_fn=clock)
+    fleet.observe_tenant("exact", _record(fairness=[
+        _qrow([24000, 0, 0], [24000, 0, 0]),
+    ], cluster_total=(48000, 0, 0)))
+    # two queues dominant on different dims: shares sum to 1.2 while
+    # actual usage fits the cluster
+    fleet.observe_tenant("old", {
+        "seq": 1, "corr_id": "", "fairness": [
+            {"queue": "a", "share_deserved": 0.6, "share_allocated": 0.6,
+             "pending": 3},
+            {"queue": "b", "share_deserved": 0.6, "share_allocated": 0.6,
+             "pending": 0},
+        ],
+    })
+    w = fleet.close_window()
+    assert w.conservation["ok"], w.conservation  # no phantom fleet_imbalance
+    assert not [k for k, _ in flight.anomalies if k == "fleet_imbalance"]
+    by = {r["tenant"]: r for r in w.tenants}
+    # the exact tenant accounts against the resource-unit aggregate
+    assert by["exact"]["realized"] == pytest.approx(0.5)
+    # the fallback tenant accounts in its OWN share units — visible,
+    # not ~0 against a 48000-millicore capacity dimension
+    assert by["old"]["realized"] == pytest.approx(1.2)
+    assert by["old"]["demand"] == pytest.approx(1.0)  # deserved clamped
+    assert w.totals["capacity"] == [48000.0, 0.0, 0.0]
+    assert w.totals["allocated"] == [24000.0, 0.0, 0.0]
+    # a genuinely starving fallback tenant still runs its clock
+    fleet.observe_tenant("old", {
+        "seq": 2, "corr_id": "", "fairness": [
+            {"queue": "a", "share_deserved": 0.9, "share_allocated": 0.0,
+             "pending": 3},
+        ],
+    })
+    fleet.close_window()
+    clock.t += 20.0
+    w3 = fleet.close_window()
+    by3 = {r["tenant"]: r for r in w3.tenants}
+    assert by3["old"]["starvation_s"] > 0
+    assert [k for k, _ in flight.anomalies].count("fleet_starvation") == 1
+
+
+def test_tenant_weights_tilt_entitlements_only_under_contention():
+    # contention: both tenants demand their full cluster on DIFFERENT
+    # dominant dims, so the fleet-level demands sum to 2.0 > 1 — the
+    # weighted fill gives t0 3x t1's entitlement
+    fleet = FleetPlane(
+        registry=MetricsRegistry(namespace="t"), weights={"t0": 3.0},
+    )
+    fleet.observe_tenant("t0", _record(fairness=[
+        _qrow([10, 0, 0], [10, 0, 0]),
+    ], cluster_total=(10, 0, 0)))
+    fleet.observe_tenant("t1", _record(fairness=[
+        _qrow([0, 10, 0], [0, 10, 0]),
+    ], cluster_total=(0, 10, 0)))
+    w = fleet.close_window()
+    by = {r["tenant"]: r for r in w.tenants}
+    assert by["t0"]["entitled"] == pytest.approx(0.75)
+    assert by["t1"]["entitled"] == pytest.approx(0.25)
+
+
+def test_weight_never_entitles_past_demand():
+    """The weight enters exactly once (the fill level): without
+    contention a weighted tenant is entitled to its demand, never more —
+    a fully-served weighted tenant must not read as starving."""
+    fleet = FleetPlane(
+        registry=MetricsRegistry(namespace="t"), weights={"t0": 3.0},
+    )
+    for t in ("t0", "t1"):
+        fleet.observe_tenant(t, _record(fairness=[
+            _qrow([5, 0, 0], [5, 0, 0], pending=2),
+        ], cluster_total=(10, 0, 0)))
+    w = fleet.close_window()
+    by = {r["tenant"]: r for r in w.tenants}
+    # each demands 0.25 of the aggregate and gets it: delta 0, no
+    # phantom starvation for the weighted tenant
+    for t in ("t0", "t1"):
+        assert by[t]["entitled"] == pytest.approx(0.25)
+        assert by[t]["delta"] == pytest.approx(0.0)
+        assert by[t]["starvation_s"] == 0.0
+
+
+# ---- conservation -> fleet_imbalance ----
+
+
+def test_conservation_breach_fires_fleet_imbalance_dump(tmp_path):
+    flight = FlightRecorder(capacity=4, dump_dir=str(tmp_path))
+    reg = MetricsRegistry(namespace="t")
+    fleet = FleetPlane(registry=reg, flight=flight)
+    # a corrupted ledger: one tenant claims 25 allocated of a 10-unit
+    # cluster — the per-dimension sum must blow the aggregate
+    fleet.observe_tenant("t0", _record(fairness=[
+        _qrow([5, 0, 0], [25, 0, 0]),
+    ], cluster_total=(10, 0, 0)))
+    fleet.observe_tenant("t1", _record(fairness=[
+        _qrow([5, 0, 0], [5, 0, 0]),
+    ], cluster_total=(10, 0, 0)))
+    w = fleet.close_window()
+    assert not w.conservation["ok"]
+    v = w.conservation["violations"][0]
+    assert v["allocated"] == 30.0 and v["capacity"] == 20.0
+    assert reg.counter_value("fleet_conservation_breaches_total") == 1
+    dumps = list(tmp_path.glob("flight-*-fleet_imbalance.json"))
+    assert len(dumps) == 1
+    payload = json.loads(dumps[0].read_text())
+    assert payload["kind"] == "fleet_imbalance"
+    assert "allocated 30" in payload["detail"]
+
+
+def test_conservation_holds_on_honest_ledgers():
+    fleet = FleetPlane(registry=MetricsRegistry(namespace="t"))
+    fleet.observe_tenant("t0", _record(fairness=[
+        _qrow([10, 10, 0], [10, 8, 0]),
+    ], cluster_total=(10, 10, 0)))
+    w = fleet.close_window()
+    assert w.conservation["ok"] and not w.conservation["violations"]
+
+
+# ---- starvation clocks ----
+
+
+def test_starvation_clock_runs_only_while_under_entitled():
+    clock = _Clock()
+    flight = _Flight()
+    fleet = FleetPlane(
+        registry=MetricsRegistry(namespace="t"), flight=flight,
+        starvation_slo_s=30.0, now_fn=clock,
+    )
+    starving = _record(fairness=[
+        _qrow([8, 0, 0], [1, 0, 0], pending=4),
+    ], cluster_total=(10, 0, 0))
+    fat = _record(fairness=[
+        _qrow([2, 0, 0], [9, 0, 0], pending=4),
+    ], cluster_total=(10, 0, 0))
+    fleet.observe_tenant("t0", starving)
+    fleet.observe_tenant("t1", fat)
+    fleet.close_window()
+    clock.t += 40.0
+    w = fleet.close_window()
+    by = {r["tenant"]: r for r in w.tenants}
+    assert by["t0"]["starvation_s"] == pytest.approx(40.0)
+    # backlogged but over-entitled = queuing, not starving
+    assert by["t1"]["starvation_s"] == 0.0
+    kinds = [k for k, _ in flight.anomalies]
+    assert kinds.count("fleet_starvation") == 1  # once per episode
+    # still starving: no re-fire
+    clock.t += 40.0
+    fleet.close_window()
+    assert [k for k, _ in flight.anomalies].count("fleet_starvation") == 1
+    # progress (at entitlement) re-arms the episode
+    fleet.observe_tenant("t0", fat)
+    fleet.close_window()
+    clock.t += 40.0
+    fleet.observe_tenant("t0", starving)
+    clock.t += 40.0
+    fleet.close_window()
+    assert [k for k, _ in flight.anomalies].count("fleet_starvation") == 2
+
+
+def test_fully_shed_tenant_runs_the_starvation_clock():
+    """A tenant shed on every request never commits a cycle (no audit
+    record, no pending count) — denial of service must still run its
+    clock, or the most-starved tenant reports starvation_s 0."""
+    clock = _Clock()
+    flight = _Flight()
+    fleet = FleetPlane(
+        registry=MetricsRegistry(namespace="t"), flight=flight,
+        starvation_slo_s=30.0, now_fn=clock,
+    )
+    fleet.note_outcome("t0", "shed")
+    fleet.close_window()
+    clock.t += 40.0
+    fleet.note_outcome("t0", "shed")
+    w = fleet.close_window()
+    row = w.tenants[0]
+    assert row["starvation_s"] == pytest.approx(40.0)
+    anoms = [d for k, d in flight.anomalies if k == "fleet_starvation"]
+    assert len(anoms) == 1 and "shed" in anoms[0]
+    # service re-arms the episode
+    fleet.note_outcome("t0", "served")
+    w2 = fleet.close_window()
+    assert w2.tenants[0]["starvation_s"] == 0.0
+
+
+def test_idle_tenants_evicted_after_retention():
+    from kube_arbitrator_tpu.utils.fleet import TENANT_IDLE_EVICT_WINDOWS
+
+    fleet = FleetPlane(registry=MetricsRegistry(namespace="t"))
+    fleet.observe_tenant("gone", _record(fairness=[_qrow([1, 0, 0], [1, 0, 0])]))
+    w = None
+    for _ in range(TENANT_IDLE_EVICT_WINDOWS + 2):
+        fleet.note_outcome("alive", "served")
+        w = fleet.close_window()
+    tenants = {r["tenant"] for r in w.tenants}
+    assert tenants == {"alive"}, tenants  # gone evicted, alive retained
+
+
+# ---- batch accounting + promtext ----
+
+
+def test_batch_accounting_per_bucket_and_promtext():
+    reg = MetricsRegistry(namespace="kat")
+    fleet = FleetPlane(registry=reg)
+    fleet.observe_batch("batch-000001", 4, 3, "r0", True, 12.0,
+                        tenants=["a", "b", "c"])
+    fleet.observe_batch("batch-000002", 4, 4, "r0", False, 9.0,
+                        tenants=["a", "b", "c", "d"])
+    fleet.observe_batch("batch-000003", 1, 1, "r1", True, 5.0, tenants=["a"])
+    assert reg.gauge_value("pool_batch_occupancy", {"bucket": "4"}) == 1.0
+    assert reg.counter_value("pool_batch_padding_total", {"bucket": "4"}) == 1
+    assert reg.counter_value(
+        "pool_batch_launches_total", {"bucket": "4", "compile": "compile"}
+    ) == 1
+    assert reg.counter_value(
+        "pool_batch_launches_total", {"bucket": "4", "compile": "reuse"}
+    ) == 1
+    rows = fleet.batch_ring.rows()
+    assert [r["occupancy"] for r in rows] == [0.75, 1.0, 1.0]
+    fleet.observe_tenant("t0", _record(fairness=[_qrow([1, 0, 0], [1, 0, 0])]))
+    w = fleet.close_window()
+    assert w.batches["launches"] == 3 and w.batches["padded_slots"] == 1
+    assert w.batches["by_bucket"]["4"]["mean_occupancy"] == pytest.approx(0.875)
+    # the new families render conformant prometheus text
+    text = reg.render()
+    check_promtext(text)
+    for fam in ("fleet_windows_total", "fleet_tenant_share",
+                "fleet_starvation_seconds", "pool_batch_occupancy",
+                "pool_batch_padding_total", "pool_batch_launches_total"):
+        assert f"kat_{fam}" in text, f"missing family {fam}"
+
+
+# ---- shard rollups + skew alert ----
+
+
+def test_shard_rollup_columns_and_skew_burn_alert():
+    reg = MetricsRegistry(namespace="t")
+    assert shard_rollup_values(reg) == {}  # never sharded: no columns
+    reg.gauge_set("shard_skew", 0.8)
+    reg.gauge_set("shard_valid_nodes", 12, labels={"shard": "0"})
+    reg.gauge_set("shard_valid_nodes", 3, labels={"shard": "1"})
+    reg.gauge_set("snapshot_shard_delta_rows", 7, labels={"shard": "1"})
+    vals = shard_rollup_values(reg)
+    assert vals == {
+        "shard_skew": 0.8, "shard_valid_s0": 12.0, "shard_valid_s1": 3.0,
+        "shard_dirty_s1": 7.0,
+    }
+    # the sampler folds the columns into its ring and the skew monitor
+    # fires an SLO-burn-style alert once per episode
+    clock = _Clock()
+    flight = _Flight()
+    ring = TimeSeriesRing(capacity=64, now_fn=clock)
+    monitor = SkewBurnMonitor(
+        ring, skew_slo=0.5, budget=0.5, windows=((40.0, 10.0, 1.5),),
+        registry=reg, flight=flight, min_samples=4,
+    )
+    sampler = CycleSampler(ring=ring, registry=reg, skew_monitor=monitor)
+    from kube_arbitrator_tpu.framework.scheduler import CycleStats
+
+    stats = CycleStats(cycle_ms=5.0, snapshot_ms=1.0, binds=1, evicts=0,
+                       pending_before=0)
+    for i in range(8):
+        clock.t += 2.0
+        sampler.on_cycle(stats, ts=clock.t)
+    assert ring.rows()[-1]["shard_skew"] == 0.8
+    kinds = [k for k, _ in flight.anomalies]
+    assert kinds.count("shard_skew") == 1, flight.anomalies
+    assert reg.counter_value("shard_skew_alerts_total", {"window": "40s"}) == 1
+    # hysteresis: balanced shards recover the short window, then a new
+    # imbalance fires a new episode
+    reg.gauge_set("shard_skew", 0.0)
+    for i in range(8):
+        clock.t += 2.0
+        sampler.on_cycle(stats, ts=clock.t)
+    reg.gauge_set("shard_skew", 0.9)
+    for i in range(16):
+        clock.t += 2.0
+        sampler.on_cycle(stats, ts=clock.t)
+    assert [k for k, _ in flight.anomalies].count("shard_skew") == 2
+
+
+# ---- pool-batch trace stitching ----
+
+
+def test_batch_trace_stitching_one_shared_span_k_links():
+    """k batched tenants -> ONE shared pool_batch span under the minted
+    batch_id, k links, and each tenant's chrome export renders the
+    shared launch."""
+    from kube_arbitrator_tpu.utils.tracing import Tracer
+
+    tr = Tracer(enabled=True)
+    import kube_arbitrator_tpu.utils.tracing as tracing_mod
+
+    prev = tracing_mod._tracer
+    tracing_mod._tracer = tr
+    try:
+        fleet = FleetPlane(registry=MetricsRegistry(namespace="t"))
+        pool = DecisionPool(replicas=1, threaded=False, fleet=fleet)
+        cfg = SchedulerConfig.default()
+        reqs = []
+        for i in range(3):
+            sim = generate_cluster(num_nodes=16, num_jobs=4, tasks_per_job=4,
+                                   num_queues=2, seed=700 + i)
+            st = build_snapshot(sim.cluster).tensors
+            reqs.append((f"t{i}", st, cfg, None, f"c{i:06d}-test"))
+        out = pool.decide_many(reqs)
+        assert all(r.error is None for r in out)
+        batch_id = out[0].batch_id
+        assert batch_id and all(r.batch_id == batch_id for r in out)
+        # one shared batch span, correct schema
+        spans = tr.spans(batch_id)
+        assert len(spans) == 1 and spans[0].name == "pool_batch"
+        args = spans[0].args
+        assert args["size"] == 3 and args["bucket"] == 4
+        assert args["replica"] == "r0" and args["compile"] == "compile"
+        assert args["tenants"] == ["t0", "t1", "t2"]
+        # k links, and every tenant's export includes the shared launch
+        for i in range(3):
+            corr = f"c{i:06d}-test"
+            assert tr.links(corr) == [batch_id]
+            names = [e["name"] for e in tr.export_chrome(corr)["traceEvents"]]
+            assert "pool_batch" in names and "pool_batch_link" in names
+        # the decision log joins by batch_id
+        served = [e for e in pool.decision_log if e["outcome"] == "served"]
+        assert all(e["batch_id"] == batch_id for e in served)
+        # a second same-shape launch is a reuse
+        out2 = pool.decide_many([r[:4] for r in reqs])
+        spans2 = tr.spans(out2[0].batch_id)
+        assert spans2[0].args["compile"] == "reuse"
+    finally:
+        tracing_mod._tracer = prev
+
+
+# ---- flight digests (satellite: pool_outcomes + shard_skew) ----
+
+
+def test_flight_digest_records_pool_outcomes_and_shard_skew():
+    from kube_arbitrator_tpu.utils.metrics import metrics
+
+    metrics().gauge_set("shard_skew", 0.125)
+    fleet = FleetPlane()
+    pool = DecisionPool(replicas=1, threaded=False, fleet=fleet)
+    sim = generate_cluster(num_nodes=16, num_jobs=4, tasks_per_job=4,
+                           num_queues=2, seed=810)
+    flight = FlightRecorder(capacity=8)
+    sched = Scheduler(sim, decider=PoolClient(pool, "t0"), arena=True,
+                      flight=flight)
+    sched.run(max_cycles=2, until_idle=False)
+    rec = flight.last()
+    assert rec.digests["shard_skew"] == 0.125
+    # per-cycle DELTA: exactly one serve per cycle
+    assert rec.digests["pool_outcomes"] == {"served": 1}
+
+
+def test_flight_digest_pool_outcomes_empty_for_local_deciders():
+    sim = generate_cluster(num_nodes=16, num_jobs=4, tasks_per_job=4,
+                           num_queues=2, seed=811)
+    flight = FlightRecorder(capacity=8)
+    sched = Scheduler(sim, flight=flight)
+    sched.run(max_cycles=1, until_idle=False)
+    assert flight.last().digests["pool_outcomes"] == {}
+
+
+# ---- the 2x4 threaded e2e reconciliation ----
+
+
+def test_fleet_e2e_2x4_reconciles_with_per_tenant_audit_ledgers():
+    """2 replicas x 4 tenant frontends on threads, each tenant with its
+    own audit log; after the run the fleet window's totals must equal
+    the sums of the per-tenant /debug/audit ledgers, the per-tenant
+    served counts must equal the pool decision log, and /debug/fleet +
+    /debug/fleet/tenants must serve the same numbers."""
+    fleet = FleetPlane()
+    pool = DecisionPool(replicas=2, threaded=True, min_fill=4,
+                        batch_delay_s=0.25, max_batch=8, fleet=fleet)
+    sims = [generate_cluster(num_nodes=16, num_jobs=4, tasks_per_job=4,
+                             num_queues=2, seed=900 + i) for i in range(4)]
+    audits = [AuditLog(capacity=16) for _ in range(4)]
+    scheds = [
+        Scheduler(s, decider=PoolClient(pool, f"t{i}"), arena=True,
+                  audit=audits[i])
+        for i, s in enumerate(sims)
+    ]
+    threads = [
+        threading.Thread(target=lambda s=s: s.run(max_cycles=3, until_idle=False))
+        for s in scheds
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    pool.close()
+    for i, audit in enumerate(audits):
+        rec = audit.last()
+        assert rec is not None, f"tenant t{i} produced no audit record"
+        fleet.observe_tenant(f"t{i}", rec)
+    w = fleet.close_window()
+    assert w.conservation["ok"], w.conservation
+    by = {r["tenant"]: r for r in w.tenants}
+    assert sorted(by) == ["t0", "t1", "t2", "t3"]
+    # per-tenant serve counts reconcile 1:1 with the pool decision log
+    for i in range(4):
+        served_log = [
+            e for e in pool.decision_log
+            if e["tenant"] == f"t{i}" and e["outcome"] in ("served", "resent")
+        ]
+        row = by[f"t{i}"]
+        assert row["served"] + row["resent"] == len(served_log) == 3
+    # fleet totals == the sums of the per-tenant audit ledgers
+    F = len(w.totals["capacity"])
+    want_cap = [0.0] * F
+    want_alloc = [0.0] * F
+    for audit in audits:
+        rec = audit.last().to_dict()
+        for f in range(F):
+            want_cap[f] += rec["cluster_total"][f]
+        for qrow in rec["fairness"]:
+            for f in range(min(F, len(qrow["allocated"]))):
+                want_alloc[f] += qrow["allocated"][f]
+    assert w.totals["capacity"] == pytest.approx(want_cap, abs=1e-2)
+    assert w.totals["allocated"] == pytest.approx(want_alloc, abs=1e-2)
+    # per-tenant realized = dominant share of the aggregate capacity
+    for i, audit in enumerate(audits):
+        rec = audit.last().to_dict()
+        alloc = [0.0] * F
+        for qrow in rec["fairness"]:
+            for f in range(min(F, len(qrow["allocated"]))):
+                alloc[f] += qrow["allocated"][f]
+        want = max(
+            (alloc[f] / want_cap[f] for f in range(F) if want_cap[f] > 0),
+            default=0.0,
+        )
+        assert by[f"t{i}"]["realized"] == pytest.approx(want, abs=1e-4)
+    # the served plane agrees with the in-memory join
+    server, _t, url = serve_obs(fleet=fleet, pool=pool)
+    try:
+        fl = json.load(urllib.request.urlopen(url + "/debug/fleet", timeout=10))
+        assert fl["window"]["totals"] == w.totals
+        assert fl["windows_closed"] == 1
+        assert fl["window"]["batches"]["launches"] >= 1
+        tb = json.load(
+            urllib.request.urlopen(url + "/debug/fleet/tenants", timeout=10)
+        )
+        assert {r["tenant"]: r for r in tb["tenants"]} == by
+        assert tb["conservation"]["ok"]
+    finally:
+        server.shutdown()
+
+
+def test_debug_fleet_unwired_returns_stub():
+    server, _t, url = serve_obs()
+    try:
+        fl = json.load(urllib.request.urlopen(url + "/debug/fleet", timeout=10))
+        assert "error" in fl and fl["tenants"] == []
+    finally:
+        server.shutdown()
+
+
+# ---- the chaos canary ----
+
+
+def test_fleet_ledger_chaos_canary_breaches():
+    from kube_arbitrator_tpu.chaos.pool_runner import run_pool_chaos
+
+    clean = run_pool_chaos(seed=3, cycles=4)
+    assert not clean.breaches, clean.breaches
+    mutated = run_pool_chaos(seed=3, cycles=4, disabled=("fleet-ledger",))
+    kinds = {b.invariant for b in mutated.breaches}
+    assert kinds == {"fleet_ledger_consistency"}, mutated.breaches

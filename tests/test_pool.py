@@ -299,7 +299,11 @@ def test_pool_log_sensitivity_canary_breaches():
 
     rep = run_pool_chaos(seed=0, cycles=4, profile="pool", disabled=("pool-log",))
     assert not rep.ok
-    assert {b.invariant for b in rep.breaches} == {"pool_consistency"}
+    kinds = {b.invariant for b in rep.breaches}
+    # the fleet ledger reconciles against the same decision log, so the
+    # dropped served entries legitimately trip BOTH checkers
+    assert "pool_consistency" in kinds
+    assert kinds <= {"pool_consistency", "fleet_ledger_consistency"}, kinds
 
 
 def test_serve_path_error_resolves_requests_with_the_real_error():

@@ -42,6 +42,24 @@ def test_host_fingerprint_stable_and_keyed():
     assert a["cpu_count"] >= 1
 
 
+def test_fingerprint_changed_detects_new_host_class():
+    """bench.py's baseline-reset warning (the BENCH_r08 trap): a
+    non-empty history with zero rows of this host class means the next
+    append silently starts a fresh baseline — flag it."""
+    from kube_arbitrator_tpu.sentinel import fingerprint_changed, history_row
+
+    host = host_fingerprint(devices="cpu")
+    row = history_row("m", 10.0, host=host)
+    # empty history: a first-ever run is not a reset
+    assert not fingerprint_changed([], host["fingerprint"])
+    # same-class rows exist: no reset
+    assert not fingerprint_changed([row], host["fingerprint"])
+    # only foreign-class rows: the baseline resets
+    other = history_row("m", 10.0, host=host_fingerprint(devices="tpu"))
+    assert fingerprint_changed([other], host["fingerprint"])
+    assert not fingerprint_changed([other, row], host["fingerprint"])
+
+
 def test_history_roundtrip_skips_torn_lines(tmp_path):
     path = str(tmp_path / "h.jsonl")
     rows = [history_row("m1", 100.0, 95.0, 105.0, [95, 100, 105], 0),
