@@ -17,6 +17,14 @@
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
+# Every smoke lane ends by re-linting its concurrency-sensitive modules
+# under the lock + dtype families.  One helper so the rule selection (and
+# the project-wide KAT-LCK-ORDER graph pass that selection triggers)
+# stays in lockstep across lanes instead of drifting per copy.
+kat_lint_lck_dty() {
+  python -m kube_arbitrator_tpu.analysis --rules KAT-LCK,KAT-DTY "$@"
+}
+
 rc_lint=0
 python -m kube_arbitrator_tpu.analysis kube_arbitrator_tpu tests || rc_lint=$?
 if [ "${rc_lint}" -ne 0 ]; then
@@ -90,7 +98,7 @@ finally:
     server.shutdown()
 print("obs smoke: /metrics + /healthz + /debug/kernels + /debug/timeseries + /debug/audit + /debug/fleet ok")
 EOF
-  python -m kube_arbitrator_tpu.analysis --rules KAT-LCK,KAT-DTY \
+  kat_lint_lck_dty \
     kube_arbitrator_tpu/utils/tracing.py \
     kube_arbitrator_tpu/utils/flightrec.py \
     kube_arbitrator_tpu/utils/metrics.py \
@@ -117,7 +125,7 @@ if [ "${ARENA_EQUIV:-0}" = "1" ]; then
     tests/test_arena.py \
     tests/test_soak.py::test_arena_soak_50_cycles_matches_full_rebuild \
     || rc_arena=$?
-  python -m kube_arbitrator_tpu.analysis --rules KAT-LCK,KAT-DTY \
+  kat_lint_lck_dty \
     kube_arbitrator_tpu/cache/arena.py \
     kube_arbitrator_tpu/cache/sim.py \
     kube_arbitrator_tpu/cache/live.py \
@@ -203,7 +211,7 @@ EOF
       --seed "${seed}" --cycles 8 --profile pipeline --out-dir /tmp \
       || rc_pipe=$?
   done
-  python -m kube_arbitrator_tpu.analysis --rules KAT-LCK,KAT-DTY \
+  kat_lint_lck_dty \
     kube_arbitrator_tpu/pipeline/executor.py \
     kube_arbitrator_tpu/pipeline/journal.py \
     kube_arbitrator_tpu/pipeline/revalidate.py \
@@ -279,7 +287,7 @@ print(f"perf smoke: q512 reclaim {int(rb.rounds)} rounds "
       f"({int(gate_on.rounds_gated)} gated), batched == sequential "
       "with gate on and off")
 EOF
-  python -m kube_arbitrator_tpu.analysis --rules KAT-LCK,KAT-DTY \
+  kat_lint_lck_dty \
     kube_arbitrator_tpu/ops/preempt.py \
     kube_arbitrator_tpu/ops/allocate.py \
     kube_arbitrator_tpu/ops/cycle.py \
@@ -373,7 +381,7 @@ EOF
     echo "fleet-ledger sensitivity canary did not breach (exit ${rc_canary})" >&2
     rc_pool=1
   fi
-  python -m kube_arbitrator_tpu.analysis --rules KAT-LCK,KAT-DTY \
+  kat_lint_lck_dty \
     kube_arbitrator_tpu/rpc/pool.py \
     kube_arbitrator_tpu/rpc/sidecar.py \
     kube_arbitrator_tpu/rpc/client.py \
@@ -412,7 +420,7 @@ if [ "${SHARD_SMOKE:-0}" = "1" ]; then
       --seed "${seed}" --cycles 8 --profile shard --out-dir /tmp \
       || rc_shard=$?
   done
-  python -m kube_arbitrator_tpu.analysis --rules KAT-LCK,KAT-DTY \
+  kat_lint_lck_dty \
     kube_arbitrator_tpu/parallel/mesh.py \
     kube_arbitrator_tpu/parallel/shard.py \
     kube_arbitrator_tpu/parallel/multihost.py \
@@ -422,6 +430,40 @@ if [ "${SHARD_SMOKE:-0}" = "1" ]; then
     echo "shard smoke job: FAILED (exit ${rc_shard})" >&2
   else
     echo "shard smoke job: ok (full parity soak + 8-seed sharded chaos + kat-lint)"
+  fi
+fi
+
+# RACE_SOAK=1: the concurrency sanitizer lane — the race profile drives
+# the real threaded fleet (pool replicas + threaded batcher, frontend
+# schedulers, live-cache churn with compaction relists, obs scrapes,
+# mid-soak replica kills and fleet window closes) under the SanLock
+# witness shim; a seeded lock-order inversion that the static graph
+# cannot see (bare acquire/release, no with-block) MUST be witnessed.
+# The blind canary then re-runs with --disable sanitizer and MUST
+# breach — exit code exactly 1; a clean exit means the witness has gone
+# blind, any other code means the soak itself crashed.  The static half
+# runs the project-wide lock-order graph over the whole package, which
+# must report zero KAT-LCK-ORDER cycles.
+rc_race=0
+if [ "${RACE_SOAK:-0}" = "1" ]; then
+  for seed in 0 1 2; do
+    env JAX_PLATFORMS=cpu python -m kube_arbitrator_tpu.chaos \
+      --seed "${seed}" --cycles 4 --profile race --out-dir /tmp \
+      || rc_race=$?
+  done
+  env JAX_PLATFORMS=cpu python -m kube_arbitrator_tpu.chaos \
+    --seed 0 --cycles 2 --profile race --disable sanitizer \
+    --out-dir /tmp >/dev/null
+  rc_canary=$?
+  if [ "${rc_canary}" -ne 1 ]; then
+    echo "sanitizer blind canary did not breach (exit ${rc_canary})" >&2
+    rc_race=1
+  fi
+  kat_lint_lck_dty kube_arbitrator_tpu || rc_race=$?
+  if [ "${rc_race}" -ne 0 ]; then
+    echo "race soak job: FAILED (exit ${rc_race})" >&2
+  else
+    echo "race soak job: ok (3-seed witnessed soak + blind canary + lock-order graph)"
   fi
 fi
 
@@ -464,7 +506,7 @@ if [ "${PERF_SENTINEL:-0}" = "1" ]; then
     echo "sentinel lane: no BENCH_HISTORY.jsonl; canaries skipped" >&2
     rc_sentinel=1
   fi
-  python -m kube_arbitrator_tpu.analysis --rules KAT-LCK,KAT-DTY \
+  kat_lint_lck_dty \
     kube_arbitrator_tpu/utils/profiling.py \
     kube_arbitrator_tpu/utils/timeseries.py \
     kube_arbitrator_tpu/sentinel.py \
@@ -485,6 +527,7 @@ if [ "${LINT_ONLY:-0}" = "1" ]; then
   if [ "${rc_sentinel}" -ne 0 ]; then exit "${rc_sentinel}"; fi
   if [ "${rc_pool}" -ne 0 ]; then exit "${rc_pool}"; fi
   if [ "${rc_shard}" -ne 0 ]; then exit "${rc_shard}"; fi
+  if [ "${rc_race}" -ne 0 ]; then exit "${rc_race}"; fi
   exit "${rc_pipe}"
 fi
 
@@ -505,4 +548,5 @@ if [ "${rc_perf}" -ne 0 ]; then exit "${rc_perf}"; fi
 if [ "${rc_sentinel}" -ne 0 ]; then exit "${rc_sentinel}"; fi
 if [ "${rc_pool}" -ne 0 ]; then exit "${rc_pool}"; fi
 if [ "${rc_shard}" -ne 0 ]; then exit "${rc_shard}"; fi
+if [ "${rc_race}" -ne 0 ]; then exit "${rc_race}"; fi
 exit "${rc_test}"

@@ -7,7 +7,13 @@ files is cheap; re-running every rule's AST walks and (especially) the
 abstract evaluation of four action kernels + the fused cycle is not.
 Both are pure functions of
 
-* the analyzed file's bytes — keyed as ``(path, mtime_ns, size)``;
+* the analyzed file's bytes — keyed by a sha1 over the content itself,
+  with the ``(mtime_ns, size)`` stat pair kept per entry as a fast-path
+  guard for callers that do not already hold the text (an unchanged stat
+  reuses the stored hash; a changed one re-reads).  Keying on content
+  instead of stats closes the staleness hole where an editor's atomic
+  replace preserves both size and mtime: the analyzer reads every file
+  into memory anyway, so hashing what was read costs no extra I/O;
 * the rule implementations — keyed as a fingerprint over the analysis
   package's own source stats, so editing any rule invalidates everything;
 * the project kernel-name context (``ACTION_KERNELS`` registrations
@@ -32,7 +38,10 @@ from typing import Dict, Iterable, List, Optional, Sequence
 
 from .core import Finding
 
-_VERSION = 1
+# v2: per-file keys switched from stat triples to content hashes (the
+# stat pair moved into the entry as a fast-path guard); old caches miss
+# wholesale and are rewritten
+_VERSION = 2
 
 
 def _stat_fingerprint(paths: Iterable[str]) -> str:
@@ -89,6 +98,10 @@ class AnalysisCache:
         self.hits = 0
         self.misses = 0
         self._files: Dict[str, dict] = {}
+        # per-path stat pair + content hash observed by file_key this
+        # run, stored into entries so the no-text fast path works next run
+        self._stat_pair: Dict[str, str] = {}
+        self._content: Dict[str, str] = {}
         self._dirty = False
         if enabled:
             self._files = self._load(os.path.join(self.dir, "findings.json"))
@@ -108,12 +121,38 @@ class AnalysisCache:
 
     # ---- per-file findings ----
 
-    def file_key(self, path: str, context_fp: str) -> Optional[str]:
+    def file_key(
+        self, path: str, context_fp: str, text: Optional[str] = None
+    ) -> Optional[str]:
+        """Content-identity key: ``sha1(bytes):context``.
+
+        ``analyze_paths`` passes the text it already read, so the common
+        path hashes in-memory bytes — exact, and free of extra I/O.
+        Without ``text``, the stored ``(mtime_ns, size)`` pair is the
+        fast-path guard: a matching stat reuses the stored content hash
+        (accepting the atomic-replace blind spot in exchange for not
+        re-reading), a mismatch re-reads and re-hashes.
+        """
         try:
             st = os.stat(path)
         except OSError:
             return None
-        return f"{st.st_mtime_ns}:{st.st_size}:{context_fp}"
+        stat_pair = f"{st.st_mtime_ns}:{st.st_size}"
+        if text is not None:
+            content = hashlib.sha1(text.encode("utf-8", "replace")).hexdigest()
+        else:
+            entry = self._files.get(path)
+            if entry is not None and entry.get("stat") == stat_pair:
+                content = str(entry.get("content", ""))
+            else:
+                try:
+                    with open(path, "rb") as fh:
+                        content = hashlib.sha1(fh.read()).hexdigest()
+                except OSError:
+                    return None
+        self._stat_pair[path] = stat_pair
+        self._content[path] = content
+        return f"{content}:{context_fp}"
 
     def get_findings(self, path: str, key: Optional[str]) -> Optional[List[Finding]]:
         if not self.enabled or key is None:
@@ -130,6 +169,8 @@ class AnalysisCache:
             return
         self._files[path] = {
             "key": key,
+            "stat": self._stat_pair.get(path, ""),
+            "content": self._content.get(path, ""),
             "findings": [_finding_to_json(f) for f in findings],
         }
         self._dirty = True

@@ -39,6 +39,74 @@ from .rules import ALL_RULES, RULES_BY_FAMILY
 
 DEFAULT_BASELINE = ".kat-baseline.json"
 CONTRACTS_FAMILY = "KAT-CTR"
+LOCK_FAMILY = "KAT-LCK"
+
+
+def _changed_files(cwd: str = ".") -> Optional[List[str]]:
+    """Absolute paths of .py files changed vs ``git merge-base HEAD
+    origin/main`` (committed on the branch + working tree + untracked).
+    ``None`` means "git unavailable or confused": callers fall back to
+    the full tree rather than silently linting nothing."""
+    import subprocess
+
+    def run(*cmd: str):
+        return subprocess.run(
+            cmd, cwd=cwd, capture_output=True, text=True, timeout=30
+        )
+
+    try:
+        top = run("git", "rev-parse", "--show-toplevel")
+        if top.returncode != 0:
+            return None
+        root = top.stdout.strip()
+        base = ""
+        for upstream in ("origin/main", "main"):
+            mb = run("git", "merge-base", "HEAD", upstream)
+            if mb.returncode == 0 and mb.stdout.strip():
+                base = mb.stdout.strip()
+                break
+        if not base:
+            return None
+        names: List[str] = []
+        branch = run("git", "diff", "--name-only", base, "HEAD")
+        if branch.returncode != 0:
+            return None
+        names += branch.stdout.splitlines()
+        # the pre-commit loop cares about uncommitted + untracked work too
+        wt = run("git", "diff", "--name-only", "HEAD")
+        if wt.returncode == 0:
+            names += wt.stdout.splitlines()
+        unt = run("git", "ls-files", "--others", "--exclude-standard")
+        if unt.returncode == 0:
+            names += unt.stdout.splitlines()
+        return sorted(
+            {
+                os.path.join(root, n.strip())
+                for n in names
+                if n.strip().endswith(".py")
+            }
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def _restrict_to_changed(paths: List[str]) -> Optional[List[str]]:
+    """The requested scope ∩ the changed set, or ``None`` for "use the
+    full tree" (git unavailable).  An empty list means genuinely nothing
+    in scope changed."""
+    changed = _changed_files()
+    if changed is None:
+        return None
+    roots = [os.path.abspath(p) for p in paths]
+    keep: List[str] = []
+    for f in changed:
+        if not os.path.isfile(f):
+            continue  # deleted on the branch: nothing to analyze
+        for r in roots:
+            if f == r or f.startswith(r.rstrip(os.sep) + os.sep):
+                keep.append(f)
+                break
+    return keep
 
 
 def _default_paths() -> List[str]:
@@ -113,6 +181,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="record the current findings as the baseline and exit 0",
     )
     ap.add_argument(
+        "--changed-only", action="store_true",
+        help="analyze only files changed vs `git merge-base HEAD "
+        "origin/main` (plus working-tree/untracked edits); falls back to "
+        "the full tree when git is unavailable — the editor/pre-commit "
+        "fast path",
+    )
+    ap.add_argument(
         "--no-cache", action="store_true",
         help="ignore and do not write .kat-cache/",
     )
@@ -155,6 +230,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     cache = AnalysisCache(args.cache_dir, enabled=not args.no_cache)
     families = [r.family for r in rules] + ([CONTRACTS_FAMILY] if want_contracts else [])
     paths = list(args.paths) or _default_paths()
+    changed_note = ""
+    if args.changed_only:
+        changed = _restrict_to_changed(paths)
+        if changed is None:
+            changed_note = "changed-only: git unavailable, full tree"
+        elif not changed:
+            print("changed-only: no changed python files in scope — clean")
+            return 0
+        else:
+            paths = changed
+            changed_note = f"changed-only: {len(changed)} file(s)"
     try:
         project, findings = analyze_paths(
             paths, rules, cache=cache, context_fp=ruleset_fingerprint(families)
@@ -162,6 +248,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     except FileNotFoundError as e:
         print(f"no such path: {e}", file=sys.stderr)
         return 2
+
+    # the lock-order graph is project-level: a one-file edit can close a
+    # cycle in a different file, so its findings never come from the
+    # per-file cache — it re-runs (cheap, pure AST) whenever the KAT-LCK
+    # family is selected.  Under --changed-only the graph only covers
+    # the changed slice; the full-tree gate remains the authority.
+    if any(r.family == LOCK_FAMILY for r in rules):
+        from .rules.lockorder import lock_order_findings
+
+        findings = sorted(
+            findings + lock_order_findings(project),
+            key=lambda f: (f.path, f.line, f.rule),
+        )
 
     contracts_cached = False
     if want_contracts and _scope_has_pipeline(project):
@@ -184,6 +283,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     wall_s = time.perf_counter() - t0
     notes = []
+    if changed_note:
+        notes.append(changed_note)
     if cache.enabled:
         notes.append(f"{cache.hits}/{cache.hits + cache.misses} files cached")
         if want_contracts:

@@ -244,7 +244,13 @@ def analyze_paths(
         ).hexdigest()
     findings: List[Finding] = []
     for unit in project.units:
-        key = cache.file_key(unit.path, file_ctx) if cache is not None else None
+        # the text is already in memory: passing it makes the cache key a
+        # true content hash (no stat-based staleness) at zero extra I/O
+        key = (
+            cache.file_key(unit.path, file_ctx, text=unit.text)
+            if cache is not None
+            else None
+        )
         cached = cache.get_findings(unit.path, key) if cache is not None else None
         if cached is not None:
             findings.extend(cached)

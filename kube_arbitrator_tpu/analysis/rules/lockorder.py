@@ -1,0 +1,397 @@
+"""KAT-LCK-ORDER / KAT-LCK-BLOCK — the project-wide lock-order graph.
+
+Per-module lint (``locks.py``) sees each critical section in isolation;
+deadlocks live in the *composition*: thread 1 acquires A then B, thread 2
+acquires B then A, and neither module looks wrong on its own.  This is
+the **static** half of the concurrency sanitizer: it collects every lock
+object's acquisition sites across the whole project, builds the static
+happens-before edges (lock A held while acquiring B), and reports
+
+* ``KAT-LCK-ORDER`` (error) — a cycle in the lock-order graph: some set
+  of locks is acquired in incompatible orders somewhere in the tree.
+  Zero tolerance; a cycle is a deadlock waiting for the right schedule.
+* ``KAT-LCK-BLOCK`` (warning) — a lock held across a call that can block
+  for unbounded time on something *other* than the CPU: condition/queue
+  waits, future results, socket accept/connect.  (The harder device/
+  network set — ``block_until_ready``, ``Decide``, ``send`` … — is
+  already a KAT-LCK-002 *error*; this rule deliberately excludes that
+  set so one site never double-reports.)
+
+**Lock identity** is the join key with the dynamic half
+(``utils/locking.py``): locks constructed as ``locking.Lock("pool.lock")``
+are named by that first string literal — the same literal the runtime
+witness records — so ``analysis/sanitizer.py`` can reconcile witnessed
+edges against this graph edge-for-edge.  Locks built without a literal
+fall back to ``<module>:<Class>.<attr>``; ``Condition(self._lock)``
+aliases to the underlying lock's name (they guard the same mutex, and
+the runtime shim shares the name the same way).
+
+Scope notes (what the graph can and cannot see): edges come from
+lexically nested ``with`` blocks plus one level of same-class
+``self.method()`` expansion (a method called under lock A that itself
+acquires B contributes A→B).  Cross-*object* call chains (e.g. a method
+of one component invoking another component's locked method) are not
+modeled statically — witnessing those at runtime and flagging the
+mismatch is exactly the reconciliation job of ``analysis/sanitizer.py``.
+
+This pass is **project-level and uncached**: a single file edit can add
+or remove graph edges whose cycle closes in a *different* file, so its
+findings must never be served from the per-file findings cache.  The
+analyzer CLI runs it whenever the KAT-LCK family is selected, after the
+cached per-module pass (``analysis/cli.py``).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Set, Tuple
+
+from ..core import Finding, FunctionNode, ModuleUnit, Project, dotted_name
+from .locks import _BLOCKING_CALLS, _is_lock_factory, _self_attr
+
+# Calls that can park the holding thread on an external event.  Disjoint
+# from locks._BLOCKING_CALLS (those are KAT-LCK-002 errors already).
+_PARKING_CALLS = {"wait", "wait_for", "result", "accept", "connect", "select"}
+# queue get/put only count when the receiver *reads* like a queue —
+# dict.get()/cache.put() are everywhere and never park
+_QUEUEISH_CALLS = {"get", "put", "get_nowait", "join"}
+_QUEUEISH_HINTS = ("queue", "_q", "inbox", "mailbox")
+
+
+@dataclasses.dataclass
+class LockGraph:
+    """Static lock-order graph over one project.
+
+    ``nodes`` maps lock name → acquisition sites; ``edges`` maps
+    (held, acquired) → the sites where the inner acquisition happens;
+    ``blocking`` lists (lock, call, path, line) for parked holds.
+    """
+
+    nodes: Dict[str, List[Tuple[str, int]]] = dataclasses.field(default_factory=dict)
+    edges: Dict[Tuple[str, str], List[Tuple[str, int]]] = dataclasses.field(
+        default_factory=dict
+    )
+    blocking: List[Tuple[str, str, str, int]] = dataclasses.field(default_factory=list)
+
+    def add_site(self, name: str, path: str, line: int) -> None:
+        self.nodes.setdefault(name, []).append((path, line))
+
+    def add_edge(self, held: str, acquired: str, path: str, line: int) -> None:
+        if held == acquired:
+            return  # reentrant same-lock nesting is an RLock question, not order
+        self.edges.setdefault((held, acquired), []).append((path, line))
+
+
+def _literal_name(call: ast.Call) -> str:
+    """The lock's declared name: first positional string literal, if any."""
+    if call.args and isinstance(call.args[0], ast.Constant) and isinstance(
+        call.args[0].value, str
+    ):
+        return call.args[0].value
+    return ""
+
+
+def _factory_leaf(call: ast.Call) -> str:
+    dn = dotted_name(call.func)
+    return dn.split(".")[-1] if dn else ""
+
+
+class _ClassLocks:
+    """Lock declarations of one class: attr -> resolved lock name."""
+
+    def __init__(self, unit: ModuleUnit, cls: ast.ClassDef):
+        self.by_attr: Dict[str, str] = {}
+        aliases: List[Tuple[str, str]] = []  # (cond attr, aliased lock attr)
+        for node in ast.walk(cls):
+            if not (isinstance(node, ast.Assign) and _is_lock_factory(node.value)):
+                continue
+            call = node.value
+            assert isinstance(call, ast.Call)
+            for t in node.targets:
+                attr = _self_attr(t)
+                if not attr:
+                    continue
+                # Condition(self._lock) guards the same mutex as _lock:
+                # alias rather than minting a second node
+                if (
+                    _factory_leaf(call) == "Condition"
+                    and call.args
+                    and _self_attr(call.args[0])
+                ):
+                    aliases.append((attr, _self_attr(call.args[0])))
+                    continue
+                name = _literal_name(call) or f"{unit.rel}:{cls.name}.{attr}"
+                self.by_attr[attr] = name
+        for cond_attr, lock_attr in aliases:
+            if lock_attr in self.by_attr:
+                self.by_attr[cond_attr] = self.by_attr[lock_attr]
+
+
+def _collect_declared(project: Project) -> Dict[str, str]:
+    """attr-leaf -> declared literal name, across ALL assignments in the
+    project (``server.api_lock = locking.Lock("httpapi.api_lock")`` makes
+    a later ``self.server.api_lock`` resolvable by its leaf)."""
+    declared: Dict[str, str] = {}
+    for unit in project.units:
+        if unit.tree is None or unit.is_test:
+            continue
+        for node in ast.walk(unit.tree):
+            if not (isinstance(node, ast.Assign) and _is_lock_factory(node.value)):
+                continue
+            name = _literal_name(node.value)  # type: ignore[arg-type]
+            if not name:
+                continue
+            for t in node.targets:
+                leaf = t.attr if isinstance(t, ast.Attribute) else (
+                    t.id if isinstance(t, ast.Name) else ""
+                )
+                if leaf:
+                    # a leaf declared twice with different literals is
+                    # ambiguous: drop it rather than mis-join the graphs
+                    if leaf in declared and declared[leaf] != name:
+                        declared[leaf] = ""
+                    else:
+                        declared.setdefault(leaf, name)
+    return {k: v for k, v in declared.items() if v}
+
+
+def _lockish_leaf(leaf: str) -> bool:
+    low = leaf.lower()
+    return "lock" in low or "mutex" in low or low in ("_cond", "cond")
+
+
+class _FnWalk:
+    """Structured walk of one function, carrying the held-lock stack."""
+
+    def __init__(
+        self,
+        unit: ModuleUnit,
+        graph: LockGraph,
+        cls_locks: Dict[str, str],
+        method_acquires: Dict[str, Set[str]],
+        current_method: str,
+    ):
+        self.unit = unit
+        self.graph = graph
+        self.cls_locks = cls_locks
+        self.method_acquires = method_acquires
+        self.current_method = current_method
+        self.declared: Dict[str, str] = {}
+        # local aliases: `lock = self.server.api_lock` then `with lock:`
+        self.local: Dict[str, str] = {}
+
+    def resolve(self, expr: ast.AST) -> str:
+        """Lock name for an acquisition expression, '' when not a lock."""
+        attr = _self_attr(expr)
+        if attr and attr in self.cls_locks:
+            return self.cls_locks[attr]
+        if isinstance(expr, ast.Name) and expr.id in self.local:
+            return self.local[expr.id]
+        dn = dotted_name(expr)
+        leaf = dn.split(".")[-1] if dn else ""
+        if leaf and leaf in self.declared:
+            return self.declared[leaf]
+        if leaf and _lockish_leaf(leaf):
+            return f"{self.unit.rel}:{leaf}"
+        return ""
+
+    def walk(self, stmts: List[ast.stmt], held: List[str]) -> None:
+        for stmt in stmts:
+            self._stmt(stmt, held)
+
+    def _stmt(self, stmt: ast.stmt, held: List[str]) -> None:
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            acquired: List[str] = []
+            for item in stmt.items:
+                name = self.resolve(item.context_expr)
+                if name:
+                    self.graph.add_site(name, self.unit.rel, item.context_expr.lineno)
+                    for h in held + acquired:
+                        self.graph.add_edge(
+                            h, name, self.unit.rel, item.context_expr.lineno
+                        )
+                    acquired.append(name)
+            self.walk(stmt.body, held + acquired)
+            return
+        if isinstance(stmt, FunctionNode):
+            return  # nested defs run on their own thread/time; not this scope
+        if (
+            isinstance(stmt, ast.Assign)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+        ):
+            resolved = self.resolve(stmt.value)
+            if resolved:
+                self.local[stmt.targets[0].id] = resolved
+        # generic: iter_child_nodes yields list-field elements one by one,
+        # so compound bodies (If/For/Try/...) recurse with held intact
+        for node in ast.iter_child_nodes(stmt):
+            if isinstance(node, ast.expr):
+                self._expr(node, held)
+            elif isinstance(node, ast.stmt):
+                self._stmt(node, held)
+            elif isinstance(node, ast.excepthandler):
+                self.walk(node.body, held)
+
+    def _expr(self, e: ast.AST, held: List[str]) -> None:
+        for sub in ast.walk(e):
+            if not isinstance(sub, ast.Call):
+                continue
+            self._call(sub, held)
+
+    def _call(self, call: ast.Call, held: List[str]) -> None:
+        if not held:
+            return
+        dn = dotted_name(call.func)
+        leaf = dn.split(".")[-1] if dn else ""
+        if not leaf:
+            return
+        # one-level same-class expansion: self.m() under lock A where m
+        # itself acquires B statically contributes the A→B edges
+        attr = _self_attr(call.func) if isinstance(call.func, ast.Attribute) else ""
+        if attr and attr != self.current_method and attr in self.method_acquires:
+            for inner in self.method_acquires[attr]:
+                for h in held:
+                    self.graph.add_edge(h, inner, self.unit.rel, call.lineno)
+        if leaf in _BLOCKING_CALLS:
+            return  # KAT-LCK-002 owns the device/network error set
+        parking = leaf in _PARKING_CALLS
+        if leaf in _QUEUEISH_CALLS:
+            recv = (
+                dotted_name(call.func.value).lower()
+                if isinstance(call.func, ast.Attribute)
+                else ""
+            )
+            parking = any(h in recv for h in _QUEUEISH_HINTS)
+        if not parking:
+            return
+        # a condition's own wait releases the lock it guards: exempt when
+        # the receiver resolves to a lock we currently hold
+        if leaf in ("wait", "wait_for") and isinstance(call.func, ast.Attribute):
+            recv_name = self.resolve(call.func.value)
+            if recv_name and recv_name in held:
+                return
+        self.graph.blocking.append((held[-1], leaf, self.unit.rel, call.lineno))
+
+
+def _method_direct_acquires(
+    unit: ModuleUnit, cls: ast.ClassDef, cls_locks: Dict[str, str]
+) -> Dict[str, Set[str]]:
+    """method name -> lock names the method acquires lexically (for the
+    one-level call expansion)."""
+    out: Dict[str, Set[str]] = {}
+    for m in cls.body:
+        if not isinstance(m, FunctionNode):
+            continue
+        names: Set[str] = set()
+        for node in ast.walk(m):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr and attr in cls_locks:
+                        names.add(cls_locks[attr])
+        if names:
+            out[m.name] = names
+    return out
+
+
+def build_lock_graph(project: Project) -> LockGraph:
+    """Project-wide lock-order graph (production modules only; tests spin
+    deliberate fixtures and serialize via joins, per KAT-LCK)."""
+    graph = LockGraph()
+    declared = _collect_declared(project)
+    for unit in project.units:
+        if unit.tree is None or unit.is_test:
+            continue
+        class_funcs: Set[int] = set()
+        for cls in ast.walk(unit.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            cl = _ClassLocks(unit, cls)
+            acquires = _method_direct_acquires(unit, cls, cl.by_attr)
+            for m in cls.body:
+                if isinstance(m, FunctionNode):
+                    class_funcs.add(id(m))
+                    w = _FnWalk(unit, graph, cl.by_attr, acquires, m.name)
+                    w.declared = declared
+                    w.walk(m.body, [])
+        for fn in ast.walk(unit.tree):
+            if isinstance(fn, FunctionNode) and id(fn) not in class_funcs:
+                w = _FnWalk(unit, graph, {}, {}, fn.name)
+                w.declared = declared
+                w.walk(fn.body, [])
+    return graph
+
+
+def _find_cycles(edges: Dict[Tuple[str, str], List[Tuple[str, int]]]) -> List[List[str]]:
+    """Simple cycles in the order graph, canonicalized and deduped."""
+    adj: Dict[str, List[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+    for targets in adj.values():
+        targets.sort()
+    seen: Set[Tuple[str, ...]] = set()
+    cycles: List[List[str]] = []
+
+    def dfs(node: str, path: List[str], on_path: Set[str]) -> None:
+        for nxt in adj.get(node, ()):
+            if nxt in on_path:
+                i = path.index(nxt)
+                cyc = path[i:]
+                k = min(range(len(cyc)), key=lambda j: cyc[j])
+                canon = tuple(cyc[k:] + cyc[:k])
+                if canon not in seen:
+                    seen.add(canon)
+                    cycles.append(list(canon))
+                continue
+            path.append(nxt)
+            on_path.add(nxt)
+            dfs(nxt, path, on_path)
+            on_path.discard(nxt)
+            path.pop()
+
+    for start in sorted(adj):
+        dfs(start, [start], {start})
+    return cycles
+
+
+def lock_order_findings(project: Project) -> List[Finding]:
+    """The KAT-LCK-ORDER / KAT-LCK-BLOCK findings for one project."""
+    graph = build_lock_graph(project)
+    out: List[Finding] = []
+    for cyc in _find_cycles(graph.edges):
+        hops = []
+        for i, a in enumerate(cyc):
+            b = cyc[(i + 1) % len(cyc)]
+            path, line = graph.edges[(a, b)][0]
+            hops.append(f"{a}->{b} at {path}:{line}")
+        first_path, first_line = graph.edges[(cyc[0], cyc[1 % len(cyc)])][0]
+        chain = " -> ".join(cyc + [cyc[0]])
+        out.append(
+            Finding(
+                "KAT-LCK-ORDER", "error", first_path, first_line,
+                f"lock-order cycle: {chain} ({'; '.join(hops)}) — two "
+                "threads taking these locks in the witnessed orders "
+                "deadlock under the right schedule",
+                hint="pick one global acquisition order for these locks "
+                "and restructure the minority site (copy state out, "
+                "release, re-acquire in order); the dynamic witness "
+                "(KAT_SANITIZE=1) shows which threads drive each edge",
+            )
+        )
+    for lock, call, path, line in graph.blocking:
+        out.append(
+            Finding(
+                "KAT-LCK-BLOCK", "warning", path, line,
+                f"`{call}` may park the thread while holding `{lock}` — "
+                "a wait under a lock extends every other thread's "
+                "critical-section latency by the wait (line is the call "
+                "site)",
+                hint="wait outside the lock (condition waits on the "
+                "lock's own Condition are exempt — they release it); "
+                "for queues, drain under the lock and block after "
+                "releasing",
+            )
+        )
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out

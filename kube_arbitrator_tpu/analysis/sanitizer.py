@@ -1,0 +1,125 @@
+"""Sanitizer reconciliation: static lock-order graph × dynamic witness.
+
+The two halves of the concurrency sanitizer see different slices of the
+truth.  The static graph (``rules/lockorder.py``) sees every *lexical*
+acquisition in the tree but cannot follow cross-object call chains; the
+runtime witness (``utils/locking.py``) sees exactly the edges the
+exercised schedules drove, and nothing else.  Their disagreement is
+therefore signal, not noise:
+
+* a **witnessed edge absent from the static graph** (``unmodeled``)
+  means real threads compose locks in a way no single function shows —
+  the next refactor can introduce an inversion the linter will never
+  see, so the edge should be added to the order discipline explicitly;
+* a **static edge never witnessed** (``unwitnessed``) means the soak did
+  not exercise that nesting — coverage debt for the race-soak profile.
+
+``reconcile`` computes both sets (ignoring the seeded canary locks and
+anonymous locks, which are test scaffolding by construction), and
+``dump_artifact`` persists the full comparison as a
+``sanitizer-<n>.json`` flight artifact next to the chaos run's other
+evidence, following the flight-recorder convention (tmp + ``os.replace``
+so a crash never leaves a half-written report as the only evidence).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import load_project
+from .rules.lockorder import LockGraph, build_lock_graph
+
+SANITIZER_FORMAT_VERSION = 1
+
+_IGNORE_PREFIXES = ("canary.", "anon-")
+
+
+def static_lock_graph(paths: Optional[Sequence[str]] = None) -> LockGraph:
+    """The static graph over the given roots (default: the installed
+    ``kube_arbitrator_tpu`` package)."""
+    if paths is None:
+        paths = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+    return build_lock_graph(load_project(paths))
+
+
+def _ignored(name: str) -> bool:
+    return name.startswith(_IGNORE_PREFIXES)
+
+
+def reconcile(
+    graph: LockGraph, witness_report: Dict[str, object]
+) -> Dict[str, List[List[str]]]:
+    """Compare witnessed edges against the static graph.
+
+    Returns ``{"unmodeled": [[src, dst], ...], "unwitnessed": [...]}``.
+    Only *named* locks participate: a witnessed edge involving a lock the
+    static graph has never heard of at all (both endpoints unknown) is
+    still unmodeled — that is the point.
+    """
+    static_edges: Set[Tuple[str, str]] = {
+        (a, b) for (a, b) in graph.edges if not (_ignored(a) or _ignored(b))
+    }
+    dyn_edges: Set[Tuple[str, str]] = set()
+    for e in witness_report.get("edges", ()):  # type: ignore[union-attr]
+        a, b = str(e["src"]), str(e["dst"])  # type: ignore[index]
+        if _ignored(a) or _ignored(b):
+            continue
+        dyn_edges.add((a, b))
+    return {
+        "unmodeled": [list(e) for e in sorted(dyn_edges - static_edges)],
+        "unwitnessed": [list(e) for e in sorted(static_edges - dyn_edges)],
+    }
+
+
+def _next_seq(out_dir: str) -> int:
+    """1 + highest existing sanitizer-<n>.json (robust across processes
+    sharing one artifact directory)."""
+    top = 0
+    try:
+        for fn in os.listdir(out_dir):
+            if fn.startswith("sanitizer-") and fn.endswith(".json"):
+                try:
+                    top = max(top, int(fn[len("sanitizer-"):-len(".json")]))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return top + 1
+
+
+def dump_artifact(
+    out_dir: str,
+    graph: LockGraph,
+    witness_report: Dict[str, object],
+    mismatches: Optional[Dict[str, List[List[str]]]] = None,
+    context: Optional[Dict[str, object]] = None,
+) -> str:
+    """Write the reconciliation as ``<out_dir>/sanitizer-<n>.json``."""
+    os.makedirs(out_dir, exist_ok=True)
+    if mismatches is None:
+        mismatches = reconcile(graph, witness_report)
+    payload: Dict[str, object] = {
+        "format_version": SANITIZER_FORMAT_VERSION,
+        "static": {
+            "locks": {
+                name: [f"{p}:{l}" for p, l in sites]
+                for name, sites in sorted(graph.nodes.items())
+            },
+            "edges": [
+                {"src": a, "dst": b, "sites": [f"{p}:{l}" for p, l in sites]}
+                for (a, b), sites in sorted(graph.edges.items())
+            ],
+        },
+        "witness": witness_report,
+        "mismatches": mismatches,
+    }
+    if context:
+        payload["context"] = context
+    seq = _next_seq(out_dir)
+    path = os.path.join(out_dir, f"sanitizer-{seq:04d}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1, default=str)
+    os.replace(tmp, path)
+    return path

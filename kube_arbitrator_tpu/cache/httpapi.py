@@ -46,6 +46,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Tuple
 
 from .fakeapi import ApiError, FakeApiServer, RESOURCES, _key
+from ..utils import locking
 
 
 def _split(path: str) -> List[str]:
@@ -84,7 +85,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _route(self, verb: str) -> None:
         api: FakeApiServer = self.server.api  # type: ignore[attr-defined]
-        lock: threading.Lock = self.server.api_lock  # type: ignore[attr-defined]
+        lock = self.server.api_lock  # type: ignore[attr-defined]
         # Bearer-token check BEFORE any dispatch (the reference's
         # clientsets always authenticate, server.go:51-56; RBAC rides on
         # the identity).  Constant-time compare: a timing oracle on a
@@ -258,7 +259,7 @@ def serve_api(
     credential to hang off."""
     server = ThreadingHTTPServer((host, port), _Handler)
     server.api = api  # type: ignore[attr-defined]
-    server.api_lock = threading.Lock()  # type: ignore[attr-defined]
+    server.api_lock = locking.Lock("httpapi.api_lock")  # type: ignore[attr-defined]
     server.api_token = token  # type: ignore[attr-defined]
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()

@@ -56,6 +56,7 @@ from ..api.info import (
 )
 from ..api.types import TaskStatus
 from ..options import options
+from ..utils import locking
 from ..utils.metrics import metrics
 from .fakeapi import ADDED, DELETED, MODIFIED, RESOURCES, ApiError, FakeApiServer
 from .sim import BindIntent, Event, EvictIntent
@@ -355,6 +356,23 @@ class LiveCache:
         # after every sync() that applied any — the hook idle waiters and
         # the pipelined executor's ingest observability ride on.
         self.on_events = None
+        if locking.sanitize_enabled():
+            # the live plane is lock-free BY CONTRACT: one pump thread
+            # owns all mutation (informer discipline).  Single-writer
+            # mode makes the sanitizer prove it — the first thread to
+            # mutate after construction claims the cache; any other
+            # thread's write is a finding.
+            locking.register_guarded(
+                None, self,
+                (
+                    "cluster", "events", "resync_queue", "_watch_rv",
+                    "_listed", "_pod_ref", "_pg_ref", "_deleted_jobs",
+                    "_task_by_uid", "_other_by_uid", "_pvs", "_pvcs",
+                    "_scs", "_raw_pod", "_claim_pods", "_pv_claims",
+                    "_last_sync_ts",
+                ),
+                name="LiveCache",
+            )
 
     # ---- informer pump ----
 

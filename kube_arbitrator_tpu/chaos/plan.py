@@ -149,6 +149,12 @@ class ChaosProfile:
     # (no double bind, single actuator, audit consistency) must hold
     # under sharding and the digests stay deterministic
     shard: int = 0
+    # concurrency race-soak (chaos/race_soak.py): real threads — threaded
+    # decision pool + tenant schedulers + live-cache churn + obs scrapes —
+    # under the sanitizer lock shim (utils/locking.py), with a seeded
+    # lock-inversion canary that must be witnessed; fault rates are
+    # ignored (real-thread schedules are not digest-deterministic)
+    race_soak: bool = False
     # fault kind -> per-cycle injection probability
     rates: Tuple[Tuple[str, float], ...] = ()
 
@@ -267,6 +273,14 @@ PROFILES: Dict[str, ChaosProfile] = {
             ("replica_partition", 0.25),
             ("replica_slow", 0.20),
         ),
+    ),
+    # concurrency sanitizer soak: small worlds, REAL threads.  No fault
+    # rates and no digests — the assertions are the witness graph's
+    # (inversions, guard violations, the seeded canary), not state hashes
+    "race": ChaosProfile(
+        name="race", nodes=6, jobs=4, tasks_per_job=3, queues=2,
+        oversubscribe=1.5, drain_cycles=0,
+        pool_replicas=2, pool_tenants=3, race_soak=True, rates=(),
     ),
 }
 

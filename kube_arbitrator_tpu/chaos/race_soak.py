@@ -1,0 +1,332 @@
+"""Race-soak: the concurrency sanitizer's dynamic half under real load.
+
+Every other chaos runner in this package is deterministic by
+construction — virtual clock, single thread, digest-stable event logs.
+This one is deliberately the opposite: it runs the fleet's genuinely
+concurrent surfaces on REAL threads under the sanitizer lock shim
+(``utils/locking.py``, forced on for the duration) and asserts over the
+*witness graph* instead of state digests:
+
+* ``profile.pool_tenants`` tenant worlds decide concurrently through one
+  shared **threaded** :class:`rpc.pool.DecisionPool` (dispatcher thread +
+  per-replica executors), with mid-soak replica kills/restarts;
+* each tenant feeds the shared :class:`utils.fleet.FleetPlane`, whose
+  accounting windows close from the main thread — cross-thread ledger
+  traffic on ``fleet.lock``;
+* a churn thread owns a private :class:`cache.live.LiveCache` world and
+  hammers sync/churn — the cache is registered in single-writer mode, so
+  the soak proves the informer discipline, not just survives it;
+* the obs HTTP server serves ``/metrics`` + ``/debug/pool`` +
+  ``/debug/fleet`` scrapes (handler threads take the registry/pool/fleet
+  locks while the owners mutate under them).
+
+**Canary** (the repo's sensitivity convention): two locks named
+``canary.a``/``canary.b`` are acquired A→B on one thread and B→A on
+another (serialized by joins — an inversion witness, never a deadlock).
+With the shim on, the witness MUST see the inversion (it is allowlisted
+via ``expected_inversions``, so it is a detection, not a breach).  Under
+``--disable sanitizer`` the shim stays off, the canary goes unwitnessed,
+and the ``sanitizer_witness`` invariant breaches — a blind witness must
+never pass.
+
+Real findings are invariants: any *unexpected* inversion breaches
+``sanitizer_lock_order``; any guarded-state mutation without the owning
+lock breaches ``sanitizer_guard``.  Hold-SLO overruns and
+static-vs-witnessed reconciliation mismatches (``analysis/sanitizer.py``)
+are detections — environment-sensitive, so they inform rather than gate —
+and the full reconciliation is dumped as a ``sanitizer-<n>.json``
+artifact when ``out_dir`` is set.
+
+Because thread schedules are nondeterministic, reports record EMPTY
+digests: replaying a race repro re-runs the soak and compares outcomes
+and invariants, not event hashes (the runner's digest check skips empty
+recordings by design).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import List, Optional, Sequence
+from urllib.request import urlopen
+
+from ..cache.fakeapi import FakeApiServer
+from ..cache.live import LiveCache
+from ..utils import locking
+from ..utils.metrics import metrics
+from .invariants import Breach
+from .plan import PROFILES, ChaosProfile, FaultPlan
+from .runner import DISABLE_CHOICES, ChaosReport, seed_world
+
+
+def _breach(breaches: List[Breach], invariant: str, cycle: int, detail: str) -> None:
+    breaches.append(Breach(invariant=invariant, cycle=cycle, detail=detail))
+    metrics().counter_add(
+        "chaos_invariant_breaches_total", labels={"invariant": invariant}
+    )
+
+
+def _seeded_inversion_canary() -> None:
+    """Acquire canary.a→canary.b on one thread and b→a on another,
+    serialized by joins so the inversion is witnessed without ever being
+    able to deadlock."""
+    a = locking.Lock("canary.a")
+    b = locking.Lock("canary.b")
+
+    # bare acquire/release (not `with` nesting) keeps the inversion
+    # INVISIBLE to the static lock-order graph — the canary plants
+    # exactly the class of edge only the runtime witness can see, so a
+    # blind witness cannot hide behind the static half
+    def ab() -> None:
+        a.acquire()
+        b.acquire()
+        b.release()
+        a.release()
+
+    def ba() -> None:
+        b.acquire()
+        a.acquire()
+        a.release()
+        b.release()
+
+    t1 = threading.Thread(target=ab, name="kat-canary-ab")
+    t1.start()
+    t1.join()
+    t2 = threading.Thread(target=ba, name="kat-canary-ba")
+    t2.start()
+    t2.join()
+
+
+class _SoakTenant:
+    """One tenant world driven by its own thread: private apiserver +
+    live cache + scheduler, deciding through the shared threaded pool."""
+
+    def __init__(self, index: int, prof: ChaosProfile, seed: int, pool) -> None:
+        from ..framework.scheduler import Scheduler
+        from ..rpc.pool import PoolClient
+        from ..utils.audit import AuditLog
+
+        self.id = f"t{index}"
+        self.api = FakeApiServer()
+        # same profile shape across tenants (batch-compatible packs),
+        # different contents per world
+        seed_world(self.api, prof, f"{seed}-race-{self.id}")
+        self.cache = LiveCache(self.api)
+        self.audit = AuditLog(capacity=1024)
+        self.sched = Scheduler(
+            self.cache, decider=PoolClient(pool, self.id), audit=self.audit
+        )
+        self.errors: List[str] = []
+        self.cycles_ok = 0
+
+    def run(self, cycles: int, fleet) -> None:
+        for _ in range(cycles):
+            try:
+                self.sched.run_once()
+                rec = self.audit.last()
+                if rec is not None:
+                    fleet.observe_tenant(self.id, rec.to_dict())
+                self.cycles_ok += 1
+            except Exception as err:  # noqa: BLE001 - soak must report, not die
+                self.errors.append(f"{type(err).__name__}: {err}")
+                return
+
+
+def _live_cache_churn(prof: ChaosProfile, seed: int, rounds: int, errors: List[str]) -> None:
+    """Single-writer live-plane churn: this thread constructs AND mutates
+    its own world, so the sanitizer's single-writer claim lands on it."""
+    try:
+        api = FakeApiServer()
+        seed_world(api, prof, f"{seed}-race-churn")
+        cache = LiveCache(api)
+        cache.sync()
+        for i in range(rounds):
+            # delete/recreate a pod each round so every sync applies real
+            # events; a periodic compaction forces the relist path, whose
+            # _reset_model rebinds are exactly what single-writer guards
+            pods, _ = api.list("pods")
+            if pods:
+                victim = dict(pods[i % len(pods)])
+                meta = victim.get("metadata", {})
+                api.delete("pods", meta.get("namespace", ""), meta.get("name", ""))
+                cache.sync()
+                meta.pop("resourceVersion", None)
+                meta.pop("uid", None)
+                api.create("pods", victim)
+            if i % 4 == 3:
+                api.compact()
+            cache.sync()
+            time.sleep(0.005)
+    except Exception as err:  # noqa: BLE001 - the soak reports, never dies
+        errors.append(f"churn: {type(err).__name__}: {err}")
+
+
+def run_race_soak(
+    seed: int = 0,
+    cycles: int = 4,
+    profile=None,
+    disabled: Sequence[str] = (),
+    plan: Optional[FaultPlan] = None,
+    out_dir: Optional[str] = None,
+) -> ChaosReport:
+    """One real-thread concurrency soak under the sanitizer shim; see the
+    module docstring.  Signature mirrors the other runners so the chaos
+    CLI routes ``--profile race`` here transparently."""
+    prof = profile if isinstance(profile, ChaosProfile) else PROFILES[profile or "race"]
+    if not getattr(prof, "race_soak", False):
+        raise ValueError(f"profile {prof.name} is not a race-soak profile")
+    disabled = tuple(sorted(set(disabled)))
+    unknown = set(disabled) - set(DISABLE_CHOICES)
+    if unknown:
+        raise ValueError(f"unknown --disable choices: {sorted(unknown)}")
+    sanitize = "sanitizer" not in disabled
+    prev = locking.force_sanitize(True if sanitize else False)
+    locking.reset_witness()
+    wit = locking.witness()
+    if sanitize:
+        wit.expect_inversion("canary.a", "canary.b")
+
+    from ..rpc.pool import DecisionPool
+    from ..utils.fleet import FleetPlane
+    from ..obs import serve_obs
+
+    outcomes: List[str] = []
+    detections: List[dict] = []
+    breaches: List[Breach] = []
+    thread_errors: List[str] = []
+
+    def detect(kind: str, **extra) -> None:
+        detections.append({"cycle": -1, "kind": kind, **extra})
+        metrics().counter_add("chaos_detections_total", labels={"kind": kind})
+
+    server = None
+    pool = None
+    try:
+        fleet = FleetPlane()
+        pool = DecisionPool(
+            replicas=max(prof.pool_replicas, 2), threaded=True, fleet=fleet
+        )
+        tenants = [
+            _SoakTenant(i, prof, seed, pool)
+            for i in range(max(prof.pool_tenants, 2))
+        ]
+        server, obs_thread, base_url = serve_obs(port=0, pool=pool, fleet=fleet)
+        threads = [
+            threading.Thread(
+                target=t.run, args=(cycles, fleet), name=f"kat-soak-{t.id}"
+            )
+            for t in tenants
+        ]
+        churn = threading.Thread(
+            target=_live_cache_churn,
+            args=(prof, seed, cycles * 3, thread_errors),
+            name="kat-soak-churn",
+        )
+        pool.begin_cycle(0)
+        for th in threads:
+            th.start()
+        churn.start()
+        # cross-thread pressure from the main thread while tenants run:
+        # obs scrapes (handler threads take registry/pool/fleet locks),
+        # replica kill/restart (pool + replica locks), window closes
+        for i in range(3):
+            time.sleep(0.05)
+            try:
+                for route in ("/metrics", "/debug/pool", "/debug/fleet"):
+                    urlopen(base_url + route, timeout=10).read()
+            except OSError as err:
+                thread_errors.append(f"obs: {type(err).__name__}: {err}")
+            pool.kill_replica(i % len(pool.replicas))
+            fleet.close_window(i)
+        for th in threads:
+            th.join(timeout=600.0)
+        churn.join(timeout=600.0)
+        fleet.close_window(len(tenants) + 3)
+        for t in tenants:
+            for e in t.errors:
+                _breach(
+                    breaches, "no_unhandled_fatal", -1, f"{t.id}: {e}"
+                )
+            outcomes.append(f"{t.id}:{'ok' if not t.errors else 'error'}")
+        for e in thread_errors:
+            _breach(breaches, "no_unhandled_fatal", -1, e)
+
+        # ---- the canary: the witness must have seen the planted inversion
+        _seeded_inversion_canary()
+        witnessed = frozenset(("canary.a", "canary.b")) in set(wit.inversions())
+        if witnessed:
+            detect("lock_inversion_canary", locks=["canary.a", "canary.b"])
+        else:
+            _breach(
+                breaches, "sanitizer_witness", -1,
+                "seeded canary.a/canary.b inversion went unwitnessed — "
+                "the sanitizer shim is disabled or blind",
+            )
+        outcomes.append(f"canary:{'witnessed' if witnessed else 'unwitnessed'}")
+
+        # ---- witness findings -> invariants / detections
+        report_w = wit.report()
+        for f in report_w["findings"]:
+            kind = f.get("kind")
+            if kind == "inversion":
+                _breach(
+                    breaches, "sanitizer_lock_order", -1,
+                    f"lock-order inversion witnessed: {f.get('locks')} "
+                    f"({f.get('stack', '')})",
+                )
+            elif kind == "guard":
+                _breach(
+                    breaches, "sanitizer_guard", -1,
+                    f"{f.get('obj')}.{f.get('field')} mutated without "
+                    f"{f.get('lock')} ({f.get('mode')} mode) on thread "
+                    f"{f.get('thread')}",
+                )
+            elif kind == "hold_slo":
+                detect(
+                    "lock_hold_slo", lock=f.get("lock"),
+                    held_ms=f.get("held_ms"),
+                )
+
+        # ---- reconcile witnessed edges against the static graph
+        if sanitize:
+            from ..analysis.sanitizer import (
+                dump_artifact,
+                reconcile,
+                static_lock_graph,
+            )
+
+            graph = static_lock_graph()
+            mismatches = reconcile(graph, report_w)
+            for src, dst in mismatches["unmodeled"]:
+                detect("sanitizer_unmodeled_edge", src=src, dst=dst)
+            for src, dst in mismatches["unwitnessed"]:
+                detect("sanitizer_unwitnessed_edge", src=src, dst=dst)
+            if out_dir:
+                path = dump_artifact(
+                    out_dir, graph, report_w, mismatches,
+                    context={
+                        "seed": seed, "cycles": cycles,
+                        "disabled": list(disabled), "profile": prof.name,
+                    },
+                )
+                detect("sanitizer_artifact", path=path)
+    finally:
+        if pool is not None:
+            pool.close()
+        if server is not None:
+            server.shutdown()
+        locking.force_sanitize(prev)
+
+    if plan is None:
+        plan = FaultPlan(seed=seed)
+    report = ChaosReport(
+        seed=seed, profile=prof, cycles=cycles, disabled=disabled, plan=plan,
+        injected=[], outcomes=outcomes,
+        digests=[],  # real threads: no digest determinism, by design
+        detections=detections, breaches=breaches,
+    )
+    if out_dir and report.breaches:
+        report.write(
+            os.path.join(out_dir, f"chaos-repro-{prof.name}-{seed}.json")
+        )
+    return report

@@ -62,7 +62,13 @@ REPRO_VERSION = 1
 # profiles) drops the first tenant's row from every closed fleet
 # accounting window — the fleet_ledger_consistency reconciler MUST
 # breach.
-DISABLE_CHOICES = ("arena-verify", "audit-edges", "pool-log", "fleet-ledger")
+# "sanitizer" (race profiles) turns the lock-witness shim OFF for the
+# soak — the seeded lock-inversion canary must then go unwitnessed and
+# the sanitizer_witness invariant MUST breach (a witness that cannot see
+# a planted inversion is blind).
+DISABLE_CHOICES = (
+    "arena-verify", "audit-edges", "pool-log", "fleet-ledger", "sanitizer"
+)
 
 
 def seed_world(api, profile: ChaosProfile, seed: int) -> None:
@@ -446,7 +452,12 @@ def main(argv=None) -> int:
         disabled |= recorded_disabled
         seed, cycles = int(rec["seed"]), int(rec["cycles"])
         run_fn = run_chaos
-        if prof.pool_replicas > 0:
+        if getattr(prof, "race_soak", False):
+            # race profiles replay through the threaded soak (no digest
+            # determinism — its repro files record empty digests, so the
+            # replay check below degrades to outcome comparison)
+            from .race_soak import run_race_soak as run_fn
+        elif prof.pool_replicas > 0:
             # pool profiles replay through the multi-tenant runner
             from .pool_runner import run_pool_chaos as run_fn
         if args.shrink:
@@ -504,7 +515,11 @@ def main(argv=None) -> int:
         )
         return 2
     run_fn = run_chaos
-    if prof.pool_replicas > 0:
+    if getattr(prof, "race_soak", False):
+        # real-thread concurrency soak under the sanitizer shim
+        # (chaos/race_soak.py): sanitizer_* invariants armed
+        from .race_soak import run_race_soak as run_fn
+    elif prof.pool_replicas > 0:
         # multi-replica posture: M tenant worlds on N shared decision
         # replicas (chaos/pool_runner.py), pool_consistency armed
         from .pool_runner import run_pool_chaos as run_fn

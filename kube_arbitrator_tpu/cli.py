@@ -41,6 +41,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="namespace (directory, in sim) of the leader-election lock object",
     )
     p.add_argument("--print-version", action="store_true")
+    p.add_argument(
+        "--sanitize", action="store_true",
+        help="run under the concurrency sanitizer shim (witnessed locks, "
+        "guarded-state checks; same as KAT_SANITIZE=1) — development/"
+        "soak posture, not for latency-sensitive production runs",
+    )
     # simulation plane
     p.add_argument("--sim-nodes", type=int, default=100)
     p.add_argument("--sim-jobs", type=int, default=20)
@@ -237,6 +243,14 @@ def main(argv=None) -> int:
 
         print(f"kube-arbitrator-tpu {__version__}")
         return 0
+
+    if args.sanitize:
+        # must land before any module constructs its locks: every plane
+        # built below (pool, fleet, obs, audit, ...) asks the factories
+        # in utils/locking.py at __init__ time
+        from .utils import locking
+
+        locking.force_sanitize(True)
 
     # Validate flags before any heavy import (the ops/jax import tree
     # initializes the accelerator backend; CheckOptionOrDie runs first in

@@ -59,6 +59,7 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
 
+from .utils import locking
 from .utils.flightrec import FlightRecorder
 from .utils.metrics import MetricsRegistry, metrics
 from .utils.profiling import KernelProfiler, profiler
@@ -324,6 +325,19 @@ def serve_obs(
     server.obs_pool = pool  # type: ignore[attr-defined]
     server.obs_fleet = fleet  # type: ignore[attr-defined]
     server.obs_replica_id = replica_id  # type: ignore[attr-defined]
+    if locking.sanitize_enabled():
+        # the obs_* wiring is written once, here, before the serve thread
+        # starts; handler threads only read it.  Single-writer mode turns
+        # any later rebind from a handler into a sanitizer finding.
+        locking.register_guarded(
+            None, server,
+            (
+                "obs_registry", "obs_flight", "obs_tracer",
+                "obs_status_fn", "obs_profiler", "obs_timeseries",
+                "obs_audit", "obs_pool", "obs_fleet", "obs_replica_id",
+            ),
+            name="ObsServer",
+        )
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     return server, thread, f"http://{host}:{server.server_address[1]}"

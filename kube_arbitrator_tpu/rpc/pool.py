@@ -65,6 +65,7 @@ import numpy as np
 
 from ..ops.cycle import schedule_cycle
 from ..utils.metrics import MetricsRegistry, metrics
+from ..utils import locking
 
 # pool admission: one (long, short, threshold) burn-window pair scaled to
 # a ~1 s cycle cadence — the long window proves the overload is
@@ -178,7 +179,7 @@ class PoolReplica:
     def __init__(self, index: int):
         self.index = index
         self.id = f"r{index}"
-        self._lock = threading.Lock()
+        self._lock = locking.Lock("pool.replica.lock")
         # tenant -> (epoch key or None, resident SnapshotTensors)
         self._packs: Dict[str, Tuple[Optional[str], object]] = {}
         self.inflight = 0
@@ -312,7 +313,7 @@ class TenantAdmission:
         self.windows = tuple(windows)
         self.min_samples = min_samples
         self.now = now_fn or time.time
-        self._lock = threading.Lock()
+        self._lock = locking.Lock("pool.admission.lock")
         self._rings: Dict[str, object] = {}
         self._monitors: Dict[str, object] = {}
 
@@ -399,7 +400,7 @@ class DecisionPool:
         # nothing
         self.fleet = fleet
         self.cycle = 0
-        self._lock = threading.Lock()
+        self._lock = locking.Lock("pool.lock")
         self._seq: Dict[str, int] = {}
         # config object -> (config ref, dumped YAML); see _conf_yaml
         self._confs: Dict[int, Tuple[object, str]] = {}
@@ -420,9 +421,34 @@ class DecisionPool:
         self._warm_buckets: set = set()
         self._stop = False
         self._queue: List[PoolRequest] = []
-        self._cond = threading.Condition(self._lock)
+        self._cond = locking.Condition(self._lock)
         self._dispatcher: Optional[threading.Thread] = None
         self._workers: Optional[List[ThreadPoolExecutor]] = None
+        if locking.sanitize_enabled():
+            # sanitizer witness: every field below is written only under
+            # self._lock (held directly or via self._cond, same mutex);
+            # NOT self.cycle — begin_cycle rebinds it bare by design
+            # (single-writer from the driving thread)
+            locking.register_guarded(
+                self._lock, self,
+                (
+                    "_seq", "_confs", "_partitions", "decision_log",
+                    "shed_log", "_rr", "_batch_seq", "_warm_buckets",
+                    "_stop", "_queue",
+                ),
+                name="DecisionPool",
+            )
+            for r in self.replicas:
+                # inflight is accounted under the POOL's lock (serve
+                # grouping); the replica's own lock guards its pack cache
+                locking.register_guarded(
+                    self._lock, r, ("inflight",), name=f"PoolReplica[{r.id}]"
+                )
+                locking.register_guarded(
+                    r._lock, r,
+                    ("_packs", "restarts", "cycles_served"),
+                    name=f"PoolReplica[{r.id}]",
+                )
         if threaded:
             self._workers = [
                 ThreadPoolExecutor(

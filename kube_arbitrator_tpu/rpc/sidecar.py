@@ -34,6 +34,7 @@ from .codec import (
     unpack_fields,
     unpack_tensors,
 )
+from ..utils import locking
 
 log = logging.getLogger(__name__)
 
@@ -62,7 +63,7 @@ class DecisionService:
         # every access takes _lock (KAT-LCK discipline: the lock guards
         # ONLY dict/int ops — the blocking schedule_cycle/block_until_ready
         # work stays outside the critical section)
-        self._lock = threading.Lock()
+        self._lock = locking.Lock("sidecar.lock")
         # injectable decide seam: the chaos plane / tests substitute a
         # fault-wrapped decider so the client's retry path runs against a
         # REAL gRPC server failing on schedule (None = LocalDecider)

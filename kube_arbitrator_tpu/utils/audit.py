@@ -55,6 +55,7 @@ from typing import Callable, Deque, Dict, List, Optional
 import numpy as np
 
 from .metrics import MetricsRegistry, metrics
+from . import locking
 
 #: Bump when a field of the serialized record changes meaning or type.
 AUDIT_SCHEMA_VERSION = 1
@@ -423,7 +424,7 @@ class AuditLog:
         self.now = now_fn or time.time
         self.metric_queues = metric_queues
         self.drop_first_edge = False
-        self._lock = threading.Lock()
+        self._lock = locking.Lock("audit.lock")
         self._ring: Deque[AuditRecord] = collections.deque(maxlen=capacity)
         self._last_progress: Dict[str, float] = {}
         self._starving: set = set()

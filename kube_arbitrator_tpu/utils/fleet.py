@@ -67,6 +67,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from .metrics import MetricsRegistry, metrics
 from .timeseries import BurnPairMonitor, TimeSeriesRing
+from . import locking
 
 #: Bump when a served window/tenant-row field changes meaning or type.
 FLEET_SCHEMA_VERSION = 1
@@ -289,7 +290,7 @@ class FleetPlane:
         self.starvation_slo_s = starvation_slo_s
         self.now = now_fn or time.time
         self.drop_tenant_rows = False
-        self._lock = threading.Lock()
+        self._lock = locking.Lock("fleet.lock")
         # tenant -> latest audit record dict observed this window
         self._records: Dict[str, dict] = {}
         # tenant -> {outcome: count} accumulated this window
@@ -310,6 +311,19 @@ class FleetPlane:
         self.batch_ring = TimeSeriesRing(
             capacity=batch_ring_capacity, now_fn=self.now
         )
+        if locking.sanitize_enabled():
+            # every ledger field mutates under self._lock (observe_*,
+            # close_window, _starvation); the sanitizer flags any bare
+            # write a future refactor introduces
+            locking.register_guarded(
+                self._lock, self,
+                (
+                    "_records", "_outcomes", "_fresh", "_idle",
+                    "_batch_agg", "_windows", "_window_seq",
+                    "_last_progress", "_starving",
+                ),
+                name="FleetPlane",
+            )
 
     # ---- metrics ----
 

@@ -32,6 +32,7 @@ import time
 from typing import Deque, Dict, List, Optional
 
 from .metrics import metrics
+from . import locking
 
 DUMP_FORMAT_VERSION = 1
 
@@ -63,7 +64,7 @@ class FlightRecorder:
     def __init__(self, capacity: int = 64, dump_dir: Optional[str] = None):
         self.capacity = capacity
         self.dump_dir = dump_dir
-        self._lock = threading.Lock()
+        self._lock = locking.Lock("flightrec.lock")
         self._ring: Deque[CycleRecord] = collections.deque(maxlen=capacity)
         self._dump_seq = 0
         if dump_dir:

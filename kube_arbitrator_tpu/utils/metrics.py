@@ -23,6 +23,7 @@ import dataclasses
 import math
 import threading
 from typing import Dict, List, Optional, Tuple
+from . import locking
 
 # One table for every family's # HELP text (kube-scheduler naming
 # conventions).  New families register here, not at the observation site.
@@ -176,7 +177,7 @@ class MetricsRegistry:
 
     def __init__(self, namespace: str = "kube_arbitrator_tpu"):
         self.namespace = namespace
-        self._lock = threading.Lock()
+        self._lock = locking.Lock("metrics.lock")
         self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
         self._gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
         self._hists: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], Histogram] = {}

@@ -46,6 +46,7 @@ import contextlib
 import threading
 import time
 from typing import Callable, Dict, Optional
+from . import locking
 
 _tls = threading.local()
 
@@ -144,7 +145,7 @@ class KernelProfiler:
     def __init__(self, now_fn: Optional[Callable[[], float]] = None):
         self.enabled = False
         self.now: Callable[[], float] = now_fn or time.time
-        self._lock = threading.Lock()
+        self._lock = locking.Lock("profiling.lock")
         # (shape_key, stage) -> measured aggregate
         self._measured: Dict[tuple, Dict[str, float]] = {}
         # (shape_key, stage) -> {"flops": .., "bytes_accessed": ..} | {"error": ..}

@@ -36,6 +36,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .metrics import MetricsRegistry, metrics
+from . import locking
 
 # (long_s, short_s, burn_threshold) pairs, fastest-burn first.  Scaled
 # for a ~1 s cycle cadence: the fast pair catches an acute stall inside
@@ -54,7 +55,7 @@ class TimeSeriesRing:
                  now_fn: Optional[Callable[[], float]] = None):
         self.capacity = capacity
         self.now: Callable[[], float] = now_fn or time.time
-        self._lock = threading.Lock()
+        self._lock = locking.Lock("timeseries.ring.lock")
         self._ring = collections.deque(maxlen=capacity)
 
     def sample(self, values: Dict[str, float],

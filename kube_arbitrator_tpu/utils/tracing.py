@@ -33,6 +33,7 @@ import threading
 import time
 import uuid
 from typing import Dict, Iterator, List, Optional
+from . import locking
 
 
 @dataclasses.dataclass
@@ -77,7 +78,7 @@ class Tracer:
         # tracing stay on at 50k-task scale where per-cycle span trees
         # would otherwise dominate the obs overhead.
         self.sample_rate = sample_rate
-        self._lock = threading.Lock()
+        self._lock = locking.Lock("tracing.lock")
         # corr id -> completed spans, insertion-ordered for eviction
         self._traces: Dict[str, List[Span]] = {}
         # corr id -> linked corr ids (e.g. a tenant cycle -> the shared

@@ -465,6 +465,142 @@ def test_lck_skips_test_files(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# KAT-LCK-ORDER / KAT-LCK-BLOCK — the project-wide lock-order graph
+
+
+def lock_graph_run(tmp_path, sources):
+    from kube_arbitrator_tpu.analysis.core import load_project
+    from kube_arbitrator_tpu.analysis.rules.lockorder import lock_order_findings
+
+    for name, src in sources.items():
+        (tmp_path / name).write_text(textwrap.dedent(src))
+    return lock_order_findings(load_project([str(tmp_path)]))
+
+
+CYCLE_FWD = """
+    from kube_arbitrator_tpu.utils import locking
+
+    LOCK_A = locking.Lock("fix.a")
+    LOCK_B = locking.Lock("fix.b")
+
+    def forward():
+        with LOCK_A:
+            with LOCK_B:
+                pass
+"""
+
+
+def test_lck_order_flags_cross_module_cycle(tmp_path):
+    findings = lock_graph_run(tmp_path, {
+        "m1.py": CYCLE_FWD,
+        "m2.py": """
+            from m1 import LOCK_A, LOCK_B
+
+            def backward():
+                with LOCK_B:
+                    with LOCK_A:
+                        pass
+        """,
+    })
+    assert rule_ids(findings) == {"KAT-LCK-ORDER"}
+    assert len(findings) == 1 and findings[0].severity == "error"
+    # the join-key names and both hop sites appear in the message
+    assert "fix.a" in findings[0].message and "fix.b" in findings[0].message
+    assert "m1.py" in findings[0].message and "m2.py" in findings[0].message
+
+
+def test_lck_order_consistent_global_order_is_clean(tmp_path):
+    findings = lock_graph_run(tmp_path, {
+        "m1.py": CYCLE_FWD,
+        "m2.py": """
+            from m1 import LOCK_A, LOCK_B
+
+            def also_forward():
+                with LOCK_A:
+                    with LOCK_B:
+                        pass
+        """,
+    })
+    assert findings == []
+
+
+def test_lck_block_flags_queue_wait_under_lock(tmp_path):
+    findings = lock_graph_run(tmp_path, {
+        "w.py": """
+            import queue
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.inbox = queue.Queue()
+
+                def drain(self, fut):
+                    with self._lock:
+                        item = self.inbox.get()     # parks under the lock
+                        return fut.result(), item   # so does the future
+        """,
+    })
+    assert rule_ids(findings) == {"KAT-LCK-BLOCK"}
+    assert len(findings) == 2
+    assert all(f.severity == "warning" for f in findings)
+    assert any("`get`" in f.message for f in findings)
+
+
+def test_lck_block_condition_wait_on_held_lock_is_exempt(tmp_path):
+    findings = lock_graph_run(tmp_path, {
+        "g.py": """
+            import threading
+
+            class Gate:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cond = threading.Condition(self._lock)
+
+                def await_ready(self):
+                    with self._cond:
+                        self._cond.wait()   # releases the held lock: fine
+        """,
+    })
+    assert findings == []
+
+
+def test_lck_order_cli_gate(tmp_path):
+    (tmp_path / "m1.py").write_text(textwrap.dedent(CYCLE_FWD))
+    (tmp_path / "m2.py").write_text(textwrap.dedent("""
+        from m1 import LOCK_A, LOCK_B
+
+        def backward():
+            with LOCK_B:
+                with LOCK_A:
+                    pass
+    """))
+    r = subprocess.run(
+        [sys.executable, "-m", "kube_arbitrator_tpu.analysis",
+         "--no-cache", "--rules", "KAT-LCK", str(tmp_path)],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "KAT-LCK-ORDER" in r.stdout
+
+
+def test_real_tree_lock_graph_has_named_nodes_and_no_cycles():
+    from kube_arbitrator_tpu.analysis.core import load_project
+    from kube_arbitrator_tpu.analysis.rules.lockorder import (
+        build_lock_graph, lock_order_findings,
+    )
+
+    project = load_project([str(REPO / "kube_arbitrator_tpu")])
+    graph = build_lock_graph(project)
+    # the literal names are the join key with the runtime witness
+    for name in ("pool.lock", "fleet.lock", "httpapi.api_lock"):
+        assert name in graph.nodes, sorted(graph.nodes)
+    orders = [f for f in lock_order_findings(project)
+              if f.rule == "KAT-LCK-ORDER"]
+    assert orders == [], "\n".join(f.format() for f in orders)
+
+
+# ---------------------------------------------------------------------------
 # integration: the real tree is clean, and the CLI contract holds
 
 
@@ -607,6 +743,48 @@ def test_fingerprint_stable_across_line_shifts():
     assert a.fingerprint() != c.fingerprint()  # different offender
 
 
+LCK_FIXTURE = (
+    "import threading\n"
+    "\n"
+    "class Service:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self.count = 0\n"
+    "\n"
+    "    def bump(self):\n"
+    "        with self._lock:\n"
+    "            self.count += 1\n"
+    "\n"
+    "    def peek(self):\n"
+    "        return self.count\n"
+)
+
+
+def test_fingerprint_survives_line_shift_in_real_findings(tmp_path):
+    """End-to-end over real analyzer output: prepending unrelated lines
+    moves the finding but keeps its baseline identity; renaming the
+    offending field mints a new one."""
+    f1 = run_on(tmp_path, "svc.py", LCK_FIXTURE)
+    f2 = run_on(tmp_path, "svc.py", "# pad\n# pad\n# pad\n" + LCK_FIXTURE)
+    assert rule_ids(f1) == rule_ids(f2) == {"KAT-LCK-001"}
+    assert f2[0].line == f1[0].line + 3
+    assert f1[0].fingerprint() == f2[0].fingerprint()
+
+    f3 = run_on(tmp_path, "svc.py", LCK_FIXTURE.replace("count", "total"))
+    assert rule_ids(f3) == {"KAT-LCK-001"}
+    assert f3[0].fingerprint() != f1[0].fingerprint()
+
+
+def test_fingerprint_redacts_embedded_line_references():
+    from kube_arbitrator_tpu.analysis.core import Finding
+
+    a = Finding("KAT-X", "error", "m.py", 1, "bad thing near line 7 here")
+    b = Finding("KAT-X", "error", "m.py", 4, "bad thing near line 99 here")
+    assert a.fingerprint() == b.fingerprint()  # `line <n>` redaction
+    c = Finding("KAT-X", "error", "other.py", 1, "bad thing near line 7 here")
+    assert a.fingerprint() != c.fingerprint()  # path still participates
+
+
 def test_baseline_tolerates_hand_edited_entries(tmp_path):
     import json
 
@@ -618,6 +796,69 @@ def test_baseline_tolerates_hand_edited_entries(tmp_path):
         "suppressions": {"aa": 2, "bb": {"count": 3}, "cc": {"count": "x"}},
     }))
     assert load_baseline(str(p)) == {"aa": 2, "bb": 3, "cc": 1}
+
+
+DRF_BAD = (
+    "def decide(st, schedule_cycle):\n"
+    "    return schedule_cycle(st, native_ops=True)\n"
+)
+
+
+def _kat_lint(cwd, *extra):
+    import os
+
+    # cwd controls the git resolution under test; the package itself is
+    # imported from the checkout
+    env = dict(os.environ, PYTHONPATH=str(REPO))
+    return subprocess.run(
+        [sys.executable, "-m", "kube_arbitrator_tpu.analysis", "--no-cache",
+         *extra],
+        cwd=cwd, capture_output=True, text=True, env=env,
+    )
+
+
+def test_cli_changed_only_restricts_scope(tmp_path):
+    def git(*a):
+        subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t", *a],
+            cwd=tmp_path, check=True, capture_output=True,
+        )
+
+    git("init", "-q", "-b", "main")
+    (tmp_path / "bad.py").write_text(DRF_BAD)   # committed violation
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    git("add", ".")
+    git("commit", "-q", "-m", "base")
+
+    # nothing changed: clean exit without analyzing anything
+    r = _kat_lint(tmp_path, "--changed-only", str(tmp_path))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "no changed python files" in r.stdout
+
+    # a clean working-tree edit: only ok.py is in scope, so the committed
+    # violation in bad.py does not gate the fast path
+    (tmp_path / "ok.py").write_text("x = 2\n")
+    r = _kat_lint(tmp_path, "--changed-only", str(tmp_path))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "changed-only: 1 file(s)" in r.stdout
+    r_full = _kat_lint(tmp_path, str(tmp_path))
+    assert r_full.returncode == 1  # the full gate still sees bad.py
+
+    # an untracked new violation IS in the changed set
+    (tmp_path / "new.py").write_text(DRF_BAD)
+    r = _kat_lint(tmp_path, "--changed-only", str(tmp_path))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "new.py" in r.stdout and "bad.py" not in r.stdout
+
+
+def test_cli_changed_only_falls_back_without_git(tmp_path):
+    (tmp_path / "bad.py").write_text(DRF_BAD)
+    # cwd is the non-repo tmp dir, so git resolution fails and the flag
+    # degrades to the full tree instead of silently linting nothing
+    r = _kat_lint(tmp_path, "--changed-only", str(tmp_path))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "git unavailable, full tree" in r.stdout
+    assert "KAT-DRF-002" in r.stdout
 
 
 def test_cli_json_conflicts_with_other_format(tmp_path):
@@ -659,6 +900,108 @@ def test_cache_roundtrip_and_invalidation(tmp_path):
     _, third = analyze_paths([str(src)], ALL_RULES, cache=cache3, context_fp="fp2")
     assert cache3.misses == 1
     assert {f.rule for f in third} == {"KAT-TRC-001"}
+
+
+def test_cache_content_key_defeats_stat_preserving_rewrite(tmp_path):
+    """The v2 staleness fix: a rewrite that preserves BOTH size and mtime
+    (editor atomic replace + utime) must still invalidate, because the
+    key is a content hash, not the stat triple."""
+    import os
+
+    from kube_arbitrator_tpu.analysis.cache import AnalysisCache
+
+    bad = (
+        "import jax\nimport jax.numpy as jnp\n\n"
+        "@jax.jit\ndef kern(x):\n"
+        "    if jnp.sum(x) > 0:\n        x = x + 1\n    return x\n"
+    )
+    ok = bad.replace("jnp.sum(x)", "notracedv0")  # same byte length
+    assert len(ok) == len(bad)
+    src = tmp_path / "kern.py"
+    src.write_text(bad)
+    cache = AnalysisCache(str(tmp_path / "cache"))
+    _, f1 = analyze_paths([str(src)], ALL_RULES, cache=cache, context_fp="fp")
+    assert rule_ids(f1) == {"KAT-TRC-001"}
+    cache.flush()
+
+    st = os.stat(src)
+    src.write_text(ok)
+    os.utime(src, ns=(st.st_atime_ns, st.st_mtime_ns))  # stat pair identical
+    cache2 = AnalysisCache(str(tmp_path / "cache"))
+    _, f2 = analyze_paths([str(src)], ALL_RULES, cache=cache2, context_fp="fp")
+    assert cache2.hits == 0 and cache2.misses == 1
+    assert f2 == []
+
+
+def test_cache_kernel_registration_invalidates_other_module(tmp_path):
+    """ACTION_KERNELS context is folded into every per-file key: a new
+    registration in module A legitimately changes module B's verdict."""
+    from kube_arbitrator_tpu.analysis.cache import AnalysisCache
+
+    helper = tmp_path / "helper.py"
+    helper.write_text(
+        "import jax.numpy as jnp\n\n"
+        "def my_action(st):\n"
+        "    while jnp.any(st > 0):\n        st = st - 1\n    return st\n"
+    )
+    reg = tmp_path / "reg.py"
+    reg.write_text("X = 1\n")
+    paths = [str(helper), str(reg)]
+
+    cache = AnalysisCache(str(tmp_path / "cache"))
+    _, first = analyze_paths(paths, ALL_RULES, cache=cache, context_fp="fp")
+    assert first == []  # unregistered helper is not kernel context
+    cache.flush()
+
+    reg.write_text('ACTION_KERNELS = {"my": my_action}\n')
+    cache2 = AnalysisCache(str(tmp_path / "cache"))
+    _, second = analyze_paths(paths, ALL_RULES, cache=cache2, context_fp="fp")
+    assert cache2.hits == 0  # helper.py unchanged on disk, still a miss
+    assert rule_ids(second) == {"KAT-TRC-001"}
+
+
+def test_cache_corrupt_and_version_mismatch_discarded(tmp_path):
+    import json
+    import os
+
+    from kube_arbitrator_tpu.analysis.cache import AnalysisCache
+
+    src = tmp_path / "ok.py"
+    src.write_text("x = 1\n")
+    cdir = tmp_path / "cache"
+
+    os.makedirs(cdir)
+    (cdir / "findings.json").write_text("{not json")
+    cache = AnalysisCache(str(cdir))
+    _, findings = analyze_paths([str(src)], ALL_RULES, cache=cache, context_fp="fp")
+    assert findings == [] and cache.hits == 0 and cache.misses == 1
+    cache.flush()
+
+    # a version bump must miss wholesale, never serve old-format entries
+    data = json.loads((cdir / "findings.json").read_text())
+    data["version"] = 999
+    (cdir / "findings.json").write_text(json.dumps(data))
+    cache2 = AnalysisCache(str(cdir))
+    _, findings = analyze_paths([str(src)], ALL_RULES, cache=cache2, context_fp="fp")
+    assert findings == [] and cache2.hits == 0 and cache2.misses == 1
+
+
+def test_ruleset_fingerprint_tracks_rule_source_edits():
+    import os
+
+    import kube_arbitrator_tpu.analysis.rules.locks as locks_mod
+    from kube_arbitrator_tpu.analysis.cache import ruleset_fingerprint
+
+    fp1 = ruleset_fingerprint(["KAT-LCK"])
+    assert ruleset_fingerprint(["KAT-DTY"]) != fp1  # family selection counts
+    p = locks_mod.__file__
+    st = os.stat(p)
+    try:
+        os.utime(p, ns=(st.st_atime_ns, st.st_mtime_ns + 1))
+        assert ruleset_fingerprint(["KAT-LCK"]) != fp1  # rule edit counts
+    finally:
+        os.utime(p, ns=(st.st_atime_ns, st.st_mtime_ns))
+    assert ruleset_fingerprint(["KAT-LCK"]) == fp1
 
 
 # ---------------------------------------------------------------------------
