@@ -519,6 +519,20 @@ if [ "${PERF_SENTINEL:-0}" = "1" ]; then
 fi
 
 if [ "${LINT_ONLY:-0}" = "1" ]; then
+  # The fast lane names the effects family in its own job line: a
+  # budget regression (hot-loop allocation, undeclared sync, blocked
+  # role, neutrality taint) should read as "effects pass: FAILED", not
+  # disappear into the aggregate lint exit.  The default gate above
+  # already runs KAT-EFF inside ALL rules, so this re-run is warm-cache.
+  rc_eff=0
+  python -m kube_arbitrator_tpu.analysis --rules KAT-EFF \
+    kube_arbitrator_tpu tests || rc_eff=$?
+  if [ "${rc_eff}" -ne 0 ]; then
+    echo "effects pass: FAILED (exit ${rc_eff})" >&2
+  else
+    echo "effects pass: ok"
+  fi
+  if [ "${rc_eff}" -ne 0 ]; then exit "${rc_eff}"; fi
   if [ "${rc_lint}" -ne 0 ]; then exit "${rc_lint}"; fi
   if [ "${rc_obs}" -ne 0 ]; then exit "${rc_obs}"; fi
   if [ "${rc_arena}" -ne 0 ]; then exit "${rc_arena}"; fi

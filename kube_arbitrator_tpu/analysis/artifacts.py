@@ -1,0 +1,40 @@
+"""One anchor for analyzer artifact paths.
+
+The findings cache (``.kat-cache/``) and the sanitizer reconciliation
+dumps both default to relative paths.  Resolved lazily against
+``os.getcwd()``, a library caller that chdirs between constructing an
+``AnalysisCache`` and flushing it (pytest's tmp-path fixtures, the
+deploy lanes that cd per-step) scatters artifacts across directories —
+the cache never warms and the dumps land wherever the process happened
+to sit.  Every relative artifact path therefore resolves HERE, against
+one anchor captured once:
+
+* ``KAT_ARTIFACT_ROOT`` (checked per call, so tests and CI lanes can
+  redirect without re-importing), else
+* the process CWD at first import of the analysis package — stable for
+  a whole run no matter who chdirs afterwards.
+
+Absolute paths pass through untouched; explicit ``--cache-dir /x/y``
+behaves exactly as typed.
+"""
+from __future__ import annotations
+
+import os
+
+#: CWD at import time — the "invocation root" every relative artifact
+#: path is anchored to for the life of the process.
+_IMPORT_CWD = os.getcwd()
+
+ENV_VAR = "KAT_ARTIFACT_ROOT"
+
+
+def root() -> str:
+    """Current artifact anchor (env override, else the import-time CWD)."""
+    return os.environ.get(ENV_VAR) or _IMPORT_CWD
+
+
+def resolve(path: str) -> str:
+    """Anchor a relative artifact path; pass absolute paths through."""
+    if os.path.isabs(path):
+        return path
+    return os.path.join(root(), path)

@@ -36,6 +36,7 @@ import json
 import os
 from typing import Dict, Iterable, List, Optional, Sequence
 
+from . import artifacts
 from .core import Finding
 
 # v2: per-file keys switched from stat triples to content hashes (the
@@ -93,7 +94,9 @@ class AnalysisCache:
     a no-op so call sites need no branches."""
 
     def __init__(self, cache_dir: str = ".kat-cache", enabled: bool = True):
-        self.dir = cache_dir
+        # anchor relative dirs at the invocation root, not whatever CWD
+        # the caller happens to be in at flush time (artifacts.resolve)
+        self.dir = artifacts.resolve(cache_dir)
         self.enabled = enabled
         self.hits = 0
         self.misses = 0

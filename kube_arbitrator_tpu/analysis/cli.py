@@ -168,6 +168,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "--list-rules", action="store_true", help="print rule families and exit"
     )
     ap.add_argument(
+        "--explain", metavar="RULE", default=None,
+        help="print a rule's rationale and fix pattern (e.g. KAT-EFF-001) "
+        "and exit",
+    )
+    ap.add_argument(
         "--no-contracts", action="store_true",
         help="skip the eval_shape contract pass even when the pipeline is "
         "in scope (it needs an importable jax)",
@@ -199,6 +204,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.json and args.format not in (None, "json"):
         ap.error(f"--json conflicts with --format {args.format}")
     out_format = "json" if args.json else (args.format or "text")
+
+    if args.explain:
+        import textwrap
+
+        from .effects import RULE_DOCS
+
+        rule_id = args.explain.upper()
+        doc = RULE_DOCS.get(rule_id)
+        if doc is None:
+            print(
+                f"no explanation recorded for {args.explain} "
+                f"(documented: {', '.join(sorted(RULE_DOCS))})",
+                file=sys.stderr,
+            )
+            return 2
+        wrap = lambda s: textwrap.fill(s, width=78, initial_indent="  ",
+                                       subsequent_indent="  ")
+        print(f"{rule_id} — {doc['title']}\n")
+        print("Why:")
+        print(wrap(doc["rationale"]) + "\n")
+        print("Fix pattern:")
+        print(wrap(doc["fix"]))
+        return 0
 
     if args.list_rules:
         for r in ALL_RULES:

@@ -1037,6 +1037,172 @@ def check_shard_layout(
     return findings
 
 
+#: Consumer modules on the by-name reply-pack path: everything that
+#: reads ``CycleDecisions`` fields back out after the codec round-trip
+#: (or would, on the local path).  Package-relative.
+WIRE_CONSUMER_MODULES: Tuple[str, ...] = (
+    "cache/decode.py",
+    "cache/persist.py",
+    "framework/decider.py",
+    "framework/session.py",
+    "ops/diagnostics.py",
+    "parallel/shard.py",
+    "utils/audit.py",
+)
+
+#: Receiver variable names under which consumers hold a CycleDecisions.
+_WIRE_RECEIVERS = frozenset({"dec", "decisions"})
+
+#: Fields whose dedicated decoder must read them (not merely *someone*):
+#: a rename that only breaks the audit plane or the compact decode still
+#: names the module that went blind.
+WIRE_PLANE_CONSUMERS: Dict[str, str] = {
+    **{name: "utils/audit.py" for name in AUDIT_AUX_SCHEMA},
+    **{name: "cache/decode.py" for name in DECODE_LISTS_SCHEMA},
+}
+
+#: Exported fields deliberately without a by-name consumer (none today:
+#: unready_alloc's consumer is ops/diagnostics.py's unplaced mask).
+WIRE_UNCONSUMED_OK: Tuple[str, ...] = ()
+
+
+def _scan_wire_reads() -> Dict[str, Dict[str, int]]:
+    """field -> {consumer module (package-relative) -> first read line}.
+
+    A "read" is a direct attribute load on a receiver named ``dec`` /
+    ``decisions`` (``dec.evict_round``) or a string-literal
+    ``getattr(dec, "evict_round", ...)``.  Generic by-name loops
+    (``getattr(dec, name)`` over a schema) are invisible on purpose:
+    they track ANY rename and so witness nothing about a specific one.
+    """
+    import ast
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out: Dict[str, Dict[str, int]] = {}
+    for rel in WIRE_CONSUMER_MODULES:
+        path = os.path.join(pkg_root, *rel.split("/"))
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                tree = ast.parse(fh.read())
+        except (OSError, SyntaxError):
+            continue
+        for node in ast.walk(tree):
+            attr = None
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in _WIRE_RECEIVERS
+            ):
+                attr = node.attr
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "getattr"
+                and len(node.args) >= 2
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id in _WIRE_RECEIVERS
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)
+            ):
+                attr = node.args[1].value
+            if attr is not None:
+                out.setdefault(attr, {}).setdefault(rel, node.lineno)
+    return out
+
+
+def check_wire_names(
+    field_names: Optional[Tuple[str, ...]] = None,
+    consumer_reads: Optional[Dict[str, Dict[str, int]]] = None,
+) -> List[Finding]:
+    """KAT-CTR-013: wire-name drift.  ``rpc/codec.py`` serializes every
+    ``CycleDecisions`` field generically BY NAME and every consumer
+    reads it back by the same name — so a one-sided rename never errors,
+    it just drops the data (the consumer's getattr default / the codec's
+    unknown-field skip).  Three static obligations close the hole:
+
+    * the dataclass's field set and :data:`DECISIONS_SCHEMA` agree in
+      both directions (the schema is what the codec/contract plane
+      believes the wire carries);
+    * every exported field has a same-named consumer read somewhere on
+      the reply-pack path (:data:`WIRE_CONSUMER_MODULES`), and the
+      plane-owned fields specifically in their dedicated decoder
+      (:data:`WIRE_PLANE_CONSUMERS`);
+    * every literal field read on a consumer's ``dec``/``decisions``
+      receiver names a real field (the consumer-side rename direction).
+
+    ``field_names`` / ``consumer_reads`` seed mutations for the
+    regression tests (a producer-side and a consumer-side rename each
+    must be reported, and only as KAT-CTR-013)."""
+    from ..ops.cycle import CycleDecisions
+
+    produced: Tuple[str, ...] = field_names if field_names is not None else tuple(
+        f.name for f in dataclasses.fields(CycleDecisions)
+    )
+    reads = consumer_reads if consumer_reads is not None else _scan_wire_reads()
+    path, line = _anchor(CycleDecisions)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings: List[Finding] = []
+    schema_names = set(DECISIONS_SCHEMA)
+    for name in produced:
+        if name not in schema_names:
+            findings.append(Finding(
+                "KAT-CTR-013", "error", path, line,
+                f"CycleDecisions exports `{name}` but DECISIONS_SCHEMA "
+                "does not declare it — the codec will ship bytes the "
+                "contract plane never checks",
+                hint="declare the field in DECISIONS_SCHEMA (or the "
+                "owning sub-schema) or remove it from the dataclass",
+            ))
+    for name in schema_names:
+        if name not in produced:
+            findings.append(Finding(
+                "KAT-CTR-013", "error", path, line,
+                f"DECISIONS_SCHEMA declares `{name}` but CycleDecisions "
+                "no longer exports it — consumers of that name now read "
+                "their getattr default forever",
+                hint="a producer-side rename must rename the schema key "
+                "and every consumer read in the same change",
+            ))
+    for name in produced:
+        if name not in schema_names or name in WIRE_UNCONSUMED_OK:
+            continue
+        where = reads.get(name, {})
+        if not where:
+            findings.append(Finding(
+                "KAT-CTR-013", "error", path, line,
+                f"CycleDecisions field `{name}` has NO by-name consumer "
+                "on the reply-pack path — a rename (or a dead field) "
+                "ships bytes nothing reads",
+                hint="wire a consumer (or list the field in "
+                "WIRE_UNCONSUMED_OK with a rationale)",
+            ))
+            continue
+        plane = WIRE_PLANE_CONSUMERS.get(name)
+        if plane is not None and plane not in where:
+            findings.append(Finding(
+                "KAT-CTR-013", "error", path, line,
+                f"`{name}` is owned by {plane} but that module never "
+                "reads it by name — its plane went blind while "
+                f"{sorted(where)} still see the field",
+                hint="the plane's decoder must consume its own fields; "
+                "update WIRE_PLANE_CONSUMERS only if ownership moved",
+            ))
+    known = set(produced) | schema_names
+    for attr, where in sorted(reads.items()):
+        if attr in known:
+            continue
+        rel_mod, rline = sorted(where.items())[0]
+        findings.append(Finding(
+            "KAT-CTR-013", "error",
+            _rel(os.path.join(pkg_root, *rel_mod.split("/"))), rline,
+            f"consumer reads `{attr}` off a CycleDecisions receiver but "
+            "the dataclass exports no such field — a consumer-side "
+            "rename now reads nothing",
+            hint="match the consumer's read to the exported field name",
+        ))
+    return findings
+
+
 def check_contracts(
     schema: Optional[Mapping[str, Tuple[Tuple[str, ...], str]]] = None,
     state_schema: Optional[Mapping[str, Tuple[Tuple[str, ...], str]]] = None,
@@ -1056,5 +1222,6 @@ def check_contracts(
     findings += check_audit_aux(schema)
     findings += check_decode_lists(schema)
     findings += check_shard_layout(schema)
+    findings += check_wire_names()
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings

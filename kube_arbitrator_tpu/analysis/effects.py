@@ -1,0 +1,1013 @@
+"""KAT-EFF — interprocedural effect budgets for the hot path.
+
+ROADMAP item 5 names the host-Python floors the perf PRs keep re-digging
+by hand: per-object construction loops in actuation, per-event dict
+handling in ingest, stray device→host syncs in the decide/decode seam.
+Gavel-style policy evaluation (arxiv 2008.09213) only stays cheap if the
+per-cycle host path stays O(1)-ish in task count — so this module makes
+that a *statically checked property*: every first-party function gets an
+**effect summary** (hot loops over T/N/J-scale iterables, object
+construction inside them, device→host sync points, blocking calls, lock
+acquisitions, appends to module-level containers), summaries propagate
+one level along the same-module call graph (a helper's constructions
+count against the stage that calls it, with call-site attribution and
+argument→parameter scale propagation), and a **budget registry**
+declares what each pipeline stage and thread role may do.
+
+Scale ("hot") evidence is syntactic, in the repo's own idiom — presence
+is near-certain, absence proves nothing:
+
+* iterables produced by ``.tolist()`` / ``np.nonzero`` (and names
+  assigned from them, transitively within the function);
+* iteration over the snapshot index's scale collections
+  (``snap.index.jobs`` / ``.tasks`` / ``.nodes`` / ``.pods``) or over
+  SNAPSHOT/STATE-schema-named per-row attributes (``task_*`` etc.);
+* ``zip`` / ``enumerate`` / ``sorted`` / ``range(len(...))`` over any of
+  the above;
+* a callee parameter that a summarized call site feeds a hot value — the
+  interprocedural hop that catches
+  ``decode_decisions -> _build_intents(rows.tolist(), ...)``.
+
+Rules (reported by rules/effects.py under family ``KAT-EFF``):
+
+- ``KAT-EFF-001``: object construction (CamelCase constructor call)
+  inside a hot loop of a stage whose budget forbids per-element
+  allocation — the intent-object / status-object floor class.
+- ``KAT-EFF-002``: a device→host sync (``.item()`` / ``.tolist()`` /
+  ``np.asarray`` / ``block_until_ready`` / ``int()``/``float()`` on a
+  non-literal) inside decide/decode that the stage budget did not
+  declare.  Syncs are the *mechanism* of those stages — the budget names
+  the sanctioned ones, so a NEW sync kind is a reviewable event instead
+  of a silent stall.
+- ``KAT-EFF-003``: a blocking call (sleep / socket / RPC / device sync)
+  on a latency-critical thread role (watch ingest, decide worker, pool
+  dispatcher) *outside* any lock region.  Deliberately disjoint from
+  KAT-LCK-002, which owns blocking-under-a-lock: a site is reported by
+  exactly one of the two rules.
+- ``KAT-EFF-004``: unbounded growth — append/add/extend to a
+  module-level container from inside a hot loop of a stage function
+  (per-cycle leak, O(T) per cycle forever).
+- ``KAT-EFF-010``: decision-neutrality taint.  The kernels in ``ops/``
+  export observability aux (``evict_claimant``/``evict_phase``/
+  ``evict_round``, ``rounds_gated``, ``claim_conflicts``) that nothing
+  decision-bearing may read — the bit-identity invariant every engine
+  pair (sequential vs batched vs optimistic) depends on, previously
+  guaranteed only by parity soaks.  The taint pass walks kernel-context
+  dataflow: a read of a neutral field may flow ONLY back into the same
+  neutral field; reaching a different output keyword or a selection
+  primitive (argmax/argsort/...) is a violation.
+
+Summaries are pure functions of the module text + the project kernel
+context, so the per-file findings cache (``.kat-cache``) covers them;
+the ruleset fingerprint includes this module's own source, so editing a
+budget invalidates every cached verdict.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .core import (
+    Finding,
+    FunctionNode,
+    ModuleUnit,
+    Project,
+    dotted_name,
+    kernel_functions,
+)
+
+# ---------------------------------------------------------------------------
+# budget registry
+
+@dataclasses.dataclass(frozen=True)
+class Budget:
+    """What one pipeline stage / thread role may do on the hot path."""
+
+    name: str
+    kind: str  # "stage" | "role"
+    # per-element object construction in a hot loop (KAT-EFF-001)
+    allow_hot_construction: bool = True
+    # device->host syncs are audited against a declared set (KAT-EFF-002)
+    restrict_syncs: bool = False
+    declared_syncs: frozenset = frozenset()
+    # blocking calls off-limits outside lock regions (KAT-EFF-003)
+    restrict_blocking: bool = False
+
+
+#: Stage budgets.  decide/decode are the device seam: their sanctioned
+#: syncs are SPELLED (the decode IS one bounded tolist-gather; the
+#: decider blocks once to time the program honestly) so any new sync
+#: kind fails the gate until declared here — a reviewable diff, not a
+#: silent per-cycle stall.  No stage may construct per-element objects
+#: in a hot loop; exceptions live in ``.kat-baseline.json`` with their
+#: justification in the adopting commit.
+STAGE_BUDGETS: Dict[str, Budget] = {
+    "snapshot": Budget("snapshot", "stage", allow_hot_construction=False),
+    "upload": Budget("upload", "stage", allow_hot_construction=False),
+    "decide": Budget(
+        "decide", "stage", allow_hot_construction=False,
+        restrict_syncs=True,
+        declared_syncs=frozenset({"block_until_ready", "int"}),
+    ),
+    "decode": Budget(
+        "decode", "stage", allow_hot_construction=False,
+        restrict_syncs=True,
+        declared_syncs=frozenset({"tolist", "asarray", "nonzero", "int", "item"}),
+    ),
+    "close": Budget("close", "stage", allow_hot_construction=False),
+    "actuate": Budget("actuate", "stage", allow_hot_construction=False),
+    "ingest": Budget("ingest", "stage", allow_hot_construction=False),
+}
+
+ROLE_BUDGETS: Dict[str, Budget] = {
+    "ingest-thread": Budget("ingest-thread", "role", restrict_blocking=True),
+    "decide-worker": Budget("decide-worker", "role", restrict_blocking=True),
+    "pool-dispatcher": Budget("pool-dispatcher", "role", restrict_blocking=True),
+}
+
+#: qualname -> stage.  Keyed by qualified name, not file path, so the
+#: seeded-mutation fixtures (a tmp-dir module defining
+#: ``Session.decode_phase``) participate exactly like the real tree.
+STAGE_FUNCTIONS: Dict[str, str] = {
+    "Session.snapshot_phase": "snapshot",
+    "Session.upload_phase": "upload",
+    "Session.decide_phase": "decide",
+    "LocalDecider.decide": "decide",
+    "Session.decode_phase": "decode",
+    "decode_decisions": "decode",
+    "decode_decisions_compact": "decode",
+    "Session.close_phase": "close",
+    "Session._close": "close",
+    "Scheduler._actuate": "actuate",
+    "Scheduler._write_back": "actuate",
+    "LiveCache.sync": "ingest",
+    "LiveCache._dispatch": "ingest",
+}
+
+#: qualname -> thread role (KAT-EFF-003's scope: the threads whose
+#: stalls serialize the whole pipeline).
+ROLE_FUNCTIONS: Dict[str, str] = {
+    "LiveCache.sync": "ingest-thread",
+    "LiveCache._dispatch": "ingest-thread",
+    "PipelinedExecutor._decide_worker": "decide-worker",
+    "DecisionPool._dispatch_loop": "pool-dispatcher",
+    "DecisionPool._process": "pool-dispatcher",
+}
+
+#: Decision-neutral AllocState/CycleDecisions fields: pure observability
+#: outputs that must never feed back into bind/evict/score computation.
+#: ``rounds`` is NOT here — it is decision-bearing (while_loop budget).
+NEUTRAL_FIELDS = frozenset({
+    "evict_claimant", "evict_phase", "evict_round",
+    "rounds_gated", "claim_conflicts",
+})
+
+#: Selection primitives: a neutral value reaching one of these is
+#: feeding a decision by construction.
+_SELECTION_CALLS = frozenset({
+    "argmax", "argmin", "argsort", "lexsort", "top_k", "sort", "searchsorted",
+})
+
+#: Blocking leaf calls for KAT-EFF-003.  Same *notion* as
+#: rules/locks.py _BLOCKING_CALLS, but EFF-003 fires only OUTSIDE lock
+#: regions, so the two rules' finding sets are disjoint by construction.
+_BLOCKING_CALLS = frozenset({
+    "block_until_ready", "sleep", "urlopen", "serve_forever",
+    "wait_for_termination", "acquire_blocking", "send", "sendall",
+    "recv", "Decide", "check_output", "check_call",
+})
+
+#: Iterating an attribute chain ending in one of these reads as walking
+#: a snapshot-index scale collection (J/T/N rows).
+_SCALE_COLLECTION_ATTRS = frozenset({"jobs", "tasks", "nodes", "pods"})
+
+#: Per-row schema-name prefixes (SNAPSHOT/STATE schemas): iterating
+#: ``st.task_resreq`` / ``dec.task_status`` etc. is a per-row walk.
+_SCALE_ATTR_RE = re.compile(r"^(task|node|job|queue|group|bind|evict)_")
+
+_CAMEL_RE = re.compile(r"^[A-Z][a-zA-Z0-9]*$")
+
+
+def _is_constructor_name(leaf: str) -> bool:
+    """CamelCase call target = object construction (the repo's dataclass
+    / api-object idiom).  ALL_CAPS names are constants, not classes."""
+    return bool(_CAMEL_RE.match(leaf)) and not leaf.isupper()
+
+
+def _leaf(node: ast.AST) -> str:
+    dn = dotted_name(node)
+    return dn.split(".")[-1] if dn else ""
+
+
+# ---------------------------------------------------------------------------
+# per-function effect summaries
+
+
+@dataclasses.dataclass
+class CallSite:
+    line: int
+    callee: str           # bare name for module funcs, method name for self.<m>
+    is_self_method: bool
+    in_hot_loop: bool
+    hot_loop_reason: str
+    # positional index / keyword name -> True for args carrying hot values
+    hot_pos: Tuple[int, ...] = ()
+    hot_kw: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass
+class EffectSummary:
+    """Effects of ONE function, before call-graph expansion."""
+
+    qualname: str
+    node: ast.AST
+    # (line, constructor, hot-loop reason)
+    hot_constructions: List[Tuple[int, str, str]] = dataclasses.field(default_factory=list)
+    # (line, container name) — module-level container mutated in a hot loop
+    hot_module_appends: List[Tuple[int, str]] = dataclasses.field(default_factory=list)
+    # (line, sync kind)
+    syncs: List[Tuple[int, str]] = dataclasses.field(default_factory=list)
+    # (line, call leaf, under a lockish with)
+    blocking: List[Tuple[int, str, bool]] = dataclasses.field(default_factory=list)
+    # (line, lock expr) — with-acquisitions, carried for budget display
+    lock_acquisitions: List[Tuple[int, str]] = dataclasses.field(default_factory=list)
+    # every construction, hot or not (counted by callers whose CALL SITE
+    # is inside a hot loop)
+    constructions: List[Tuple[int, str]] = dataclasses.field(default_factory=list)
+    # param name -> constructions inside loops over that bare parameter
+    # (materialized when a call site feeds the param a hot value)
+    param_loop_constructions: Dict[str, List[Tuple[int, str]]] = dataclasses.field(default_factory=dict)
+    param_loop_appends: Dict[str, List[Tuple[int, str]]] = dataclasses.field(default_factory=dict)
+    calls: List[CallSite] = dataclasses.field(default_factory=list)
+
+
+def _module_containers(tree: ast.Module) -> Set[str]:
+    """Module-level names bound to a growable container literal/factory."""
+    out: Set[str] = set()
+    factories = {"list", "set", "dict", "deque", "defaultdict", "OrderedDict"}
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            value = stmt.value
+            if value is None:
+                continue
+            is_container = isinstance(value, (ast.List, ast.Set, ast.Dict)) or (
+                isinstance(value, ast.Call) and _leaf(value.func) in factories
+            )
+            if not is_container:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    names = [x.arg for x in list(a.posonlyargs) + list(a.args)]
+    return names
+
+
+class _FunctionScan:
+    """One pass over a function body building its EffectSummary.
+
+    ast.walk has no scope, so recursion is manual, carrying (a) the
+    innermost hot-loop reason, (b) whether a lockish ``with`` is held
+    (for the EFF-003 / KAT-LCK-002 disjointness split)."""
+
+    _GROWS = {"append", "add", "extend", "appendleft", "update", "setdefault"}
+
+    def __init__(
+        self,
+        qualname: str,
+        fn: ast.AST,
+        unit: ModuleUnit,
+        module_containers: Set[str],
+    ):
+        self.unit = unit
+        self.containers = module_containers
+        self.params = set(_param_names(fn))
+        self.summary = EffectSummary(qualname=qualname, node=fn)
+        self.hot_names: Set[str] = set()
+        self._prescan_hot_names(fn)
+        self._walk(fn.body, hot="", locked=False)
+
+    # -- hot-value tracking ------------------------------------------------
+
+    def _expr_is_hot_value(self, e: ast.AST) -> bool:
+        """Does this expression produce a T/N/J-scale host list/array?"""
+        for sub in ast.walk(e):
+            if isinstance(sub, ast.Call):
+                leaf = _leaf(sub.func)
+                if leaf in ("tolist", "nonzero"):
+                    return True
+            elif isinstance(sub, ast.Name) and sub.id in self.hot_names:
+                return True
+        return False
+
+    def _prescan_hot_names(self, fn: ast.AST) -> None:
+        """Fixpoint over assignments: names bound (directly or
+        transitively) to ``.tolist()`` / ``np.nonzero`` products."""
+        assigns: List[Tuple[List[ast.AST], ast.AST]] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                assigns.append((list(node.targets), node.value))
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                assigns.append(([node.target], node.value))
+        changed = True
+        while changed:
+            changed = False
+            for targets, value in assigns:
+                if not self._expr_is_hot_value(value):
+                    continue
+                for t in targets:
+                    # element-wise tuple unpack keeps taint per slot; a
+                    # blanket mark would smear one hot element over the
+                    # whole unpack
+                    if isinstance(t, (ast.Tuple, ast.List)) and isinstance(
+                        value, (ast.Tuple, ast.List)
+                    ) and len(t.elts) == len(value.elts):
+                        for te, ve in zip(t.elts, value.elts):
+                            if isinstance(te, ast.Name) and self._expr_is_hot_value(ve):
+                                if te.id not in self.hot_names:
+                                    self.hot_names.add(te.id)
+                                    changed = True
+                        continue
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name) and n.id not in self.hot_names:
+                            self.hot_names.add(n.id)
+                            changed = True
+
+    # -- hot-loop classification -------------------------------------------
+
+    def _iter_hotness(self, it: ast.AST) -> str:
+        """Why this loop iterable is scale-hot ('' = not hot)."""
+        # zip/enumerate/sorted/reversed/list over a hot thing
+        if isinstance(it, ast.Call) and _leaf(it.func) in (
+            "zip", "enumerate", "sorted", "reversed", "list",
+        ):
+            for a in it.args:
+                why = self._iter_hotness(a)
+                if why:
+                    return why
+            return ""
+        # range(len(X)) / range(X.shape[0]) over a hot or schema-named X
+        if isinstance(it, ast.Call) and _leaf(it.func) == "range":
+            for a in it.args:
+                for sub in ast.walk(a):
+                    if isinstance(sub, ast.Attribute) and sub.attr == "shape":
+                        base = sub.value
+                        if isinstance(base, ast.Attribute) and _SCALE_ATTR_RE.match(base.attr):
+                            return f"range over `{dotted_name(base)}.shape`"
+                        if isinstance(base, ast.Name) and base.id in self.hot_names:
+                            return f"range over hot `{base.id}.shape`"
+                    if isinstance(sub, ast.Call) and _leaf(sub.func) == "len":
+                        inner = sub.args[0] if sub.args else None
+                        if inner is not None and self._iter_hotness(inner):
+                            return self._iter_hotness(inner)
+            return ""
+        if isinstance(it, ast.Call) and _leaf(it.func) in ("tolist", "nonzero"):
+            return f"`{_leaf(it.func)}()` product"
+        if isinstance(it, ast.Name):
+            if it.id in self.hot_names:
+                return f"`{it.id}` (a `.tolist()`/`nonzero` product)"
+            if it.id in self.params:
+                # bare parameter: hot only when a call site says so —
+                # recorded separately, materialized at expansion
+                return ""
+            return ""
+        if isinstance(it, ast.Attribute):
+            if it.attr in _SCALE_COLLECTION_ATTRS:
+                return f"`{dotted_name(it)}` (snapshot index collection)"
+            if _SCALE_ATTR_RE.match(it.attr):
+                return f"`{dotted_name(it)}` (per-row schema tensor)"
+            return ""
+        if isinstance(it, ast.Subscript):
+            return self._iter_hotness(it.value)
+        return ""
+
+    def _iter_params(self, it: ast.AST) -> Set[str]:
+        """Bare parameters this iterable walks (for call-site scale
+        propagation): ``for x in rows`` / ``zip(rows, nodes)``."""
+        out: Set[str] = set()
+        if isinstance(it, ast.Name) and it.id in self.params:
+            out.add(it.id)
+        elif isinstance(it, ast.Call) and _leaf(it.func) in (
+            "zip", "enumerate", "sorted", "reversed", "list",
+        ):
+            for a in it.args:
+                out |= self._iter_params(a)
+        return out
+
+    # -- the walk ----------------------------------------------------------
+
+    def _walk(self, stmts: Sequence[ast.stmt], hot: str, locked: bool) -> None:
+        for stmt in stmts:
+            self._stmt(stmt, hot, locked)
+
+    def _stmt(self, stmt: ast.stmt, hot: str, locked: bool) -> None:
+        if isinstance(stmt, FunctionNode):
+            return  # nested defs carry their own summaries
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            lockish = any(_lockish_with_item(i) for i in stmt.items)
+            for i in stmt.items:
+                if lockish:
+                    self.summary.lock_acquisitions.append(
+                        (stmt.lineno, ast.unparse(i.context_expr))
+                    )
+                self._expr(i.context_expr, hot, locked)
+            self._walk(stmt.body, hot, locked or lockish)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            why = self._iter_hotness(stmt.iter)
+            params = self._iter_params(stmt.iter)
+            self._expr(stmt.iter, hot, locked)
+            inner = why or hot
+            if params and not inner:
+                self._param_loop(stmt.body, params)
+            self._walk(stmt.body, inner, locked)
+            self._walk(stmt.orelse, hot, locked)
+            return
+        if isinstance(stmt, ast.Raise):
+            # a raise aborts the loop: its constructor call is not a
+            # per-element allocation floor
+            return
+        for field in ("test", "value", "exc", "msg", "target"):
+            v = getattr(stmt, field, None)
+            if isinstance(v, ast.expr):
+                self._expr(v, hot, locked)
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                self._expr(t, hot, locked)
+        for field in ("body", "orelse", "finalbody"):
+            v = getattr(stmt, field, None)
+            if isinstance(v, list) and v and isinstance(v[0], ast.stmt):
+                self._walk(v, hot, locked)
+        for h in getattr(stmt, "handlers", ()):
+            self._walk(h.body, hot, locked)
+
+    def _param_loop(self, body: Sequence[ast.stmt], params: Set[str]) -> None:
+        """Record constructions/appends in a loop over bare parameters —
+        hot only if a call site feeds those params hot values."""
+        for stmt in body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call):
+                    leaf = _leaf(sub.func)
+                    if _is_constructor_name(leaf):
+                        for p in params:
+                            self.summary.param_loop_constructions.setdefault(
+                                p, []
+                            ).append((sub.lineno, leaf))
+                    elif (
+                        isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr in self._GROWS
+                        and isinstance(sub.func.value, ast.Name)
+                        and sub.func.value.id in self.containers
+                    ):
+                        for p in params:
+                            self.summary.param_loop_appends.setdefault(
+                                p, []
+                            ).append((sub.lineno, sub.func.value.id))
+
+    def _expr(self, e: ast.AST, hot: str, locked: bool) -> None:
+        for sub in ast.walk(e):
+            if isinstance(sub, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+                self._comprehension(sub, hot, locked)
+            if not isinstance(sub, ast.Call):
+                continue
+            self._call(sub, hot, locked)
+
+    def _comprehension(self, comp: ast.AST, hot: str, locked: bool) -> None:
+        """A comprehension is a loop: classify its generators, then let
+        the normal Call scan below see the element expression with the
+        loop's hotness (ast.walk already visits the children; we only
+        need to record the hotness upgrade here)."""
+        why = ""
+        params: Set[str] = set()
+        for gen in comp.generators:
+            why = why or self._iter_hotness(gen.iter)
+            params |= self._iter_params(gen.iter)
+        inner = why or hot
+        elements = [
+            getattr(comp, "elt", None), getattr(comp, "key", None),
+            getattr(comp, "value", None),
+        ]
+        for el in elements:
+            if el is None:
+                continue
+            for sub in ast.walk(el):
+                if isinstance(sub, ast.Call):
+                    leaf = _leaf(sub.func)
+                    if inner and _is_constructor_name(leaf):
+                        self.summary.hot_constructions.append(
+                            (sub.lineno, leaf, inner)
+                        )
+                    elif params and not inner and _is_constructor_name(leaf):
+                        for p in params:
+                            self.summary.param_loop_constructions.setdefault(
+                                p, []
+                            ).append((sub.lineno, leaf))
+
+    def _call(self, call: ast.Call, hot: str, locked: bool) -> None:
+        leaf = _leaf(call.func)
+        line = call.lineno
+        s = self.summary
+        # device->host syncs
+        if leaf in ("item", "tolist", "block_until_ready", "device_get"):
+            s.syncs.append((line, leaf))
+        elif isinstance(call.func, ast.Attribute):
+            root = call.func.value
+            base = dotted_name(root).split(".")[0] if dotted_name(root) else ""
+            if leaf in ("asarray", "nonzero") and base in self.unit.np_aliases:
+                s.syncs.append((line, leaf))
+        elif leaf in ("int", "float") and isinstance(call.func, ast.Name):
+            if call.args and not isinstance(call.args[0], ast.Constant):
+                s.syncs.append((line, leaf))
+        # blocking calls (EFF-003 fires only when NOT under a lock;
+        # under a lock the site belongs to KAT-LCK-002)
+        if leaf in _BLOCKING_CALLS:
+            s.blocking.append((line, leaf, locked))
+        # constructions
+        if _is_constructor_name(leaf):
+            s.constructions.append((line, leaf))
+            if hot:
+                s.hot_constructions.append((line, leaf, hot))
+        # module-container growth
+        if (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr in self._GROWS
+            and isinstance(call.func.value, ast.Name)
+            and call.func.value.id in self.containers
+        ):
+            if hot:
+                s.hot_module_appends.append((line, call.func.value.id))
+        # call-graph edges (same-module resolution happens at expansion)
+        callee = is_self = None
+        if isinstance(call.func, ast.Name):
+            callee, is_self = call.func.id, False
+        elif (
+            isinstance(call.func, ast.Attribute)
+            and isinstance(call.func.value, ast.Name)
+            and call.func.value.id == "self"
+        ):
+            callee, is_self = call.func.attr, True
+        if callee is not None:
+            hot_pos = tuple(
+                i for i, a in enumerate(call.args) if self._expr_is_hot_value(a)
+            )
+            hot_kw = tuple(
+                kw.arg for kw in call.keywords
+                if kw.arg and self._expr_is_hot_value(kw.value)
+            )
+            s.calls.append(CallSite(
+                line=line, callee=callee, is_self_method=is_self,
+                in_hot_loop=bool(hot), hot_loop_reason=hot,
+                hot_pos=hot_pos, hot_kw=hot_kw,
+            ))
+
+
+def _lockish_with_item(item: ast.withitem) -> bool:
+    expr = item.context_expr
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    dn = dotted_name(expr).lower()
+    return "lock" in dn or "mutex" in dn
+
+
+# ---------------------------------------------------------------------------
+# module indexing + one-level expansion
+
+
+def _function_index(
+    tree: ast.Module,
+) -> Tuple[Dict[str, ast.AST], Dict[str, Dict[str, ast.AST]]]:
+    """(module functions by name, class -> method -> node)."""
+    mod_funcs: Dict[str, ast.AST] = {}
+    methods: Dict[str, Dict[str, ast.AST]] = {}
+    for node in tree.body:
+        if isinstance(node, FunctionNode):
+            mod_funcs[node.name] = node
+        elif isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, FunctionNode):
+                    methods.setdefault(node.name, {})[item.name] = item
+    return mod_funcs, methods
+
+
+def summarize_module(unit: ModuleUnit) -> Dict[str, EffectSummary]:
+    """Effect summary for every top-level function / method in the
+    module, keyed by qualname (``f`` / ``Cls.m``)."""
+    if unit.tree is None:
+        return {}
+    containers = _module_containers(unit.tree)
+    mod_funcs, methods = _function_index(unit.tree)
+    out: Dict[str, EffectSummary] = {}
+    for name, fn in mod_funcs.items():
+        out[name] = _FunctionScan(name, fn, unit, containers).summary
+    for cls, ms in methods.items():
+        for name, fn in ms.items():
+            q = f"{cls}.{name}"
+            out[q] = _FunctionScan(q, fn, unit, containers).summary
+    return out
+
+
+@dataclasses.dataclass
+class ExpandedEffects:
+    """A root function's effects after ONE level of same-module call
+    expansion.  ``via`` is '' for own effects, the callee qualname for
+    inherited ones."""
+
+    hot_constructions: List[Tuple[int, str, str, str]]  # line, cls, reason, via
+    hot_module_appends: List[Tuple[int, str, str]]      # line, container, via
+    syncs: List[Tuple[int, str, str]]                   # line, kind, via
+    blocking: List[Tuple[int, str, bool, str]]          # line, leaf, locked, via
+
+
+def expand(
+    root: EffectSummary,
+    summaries: Dict[str, EffectSummary],
+) -> ExpandedEffects:
+    """Fold one level of same-module callees into ``root``'s effects.
+
+    * a call site inside a hot loop inherits the callee's constructions
+      (the ``self._job_status(...)``-in-the-census-loop shape);
+    * a call site feeding a hot value to a parameter materializes the
+      callee's loops over that bare parameter (the
+      ``_build_intents(rows.tolist(), ...)`` shape);
+    * callee syncs/blocking count against the caller's stage/role budget
+      (the helper is part of the stage's wall time).
+    """
+    cls_prefix = root.qualname.rsplit(".", 1)[0] + "." if "." in root.qualname else ""
+    eff = ExpandedEffects(
+        hot_constructions=[(l, c, r, "") for (l, c, r) in root.hot_constructions],
+        hot_module_appends=[(l, c, "") for (l, c) in root.hot_module_appends],
+        syncs=[(l, k, "") for (l, k) in root.syncs],
+        blocking=[(l, b, lk, "") for (l, b, lk) in root.blocking],
+    )
+    for site in root.calls:
+        key = (cls_prefix + site.callee) if site.is_self_method else site.callee
+        callee = summaries.get(key)
+        if callee is None or callee is root:
+            continue
+        via = callee.qualname
+        if site.in_hot_loop:
+            for (l, c) in callee.constructions:
+                eff.hot_constructions.append(
+                    (site.line, c, site.hot_loop_reason, via)
+                )
+        # scale propagation: hot argument -> callee parameter loops
+        if site.hot_pos or site.hot_kw:
+            pnames = _param_names(callee.node)
+            if pnames and pnames[0] == "self":
+                pnames = pnames[1:]
+            fed: Set[str] = set()
+            for i in site.hot_pos:
+                if i < len(pnames):
+                    fed.add(pnames[i])
+            fed |= set(site.hot_kw)
+            # sorted: a construction recorded against several fed params
+            # (a zip loop) must pick the SAME one every run, or the
+            # finding fingerprint flips under hash randomization
+            for p in sorted(fed):
+                for (l, c) in callee.param_loop_constructions.get(p, ()):
+                    eff.hot_constructions.append(
+                        (l, c, f"loop over hot argument `{p}`", via)
+                    )
+                for (l, c) in callee.param_loop_appends.get(p, ()):
+                    eff.hot_module_appends.append((l, c, via))
+        for (l, k) in callee.syncs:
+            eff.syncs.append((l, k, via))
+        for (l, b, lk) in callee.blocking:
+            eff.blocking.append((l, b, lk, via))
+    return eff
+
+
+# ---------------------------------------------------------------------------
+# budget application (KAT-EFF-001..004)
+
+
+def _fmt_via(via: str) -> str:
+    return f" (via `{via}`)" if via else ""
+
+
+def budget_findings(unit: ModuleUnit, project: Project) -> Iterator[Finding]:
+    summaries = summarize_module(unit)
+    if not summaries:
+        return
+    seen: Set[Tuple[str, int, str]] = set()
+
+    def once(rule: str, line: int, key: str) -> bool:
+        k = (rule, line, key)
+        if k in seen:
+            return False
+        seen.add(k)
+        return True
+
+    for qualname, summary in summaries.items():
+        stage = STAGE_FUNCTIONS.get(qualname)
+        role = ROLE_FUNCTIONS.get(qualname)
+        if stage is None and role is None:
+            continue
+        eff = expand(summary, summaries)
+        if stage is not None:
+            budget = STAGE_BUDGETS[stage]
+            if not budget.allow_hot_construction:
+                for line, cls, reason, via in eff.hot_constructions:
+                    if not once("KAT-EFF-001", line, cls + via):
+                        continue
+                    yield Finding(
+                        "KAT-EFF-001", "error", unit.rel, line,
+                        f"`{qualname}` ({stage} stage) constructs "
+                        f"`{cls}` per element of a hot loop over "
+                        f"{reason}{_fmt_via(via)} — the {stage} budget "
+                        "forbids per-element allocation (an O(rows) "
+                        "host floor every cycle)",
+                        hint="hoist to a batched/vectorized form (one "
+                        "tolist per COLUMN, np.bincount per status — the "
+                        "PR 10/13 idiom), or record the justified "
+                        "exception in .kat-baseline.json",
+                    )
+            if budget.restrict_syncs:
+                for line, kind, via in eff.syncs:
+                    if kind in budget.declared_syncs:
+                        continue
+                    if not once("KAT-EFF-002", line, kind + via):
+                        continue
+                    yield Finding(
+                        "KAT-EFF-002", "error", unit.rel, line,
+                        f"`{qualname}` ({stage} stage) performs an "
+                        f"undeclared device→host sync `{kind}`"
+                        f"{_fmt_via(via)} — the {stage} budget declares "
+                        f"only {sorted(budget.declared_syncs)}",
+                        hint="batch the transfer into the stage's "
+                        "declared sync (one tolist per column), or — if "
+                        "this sync is intentional — add it to the stage "
+                        "budget in analysis/effects.py with a comment",
+                    )
+            for line, container, via in eff.hot_module_appends:
+                if not once("KAT-EFF-004", line, container + via):
+                    continue
+                yield Finding(
+                    "KAT-EFF-004", "error", unit.rel, line,
+                    f"`{qualname}` ({stage} stage) grows module-level "
+                    f"container `{container}` inside a hot loop"
+                    f"{_fmt_via(via)} — unbounded O(rows)-per-cycle "
+                    "growth that no cycle ever trims",
+                    hint="accumulate into a local and publish one "
+                    "bounded aggregate, or move the container into a "
+                    "capacity-bounded ring (utils/flightrec.py idiom)",
+                )
+        if role is not None and ROLE_BUDGETS[role].restrict_blocking:
+            for line, leaf, locked, via in eff.blocking:
+                if locked:
+                    continue  # KAT-LCK-002's jurisdiction — stay disjoint
+                if not once("KAT-EFF-003", line, leaf + via):
+                    continue
+                yield Finding(
+                    "KAT-EFF-003", "error", unit.rel, line,
+                    f"`{qualname}` runs on the {role} role and makes "
+                    f"blocking call `{leaf}`{_fmt_via(via)} — a stall "
+                    "here serializes the whole pipeline (the role's "
+                    "budget allows no blocking outside lock regions)",
+                    hint="move the blocking work to a worker thread "
+                    "(submit, don't wait) or behind the role's poll "
+                    "seam; blocking *under a lock* is KAT-LCK-002's "
+                    "separate violation",
+                )
+
+
+# ---------------------------------------------------------------------------
+# KAT-EFF-010 — decision-neutrality taint
+
+
+def _taint_of(e: ast.AST, env: Dict[str, Set[str]]) -> Set[str]:
+    """Neutral source names reachable in this expression: direct reads
+    of ``.{neutral}`` plus tainted locals.
+
+    Aggregate rebuilds (``dataclasses.replace`` / CamelCase constructor
+    calls) are taint BARRIERS: their keyword flows are checked
+    field-wise at the sink, so the resulting aggregate carries no taint
+    — otherwise ``state = replace(state, evict_round=...)`` would smear
+    every neutral field over every later read of ``state``."""
+    if isinstance(e, ast.Call):
+        leaf = _leaf(e.func)
+        if leaf == "replace" or _is_constructor_name(leaf):
+            return set()
+    if isinstance(e, ast.Attribute):
+        if e.attr in NEUTRAL_FIELDS:
+            return {e.attr}
+        # non-neutral field read off an aggregate: the aggregate name
+        # itself is untainted (barrier above); only walk tainted
+        # element-wise names in the base
+        return _taint_of(e.value, env)
+    if isinstance(e, ast.Name):
+        return set(env.get(e.id, ()))
+    out: Set[str] = set()
+    for child in ast.iter_child_nodes(e):
+        out |= _taint_of(child, env)
+    return out
+
+
+def _taint_env(fn: ast.AST) -> Dict[str, Set[str]]:
+    """Fixpoint: local name -> neutral fields its value derives from."""
+    env: Dict[str, Set[str]] = {}
+    assigns: List[Tuple[List[ast.AST], ast.AST]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            assigns.append((list(node.targets), node.value))
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            assigns.append(([node.target], node.value))
+        elif isinstance(node, ast.AugAssign):
+            assigns.append(([node.target], node.value))
+    changed = True
+    while changed:
+        changed = False
+        for targets, value in assigns:
+            for t in targets:
+                # element-wise tuple unpack keeps taint per slot
+                if isinstance(t, (ast.Tuple, ast.List)) and isinstance(
+                    value, (ast.Tuple, ast.List)
+                ) and len(t.elts) == len(value.elts):
+                    pairs = zip(t.elts, value.elts)
+                else:
+                    pairs = ((t, value),)
+                for te, ve in pairs:
+                    if not isinstance(te, ast.Name):
+                        continue
+                    taint = _taint_of(ve, env)
+                    if taint - env.get(te.id, set()):
+                        env[te.id] = env.get(te.id, set()) | taint
+                        changed = True
+    return env
+
+
+def neutrality_findings(unit: ModuleUnit, project: Project) -> Iterator[Finding]:
+    """KAT-EFF-010: within kernel context, a value derived from a
+    decision-neutral field may flow only back into the SAME neutral
+    field.  Reaching a different output keyword (``dataclasses.replace``
+    / state-constructor call) or a selection primitive feeds
+    observability back into decisions — the bit-identity break."""
+    if unit.tree is None:
+        return
+    for fn in kernel_functions(unit, project):
+        env = _taint_env(fn)
+        if not env and not any(
+            isinstance(n, ast.Attribute) and n.attr in NEUTRAL_FIELDS
+            for n in ast.walk(fn)
+        ):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = _leaf(node.func)
+            if leaf == "replace" or _is_constructor_name(leaf):
+                for kw in node.keywords:
+                    if kw.arg is None:
+                        continue
+                    leaked = _taint_of(kw.value, env) - {kw.arg}
+                    if leaked:
+                        yield Finding(
+                            "KAT-EFF-010", "error", unit.rel, kw.value.lineno,
+                            f"`{fn.name}` routes decision-neutral "
+                            f"field(s) {sorted(leaked)} into output "
+                            f"`{kw.arg}` of `{leaf}` — observability "
+                            "aux must never feed bind/evict/score "
+                            "state (the engine-parity bit-identity "
+                            "invariant)",
+                            hint="neutral fields (evict_claimant/phase/"
+                            "round, rounds_gated, claim_conflicts) may "
+                            "only carry forward into themselves; "
+                            "derive decision inputs from decision-"
+                            "bearing state instead",
+                        )
+            elif leaf in _SELECTION_CALLS:
+                for a in list(node.args) + [kw.value for kw in node.keywords]:
+                    leaked = _taint_of(a, env)
+                    if leaked:
+                        yield Finding(
+                            "KAT-EFF-010", "error", unit.rel, a.lineno,
+                            f"`{fn.name}` feeds decision-neutral "
+                            f"field(s) {sorted(leaked)} into selection "
+                            f"primitive `{leaf}` — observability aux "
+                            "is steering a decision",
+                            hint="select over decision-bearing state; "
+                            "the neutral aux exists so engines can "
+                            "differ in attribution without differing "
+                            "in decisions",
+                        )
+                        break
+
+
+def effect_findings(unit: ModuleUnit, project: Project) -> Iterator[Finding]:
+    """All KAT-EFF findings for one module (rules/effects.py entry)."""
+    yield from budget_findings(unit, project)
+    yield from neutrality_findings(unit, project)
+
+
+# ---------------------------------------------------------------------------
+# rule documentation (kat-lint --explain)
+
+RULE_DOCS: Dict[str, Dict[str, str]] = {
+    "KAT-EFF-001": {
+        "title": "per-element object construction in a hot loop",
+        "rationale": (
+            "The per-cycle host path must stay O(1)-ish in task count for "
+            "Gavel-style policy evaluation to stay cheap (ROADMAP item 5). "
+            "A CamelCase constructor inside a loop over a T/N/J-scale "
+            "iterable allocates O(rows) Python objects every cycle — the "
+            "floor class PRs 6/13/14 each had to re-dig out by hand. The "
+            "stage budgets (analysis/effects.py STAGE_BUDGETS) forbid it "
+            "on every pipeline stage."
+        ),
+        "fix": (
+            "Vectorize: one batched .tolist() per COLUMN, np.bincount per "
+            "status class, construct only for rows that changed (the "
+            "status-cache signature skip in Session._close is the model). "
+            "Intentional exceptions go to .kat-baseline.json with a "
+            "justification in the adopting commit."
+        ),
+    },
+    "KAT-EFF-002": {
+        "title": "undeclared device→host sync inside decide/decode",
+        "rationale": (
+            "decide/decode sit on the device seam; each sync kind they "
+            "perform is declared in the stage budget (block_until_ready "
+            "to time the program, the bounded tolist-gather decode). An "
+            "undeclared .item()/float()/np.asarray is a new stall on the "
+            "cycle's critical path that no bench asserts on."
+        ),
+        "fix": (
+            "Batch the transfer into an existing declared sync (one "
+            "tolist per column, scalar reads via int() on the counts), "
+            "or declare the new sync kind in STAGE_BUDGETS with a "
+            "comment saying why it is bounded."
+        ),
+    },
+    "KAT-EFF-003": {
+        "title": "blocking call on a latency-critical thread role",
+        "rationale": (
+            "The watch-ingest thread, the decide worker and the pool "
+            "dispatcher serialize the pipeline: a sleep/socket/device "
+            "block on any of them stalls every cycle behind it. Disjoint "
+            "from KAT-LCK-002 by construction — blocking UNDER a lock is "
+            "that rule's finding; this one owns the lock-free sites."
+        ),
+        "fix": (
+            "Submit blocking work to a worker (don't wait inline), or "
+            "route it through the role's poll seam (event_waiter / "
+            "_wait's bounded poll). If the call is wrongly classified as "
+            "blocking, narrow _BLOCKING_CALLS in analysis/effects.py."
+        ),
+    },
+    "KAT-EFF-004": {
+        "title": "append-in-hot-loop to a module-level container",
+        "rationale": (
+            "A module-level list/set/dict grown inside a hot loop leaks "
+            "O(rows) entries per cycle forever — the process-lifetime "
+            "version of the allocation floor, invisible until RSS pages."
+        ),
+        "fix": (
+            "Accumulate into a local and publish one bounded aggregate, "
+            "or use a capacity-bounded ring (utils/flightrec.py idiom)."
+        ),
+    },
+    "KAT-EFF-010": {
+        "title": "decision-neutrality taint (observability aux feeding decisions)",
+        "rationale": (
+            "CycleDecisions' audit aux (evict_claimant/evict_phase/"
+            "evict_round) and the round counters (rounds_gated, "
+            "claim_conflicts) are attribution outputs: every engine pair "
+            "(sequential vs batched vs optimistic) is pinned "
+            "bit-identical on decisions while free to differ in "
+            "attribution detail. If a kernel reads one of these into a "
+            "score, a mask, or a selection primitive, the parity "
+            "invariant silently breaks — previously only soak-tested."
+        ),
+        "fix": (
+            "A neutral field may only carry forward into ITSELF "
+            "(evict_round=jnp.where(evict, state.rounds, "
+            "state.evict_round) is fine). Derive decision inputs from "
+            "decision-bearing state (evicted_for, task_status, rounds)."
+        ),
+    },
+    "KAT-CTR-013": {
+        "title": "CycleDecisions wire-name drift",
+        "rationale": (
+            "rpc/codec.py serializes every CycleDecisions field "
+            "generically BY NAME, and consumers (cache/decode.py, "
+            "utils/audit.py, framework/session.py, ops/diagnostics.py) "
+            "read them back by the same names. A silent rename on either "
+            "side doesn't error — the consumer's getattr default or the "
+            "codec's unknown-field skip just drops the data on the "
+            "floor (audit aux first)."
+        ),
+        "fix": (
+            "Rename producer and consumers together; "
+            "analysis/contracts.py check_wire_names() lists the exact "
+            "missing/extra names and the consumer module expected to "
+            "read each."
+        ),
+    },
+}

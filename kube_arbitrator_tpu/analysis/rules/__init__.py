@@ -1,6 +1,7 @@
 """Rule registry: one instance of every rule family, in report order."""
 from .drift import ConfigDriftRule
 from .dtypes import DtypeDisciplineRule
+from .effects import EffectBudgetRule
 from .locks import LockDisciplineRule
 from .purity import PurityRule
 from .retrace import RetraceRule
@@ -15,6 +16,7 @@ ALL_RULES = (
     ConfigDriftRule(),
     DtypeDisciplineRule(),
     LockDisciplineRule(),
+    EffectBudgetRule(),
 )
 
 RULES_BY_FAMILY = {r.family: r for r in ALL_RULES}

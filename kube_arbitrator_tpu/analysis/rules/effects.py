@@ -1,0 +1,27 @@
+"""KAT-EFF — effect budgets for pipeline stages and thread roles.
+
+Thin rule shell: the summaries, the budget registry and the neutrality
+taint walker live in analysis/effects.py (they are also imported by the
+CLI's ``--explain`` and by tests); this module adapts them to the Rule
+protocol so the family rides the cache, the baseline, SARIF and
+``--rules`` selection like every other family.
+"""
+from __future__ import annotations
+
+from typing import Iterator
+
+from ..core import Finding, ModuleUnit, Project, Rule
+from ..effects import effect_findings
+
+
+class EffectBudgetRule(Rule):
+    family = "KAT-EFF"
+    name = "effect budgets (hot-path floors, syncs, neutrality)"
+    # budgets are a production-plane contract; tests construct objects
+    # in loops on purpose (fixtures) and block on purpose (joins)
+    applies_to_tests = False
+
+    def check(self, unit: ModuleUnit, project: Project) -> Iterator[Finding]:
+        if unit.tree is None:
+            return
+        yield from effect_findings(unit, project)

@@ -27,6 +27,7 @@ import json
 import os
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+from . import artifacts
 from .core import load_project
 from .rules.lockorder import LockGraph, build_lock_graph
 
@@ -95,7 +96,12 @@ def dump_artifact(
     mismatches: Optional[Dict[str, List[List[str]]]] = None,
     context: Optional[Dict[str, object]] = None,
 ) -> str:
-    """Write the reconciliation as ``<out_dir>/sanitizer-<n>.json``."""
+    """Write the reconciliation as ``<out_dir>/sanitizer-<n>.json``.
+
+    A relative ``out_dir`` anchors at the invocation root (see
+    ``artifacts``), so a chaos soak that chdirs per-scenario still
+    stacks every dump in one evidence directory."""
+    out_dir = artifacts.resolve(out_dir)
     os.makedirs(out_dir, exist_ok=True)
     if mismatches is None:
         mismatches = reconcile(graph, witness_report)

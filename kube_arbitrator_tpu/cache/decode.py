@@ -41,7 +41,12 @@ def _build_intents(
 ) -> Tuple[List[BindIntent], List[EvictIntent]]:
     """Intent objects from host-side python lists of ordinals — the ONE
     assembly both decode paths share, so their output cannot diverge in
-    anything but how the ordinal lists were obtained."""
+    anything but how the ordinal lists were obtained.
+
+    This is the decode stage's baselined KAT-EFF-001 floor (see
+    ``.kat-baseline.json``): intent objects ARE the actuation contract,
+    and the loops are O(decisions) bounded by ``ops/cycle.decode_caps``
+    — never O(T).  Growing this shape elsewhere fails the gate."""
     task_uid, node_name = _uid_lookup(index)
     binds = [
         BindIntent(task_uid=task_uid(i), node_name=node_name(n))

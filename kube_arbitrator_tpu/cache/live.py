@@ -493,6 +493,9 @@ class LiveCache:
         return wait
 
     def _dispatch(self, resource: str, etype: str, obj: dict) -> None:
+        # ingest-thread role + ingest stage (analysis/effects.py): no
+        # blocking calls, no per-element allocation in hot loops — every
+        # watch event funnels through here (KAT-EFF-001/003)
         handler = {
             "pods": self._on_pod,
             "nodes": self._on_node,

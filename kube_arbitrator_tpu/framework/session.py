@@ -484,8 +484,16 @@ class Session:
                 ready_l[o], min_l[o], run_l[o], alloc_l[o], succ_l[o],
                 fail_l[o], ready0_l[o], ntasks_l[o],
             )
-            if quiet and cache.get(job.uid) == sig:
-                continue  # unchanged: zero objects constructed
+            if cache is not None and cache.get(job.uid) == sig and (
+                quiet or ready_l[o] or not min_l[o]
+            ):
+                # Unchanged: zero objects constructed.  A ready gang's
+                # status (and a min_available==0 job's) is a pure
+                # function of the signature, so it skips on ACTIVE
+                # cycles too; an unready gang's Unschedulable message
+                # embeds the per-node reason histogram, so it
+                # additionally needs the quiet node digest.
+                continue
             unsched_cond = None
             min_avail = min_l[o]
             if not ready_l[o] and min_avail > 0:

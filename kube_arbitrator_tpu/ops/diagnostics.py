@@ -226,15 +226,16 @@ def explain_pending_tasks_with_reasons(
     task_job = np.asarray(t.task_job)
     task_group = np.asarray(t.task_group)
 
+    # unready_alloc IS the "allocated this cycle but gang-uncommitted"
+    # half of unplaced-ness (commit_cycle exports it for exactly this
+    # channel: valid & was-PENDING & now-ALLOCATED & ~job_ready); the
+    # still-PENDING half is the only part derived locally
     unplaced = (
         task_valid
         & (task_status0 == int(TaskStatus.PENDING))
-        & (
-            (task_status1 == int(TaskStatus.PENDING))
-            | (task_status1 == int(TaskStatus.ALLOCATED))
-        )
+        & (task_status1 == int(TaskStatus.PENDING))
         & ~job_ready[task_job]
-    )
+    ) | np.asarray(decisions.unready_alloc)
     if not unplaced.any():
         return {}, {}
 

@@ -189,6 +189,9 @@ class PipelinedExecutor:
         return freeze_ms
 
     def _decide_worker(self, ep: _Epoch, st, pack_meta):
+        # decide-worker role (analysis/effects.py ROLE_FUNCTIONS): no
+        # blocking calls outside lock regions — a stall here holds the
+        # whole pipeline's decide seam (KAT-EFF-003 enforces statically)
         tr = tracer()
         with tr.activate(ep.corr):
             with tr.span("pipeline.decide", seq=ep.seq):

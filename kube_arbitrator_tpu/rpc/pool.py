@@ -683,6 +683,9 @@ class DecisionPool:
             self._serve_group(chunk, excluded=set())
 
     def _dispatch_loop(self) -> None:
+        # pool-dispatcher role (analysis/effects.py ROLE_FUNCTIONS): the
+        # condition wait is the ONE sanctioned park; any other blocking
+        # call here stalls every queued tenant (KAT-EFF-003)
         while True:
             with self._cond:
                 while not self._queue and not self._stop:

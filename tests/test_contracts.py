@@ -186,6 +186,55 @@ def test_mutated_audit_aux_schema_reports_exactly_that_field():
     assert "`evict_round`" in findings[0].message
 
 
+def test_wire_names_clean_on_real_tree():
+    # KAT-CTR-013: every CycleDecisions field has a same-named consumer
+    # on the reply-pack path and every literal consumer read names a
+    # real field (the scan itself is exercised: it must see reads for
+    # all 19 fields, not return an empty map and vacuously pass)
+    assert contracts.check_wire_names() == []
+    reads = contracts._scan_wire_reads()
+    import dataclasses as dc
+
+    from kube_arbitrator_tpu.ops.cycle import CycleDecisions
+
+    for f in dc.fields(CycleDecisions):
+        assert f.name in reads, f"no by-name consumer read for {f.name}"
+
+
+def test_wire_names_producer_rename_reports_only_ctr013():
+    # seed a producer-side rename: evict_round -> evict_rnd.  The schema
+    # mismatch (both directions) and the missing consumer must all
+    # surface, and only as KAT-CTR-013
+    import dataclasses as dc
+
+    from kube_arbitrator_tpu.ops.cycle import CycleDecisions
+
+    names = tuple(
+        "evict_rnd" if f.name == "evict_round" else f.name
+        for f in dc.fields(CycleDecisions)
+    )
+    findings = contracts.check_wire_names(field_names=names)
+    assert findings and {f.rule for f in findings} == {"KAT-CTR-013"}
+    text = "\n".join(f.message for f in findings)
+    assert "`evict_rnd`" in text and "`evict_round`" in text
+
+
+def test_wire_names_consumer_rename_reports_only_ctr013():
+    # seed a consumer-side drift: the audit plane stops reading
+    # evict_round (renamed on its end) and instead reads a ghost field
+    reads = contracts._scan_wire_reads()
+    seeded = dict(reads)
+    # audit.py no longer reads evict_round (another module still does),
+    # and reads a ghost name instead
+    seeded["evict_round"] = {"framework/session.py": 1}
+    seeded["evict_rnd"] = {"utils/audit.py": 1}
+    findings = contracts.check_wire_names(consumer_reads=seeded)
+    assert findings and {f.rule for f in findings} == {"KAT-CTR-013"}
+    text = "\n".join(f.message for f in findings)
+    # the plane going blind AND the ghost read both surface
+    assert "utils/audit.py" in text and "`evict_rnd`" in text
+
+
 def test_producer_crash_becomes_a_finding_not_a_traceback(monkeypatch):
     # a build_snapshot that RAISES (e.g. its own pack-dtype guard firing)
     # must surface as a KAT-CTR-002 finding, not crash the analyzer and
@@ -226,9 +275,10 @@ def test_cli_runs_contract_pass_on_package_scope(tmp_path):
                 sys.executable, "-m", "kube_arbitrator_tpu.analysis",
                 "--format", "json",
                 "--cache-dir", str(tmp_path / "kat-cache"),  # isolated cache
-                # an absent baseline path: the repo's own baseline (if
-                # any) must not mask findings this asserts on
-                "--baseline", str(tmp_path / "no-baseline.json"),
+                # the COMMITTED baseline is part of the gate: it holds
+                # exactly the justified KAT-EFF allocation floors, and
+                # anything beyond it must fail this test
+                "--baseline", str(REPO / ".kat-baseline.json"),
                 str(REPO / "kube_arbitrator_tpu"), str(REPO / "tests"),
             ],
             cwd=REPO,

@@ -1,0 +1,416 @@
+"""KAT-EFF effect budgets: seeded-mutation tests (each fixture fires
+exactly its own rule across ALL families), the interprocedural
+propagation shapes, the neutrality-taint pass, the real-tree smoke
+against the committed baseline, and the artifact-dir anchoring fix."""
+import json
+import os
+import pathlib
+import textwrap
+
+import pytest
+
+from kube_arbitrator_tpu.analysis import ALL_RULES, analyze_paths
+from kube_arbitrator_tpu.analysis.rules import RULES_BY_FAMILY
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+EFF = (RULES_BY_FAMILY["KAT-EFF"],)
+
+
+def run_on(tmp_path, name, source, rules=ALL_RULES):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    _, findings = analyze_paths([str(f)], rules)
+    return findings
+
+
+def rule_ids(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# KAT-EFF-001 — per-element construction in a hot loop
+
+
+def test_eff001_construction_in_decode_hot_loop(tmp_path):
+    findings = run_on(
+        tmp_path,
+        "sess.py",
+        """
+        class Session:
+            def decode_phase(self, snap, dec):
+                out = []
+                for i in dec.task_status.tolist():
+                    out.append(PodGroupCondition(i))
+                return out
+        """,
+    )
+    assert rule_ids(findings) == {"KAT-EFF-001"}
+    assert "PodGroupCondition" in findings[0].message
+
+
+def test_eff001_via_self_method_expansion(tmp_path):
+    # the Session._close shape: the loop body calls a same-class helper
+    # whose construction counts against the caller's stage
+    findings = run_on(
+        tmp_path,
+        "sess.py",
+        """
+        class Session:
+            def _close(self, snap, dec):
+                out = {}
+                for job in snap.index.jobs:
+                    out[job.uid] = self._status(job)
+                return out
+
+            def _status(self, job):
+                return PodGroupStatus(job)
+        """,
+    )
+    assert rule_ids(findings) == {"KAT-EFF-001"}
+    assert "via `Session._status`" in findings[0].message
+
+
+def test_eff001_via_hot_argument_propagation(tmp_path):
+    # the decode_decisions -> _build_intents shape: a .tolist() product
+    # fed to a module helper materializes the helper's param loop
+    findings = run_on(
+        tmp_path,
+        "dec.py",
+        """
+        class Session:
+            def decode_phase(self, snap, dec):
+                rows = dec.bind_idx.tolist()
+                return build(rows)
+
+        def build(rows):
+            return [Intent(r) for r in rows]
+        """,
+    )
+    assert rule_ids(findings) == {"KAT-EFF-001"}
+    assert "via `build`" in findings[0].message
+
+
+def test_eff001_silent_outside_mapped_stages(tmp_path):
+    # same loop + construction, but the function is no stage: budgets
+    # bind to the pipeline, not to arbitrary code
+    findings = run_on(
+        tmp_path,
+        "free.py",
+        """
+        def helper(dec):
+            return [Intent(i) for i in dec.task_status.tolist()]
+        """,
+        rules=EFF,
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# KAT-EFF-002 — undeclared host sync in decide/decode
+
+
+def test_eff002_undeclared_item_in_decide(tmp_path):
+    findings = run_on(
+        tmp_path,
+        "sess.py",
+        """
+        class Session:
+            def decide_phase(self, snap, st):
+                n = st.bind_count.item()
+                return n
+        """,
+    )
+    assert rule_ids(findings) == {"KAT-EFF-002"}
+    assert "`item`" in findings[0].message
+
+
+def test_eff002_declared_syncs_are_clean(tmp_path):
+    # decode's budget declares tolist/asarray/int; decide's declares
+    # block_until_ready/int — the sanctioned mechanisms stay silent
+    findings = run_on(
+        tmp_path,
+        "sess.py",
+        """
+        import numpy as np
+
+        class Session:
+            def decide_phase(self, snap, st):
+                dec = go(st)
+                dec.task_node.block_until_ready()
+                return dec
+
+            def decode_phase(self, snap, dec):
+                n = int(dec.bind_count)
+                return np.asarray(dec.task_node)
+        """,
+        rules=EFF,
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# KAT-EFF-003 — blocking on a latency-critical role, disjoint from KAT-LCK-002
+
+
+def test_eff003_sleep_on_ingest_thread(tmp_path):
+    findings = run_on(
+        tmp_path,
+        "live.py",
+        """
+        import time
+
+        class LiveCache:
+            def _dispatch(self, ev):
+                time.sleep(0.1)
+        """,
+    )
+    assert rule_ids(findings) == {"KAT-EFF-003"}
+
+
+def test_eff003_disjoint_from_lck002_under_lock(tmp_path):
+    # the SAME call under a lockish with is KAT-LCK-002's finding and
+    # must NOT double-report as KAT-EFF-003
+    findings = run_on(
+        tmp_path,
+        "live.py",
+        """
+        import threading
+        import time
+
+        class LiveCache:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _dispatch(self, ev):
+                with self._lock:
+                    time.sleep(0.1)
+        """,
+    )
+    assert rule_ids(findings) == {"KAT-LCK-002"}
+
+
+# ---------------------------------------------------------------------------
+# KAT-EFF-004 — unbounded growth of a module-level container
+
+
+def test_eff004_module_append_in_hot_loop(tmp_path):
+    findings = run_on(
+        tmp_path,
+        "sess.py",
+        """
+        SEEN = []
+
+        class Session:
+            def close_phase(self, snap, dec):
+                for uid in dec.task_node.tolist():
+                    SEEN.append(uid)
+        """,
+    )
+    assert rule_ids(findings) == {"KAT-EFF-004"}
+
+
+def test_eff004_local_append_is_clean(tmp_path):
+    findings = run_on(
+        tmp_path,
+        "sess.py",
+        """
+        class Session:
+            def close_phase(self, snap, dec):
+                out = []
+                for uid in dec.task_node.tolist():
+                    out.append(uid)
+                return out
+        """,
+        rules=EFF,
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# KAT-EFF-010 — decision-neutrality taint
+
+
+def test_eff010_neutral_field_into_selection(tmp_path):
+    findings = run_on(
+        tmp_path,
+        "ops.py",
+        """
+        import jax.numpy as jnp
+
+        def my_action(st, state):
+            victim = jnp.argmax(state.evict_claimant)
+            return victim
+
+        ACTION_KERNELS = {"my": my_action}
+        """,
+    )
+    assert rule_ids(findings) == {"KAT-EFF-010"}
+    assert "evict_claimant" in findings[0].message
+
+
+def test_eff010_neutral_field_into_other_output(tmp_path):
+    # routed through a local, into a DIFFERENT keyword of the state
+    # rebuild: the taint must survive the assignment hop
+    findings = run_on(
+        tmp_path,
+        "ops.py",
+        """
+        import dataclasses
+        import jax.numpy as jnp
+
+        def my_action(st, state):
+            pressure = state.rounds_gated.astype(jnp.float32)
+            return dataclasses.replace(state, progress=pressure)
+
+        ACTION_KERNELS = {"my": my_action}
+        """,
+    )
+    assert rule_ids(findings) == {"KAT-EFF-010"}
+    assert "rounds_gated" in findings[0].message
+
+
+def test_eff010_same_name_carry_is_clean(tmp_path):
+    # the repo's real idiom: neutral fields carried forward into
+    # THEMSELVES (including conditionals mixing decision-bearing state)
+    findings = run_on(
+        tmp_path,
+        "ops.py",
+        """
+        import dataclasses
+        import jax.numpy as jnp
+
+        def my_action(st, state, evict, gated):
+            return dataclasses.replace(
+                state,
+                evict_claimant=jnp.where(evict, st.task_job, state.evict_claimant),
+                evict_round=jnp.where(evict, state.rounds, state.evict_round),
+                rounds_gated=state.rounds_gated + gated,
+            )
+
+        ACTION_KERNELS = {"my": my_action}
+        """,
+        rules=EFF,
+    )
+    assert findings == []
+
+
+def test_eff010_state_rebuild_does_not_smear_taint(tmp_path):
+    # `state = replace(state, evict_round=...)` must not taint every
+    # later read of `state` (the aggregate is a barrier; flows are
+    # checked field-wise at each sink)
+    findings = run_on(
+        tmp_path,
+        "ops.py",
+        """
+        import dataclasses
+        import jax.numpy as jnp
+
+        def my_action(st, state, evict):
+            state = dataclasses.replace(
+                state,
+                evict_round=jnp.where(evict, state.rounds, state.evict_round),
+            )
+            score = state.task_status + 1
+            return dataclasses.replace(state, progress=score)
+
+        ACTION_KERNELS = {"my": my_action}
+        """,
+        rules=EFF,
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# real-tree smoke
+
+
+def test_real_tree_findings_match_committed_baseline(monkeypatch):
+    """The only real-tree KAT-EFF findings are the four justified
+    allocation floors in .kat-baseline.json (decode intent construction,
+    close-census status objects) — every other stage/role is clean, and
+    the baseline file itself is neither stale nor short."""
+    from kube_arbitrator_tpu.analysis.report import load_baseline
+
+    monkeypatch.chdir(REPO)  # fingerprints embed CWD-relative paths
+    _, findings = analyze_paths([str(REPO / "kube_arbitrator_tpu")], EFF)
+    assert rule_ids(findings) <= {"KAT-EFF-001"}
+    by_file = {}
+    for f in findings:
+        by_file.setdefault(os.path.basename(f.path), []).append(f)
+    assert set(by_file) == {"decode.py", "session.py"}
+    baseline = load_baseline(str(REPO / ".kat-baseline.json"))
+    assert sorted(f.fingerprint() for f in findings) == sorted(baseline)
+
+
+# ---------------------------------------------------------------------------
+# artifact-dir anchoring (cache + sanitizer dumps)
+
+
+def test_resolve_anchors_relative_paths(tmp_path, monkeypatch):
+    from kube_arbitrator_tpu.analysis import artifacts
+
+    a, b = tmp_path / "a", tmp_path / "b"
+    a.mkdir(), b.mkdir()
+    monkeypatch.setenv(artifacts.ENV_VAR, str(a))
+    monkeypatch.chdir(b)
+    assert artifacts.resolve(".kat-cache") == str(a / ".kat-cache")
+    # absolute paths pass through untouched
+    assert artifacts.resolve(str(b / "x")) == str(b / "x")
+    # without the env var the IMPORT-time cwd anchors, not the current one
+    monkeypatch.delenv(artifacts.ENV_VAR)
+    assert artifacts.resolve(".kat-cache") == os.path.join(
+        artifacts._IMPORT_CWD, ".kat-cache"
+    )
+
+
+def test_cache_writes_to_anchor_not_cwd(tmp_path, monkeypatch):
+    from kube_arbitrator_tpu.analysis.cache import AnalysisCache
+
+    anchor, elsewhere = tmp_path / "anchor", tmp_path / "elsewhere"
+    anchor.mkdir(), elsewhere.mkdir()
+    monkeypatch.setenv("KAT_ARTIFACT_ROOT", str(anchor))
+    monkeypatch.chdir(elsewhere)
+    cache = AnalysisCache(".kat-cache")
+    cache.put_findings("f.py", "k", [])
+    cache.flush()
+    assert (anchor / ".kat-cache" / "findings.json").exists()
+    assert not (elsewhere / ".kat-cache").exists()
+    # and a fresh instance from yet another CWD warms from the same store
+    monkeypatch.chdir(tmp_path)
+    assert AnalysisCache(".kat-cache").get_findings("f.py", "k") == []
+
+
+def test_sanitizer_dump_lands_at_anchor(tmp_path, monkeypatch):
+    from kube_arbitrator_tpu.analysis.rules.lockorder import LockGraph
+    from kube_arbitrator_tpu.analysis.sanitizer import dump_artifact
+
+    anchor, elsewhere = tmp_path / "anchor", tmp_path / "elsewhere"
+    anchor.mkdir(), elsewhere.mkdir()
+    monkeypatch.setenv("KAT_ARTIFACT_ROOT", str(anchor))
+    monkeypatch.chdir(elsewhere)
+    graph = LockGraph()
+    graph.add_site("x.a", "m.py", 1)
+    p = dump_artifact("evidence", graph, {"edges": []})
+    assert p == str(anchor / "evidence" / "sanitizer-0001.json")
+    assert json.loads((anchor / "evidence" / "sanitizer-0001.json").read_text())
+    assert not (elsewhere / "evidence").exists()
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+
+
+def test_cli_explain_prints_rationale(capsys):
+    from kube_arbitrator_tpu.analysis.cli import main
+
+    assert main(["--explain", "KAT-EFF-001"]) == 0
+    out = capsys.readouterr().out
+    assert "KAT-EFF-001" in out and "Fix pattern:" in out
+    assert main(["--explain", "KAT-NOPE-999"]) == 2
+
+
+def test_cli_lists_eff_family(capsys):
+    from kube_arbitrator_tpu.analysis.cli import main
+
+    assert main(["--list-rules"]) == 0
+    assert "KAT-EFF" in capsys.readouterr().out
