@@ -518,6 +518,105 @@ if [ "${PERF_SENTINEL:-0}" = "1" ]; then
   fi
 fi
 
+# REPLAY_SMOKE=1: the session capture & replay lane — record a 20-cycle
+# contended run into the capture plane, then drive the offline replayer
+# through its acceptance sequence in FRESH processes (different
+# PYTHONHASHSEED than the recorder): bit-identical verify (exit 0), a
+# one-bit conf mutation pinpointed to cycle 1 (exit exactly 1), a seeded
+# single-field decision mutation pinpointed with a field-level diff
+# (exit exactly 1), and a doubled-queue-weight differential replay that
+# must report a nonzero fairness-ledger delta.  Then the capture test
+# suite (including the 8-seed chaos determinism matrix) and kat-lint
+# KAT-LCK/KAT-DTY/KAT-EFF over the new package.
+rc_replay=0
+if [ "${REPLAY_SMOKE:-0}" = "1" ]; then
+  CAP_DIR=$(mktemp -d /tmp/kat-capture-XXXXXX)
+  env JAX_PLATFORMS=cpu python - "${CAP_DIR}" <<'EOF' || rc_replay=$?
+import sys
+from kube_arbitrator_tpu.platform import enable_persistent_cache, ensure_jax_backend
+ensure_jax_backend(); enable_persistent_cache()
+from kube_arbitrator_tpu.capture import SessionCapture
+from kube_arbitrator_tpu.cache.sim import generate_cluster
+from kube_arbitrator_tpu.framework import Scheduler
+from kube_arbitrator_tpu.framework.conf import dump_conf
+
+# contended (demand > capacity): queue weights matter to the water-filled
+# deserved shares, so the differential leg below has a delta to find
+sim = generate_cluster(num_nodes=4, num_jobs=8, tasks_per_job=5,
+                       num_queues=2, seed=0)
+sched = Scheduler(sim)
+cap = SessionCapture(sys.argv[1], conf_yaml=dump_conf(sched.config))
+sched.capture = cap
+cycles = sched.run(max_cycles=20, until_idle=False)
+cap.close()
+st = cap.status()
+assert cycles == 20 and st["cycles"] == 20, (cycles, st)
+assert st["dropped_cycles"] == 0, st
+print(f"replay smoke: recorded {st['cycles']} cycles, {st['bytes']} bytes")
+EOF
+  # bit-identity in a fresh process: a different hash seed proves the
+  # determinism contract isn't shared-process-state luck
+  env JAX_PLATFORMS=cpu PYTHONHASHSEED=12345 \
+    python -m kube_arbitrator_tpu.capture --replay "${CAP_DIR}" \
+    || rc_replay=$?
+  # conf-mutation canary: drop one plugin from the recorded conf; the
+  # replay MUST diverge at cycle 1 — exit code exactly 1.  Exit 0 means
+  # the verifier has gone blind; any other code means it crashed.
+  env JAX_PLATFORMS=cpu python - "${CAP_DIR}" <<'EOF' || rc_replay=$?
+import json, sys
+man = json.load(open(sys.argv[1] + "/manifest.json"))
+mut = man["conf"].replace("  - name: proportion\n", "")
+assert mut != man["conf"], "recorded conf lost its proportion plugin?"
+open(sys.argv[1] + "/conf-mut.yaml", "w").write(mut)
+EOF
+  out=$(env JAX_PLATFORMS=cpu PYTHONHASHSEED=777 \
+    python -m kube_arbitrator_tpu.capture --replay "${CAP_DIR}" \
+    --conf "${CAP_DIR}/conf-mut.yaml" 2>&1)
+  rc_canary=$?
+  if [ "${rc_canary}" -ne 1 ] || ! echo "${out}" | grep -q "cycle 1 "; then
+    echo "conf-mutation canary: want exit 1 + divergence at cycle 1, got exit ${rc_canary}:" >&2
+    echo "${out}" >&2
+    rc_replay=1
+  fi
+  # seeded decision-field mutation: MUST be pinpointed to its cycle with
+  # the channel + entity named in the field-level diff — exit exactly 1
+  out=$(env JAX_PLATFORMS=cpu PYTHONHASHSEED=777 \
+    python -m kube_arbitrator_tpu.capture --replay "${CAP_DIR}" \
+    --mutate bind_mask@7 2>&1)
+  rc_canary=$?
+  if [ "${rc_canary}" -ne 1 ] || ! echo "${out}" | grep -q "cycle 7 " \
+    || ! echo "${out}" | grep -q "channel bind_mask"; then
+    echo "decision-mutation canary: want exit 1 + bind_mask diff at cycle 7, got exit ${rc_canary}:" >&2
+    echo "${out}" >&2
+    rc_replay=1
+  fi
+  # differential replay: doubling one queue's weight over the contended
+  # window must move the deserved-share ledger (nonzero delta)
+  env JAX_PLATFORMS=cpu python -m kube_arbitrator_tpu.capture \
+    --replay "${CAP_DIR}" --diff --queue-weight queue-001=2.0 \
+    --json --out "${CAP_DIR}/diff.json" >/dev/null || rc_replay=$?
+  env JAX_PLATFORMS=cpu python - "${CAP_DIR}" <<'EOF' || rc_replay=$?
+import json, sys
+rep = json.load(open(sys.argv[1] + "/diff.json"))
+assert rep["mode"] == "differential" and rep["cycles"] == 20, rep
+deltas = [abs(q["delta"]["share_deserved"]) for q in rep["fairness"].values()]
+assert max(deltas) > 0.01, rep["fairness"]
+print(f"replay smoke: differential max deserved-share delta {max(deltas):.4f}")
+EOF
+  rm -rf "${CAP_DIR}"
+  # the capture suite, INCLUDING the slow 8-seed chaos determinism matrix
+  # (tier-1 only runs seeds 0-1; this lane is where the full matrix lives)
+  env JAX_PLATFORMS=cpu python -m pytest -q tests/test_capture.py \
+    || rc_replay=$?
+  python -m kube_arbitrator_tpu.analysis --rules KAT-LCK,KAT-DTY,KAT-EFF \
+    kube_arbitrator_tpu/capture || rc_replay=$?
+  if [ "${rc_replay}" -ne 0 ]; then
+    echo "replay smoke job: FAILED (exit ${rc_replay})" >&2
+  else
+    echo "replay smoke job: ok (20-cycle record + fresh-process verify + conf/decision mutation canaries + differential delta + suite + kat-lint)"
+  fi
+fi
+
 if [ "${LINT_ONLY:-0}" = "1" ]; then
   # The fast lane names the effects family in its own job line: a
   # budget regression (hot-loop allocation, undeclared sync, blocked
@@ -542,6 +641,7 @@ if [ "${LINT_ONLY:-0}" = "1" ]; then
   if [ "${rc_pool}" -ne 0 ]; then exit "${rc_pool}"; fi
   if [ "${rc_shard}" -ne 0 ]; then exit "${rc_shard}"; fi
   if [ "${rc_race}" -ne 0 ]; then exit "${rc_race}"; fi
+  if [ "${rc_replay}" -ne 0 ]; then exit "${rc_replay}"; fi
   exit "${rc_pipe}"
 fi
 
@@ -563,4 +663,5 @@ if [ "${rc_sentinel}" -ne 0 ]; then exit "${rc_sentinel}"; fi
 if [ "${rc_pool}" -ne 0 ]; then exit "${rc_pool}"; fi
 if [ "${rc_shard}" -ne 0 ]; then exit "${rc_shard}"; fi
 if [ "${rc_race}" -ne 0 ]; then exit "${rc_race}"; fi
+if [ "${rc_replay}" -ne 0 ]; then exit "${rc_replay}"; fi
 exit "${rc_test}"

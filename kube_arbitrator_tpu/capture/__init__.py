@@ -1,0 +1,37 @@
+"""Session capture & deterministic replay plane.
+
+Capture (``recorder.SessionCapture``): every committed cycle's snapshot
+pack teed — as compressed columnar delta blocks against the last
+captured cycle — plus its decision tensors and a wall-clock-free audit
+digest, into chunk-rotated files under a byte budget, with a manifest
+stamping the conf fingerprint, engine flags, decode caps, and the
+sentinel host fingerprint.  Enabled via ``--capture-dir`` /
+``--capture-max-bytes`` on the CLI and the chaos runner; served at
+``/debug/capture``.
+
+Replay (``python -m kube_arbitrator_tpu.capture --replay <dir>``):
+reconstructs each cycle's exact pack and re-runs the real Session
+decide/decode phases — **verify** mode asserts bit-identical decisions
+and pinpoints the first divergence down to the channel/row/entity;
+**differential** mode (``--diff``) re-runs the window under a changed
+conf or queue-weight overlay and reports the fairness-ledger +
+bind/evict-edge delta (recorded-trace policy simulation, after Gavel).
+"""
+from .format import (
+    CAPTURE_FORMAT_VERSION,
+    CaptureError,
+    load_manifest,
+)
+from .recorder import DEFAULT_MAX_BYTES, SessionCapture
+from .replay import iter_cycles, replay_differential, replay_verify
+
+__all__ = [
+    "CAPTURE_FORMAT_VERSION",
+    "CaptureError",
+    "DEFAULT_MAX_BYTES",
+    "SessionCapture",
+    "iter_cycles",
+    "load_manifest",
+    "replay_differential",
+    "replay_verify",
+]

@@ -1,0 +1,162 @@
+"""``python -m kube_arbitrator_tpu.capture`` — the offline replayer.
+
+Exit codes (the chaos-runner convention): 0 = verified bit-identical
+(or a differential report emitted), 1 = divergence found (the report
+names the first divergent cycle with a field-level diff), 2 = usage or
+capture-format error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict
+
+from .format import CaptureError
+
+
+def _parse_queue_weights(specs) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for spec in specs:
+        name, sep, mult = spec.partition("=")
+        if not sep or not name:
+            raise CaptureError(
+                f"bad --queue-weight {spec!r}: want <queue>=<multiplier>"
+            )
+        try:
+            out[name] = float(mult)
+        except ValueError as err:
+            raise CaptureError(f"bad --queue-weight {spec!r}: {err}") from err
+    return out
+
+
+def _print_verify(report: dict, as_json: bool) -> None:
+    if as_json:
+        print(json.dumps(report, sort_keys=True))
+        return
+    if report["verdict"] == "identical":
+        print(
+            f"replay verified: {report['cycles_verified']} cycles "
+            f"bit-identical (conf {report['conf_fingerprint']})"
+        )
+        return
+    print(
+        f"first divergence at cycle {report['cycle']} "
+        f"(corr={report['corr'] or '-'}, capture_ref={report['capture_ref']}):"
+    )
+    print(
+        f"  channel {report['channel']} row {report['row']} "
+        f"({report['entity']}): recorded {report['recorded']!r} != "
+        f"replayed {report['replayed']!r}"
+    )
+    print(
+        f"  audit digest recorded {report['digest_recorded']} vs "
+        f"replayed {report['digest_replayed']}; "
+        f"{report['cycles_verified']} cycles verified before this one"
+    )
+
+
+def _print_diff(report: dict) -> None:
+    print(
+        f"differential replay over {report['cycles']} cycles "
+        f"(recorded conf {report['conf_fingerprint_recorded']}, overlay "
+        f"{report['overlay']})"
+    )
+    for q, row in report["fairness"].items():
+        d = row["delta"]
+        print(
+            f"  queue {q}: share_deserved {row['base']['share_deserved']:.4f}"
+            f" -> {row['overlay']['share_deserved']:.4f} "
+            f"(delta {d['share_deserved']:+.4f}), share_allocated "
+            f"{row['base']['share_allocated']:.4f} -> "
+            f"{row['overlay']['share_allocated']:.4f} "
+            f"(delta {d['share_allocated']:+.4f})"
+        )
+    e = report["edges"]
+    print(
+        f"  bind edges: +{e['binds_added']} / -{e['binds_removed']}; "
+        f"evict edges: +{e['evicts_added']} / -{e['evicts_removed']}"
+    )
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m kube_arbitrator_tpu.capture",
+        description="replay a recorded session: verify bit-identity or "
+        "run a differential policy simulation",
+    )
+    p.add_argument(
+        "--replay", required=True, metavar="DIR",
+        help="capture directory (manifest.json + chunk files)",
+    )
+    p.add_argument(
+        "--diff", action="store_true",
+        help="differential mode: re-run under the overlay and report the "
+        "fairness-ledger + bind/evict-edge diff (default: verify mode)",
+    )
+    p.add_argument(
+        "--conf", default="", metavar="YAML",
+        help="conf overlay file; in verify mode a changed conf is "
+        "expected to DIVERGE (exit 1 names the first divergent cycle)",
+    )
+    p.add_argument(
+        "--queue-weight", action="append", default=[], metavar="QUEUE=MULT",
+        help="differential overlay: multiply one queue's weight "
+        "(repeatable)",
+    )
+    p.add_argument(
+        "--mutate", default="", metavar="CHANNEL@SEQ[:ROW]",
+        help="verify-mode canary: flip one replayed decision value and "
+        "prove the diff pinpoints it",
+    )
+    p.add_argument(
+        "--limit", type=int, default=0,
+        help="replay at most N recorded cycles (0 = all)",
+    )
+    p.add_argument("--out", default="", help="write the JSON report here")
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable stdout"
+    )
+    args = p.parse_args(argv)
+    try:
+        from ..platform import enable_persistent_cache, ensure_jax_backend
+
+        ensure_jax_backend()
+        enable_persistent_cache()
+        if args.diff:
+            from .replay import replay_differential
+
+            rc, report = replay_differential(
+                args.replay,
+                conf_overlay=args.conf,
+                queue_weights=_parse_queue_weights(args.queue_weight),
+                limit=args.limit,
+            )
+            if args.json:
+                print(json.dumps(report, sort_keys=True))
+            else:
+                _print_diff(report)
+        else:
+            from .replay import replay_verify
+
+            rc, report = replay_verify(
+                args.replay,
+                conf_overlay=args.conf,
+                mutate=args.mutate,
+                limit=args.limit,
+            )
+            _print_verify(report, args.json)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, sort_keys=True, indent=1)
+        return rc
+    except CaptureError as err:
+        print(f"error: {err}", file=sys.stderr)
+        return 2
+    except OSError as err:
+        print(f"error: {err}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

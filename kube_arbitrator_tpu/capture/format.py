@@ -1,0 +1,189 @@
+"""Capture chunk/manifest format v1 — the on-disk contract.
+
+A capture directory holds a ``manifest.json`` plus chunk files
+(``chunk-000001.bin``, ...).  A chunk is::
+
+    b"KATC" <u32 version> then per cycle record:
+    <u32 len> <zlib'd JSON header> <u32 len> <npz array block>
+
+The header carries the cycle identity (seq, corr, ts), the wall-clock-
+free decision digest (utils/audit.decision_digest), the per-field delta
+status map (``full`` / ``rows`` / ``same``), the pack statics, and —
+when changed — the index identity tables.  The npz block is the
+compressed columnar payload: ``f_<field>`` full arrays, ``i_``/``v_``
+row-delta pairs, and ``d_<channel>`` decision tensors.
+
+The FIRST record of every chunk is a ``base`` (every field full, index
+tables included), so each chunk replays independently and the recorder
+can evict old chunks under its byte budget without corrupting the tail.
+
+Every malformed artifact — bad magic, version skew, a truncated record,
+an undecodable block — surfaces as :class:`CaptureError` with the file
+named, never a raw traceback: a capture directory is an artifact humans
+hand around, and "what is wrong with it" is the error's whole job.
+"""
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import os
+import struct
+import zlib
+from typing import Dict, Iterator, Tuple
+
+import numpy as np
+
+from ..cache.snapshot import SnapshotTensors
+
+CAPTURE_FORMAT_VERSION = 1
+CHUNK_MAGIC = b"KATC"
+MANIFEST_NAME = "manifest.json"
+
+# the pack's array fields (captured full-or-delta per cycle) and its
+# static scalars (stamped in every header) — derived from the dataclass
+# so the recorder can never silently drift from the snapshot schema
+ARRAY_FIELDS: Tuple[str, ...] = tuple(
+    f.name
+    for f in dataclasses.fields(SnapshotTensors)
+    if not f.metadata.get("static")
+)
+STATIC_FIELDS: Tuple[str, ...] = tuple(
+    f.name
+    for f in dataclasses.fields(SnapshotTensors)
+    if f.metadata.get("static")
+)
+
+# the decision channels recorded verbatim each cycle — the required
+# CycleDecisions tensors (the optional compact decode lists are derived
+# data: replay re-materializes them from the same kernel), keyed to the
+# axis their rows live on so a divergence names the entity, not just a
+# row ordinal
+DECISION_AXES: Dict[str, str] = {
+    "task_node": "task",
+    "task_status": "task",
+    "bind_mask": "task",
+    "evict_mask": "task",
+    "job_ready": "job",
+    "unready_alloc": "task",
+    "node_idle": "node",
+    "node_num_tasks": "node",
+    "node_ports": "node",
+    "evict_claimant": "task",
+    "evict_phase": "task",
+    "evict_round": "task",
+    "queue_deserved": "queue",
+    "queue_alloc": "queue",
+}
+DECISION_FIELDS: Tuple[str, ...] = tuple(DECISION_AXES)
+
+
+class CaptureError(RuntimeError):
+    """A capture artifact this build cannot read (version skew,
+    truncation, corruption) — reported with the offending file, exit 2
+    from the CLI, never a traceback."""
+
+
+def conf_fingerprint(conf_yaml: str) -> str:
+    import hashlib
+
+    return hashlib.sha256(conf_yaml.encode()).hexdigest()[:16]
+
+
+def encode_record(header: dict, arrays: Dict[str, np.ndarray]) -> bytes:
+    hblob = zlib.compress(
+        json.dumps(header, sort_keys=True).encode(), 6
+    )
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    ablob = buf.getvalue()
+    return b"".join(
+        (struct.pack("<I", len(hblob)), hblob,
+         struct.pack("<I", len(ablob)), ablob)
+    )
+
+
+def _read_exact(f, n: int, path: str, what: str) -> bytes:
+    blob = f.read(n)
+    if len(blob) != n:
+        raise CaptureError(
+            f"{path}: truncated chunk ({what}: wanted {n} bytes, got "
+            f"{len(blob)}) — the capture was cut off mid-record; replay "
+            "the preceding chunks or re-record"
+        )
+    return blob
+
+
+def read_records(path: str) -> Iterator[Tuple[dict, Dict[str, np.ndarray]]]:
+    """Yield (header, arrays) per record; :class:`CaptureError` on any
+    malformed byte — including a clean-looking file of the wrong kind."""
+    with open(path, "rb") as f:
+        magic = f.read(len(CHUNK_MAGIC))
+        if magic != CHUNK_MAGIC:
+            raise CaptureError(f"{path}: not a capture chunk (bad magic)")
+        (ver,) = struct.unpack("<I", _read_exact(f, 4, path, "version"))
+        if ver != CAPTURE_FORMAT_VERSION:
+            raise CaptureError(
+                f"{path}: chunk format v{ver}; this build reads "
+                f"v{CAPTURE_FORMAT_VERSION} — re-record with this build "
+                "or replay with a matching one"
+            )
+        while True:
+            lead = f.read(4)
+            if not lead:
+                return  # clean end of chunk
+            if len(lead) != 4:
+                raise CaptureError(
+                    f"{path}: truncated chunk (dangling record length)"
+                )
+            (hlen,) = struct.unpack("<I", lead)
+            hblob = _read_exact(f, hlen, path, "record header")
+            try:
+                header = json.loads(zlib.decompress(hblob).decode())
+            except (zlib.error, ValueError) as err:
+                raise CaptureError(
+                    f"{path}: undecodable record header ({err})"
+                ) from err
+            (alen,) = struct.unpack(
+                "<I", _read_exact(f, 4, path, "array block length")
+            )
+            ablob = _read_exact(f, alen, path, "array block")
+            try:
+                with np.load(io.BytesIO(ablob), allow_pickle=False) as z:
+                    arrays = {k: z[k] for k in z.files}
+            except (ValueError, OSError, zlib.error) as err:
+                raise CaptureError(
+                    f"{path}: undecodable array block ({err})"
+                ) from err
+            yield header, arrays
+
+
+def write_manifest(path_dir: str, manifest: dict) -> None:
+    """Atomic write-then-rename: a reader (or a crash) never sees a
+    half-written manifest."""
+    final = os.path.join(path_dir, MANIFEST_NAME)
+    tmp = final + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, sort_keys=True, indent=1)
+    os.replace(tmp, final)
+
+
+def load_manifest(path_dir: str) -> dict:
+    mp = os.path.join(path_dir, MANIFEST_NAME)
+    try:
+        with open(mp) as f:
+            man = json.load(f)
+    except OSError as err:
+        raise CaptureError(
+            f"{path_dir}: not a capture directory ({err})"
+        ) from err
+    except ValueError as err:
+        raise CaptureError(f"{mp}: unreadable manifest ({err})") from err
+    ver = man.get("version")
+    if ver != CAPTURE_FORMAT_VERSION:
+        raise CaptureError(
+            f"{mp}: capture format v{ver}; this build replays "
+            f"v{CAPTURE_FORMAT_VERSION} — re-record with this build or "
+            "replay with a matching one"
+        )
+    return man

@@ -1,0 +1,401 @@
+"""The continuous session recorder: every committed cycle's pack +
+decisions, teed off the scheduler's commit tail into bounded,
+chunk-rotated, independently-replayable delta blocks.
+
+The recorder diffs each pack field against the LAST CAPTURED cycle with
+the arena's own ``_changed_rows`` primitive — its own tee of the delta
+stream rather than a reuse of ``arena.pack_meta.changed_fields``,
+because under the pipelined executor discarded speculative epochs
+advance the arena's diff base past the last *committed* (and therefore
+last captured) cycle, so the arena's change set can under-report against
+this stream.  Self-diffing is immune to that and works identically with
+no arena at all.
+
+A write failure (disk full, yanked volume) must never fail a scheduling
+cycle that already actuated: the cycle is counted into
+``capture_dropped_cycles_total``, a once-per-episode warning lands on
+stderr, and recording resumes (with a fresh base chunk) when the sink
+heals — the audit log's error-latch stance.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+import sys
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..cache.arena import _changed_rows
+from ..utils import locking
+from ..utils.metrics import MetricsRegistry, metrics
+from .format import (
+    ARRAY_FIELDS,
+    CAPTURE_FORMAT_VERSION,
+    CHUNK_MAGIC,
+    DECISION_FIELDS,
+    STATIC_FIELDS,
+    conf_fingerprint,
+    encode_record,
+    write_manifest,
+)
+
+DEFAULT_MAX_BYTES = 256 << 20  # 256 MiB of chunks before oldest-first eviction
+
+
+def _index_tables(snap) -> dict:
+    """The identity tables a replayed cycle decodes/audits through,
+    for BOTH index flavors (cache/decode._uid_lookup): the object-model
+    SnapshotIndex and the native cache's ordinal-lookup methods.  The
+    flavor is recorded so replay mimics the same audit-helper branches
+    (e.g. gang verdicts need a ``jobs`` list; the ordinal flavor has
+    none) and digests stay comparable."""
+    index, t = snap.index, snap.tensors
+    if hasattr(index, "tasks"):
+        return {
+            "flavor": "object",
+            "tasks": [task.uid for task in index.tasks],
+            "nodes": [node.name for node in index.nodes],
+            "jobs": [
+                [j.uid, int(j.min_available), int(j.ordinal)]
+                for j in index.jobs
+            ],
+            "queues": [getattr(q, "name", "") or q.uid for q in index.queues],
+        }
+    return {
+        "flavor": "ordinal",
+        "tasks": [index.task_uid(i) for i in range(int(t.num_tasks))],
+        "nodes": [index.node_name(n) for n in range(int(t.num_nodes))],
+    }
+
+
+class SessionCapture:
+    """Continuous bounded recorder; one per scheduler.  ``on_cycle`` is
+    called from the commit tail (sequential run_once AND the pipelined
+    executor); ``status()`` serves ``/debug/capture`` from the obs
+    thread, so the small status fields live under a lock while all file
+    I/O stays outside it."""
+
+    def __init__(
+        self,
+        path: str,
+        max_bytes: int = DEFAULT_MAX_BYTES,
+        chunk_bytes: Optional[int] = None,
+        conf_yaml: str = "",
+        engine: Optional[dict] = None,
+        decode_caps=None,
+        audit=None,
+        registry: Optional[MetricsRegistry] = None,
+    ):
+        os.makedirs(path, exist_ok=True)
+        self.path = path
+        self.max_bytes = int(max_bytes)
+        # chunks small enough that oldest-first eviction has granularity,
+        # large enough that base records (every field full) stay rare
+        self.chunk_bytes = int(chunk_bytes or max(self.max_bytes // 8, 1 << 20))
+        self.conf_yaml = conf_yaml
+        self.engine = dict(engine or {})
+        self.decode_caps = (
+            list(decode_caps) if decode_caps is not None else None
+        )
+        self.audit = audit  # AuditLog: its rotated JSONL segments are linked
+        self.registry = registry
+        self._lock = locking.Lock("capture.lock")
+        self._prev: Dict[str, np.ndarray] = {}
+        self._prev_tables: Optional[dict] = None
+        self._chunk = None  # open file object of the active chunk
+        self._chunk_meta: Optional[dict] = None
+        self._chunk_hash = None  # running digest chain of the active chunk
+        self._chunk_seq = 0  # monotonic chunk ordinal (survives eviction)
+        self._chunks: List[dict] = []  # closed chunks, oldest first
+        self._cycles_total = 0
+        self._bytes_total = 0
+        self._dropped = 0
+        self._last_ref: Optional[str] = None
+        self._last_seq: Optional[int] = None
+        self._broken = False
+        self._closed = False
+        self._created_ts = time.time()
+        try:
+            from ..sentinel import host_fingerprint
+
+            self.host = host_fingerprint()
+        except Exception:
+            self.host = {}
+
+    def _metrics(self) -> MetricsRegistry:
+        return self.registry if self.registry is not None else metrics()
+
+    # ---- recording (scheduler thread) ----
+
+    def on_cycle(self, seq: int, corr: str, ts: float, snap, dec) -> int:
+        """Record one committed cycle; returns bytes written (0 when the
+        cycle was dropped).  Never raises: a broken sink drops cycles
+        and warns once per episode, it does not fail scheduling."""
+        if self._closed:
+            return 0
+        try:
+            n = self._record(seq, corr, ts, snap, dec)
+            if self._broken:
+                self._broken = False
+                print(
+                    f"# kat: capture {self.path} recovered; recording "
+                    "resumed on a fresh base chunk",
+                    file=sys.stderr,
+                )
+            return n
+        except Exception as err:
+            self._metrics().counter_add("capture_dropped_cycles_total")
+            with self._lock:
+                self._dropped += 1
+            # a half-written record poisons the whole chunk tail: close
+            # it so the next healthy cycle starts a fresh base chunk
+            self._abandon_chunk()
+            self._prev.clear()
+            self._prev_tables = None
+            if not self._broken:
+                self._broken = True
+                print(
+                    f"# kat: capture {self.path} dropping cycles "
+                    f"({type(err).__name__}: {err}); scheduling continues",
+                    file=sys.stderr,
+                )
+            return 0
+
+    def _record(self, seq: int, corr: str, ts: float, snap, dec) -> int:
+        t = snap.tensors
+        base = self._chunk is None
+        fields: Dict[str, str] = {}
+        arrays: Dict[str, np.ndarray] = {}
+        for name in ARRAY_FIELDS:
+            arr = np.asarray(getattr(t, name))
+            prev = self._prev.get(name)
+            if base or prev is None:
+                fields[name] = "full"
+                arrays["f_" + name] = arr
+            else:
+                d = _changed_rows(prev, arr)
+                if d is None:
+                    fields[name] = "same"
+                elif isinstance(d, str):  # shape/dtype drift: not row-diffable
+                    fields[name] = "full"
+                    arrays["f_" + name] = arr
+                else:
+                    fields[name] = "rows"
+                    arrays["i_" + name] = d
+                    arrays["v_" + name] = arr[d]
+            # packs are immutable by contract (KAT-PUR: producers never
+            # write into shipped arrays), so holding references is safe
+            # and the tee costs zero copies on unchanged fields
+            self._prev[name] = arr
+        for name in DECISION_FIELDS:
+            arrays["d_" + name] = np.asarray(getattr(dec, name))
+        from ..utils.audit import decision_digest
+
+        digest = decision_digest(snap, dec)
+        header = {
+            "seq": int(seq),
+            "corr": corr or "",
+            "ts": float(ts),
+            "digest": digest,
+            "kind": "base" if base else "delta",
+            "statics": {n: int(getattr(t, n)) for n in STATIC_FIELDS},
+            "fields": fields,
+        }
+        tables = _index_tables(snap)
+        if base or tables != self._prev_tables:
+            header["index"] = tables
+            self._prev_tables = tables
+        blob = encode_record(header, arrays)
+        if base:
+            self._open_chunk(seq, corr)
+        self._chunk.write(blob)
+        self._chunk.flush()
+        meta = self._chunk_meta
+        meta["cycles"] += 1
+        meta["bytes"] += len(blob)
+        meta["last_seq"] = int(seq)
+        meta["last_corr"] = corr or ""
+        self._chunk_hash.update(digest.encode())
+        meta["digest_chain"] = self._chunk_hash.hexdigest()[:16]
+        ref = f"{meta['file']}:{meta['cycles'] - 1}"
+        m = self._metrics()
+        m.counter_add("capture_bytes_total", len(blob))
+        with self._lock:
+            self._cycles_total += 1
+            self._bytes_total += len(blob)
+            self._last_ref = ref
+            self._last_seq = int(seq)
+        if meta["bytes"] >= self.chunk_bytes:
+            self._close_chunk()
+        self._enforce_budget()
+        self._write_manifest()
+        return len(blob)
+
+    # ---- chunk lifecycle ----
+
+    def _open_chunk(self, seq: int, corr: str) -> None:
+        self._chunk_seq += 1
+        name = f"chunk-{self._chunk_seq:06d}.bin"
+        reason = "first" if self._chunk_seq == 1 else "rotate"
+        f = open(os.path.join(self.path, name), "wb")
+        f.write(CHUNK_MAGIC)
+        f.write(struct.pack("<I", CAPTURE_FORMAT_VERSION))
+        self._chunk = f
+        self._chunk_hash = hashlib.sha256()
+        self._chunk_meta = {
+            "file": name,
+            "first_seq": int(seq),
+            "first_corr": corr or "",
+            "last_seq": int(seq),
+            "last_corr": corr or "",
+            "cycles": 0,
+            "bytes": len(CHUNK_MAGIC) + 4,
+            "digest_chain": "",
+        }
+        self._metrics().counter_add(
+            "capture_chunks_total", labels={"reason": reason}
+        )
+
+    def _close_chunk(self) -> None:
+        if self._chunk is None:
+            return
+        self._chunk.close()
+        self._chunks.append(self._chunk_meta)
+        self._chunk = None
+        self._chunk_meta = None
+        self._chunk_hash = None
+
+    def _abandon_chunk(self) -> None:
+        """Drop the active chunk after a write error: its tail may be a
+        half-record, so it is closed and EXCLUDED from the manifest (a
+        replayer would reject the truncation)."""
+        if self._chunk is None:
+            return
+        try:
+            self._chunk.close()
+        except OSError:
+            pass
+        meta = self._chunk_meta or {"cycles": 0, "bytes": 0, "file": ""}
+        if meta["cycles"]:
+            self._metrics().counter_add(
+                "capture_dropped_cycles_total", meta["cycles"]
+            )
+        with self._lock:
+            self._dropped += meta["cycles"]
+            self._cycles_total -= meta["cycles"]
+            self._bytes_total -= min(meta["bytes"], self._bytes_total)
+        if meta["file"]:
+            try:
+                os.remove(os.path.join(self.path, meta["file"]))
+            except OSError:
+                pass
+        self._chunk = None
+        self._chunk_meta = None
+        self._chunk_hash = None
+
+    def _enforce_budget(self) -> None:
+        """Evict whole closed chunks, oldest first, until under
+        ``max_bytes``; the active chunk is never evicted.  Works because
+        every chunk opens with a base record — the remaining tail replays
+        without the evicted prefix."""
+        def total() -> int:
+            n = sum(c["bytes"] for c in self._chunks)
+            if self._chunk_meta is not None:
+                n += self._chunk_meta["bytes"]
+            return n
+
+        while self._chunks and total() > self.max_bytes:
+            victim = self._chunks.pop(0)
+            try:
+                os.remove(os.path.join(self.path, victim["file"]))
+            except OSError:
+                pass
+            self._metrics().counter_add(
+                "capture_dropped_cycles_total", victim["cycles"]
+            )
+            with self._lock:
+                self._dropped += victim["cycles"]
+                self._bytes_total -= victim["bytes"]
+                self._cycles_total -= victim["cycles"]
+
+    def _manifest(self) -> dict:
+        chunks = list(self._chunks)
+        if self._chunk_meta is not None and self._chunk_meta["cycles"]:
+            chunks.append(dict(self._chunk_meta))
+        audit_log = None
+        if self.audit is not None and getattr(self.audit, "log_path", None):
+            audit_log = {
+                "path": self.audit.log_path,
+                "segments": [
+                    os.path.basename(p)
+                    for p in getattr(
+                        self.audit, "rotated_segments", lambda: []
+                    )()
+                ],
+            }
+        with self._lock:
+            dropped = self._dropped
+            total_bytes = self._bytes_total
+            cycles = self._cycles_total
+        return {
+            "version": CAPTURE_FORMAT_VERSION,
+            "created_ts": self._created_ts,
+            "conf": self.conf_yaml,
+            "conf_fingerprint": conf_fingerprint(self.conf_yaml),
+            "engine": self.engine,
+            "decode_caps": self.decode_caps,
+            "host": self.host,
+            "audit_log": audit_log,
+            "chunks": chunks,
+            "cycles": cycles,
+            "dropped_cycles": dropped,
+            "total_bytes": total_bytes,
+        }
+
+    def _write_manifest(self) -> None:
+        write_manifest(self.path, self._manifest())
+
+    # ---- the obs surface (any thread) ----
+
+    def last_ref(self) -> Optional[str]:
+        """``<chunk file>:<cycle offset>`` of the last recorded cycle —
+        the join key flight digests carry (``capture_ref``) so an
+        anomaly dump names the recorded window that reproduces it."""
+        with self._lock:
+            return self._last_ref
+
+    def status(self) -> dict:
+        with self._lock:
+            out = {
+                "dir": self.path,
+                "format_version": CAPTURE_FORMAT_VERSION,
+                "conf_fingerprint": conf_fingerprint(self.conf_yaml),
+                "max_bytes": self.max_bytes,
+                "chunk_bytes": self.chunk_bytes,
+                "chunks": len(self._chunks)
+                + (1 if self._chunk_meta is not None else 0),
+                "cycles": self._cycles_total,
+                "bytes": self._bytes_total,
+                "dropped_cycles": self._dropped,
+                "last_seq": self._last_seq,
+                "last_ref": self._last_ref,
+                "broken": self._broken,
+            }
+        return out
+
+    def close(self) -> None:
+        """Flush the active chunk and the final manifest; idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._close_chunk()
+            self._write_manifest()
+        except OSError as err:
+            print(
+                f"# kat: capture {self.path} close failed ({err})",
+                file=sys.stderr,
+            )

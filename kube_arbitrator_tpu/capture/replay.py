@@ -1,0 +1,475 @@
+"""Offline replay of a captured session: verify and differential modes.
+
+Both modes reconstruct each cycle's exact snapshot pack from the
+recorded delta blocks and drive the REAL cycle phases — the same
+``Session.decide_phase`` / ``decode_phase`` the live loop ran, under the
+conf recorded in the manifest (or an overlay).
+
+* **verify** asserts bit-identical decisions channel-by-channel against
+  the recorded tensors AND the recorded wall-clock-free audit digest,
+  reporting the FIRST divergence with a field-level diff: which decision
+  channel, which row, which entity (task uid / node name / queue) —
+  joined to the recorded corr-id and ``capture_ref`` so the cycle's
+  trace and flight dump are one lookup away.
+* **differential** re-runs the same window under a changed conf and/or
+  queue-weight overlay and emits a side-by-side fairness-ledger +
+  bind/evict-edge diff report (the Gavel-style "what if this policy had
+  been on" simulation) as JSON plus a stdout summary.
+
+Determinism contract (also in the README): the pack and the decision
+kernels are pure functions, so a replay on the same host class
+reproduces decisions bit-identically; wall clocks, pids, and the host
+fingerprint are STAMPED in the manifest, never replayed, and the audit
+digest strips every wall-clock-derived field (``ts``, ``starvation_s``,
+``actuated``) for exactly this reason.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from types import SimpleNamespace
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from .format import (
+    ARRAY_FIELDS,
+    DECISION_AXES,
+    DECISION_FIELDS,
+    STATIC_FIELDS,
+    CaptureError,
+    load_manifest,
+    read_records,
+)
+
+
+@dataclasses.dataclass
+class ReplayCycle:
+    seq: int
+    corr: str
+    ts: float
+    digest: str
+    ref: str  # capture_ref: <chunk file>:<cycle offset>
+    snap: object  # cache.snapshot.Snapshot
+    recorded: Dict[str, np.ndarray]  # decision channels as recorded
+
+
+class _OrdinalIndex:
+    """Mimics the native cache's method-flavor index (``task_uid``/
+    ``node_name``, deliberately NO ``tasks``/``jobs`` attributes) so the
+    audit helpers take the same branches they took at record time."""
+
+    def __init__(self, tasks: List[str], nodes: List[str]):
+        self._tasks = tasks
+        self._nodes = nodes
+
+    def task_uid(self, i: int) -> str:
+        return self._tasks[i]
+
+    def node_name(self, n: int) -> str:
+        return self._nodes[n] if 0 <= n < len(self._nodes) else str(n)
+
+
+def _build_index(tables: dict):
+    if tables.get("flavor") == "ordinal":
+        return _OrdinalIndex(tables["tasks"], tables["nodes"])
+    from ..cache.snapshot import SnapshotIndex
+
+    return SnapshotIndex(
+        tasks=[SimpleNamespace(uid=u) for u in tables["tasks"]],
+        nodes=[SimpleNamespace(name=n) for n in tables["nodes"]],
+        jobs=[
+            SimpleNamespace(uid=u, min_available=ma, ordinal=o)
+            for u, ma, o in tables["jobs"]
+        ],
+        queues=[SimpleNamespace(name=q, uid=q) for q in tables["queues"]],
+        port_universe=[],
+    )
+
+
+def iter_cycles(path: str, limit: int = 0) -> Iterator[ReplayCycle]:
+    """Reconstruct cycles across the manifest's chunks, applying delta
+    blocks onto the running pack.  :class:`CaptureError` on any
+    malformed artifact."""
+    from ..cache.snapshot import Snapshot, SnapshotTensors
+
+    man = load_manifest(path)
+    arrays: Dict[str, np.ndarray] = {}
+    tables: Optional[dict] = None
+    index = None
+    yielded = 0
+    for ch in man.get("chunks", []):
+        cpath = os.path.join(path, ch["file"])
+        if not os.path.exists(cpath):
+            raise CaptureError(
+                f"{path}: manifest names missing chunk {ch['file']}"
+            )
+        for off, (header, rec) in enumerate(read_records(cpath)):
+            fields = header.get("fields", {})
+            missing = set(ARRAY_FIELDS) - set(fields)
+            if missing and header.get("kind") == "base":
+                raise CaptureError(
+                    f"{cpath}: recorded pack schema lacks fields "
+                    f"{sorted(missing)[:4]}... — recorded by an older "
+                    "build; re-record"
+                )
+            for name, st in fields.items():
+                if name not in ARRAY_FIELDS:
+                    continue  # fields this build no longer knows: ignore
+                if st == "full":
+                    arrays[name] = rec["f_" + name]
+                elif st == "rows":
+                    a = np.array(arrays[name], copy=True)
+                    a[rec["i_" + name]] = rec["v_" + name]
+                    arrays[name] = a
+            if "index" in header:
+                tables = header["index"]
+                index = _build_index(tables)
+            if index is None:
+                raise CaptureError(
+                    f"{cpath}: first record carries no index tables"
+                )
+            statics = {
+                n: int(header.get("statics", {}).get(n, 0))
+                for n in STATIC_FIELDS
+            }
+            tens = SnapshotTensors(
+                **{n: arrays[n] for n in ARRAY_FIELDS}, **statics
+            )
+            recorded = {
+                n: rec["d_" + n] for n in DECISION_FIELDS if "d_" + n in rec
+            }
+            yield ReplayCycle(
+                seq=int(header["seq"]),
+                corr=header.get("corr", ""),
+                ts=float(header.get("ts", 0.0)),
+                digest=header.get("digest", ""),
+                ref=f"{ch['file']}:{off}",
+                snap=Snapshot(tensors=tens, index=index),
+                recorded=recorded,
+            )
+            yielded += 1
+            if limit and yielded >= limit:
+                return
+
+
+def _session(config):
+    from ..framework.decider import LocalDecider
+    from ..framework.session import Session
+
+    # no cluster: replay only drives the pack-pure phases
+    # (decide/decode); the snapshot phase is the recording itself
+    return Session(None, config, decider=LocalDecider())
+
+
+def _load_config(man: dict, conf_overlay: str = ""):
+    from ..framework.conf import load_conf
+
+    if conf_overlay:
+        with open(conf_overlay) as f:
+            return load_conf(f.read())
+    conf = man.get("conf", "")
+    if not conf:
+        raise CaptureError("manifest carries no conf; pass --conf")
+    return load_conf(conf)
+
+
+def _entity(snap, channel: str, row: int) -> str:
+    from ..utils.audit import _node_name, _queue_names, _task_uid
+
+    axis = DECISION_AXES.get(channel, "")
+    try:
+        if axis == "task":
+            return f"task={_task_uid(snap.index, row)}"
+        if axis == "node":
+            return f"node={_node_name(snap.index, row)}"
+        if axis == "queue":
+            names = _queue_names(snap)
+            return f"queue={names[row] if row < len(names) else row}"
+        if axis == "job":
+            from ..utils.audit import _job_uids
+
+            uids = _job_uids(snap)
+            return f"job={uids[row] if row < len(uids) else row}"
+    except Exception:
+        pass
+    return f"{axis or 'row'}#{row}"
+
+
+def _first_diff(
+    recorded: np.ndarray, replayed: np.ndarray
+) -> Tuple[int, object, object]:
+    """(row, recorded value, replayed value) of the first differing row."""
+    if recorded.shape != replayed.shape:
+        return -1, f"shape{recorded.shape}", f"shape{replayed.shape}"
+    d = recorded != replayed
+    if d.ndim > 1:
+        d = d.any(axis=tuple(range(1, d.ndim)))
+    if d.ndim == 0:
+        return 0, recorded.tolist(), replayed.tolist()
+    row = int(np.nonzero(d)[0][0])
+    return row, recorded[row].tolist(), replayed[row].tolist()
+
+
+def _mutate_decisions(dec, channel: str, row: Optional[int]):
+    """The seeded single-field mutation seam (``--mutate``): flips one
+    value in one replayed decision channel so the verify report's
+    pinpointing is itself testable."""
+    arr = np.array(np.asarray(getattr(dec, channel)), copy=True)
+    if row is None:
+        # first "interesting" row: a set mask bit / nonzero entry, else 0
+        nz = np.nonzero(arr.reshape(arr.shape[0], -1).any(axis=1))[0]
+        row = int(nz[0]) if nz.size else 0
+    if arr.dtype == bool:
+        arr[row] = ~arr[row]
+    else:
+        arr[row] = arr[row] + 1
+    return dataclasses.replace(dec, **{channel: arr}), row
+
+
+def parse_mutation(spec: str) -> Tuple[str, int, Optional[int]]:
+    """``channel@seq[:row]`` -> (channel, seq, row|None)."""
+    channel, _, rest = spec.partition("@")
+    if not rest or channel not in DECISION_AXES:
+        raise CaptureError(
+            f"bad --mutate {spec!r}: want <channel>@<seq>[:row] with "
+            f"channel one of {', '.join(DECISION_FIELDS)}"
+        )
+    seq_s, _, row_s = rest.partition(":")
+    try:
+        return channel, int(seq_s), (int(row_s) if row_s else None)
+    except ValueError as err:
+        raise CaptureError(f"bad --mutate {spec!r}: {err}") from err
+
+
+def _count_divergence() -> None:
+    # the offline verifier's one exported family: a nightly replay job
+    # pushes it (pushgateway / textfile collector) so the dashboard's
+    # divergence panel goes nonzero the run a build stops reproducing
+    from ..utils.metrics import metrics
+
+    metrics().counter_add("replay_divergence_total")
+
+
+def replay_verify(
+    path: str,
+    conf_overlay: str = "",
+    mutate: str = "",
+    limit: int = 0,
+) -> Tuple[int, dict]:
+    """Replay-verify; returns (exit code, report).  0 = every cycle
+    bit-identical; 1 = divergence (report carries the field-level diff
+    of the FIRST divergent cycle)."""
+    from ..utils.audit import decision_digest
+
+    man = load_manifest(path)
+    config = _load_config(man, conf_overlay)
+    mut = parse_mutation(mutate) if mutate else None
+    session = _session(config)
+    cycles = 0
+    for rc in iter_cycles(path, limit=limit):
+        dec, _, _ = session.decide_phase(rc.snap, rc.snap.tensors, None)
+        if mut is not None and rc.seq == mut[1]:
+            dec, _ = _mutate_decisions(dec, mut[0], mut[2])
+        cycles += 1
+        for name in DECISION_FIELDS:
+            if name not in rc.recorded:
+                continue
+            rec_arr = rc.recorded[name]
+            rep_arr = np.asarray(getattr(dec, name))
+            if rec_arr.shape == rep_arr.shape and np.array_equal(
+                rec_arr, rep_arr
+            ):
+                continue
+            row, rv, pv = _first_diff(rec_arr, rep_arr)
+            _count_divergence()
+            return 1, {
+                "verdict": "divergent",
+                "cycle": rc.seq,
+                "corr": rc.corr,
+                "capture_ref": rc.ref,
+                "channel": name,
+                "row": row,
+                "entity": _entity(rc.snap, name, max(row, 0)),
+                "recorded": rv,
+                "replayed": pv,
+                "digest_recorded": rc.digest,
+                "digest_replayed": decision_digest(rc.snap, dec),
+                "cycles_verified": cycles - 1,
+            }
+        d = decision_digest(rc.snap, dec)
+        if rc.digest and d != rc.digest:
+            # channels match but the digest does not: the audit
+            # projection itself drifted (schema/helper change)
+            _count_divergence()
+            return 1, {
+                "verdict": "divergent",
+                "cycle": rc.seq,
+                "corr": rc.corr,
+                "capture_ref": rc.ref,
+                "channel": "audit_digest",
+                "row": -1,
+                "entity": "",
+                "recorded": rc.digest,
+                "replayed": d,
+                "digest_recorded": rc.digest,
+                "digest_replayed": d,
+                "cycles_verified": cycles - 1,
+            }
+    return 0, {
+        "verdict": "identical",
+        "cycles_verified": cycles,
+        "conf_fingerprint": man.get("conf_fingerprint", ""),
+    }
+
+
+def _edges(snap, arrays: Dict[str, np.ndarray]) -> Tuple[set, set]:
+    """(bind edges, evict edges) as entity tuples, from raw channels —
+    one definition for the recorded AND the overlay side."""
+    from ..utils.audit import _node_name, _task_uid
+
+    bind_mask = np.asarray(arrays["bind_mask"])
+    task_node = np.asarray(arrays["task_node"])
+    binds = {
+        (
+            _task_uid(snap.index, int(i)),
+            _node_name(snap.index, int(task_node[i])),
+        )
+        for i in np.nonzero(bind_mask)[0]
+    }
+    evict_mask = np.asarray(arrays["evict_mask"])
+    evicts = {_task_uid(snap.index, int(i)) for i in np.nonzero(evict_mask)[0]}
+    return binds, evicts
+
+
+def _fair_rows(snap, arrays: Dict[str, np.ndarray]) -> List[dict]:
+    from ..utils.audit import fairness_ledger
+
+    dec = SimpleNamespace(
+        queue_deserved=arrays["queue_deserved"],
+        queue_alloc=arrays["queue_alloc"],
+    )
+    return fairness_ledger(snap, dec)
+
+
+def replay_differential(
+    path: str,
+    conf_overlay: str = "",
+    queue_weights: Optional[Dict[str, float]] = None,
+    limit: int = 0,
+    max_cycle_rows: int = 50,
+) -> Tuple[int, dict]:
+    """Re-run the recorded window under an overlay (changed conf and/or
+    queue-weight multipliers) and diff it against the recorded decisions:
+    the per-queue fairness ledger side-by-side plus bind/evict edge
+    adds/removes.  Returns (exit code, report)."""
+    man = load_manifest(path)
+    config = _load_config(man, conf_overlay)
+    queue_weights = queue_weights or {}
+    session = _session(config)
+    fair: Dict[str, dict] = {}
+    bind_added = bind_removed = evict_added = evict_removed = 0
+    per_cycle: List[dict] = []
+    cycles = 0
+    samples: List[dict] = []
+    for rc in iter_cycles(path, limit=limit):
+        snap = rc.snap
+        if queue_weights:
+            from ..utils.audit import _queue_names
+
+            qnames = _queue_names(snap)
+            qw = np.array(np.asarray(snap.tensors.queue_weight), copy=True)
+            for qname, mult in queue_weights.items():
+                if qname not in qnames:
+                    raise CaptureError(
+                        f"--queue-weight {qname}: no such queue in the "
+                        f"recorded window (queues: {', '.join(qnames)})"
+                    )
+                qi = qnames.index(qname)
+                qw[qi] = qw[qi] * mult
+            snap = dataclasses.replace(
+                snap, tensors=dataclasses.replace(snap.tensors, queue_weight=qw)
+            )
+        dec, _, _ = session.decide_phase(snap, snap.tensors, None)
+        cycles += 1
+        # fairness ledger, base (recorded channels) vs overlay (replayed)
+        base_rows = _fair_rows(rc.snap, rc.recorded)
+        over_rows = _fair_rows(
+            snap, {n: np.asarray(getattr(dec, n)) for n in
+                   ("queue_deserved", "queue_alloc")}
+        )
+        for side, rows in (("base", base_rows), ("overlay", over_rows)):
+            for r in rows:
+                agg = fair.setdefault(r["queue"], {
+                    "base": {"share_deserved": 0.0, "share_allocated": 0.0},
+                    "overlay": {"share_deserved": 0.0, "share_allocated": 0.0},
+                })
+                agg[side]["share_deserved"] += r["share_deserved"]
+                agg[side]["share_allocated"] += r["share_allocated"]
+        # edge diffs
+        b0, e0 = _edges(rc.snap, rc.recorded)
+        b1, e1 = _edges(
+            snap,
+            {n: np.asarray(getattr(dec, n))
+             for n in ("bind_mask", "task_node", "evict_mask")},
+        )
+        add_b, rem_b = b1 - b0, b0 - b1
+        add_e, rem_e = e1 - e0, e0 - e1
+        bind_added += len(add_b)
+        bind_removed += len(rem_b)
+        evict_added += len(add_e)
+        evict_removed += len(rem_e)
+        for task, node in sorted(add_b)[:2]:
+            if len(samples) < 20:
+                samples.append({
+                    "cycle": rc.seq, "kind": "bind_added",
+                    "task": task, "node": node,
+                })
+        if (add_b or rem_b or add_e or rem_e) and len(per_cycle) < max_cycle_rows:
+            per_cycle.append({
+                "cycle": rc.seq,
+                "capture_ref": rc.ref,
+                "binds_added": len(add_b),
+                "binds_removed": len(rem_b),
+                "evicts_added": len(add_e),
+                "evicts_removed": len(rem_e),
+            })
+    if cycles == 0:
+        raise CaptureError(f"{path}: capture holds no replayable cycles")
+    queues = {}
+    for q, agg in sorted(fair.items()):
+        row = {
+            side: {
+                k: round(v / cycles, 6) for k, v in agg[side].items()
+            }
+            for side in ("base", "overlay")
+        }
+        row["delta"] = {
+            k: round(
+                row["overlay"][k] - row["base"][k], 6
+            )
+            for k in ("share_deserved", "share_allocated")
+        }
+        queues[q] = row
+    report = {
+        "version": 1,
+        "mode": "differential",
+        "cycles": cycles,
+        "conf_fingerprint_recorded": man.get("conf_fingerprint", ""),
+        "overlay": {
+            "conf": os.path.basename(conf_overlay) if conf_overlay else None,
+            "queue_weights": queue_weights,
+        },
+        # mean-over-cycles dominant shares per queue, both sides + delta
+        "fairness": queues,
+        "edges": {
+            "binds_added": bind_added,
+            "binds_removed": bind_removed,
+            "evicts_added": evict_added,
+            "evicts_removed": evict_removed,
+            "samples": samples,
+        },
+        "per_cycle": per_cycle,
+    }
+    return 0, report

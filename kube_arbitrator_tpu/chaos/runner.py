@@ -204,10 +204,13 @@ def run_chaos(
     disabled: Sequence[str] = (),
     plan: Optional[FaultPlan] = None,
     out_dir: Optional[str] = None,
+    capture_dir: Optional[str] = None,
 ) -> ChaosReport:
     """One deterministic chaos run; see the module docstring.  ``plan``
     overrides generation (replay/shrink); ``out_dir`` (if set) receives a
-    repro file when any invariant breaches."""
+    repro file when any invariant breaches; ``capture_dir`` (if set) tees
+    every committed cycle into the session-capture plane, so a chaos run
+    replay-verifies offline like any other recorded session."""
     prof = profile if isinstance(profile, ChaosProfile) else PROFILES[profile or "smoke"]
     disabled = tuple(sorted(set(disabled)))
     unknown = set(disabled) - set(DISABLE_CHOICES)
@@ -259,6 +262,24 @@ def run_chaos(
         phase_hook=make_phase_hook(injector, clock, elector),
         audit=audit,
     )
+    capture = None
+    if capture_dir:
+        from ..capture import SessionCapture
+        from ..framework.conf import dump_conf
+
+        capture = SessionCapture(
+            capture_dir,
+            conf_yaml=dump_conf(sched.config),
+            engine={
+                "chaos_profile": prof.name,
+                "chaos_seed": seed,
+                "pipeline": bool(prof.pipeline),
+                "arena": bool(prof.arena),
+                "shard": prof.shard,
+            },
+            audit=audit,
+        )
+        sched.capture = capture
     if not elector.acquire_blocking(timeout_s=120.0):
         raise RuntimeError("chaos: initial leader acquisition failed")
     executor = None
@@ -294,6 +315,8 @@ def run_chaos(
             # close on EVERY path (an escaped fatal must not leak the
             # decide worker or leave the journal teed into the arena)
             executor.close()
+        if capture is not None:
+            capture.close()
     breaches += checker.final(api, cache, total)
     report = ChaosReport(
         seed=seed, profile=prof, cycles=cycles, disabled=disabled, plan=plan,
@@ -428,6 +451,12 @@ def main(argv=None) -> int:
         f"({', '.join(DISABLE_CHOICES)})",
     )
     p.add_argument("--out-dir", default=".", help="failure repro files land here")
+    p.add_argument(
+        "--capture-dir", default="",
+        help="record the run into the session-capture plane (replayable "
+        "with `python -m kube_arbitrator_tpu.capture --replay DIR`); "
+        "single-world profiles only",
+    )
     p.add_argument("--json", action="store_true", help="machine-readable summary")
     args = p.parse_args(argv)
     disabled = {x.strip() for x in args.disable.split(",") if x.strip()}
@@ -523,9 +552,20 @@ def main(argv=None) -> int:
         # multi-replica posture: M tenant worlds on N shared decision
         # replicas (chaos/pool_runner.py), pool_consistency armed
         from .pool_runner import run_pool_chaos as run_fn
+    kwargs = {}
+    if args.capture_dir:
+        if run_fn is not run_chaos:
+            # the soak/pool runners drive several worlds at once — there
+            # is no single session stream to capture
+            print(
+                "error: --capture-dir needs a single-world profile",
+                file=sys.stderr,
+            )
+            return 2
+        kwargs["capture_dir"] = args.capture_dir
     report = run_fn(
         seed=args.seed, cycles=args.cycles, profile=prof,
-        disabled=disabled, out_dir=args.out_dir,
+        disabled=disabled, out_dir=args.out_dir, **kwargs,
     )
     repro = (
         os.path.join(args.out_dir, f"chaos-repro-{prof.name}-{args.seed}.json")

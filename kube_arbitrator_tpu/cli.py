@@ -137,6 +137,23 @@ def build_parser() -> argparse.ArgumentParser:
         help="decision-audit ring capacity in cycles (default 256)",
     )
     p.add_argument(
+        "--audit-log-max-bytes",
+        type=int,
+        default=0,
+        metavar="N",
+        help="size-rotate the --audit-log JSONL: when the next record "
+        "would push it past N bytes, shift path -> path.1 -> ... and "
+        "start fresh (0 = never rotate)",
+    )
+    p.add_argument(
+        "--audit-log-keep",
+        type=int,
+        default=4,
+        metavar="K",
+        help="rotated --audit-log segments kept (path.1..path.K) before "
+        "the oldest is dropped (default 4)",
+    )
+    p.add_argument(
         "--starvation-slo-s",
         type=float,
         default=0.0,
@@ -233,6 +250,26 @@ def build_parser() -> argparse.ArgumentParser:
         default="",
         help="replay a recorded trace through the decision kernel and exit",
     )
+    # session capture & deterministic replay plane (capture/)
+    p.add_argument(
+        "--capture-dir",
+        default="",
+        metavar="DIR",
+        help="continuously record every committed cycle (snapshot deltas, "
+        "decision tensors, audit digest) into versioned chunk files under "
+        "DIR; replay offline with `python -m kube_arbitrator_tpu.capture "
+        "--replay DIR` (verify bit-identity, pinpoint divergence, or "
+        "differential-replay a conf/queue-weight change)",
+    )
+    p.add_argument(
+        "--capture-max-bytes",
+        type=int,
+        default=256 << 20,  # capture.recorder.DEFAULT_MAX_BYTES
+        metavar="N",
+        help="capture-dir disk budget; oldest closed chunks are evicted "
+        "to stay under it (every chunk starts with a full base record, "
+        "so the surviving window always replays; default 256 MiB)",
+    )
     return p
 
 
@@ -322,11 +359,15 @@ def main(argv=None) -> int:
             log_path=args.audit_log or None,
             flight=flight,
             starvation_slo_s=args.starvation_slo_s or None,
+            log_max_bytes=args.audit_log_max_bytes,
+            log_keep=args.audit_log_keep,
         )
     if args.profile_kernels:
         from .utils.profiling import profiler
 
         profiler().enable()
+
+    capture = None  # built after the Scheduler (needs the resolved conf)
 
     def _serve_obs(status_fn=None):
         if args.obs_port is None:
@@ -336,7 +377,7 @@ def main(argv=None) -> int:
         server, _thread, url = serve_obs(
             host=args.obs_host, port=args.obs_port,
             flight=flight, status_fn=status_fn, timeseries=sampler,
-            audit=audit, replica_id=args.replica_id,
+            audit=audit, capture=capture, replica_id=args.replica_id,
         )
         # the bound address is logged (not just the requested one):
         # --obs-port 0 binds an ephemeral port per replica, and this
@@ -464,6 +505,26 @@ def main(argv=None) -> int:
 
         recorder = TraceRecorder(args.record_trace, conf_yaml=dump_conf(sched.config))
         sched.trace_recorder = recorder
+    if args.capture_dir:
+        # like the trace recorder, the capture manifest carries the
+        # *resolved* conf (plus engine flags + decode caps) so an offline
+        # replay re-runs exactly the decision program the live run used
+        from .capture import SessionCapture
+        from .framework.conf import dump_conf
+
+        capture = SessionCapture(
+            args.capture_dir,
+            max_bytes=args.capture_max_bytes,
+            conf_yaml=dump_conf(sched.config),
+            engine={
+                "pipeline": bool(args.pipeline),
+                "arena": bool(args.arena or args.pipeline),
+                "decision_endpoint": args.decision_endpoint or "",
+            },
+            decode_caps=getattr(arena, "decode_caps", None),
+            audit=audit,
+        )
+        sched.capture = capture
     from .obs import scheduler_status_fn
 
     obs_server = _serve_obs(status_fn=scheduler_status_fn(sched))
@@ -482,6 +543,14 @@ def main(argv=None) -> int:
             recorder.close()
             print(
                 f"recorded {len(recorder)} cycle snapshots to {args.record_trace}",
+                file=sys.stderr,
+            )
+        if capture is not None:
+            capture.close()
+            st = capture.status()
+            print(
+                f"captured {st['cycles']} cycles ({st['bytes']} bytes, "
+                f"{st['chunks']} chunks) to {args.capture_dir}",
                 file=sys.stderr,
             )
     total_binds = sum(s.binds for s in sched.history)

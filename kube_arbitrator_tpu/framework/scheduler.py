@@ -77,6 +77,7 @@ class CycleStats:
     actuate_ms: float = 0.0
     transport_ms: float = 0.0
     upload_ms: float = 0.0
+    capture_ms: float = 0.0
 
 
 class Scheduler:
@@ -100,6 +101,7 @@ class Scheduler:
         wait_for_event=None,
         timeseries=None,
         audit=None,
+        capture=None,
     ):
         # conf is re-loadable per Run like the reference (scheduler.go:66-78)
         self.sim = sim
@@ -159,6 +161,11 @@ class Scheduler:
         # (run_once AND the pipelined executor, which passes its
         # post-revalidation actuated sets); None costs nothing
         self.audit = audit
+        # session capture plane (capture.SessionCapture): every committed
+        # cycle's pack + decisions teed into bounded replayable chunks,
+        # on the sequential AND the pipelined commit tail; None costs
+        # nothing
+        self.capture = capture
         self._consecutive_cycle_errors = 0
         self.job_status: Dict[str, PodGroupStatus] = {}
         # delta write-back signatures (Session.status_cache): lets quiet
@@ -169,6 +176,8 @@ class Scheduler:
         # flight digest records per-cycle DELTAS for this tenant)
         self._pool_outcomes_prev: Dict[str, float] = {}
         self.last_cycle_ts: Optional[float] = None  # /readyz freshness
+        self._cycle_corr: Optional[str] = None
+        self._cycle_ts: float = 0.0
         self._last_event_msg: Dict[tuple, str] = {}
         self._cycle_seq = 0
         self._last_pending_hist: Dict[str, int] = {}
@@ -187,6 +196,11 @@ class Scheduler:
         # corr None, so activate() passes through and no spans allocate
         corr = tr.corr_for_cycle(self._cycle_seq)
         cycle_ts = time.time()
+        # the inner cycle's capture tee needs the cycle identity (it
+        # runs before CycleStats assembly so capture_ms lands in the
+        # SAME cycle's stats/timeseries row)
+        self._cycle_corr = corr
+        self._cycle_ts = cycle_ts
         with ctx, tr.activate(corr):
             try:
                 with tr.span("cycle", seq=self._cycle_seq):
@@ -209,6 +223,31 @@ class Scheduler:
         if self.audit is None:
             return
         self.audit.observe_cycle(seq, corr, cycle_ts, result)
+
+    def _capture_cycle(
+        self, seq: int, corr: Optional[str], cycle_ts: float, result: CycleResult
+    ) -> float:
+        """Tee the committed cycle into the session capture plane
+        (capture.SessionCapture) — shared by run_once and the pipelined
+        executor; returns the capture wall ms for the cycle's stats.
+        The recorder absorbs its own sink errors (dropped-cycle
+        accounting), so this never fails a cycle that already
+        actuated."""
+        if self.capture is None:
+            return 0.0
+        t0 = time.perf_counter()
+        self.capture.on_cycle(
+            seq, corr or "", cycle_ts, result.snapshot, result.decisions
+        )
+        return (time.perf_counter() - t0) * 1000
+
+    def _capture_ref(self) -> Optional[str]:
+        """``<chunk>:<offset>`` of the last captured cycle, or None —
+        flight dumps carry it so an anomaly names the recorded window
+        that reproduces it."""
+        if self.capture is None:
+            return None
+        return self.capture.last_ref()
 
     def _fairness_digest(self) -> list:
         """Compact top-|delta| ledger rows for the flight digest, reused
@@ -291,6 +330,9 @@ class Scheduler:
                     # must show the fleet posture of the failing cycle
                     "pool_outcomes": self._pool_outcomes_digest(),
                     "shard_skew": metrics().gauge_value("shard_skew"),
+                    # the capture join key: which recorded chunk+offset
+                    # replays this cycle (None with capture off)
+                    "capture_ref": self._capture_ref(),
                 },
                 spans=[s.to_dict() for s in tracer().spans(corr)] if corr else [],
             )
@@ -325,6 +367,10 @@ class Scheduler:
                 corr_id=corr,
                 ts=cycle_ts,
                 error=f"{type(err).__name__}: {err}",
+                # the failing cycle never committed (no record of its
+                # own): the ref names the last captured cycle — the
+                # recorded window leading up to this failure
+                digests={"capture_ref": self._capture_ref()},
                 spans=[s.to_dict() for s in spans],
             )
         )
@@ -486,6 +532,9 @@ class Scheduler:
         result.failed_actuations = self._actuate(result.binds, result.evicts)
         self._write_back(result)
         t2 = time.perf_counter()
+        capture_ms = self._capture_cycle(
+            self._cycle_seq, self._cycle_corr, self._cycle_ts, result
+        )
         stats = CycleStats(
             cycle_ms=(t2 - t0) * 1000,
             snapshot_ms=result.snapshot_ms,
@@ -498,6 +547,7 @@ class Scheduler:
             actuate_ms=(t2 - t1) * 1000,
             transport_ms=result.transport_ms,
             upload_ms=result.upload_ms,
+            capture_ms=capture_ms,
         )
         self.history.append(stats)
         self._record_metrics(stats, result.action_ms, result.action_rounds)

@@ -144,6 +144,7 @@ class _ObsHandler(BaseHTTPRequestHandler):
         audit = self.server.obs_audit  # type: ignore[attr-defined]
         pool = self.server.obs_pool  # type: ignore[attr-defined]
         fleet = self.server.obs_fleet  # type: ignore[attr-defined]
+        capture = self.server.obs_capture  # type: ignore[attr-defined]
         replica_id = self.server.obs_replica_id  # type: ignore[attr-defined]
         path, _, query = self.path.partition("?")
         path = path.rstrip("/") or "/"
@@ -159,7 +160,8 @@ class _ObsHandler(BaseHTTPRequestHandler):
         if route not in ("/", "/metrics", "/healthz", "/readyz",
                          "/debug/cycles", "/debug/trace", "/debug/audit",
                          "/debug/kernels", "/debug/timeseries", "/debug/pool",
-                         "/debug/fleet", "/debug/fleet/tenants"):
+                         "/debug/fleet", "/debug/fleet/tenants",
+                         "/debug/capture"):
             route = "other"
         registry.counter_add("obs_requests_total", labels={"path": route})
 
@@ -204,6 +206,16 @@ class _ObsHandler(BaseHTTPRequestHandler):
                 else fleet.status()
             )
             self._send_json(200, body)
+            return
+        if path == "/debug/capture":
+            if capture is None:
+                self._send_json(200, {
+                    "cycles": 0, "chunks": 0,
+                    "error": "no session capture wired (run with "
+                             "--capture-dir / pass capture= to serve_obs)",
+                })
+                return
+            self._send_json(200, capture.status())
             return
         if path == "/debug/cycles":
             entries = flight.entries() if flight is not None else []
@@ -281,6 +293,7 @@ class _ObsHandler(BaseHTTPRequestHandler):
                 "/debug/kernels", "/debug/timeseries?window=<s>",
                 "/debug/audit?n=<count>", "/debug/audit/<corr_id>",
                 "/debug/pool", "/debug/fleet", "/debug/fleet/tenants",
+                "/debug/capture",
             ]})
             return
         self._send_json(404, {"error": f"no route {path}"})
@@ -298,6 +311,7 @@ def serve_obs(
     audit=None,
     pool=None,
     fleet=None,
+    capture=None,
     replica_id: str = "",
 ) -> Tuple[ThreadingHTTPServer, threading.Thread, str]:
     """Serve the observability plane; returns (server, thread, base_url).
@@ -312,8 +326,10 @@ def serve_obs(
     ``audit=``) for the ``/debug/audit`` routes; ``pool`` a
     :class:`rpc.pool.DecisionPool` for ``/debug/pool``; ``fleet`` a
     :class:`utils.fleet.FleetPlane` for ``/debug/fleet`` +
-    ``/debug/fleet/tenants``; ``replica_id``
-    stamps /healthz + /readyz in multi-replica deployments."""
+    ``/debug/fleet/tenants``; ``capture`` a
+    :class:`capture.recorder.SessionCapture` for ``/debug/capture``;
+    ``replica_id`` stamps /healthz + /readyz in multi-replica
+    deployments."""
     server = ThreadingHTTPServer((host, port), _ObsHandler)
     server.obs_registry = registry if registry is not None else metrics()  # type: ignore[attr-defined]
     server.obs_flight = flight  # type: ignore[attr-defined]
@@ -324,6 +340,7 @@ def serve_obs(
     server.obs_audit = audit  # type: ignore[attr-defined]
     server.obs_pool = pool  # type: ignore[attr-defined]
     server.obs_fleet = fleet  # type: ignore[attr-defined]
+    server.obs_capture = capture  # type: ignore[attr-defined]
     server.obs_replica_id = replica_id  # type: ignore[attr-defined]
     if locking.sanitize_enabled():
         # the obs_* wiring is written once, here, before the serve thread
@@ -334,7 +351,8 @@ def serve_obs(
             (
                 "obs_registry", "obs_flight", "obs_tracer",
                 "obs_status_fn", "obs_profiler", "obs_timeseries",
-                "obs_audit", "obs_pool", "obs_fleet", "obs_replica_id",
+                "obs_audit", "obs_pool", "obs_fleet", "obs_capture",
+                "obs_replica_id",
             ),
             name="ObsServer",
         )

@@ -401,6 +401,10 @@ class PipelinedExecutor:
             transport_ms=t["transport_ms"],
             upload_ms=ep.upload_ms,
         )
+        # session capture tee, pipelined flavor: the COMMITTED epoch only
+        # (discarded speculation never reaches this tail), before the
+        # stats row is sampled so capture_ms lands in the same cycle
+        stats.capture_ms = sched._capture_cycle(ep.seq, ep.corr, ep.ts, result)
         sched.history.append(stats)
         sched._record_metrics(stats, action_ms, action_rounds)
         sched.last_cycle_ts = time.time()

@@ -336,6 +336,35 @@ def fairness_top(snap, dec, k: int = 5) -> List[dict]:
     return fairness_top_of(fairness_ledger(snap, dec), k)
 
 
+def decision_digest(snap, dec) -> str:
+    """Wall-clock-free digest of one cycle's decisions — the capture
+    plane's bit-identity contract (kube_arbitrator_tpu/capture).
+
+    A pure function of (snapshot, decisions): the audit projections with
+    every wall-clock- or actuation-derived field stripped (``ts`` never
+    enters; fairness ``starvation_s`` runs on the progress clock;
+    ``actuated`` depends on apiserver outcomes replay does not re-run),
+    so the SAME value is computable at record time and from a replayed
+    pack in a different process on a different day."""
+    import hashlib
+
+    def _strip(rows: List[dict], drop: str) -> List[dict]:
+        return [{k: v for k, v in r.items() if k != drop} for r in rows]
+
+    blob = json.dumps(
+        {
+            "version": AUDIT_SCHEMA_VERSION,
+            "binds": _strip(bind_rows(snap, dec), "actuated"),
+            "evictions": _strip(eviction_edges(snap, dec), "actuated"),
+            "fairness": _strip(fairness_ledger(snap, dec), "starvation_s"),
+            "gangs": gang_verdicts(snap, dec),
+            "cluster_total": cluster_fair_total(snap),
+        },
+        sort_keys=True,
+    )
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
 @dataclasses.dataclass
 class AuditRecord:
     """One cycle's decision audit, JSON-ready and versioned."""
@@ -415,9 +444,19 @@ class AuditLog:
         starvation_slo_s: Optional[float] = None,
         now_fn: Optional[Callable[[], float]] = None,
         metric_queues: int = AUDIT_METRIC_QUEUES,
+        log_max_bytes: int = 0,
+        log_keep: int = 4,
     ):
         self.capacity = capacity
         self.log_path = log_path
+        # size-based JSONL rotation (0 = unbounded, the pre-rotation
+        # behavior): when an append would push the active file past
+        # ``log_max_bytes``, it becomes ``<path>.1`` and older segments
+        # shift up, keeping at most ``log_keep`` rotated segments (the
+        # oldest is dropped).  The capture manifest links the segments
+        # (SessionCapture), so a replay window still finds its records.
+        self.log_max_bytes = int(log_max_bytes)
+        self.log_keep = max(int(log_keep), 1)
         self.registry = registry
         self.flight = flight
         self.starvation_slo_s = starvation_slo_s
@@ -487,8 +526,11 @@ class AuditLog:
             # that already actuated: log once per episode and keep going
             # (the in-memory ring and metrics still record the cycle)
             try:
+                line = json.dumps(rec.to_dict(), sort_keys=True) + "\n"
+                if self.log_max_bytes:
+                    self._maybe_rotate(len(line))
                 with open(self.log_path, "a") as f:
-                    f.write(json.dumps(rec.to_dict(), sort_keys=True) + "\n")
+                    f.write(line)
                 self._log_broken = False
             except OSError as err:
                 m = self.registry if self.registry is not None else metrics()
@@ -503,6 +545,39 @@ class AuditLog:
                         file=sys.stderr,
                     )
         return rec
+
+    def _maybe_rotate(self, incoming: int) -> None:
+        """Shift ``<path>`` -> ``<path>.1`` -> ... when the next append
+        would pass ``log_max_bytes``; at most ``log_keep`` rotated
+        segments survive (``os.replace`` drops the oldest).  Runs on the
+        observe path OUTSIDE the ring lock, same as the append itself;
+        an OSError here rides the caller's once-per-episode latch."""
+        try:
+            size = os.path.getsize(self.log_path)
+        except OSError:
+            return  # nothing to rotate yet
+        if size + incoming <= self.log_max_bytes:
+            return
+        for i in range(self.log_keep - 1, 0, -1):
+            src = f"{self.log_path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.log_path}.{i + 1}")
+        os.replace(self.log_path, f"{self.log_path}.1")
+        m = self.registry if self.registry is not None else metrics()
+        m.counter_add("audit_log_rotations_total")
+
+    def rotated_segments(self) -> List[str]:
+        """Existing rotated segment paths, newest first — the capture
+        manifest's audit-log linkage."""
+        if not self.log_path:
+            return []
+        return [
+            p
+            for p in (
+                f"{self.log_path}.{i}" for i in range(1, self.log_keep + 1)
+            )
+            if os.path.exists(p)
+        ]
 
     def _emit_metrics(self, rec: AuditRecord) -> None:
         m = self.registry if self.registry is not None else metrics()

@@ -105,6 +105,12 @@ METRIC_HELP: Dict[str, str] = {
     # decision audit & fairness accounting plane (utils/audit.py)
     "audit_records_total": "Decision audit records assembled (one per committed cycle with auditing on).",
     "audit_log_write_errors_total": "Audit JSONL append failures (records continue in the in-memory ring).",
+    "audit_log_rotations_total": "Audit JSONL size-based rotations (--audit-log-max-bytes; active file became segment .1).",
+    # session capture & replay plane (kube_arbitrator_tpu/capture)
+    "capture_bytes_total": "Compressed bytes the session recorder appended to capture chunks.",
+    "capture_chunks_total": "Capture chunks opened (reason label: first/rotate — each opens with a base record).",
+    "capture_dropped_cycles_total": "Committed cycles the capture plane did not retain (sink write errors, byte-budget chunk eviction).",
+    "replay_divergence_total": "Replay-verify runs that found a decision divergence (offline verifier; scrape via pushgateway or textfile collector).",
     "fairness_share": "Per-queue dominant fair share (queue + kind label: deserved = proportion water-fill entitlement, allocated = realized).",
     "queue_starvation_seconds": "Seconds a pending, under-entitled queue has gone without a placement or eviction claim (queue label; 0 when progressing).",
     "evictions_attributed_total": "Eviction edges attributed by the decision audit plane (action + phase label: preempt inter/intra, reclaim).",

@@ -271,6 +271,10 @@ class CycleSampler:
         # (summed over shards; the per-shard split stays in the gauges)
         "shard_uploads": "shard_uploads_total",
         "shard_upload_bytes": "shard_upload_bytes_total",
+        # capture plane: compressed bytes the recorder appended this
+        # cycle — with capture_ms, the per-cycle cost/volume columns the
+        # Grafana capture panels read
+        "capture_bytes": "capture_bytes_total",
     }
     OCCUPANCY_GAUGE = "pipeline_stage_occupancy"
 
@@ -326,6 +330,9 @@ class CycleSampler:
             # remote) — without it the grafana board can't tell a decode
             # tail from a transport tail
             "transport_ms": stats.transport_ms,
+            # capture-plane tee cost (0.0 with capture off, and on
+            # stats objects predating the capture plane)
+            "capture_ms": getattr(stats, "capture_ms", 0.0),
         }
         for stage, ms in (action_ms or {}).items():
             values[f"kernel_{stage}_ms"] = ms
