@@ -309,6 +309,42 @@ EOF
   fi
 fi
 
+# INGEST_SMOKE=1: the columnar actuation + batched ingest lane — the
+# ingest/columnar parity suite (3-seed batched-vs-scalar soak, the
+# revalidate column gate vs the intent gate on every discard reason,
+# columnar-vs-object actuation digests with volume-failure injection),
+# a 4-seed chaos matrix with batched ingest pinned ON + the decode
+# parity oracle armed, one kill-switch seed with KAT_BATCH_INGEST=0
+# (the scalar fallback must stay green, not just exist), and kat-lint
+# KAT-EFF/KAT-LCK/KAT-DTY over the ingest -> decode -> revalidate ->
+# actuate chain.
+rc_ingest=0
+if [ "${INGEST_SMOKE:-0}" = "1" ]; then
+  env JAX_PLATFORMS=cpu python -m pytest -q tests/test_ingest_batch.py \
+    || rc_ingest=$?
+  for seed in 0 1 2 3; do
+    env JAX_PLATFORMS=cpu KAT_BATCH_INGEST=1 KAT_DECODE_PARITY=1 \
+      python -m kube_arbitrator_tpu.chaos \
+      --seed "${seed}" --cycles 8 --profile smoke --out-dir /tmp \
+      || rc_ingest=$?
+  done
+  # kill-switch leg: the per-event scalar path is the fallback story —
+  # it must keep passing the same invariant matrix it did before blocks
+  env JAX_PLATFORMS=cpu KAT_BATCH_INGEST=0 python -m kube_arbitrator_tpu.chaos \
+    --seed 0 --cycles 8 --profile smoke --out-dir /tmp || rc_ingest=$?
+  python -m kube_arbitrator_tpu.analysis --rules KAT-EFF,KAT-LCK,KAT-DTY \
+    kube_arbitrator_tpu/cache/live.py \
+    kube_arbitrator_tpu/cache/sim.py \
+    kube_arbitrator_tpu/cache/decode.py \
+    kube_arbitrator_tpu/cache/arena.py \
+    kube_arbitrator_tpu/pipeline/revalidate.py || rc_ingest=$?
+  if [ "${rc_ingest}" -ne 0 ]; then
+    echo "ingest smoke job: FAILED (exit ${rc_ingest})" >&2
+  else
+    echo "ingest smoke job: ok (parity suite + 4-seed batched chaos + kill-switch leg + kat-lint)"
+  fi
+fi
+
 # POOL_SMOKE=1: the decision-pool lane — a live 2-replica x 4-frontend
 # pooled run (threaded batcher stacking same-shape packs, decisions
 # asserted equal to independent runs), the pool suite, the 8-seed
@@ -642,6 +678,7 @@ if [ "${LINT_ONLY:-0}" = "1" ]; then
   if [ "${rc_shard}" -ne 0 ]; then exit "${rc_shard}"; fi
   if [ "${rc_race}" -ne 0 ]; then exit "${rc_race}"; fi
   if [ "${rc_replay}" -ne 0 ]; then exit "${rc_replay}"; fi
+  if [ "${rc_ingest}" -ne 0 ]; then exit "${rc_ingest}"; fi
   exit "${rc_pipe}"
 fi
 
@@ -664,4 +701,5 @@ if [ "${rc_pool}" -ne 0 ]; then exit "${rc_pool}"; fi
 if [ "${rc_shard}" -ne 0 ]; then exit "${rc_shard}"; fi
 if [ "${rc_race}" -ne 0 ]; then exit "${rc_race}"; fi
 if [ "${rc_replay}" -ne 0 ]; then exit "${rc_replay}"; fi
+if [ "${rc_ingest}" -ne 0 ]; then exit "${rc_ingest}"; fi
 exit "${rc_test}"

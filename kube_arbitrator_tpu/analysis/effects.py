@@ -25,8 +25,10 @@ is near-certain, absence proves nothing:
 * ``zip`` / ``enumerate`` / ``sorted`` / ``range(len(...))`` over any of
   the above;
 * a callee parameter that a summarized call site feeds a hot value — the
-  interprocedural hop that catches
-  ``decode_decisions -> _build_intents(rows.tolist(), ...)``.
+  interprocedural hop that caught the historical
+  ``decode_decisions -> _build_intents(rows.tolist(), ...)`` floor
+  (burned down by the columnar decode: the decode stage now ships
+  ordinal columns and no longer constructs intent objects at all).
 
 Rules (reported by rules/effects.py under family ``KAT-EFF``):
 
@@ -138,12 +140,22 @@ STAGE_FUNCTIONS: Dict[str, str] = {
     "Session.decode_phase": "decode",
     "decode_decisions": "decode",
     "decode_decisions_compact": "decode",
+    "decode_batch": "decode",
+    "decode_batch_compact": "decode",
     "Session.close_phase": "close",
     "Session._close": "close",
     "Scheduler._actuate": "actuate",
     "Scheduler._write_back": "actuate",
     "LiveCache.sync": "ingest",
     "LiveCache._dispatch": "ingest",
+    # the batched ingest plane: event-block builders + the batched sink
+    # stay under the ingest budget (no hot construction) so the gate
+    # keeps guarding the columnar shape
+    "LiveCache._apply_event_blocks": "ingest",
+    "LiveCache._pod_block_eligible": "ingest",
+    "LiveCache._on_pod_block": "ingest",
+    "SnapshotArena.task_dirty_rows": "ingest",
+    "DeltaJournal.task_dirty_rows": "ingest",
 }
 
 #: qualname -> thread role (KAT-EFF-003's scope: the threads whose
@@ -151,6 +163,11 @@ STAGE_FUNCTIONS: Dict[str, str] = {
 ROLE_FUNCTIONS: Dict[str, str] = {
     "LiveCache.sync": "ingest-thread",
     "LiveCache._dispatch": "ingest-thread",
+    "LiveCache._apply_event_blocks": "ingest-thread",
+    "LiveCache._pod_block_eligible": "ingest-thread",
+    "LiveCache._on_pod_block": "ingest-thread",
+    "SnapshotArena.task_dirty_rows": "ingest-thread",
+    "DeltaJournal.task_dirty_rows": "ingest-thread",
     "PipelinedExecutor._decide_worker": "decide-worker",
     "DecisionPool._dispatch_loop": "pool-dispatcher",
     "DecisionPool._process": "pool-dispatcher",

@@ -458,6 +458,19 @@ class SnapshotArena:
             if node_name:
                 self._dirty_nodes.add(node_name)
 
+    def task_dirty_rows(self, uids, node_names=()) -> None:
+        """Batched twin of :meth:`task_dirty`: ONE call for a whole
+        event block's (or commit's) row dirt — parallel uid/node
+        vectors; empty node entries mean "no node implicated" exactly
+        like the scalar default.  Dirty-set semantics are identical to
+        the equivalent scalar call sequence, so packs (and the journal
+        tee) cannot tell which surface the producer used."""
+        if self.journal is not None:
+            self.journal.task_dirty_rows(uids, node_names)
+        if self._structural is None:
+            self._dirty_tasks.update(uids)
+            self._dirty_nodes.update(n for n in node_names if n)
+
     def node_dirty(self, name: str) -> None:
         if self.journal is not None:
             self.journal.node_dirty(name)

@@ -1,29 +1,40 @@
-"""Decode device decisions back into host-side intents (actuation plane).
+"""Decode device decisions back into host-side actuation columns.
 
-Two paths produce the SAME intent stream:
+Two paths produce the SAME decision stream:
 
-* :func:`decode_decisions_compact` — the fast path: the kernel's commit
+* :func:`decode_batch_compact` — the fast path: the kernel's commit
   tail (ops/cycle.commit_cycle) ships compact, length-prefixed bind/evict
   index lists (``bind_idx``/``bind_node``/``evict_idx`` + counts)
-  compacted in-graph, so the host pays one bounded gather + batched
-  ``.tolist()`` over O(decisions) elements — never an O(T) mask transfer
-  or a ``np.nonzero`` scan.  Counts exceeding the list caps mean the
-  cycle overflowed (``None`` return; the caller falls back dense and
-  counts ``decode_overflow_total``).
-* :func:`decode_decisions` — the dense-mask path, kept as the PARITY
+  compacted in-graph, so the host pays one bounded gather over
+  O(decisions) elements — never an O(T) mask transfer or a
+  ``np.nonzero`` scan.  Counts exceeding the list caps mean the cycle
+  overflowed (``None`` return; the caller falls back dense and counts
+  ``decode_overflow_total``).
+* :func:`decode_batch` — the dense-mask path, kept as the PARITY
   ORACLE: batched gathers over ``np.nonzero`` of the [T] masks.  The
   compact path's entries are emitted in the same ascending task-ordinal
-  order, so the two paths are intent-identical whenever the lists fit
+  order, so the two paths are decision-identical whenever the lists fit
   (pinned by tests/test_decode_parity.py).
+
+Both return a :class:`DecisionBatch` of COLUMNS (ordinal ndarrays plus
+the snapshot index that resolves them), not intent objects: the
+pipeline — revalidation, the leader fence, batched actuation, the audit
+record — consumes the columns directly, and ``BindIntent``/
+``EvictIntent`` objects are materialized only at the apiserver wire (or
+lazily, for callers that still iterate).  The legacy
+:func:`decode_decisions` / :func:`decode_decisions_compact` wrappers
+keep returning intent lists for oracle checks and old callers.
 """
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from .sim import BindIntent, EvictIntent
 from .snapshot import Snapshot
+
+_I64 = np.int64
 
 
 def _uid_lookup(index):
@@ -36,37 +47,135 @@ def _uid_lookup(index):
     return index.task_uid, index.node_name
 
 
-def _build_intents(
-    index, bind_rows, bind_nodes, evict_rows
-) -> Tuple[List[BindIntent], List[EvictIntent]]:
-    """Intent objects from host-side python lists of ordinals — the ONE
-    assembly both decode paths share, so their output cannot diverge in
-    anything but how the ordinal lists were obtained.
+class _Column:
+    """Shared plumbing for the bind/evict columns: a row-ordinal ndarray
+    plus the snapshot index that resolves ordinals to identities.  The
+    column is Sequence-compatible (len/iter/getitem/==) by lazily
+    materializing the intent objects ONCE — the single assembly point
+    that replaced ``_build_intents``, so legacy iterators and the
+    columnar consumers cannot diverge in anything but cost."""
 
-    This is the decode stage's baselined KAT-EFF-001 floor (see
-    ``.kat-baseline.json``): intent objects ARE the actuation contract,
-    and the loops are O(decisions) bounded by ``ops/cycle.decode_caps``
-    — never O(T).  Growing this shape elsewhere fails the gate."""
-    task_uid, node_name = _uid_lookup(index)
-    binds = [
-        BindIntent(task_uid=task_uid(i), node_name=node_name(n))
-        for i, n in zip(bind_rows, bind_nodes)
-    ]
-    evicts = [EvictIntent(task_uid=task_uid(i)) for i in evict_rows]
-    return binds, evicts
+    __slots__ = ("index", "rows", "_uids", "_intents")
+
+    def __init__(self, index, rows) -> None:
+        self.index = index
+        self.rows = np.asarray(rows, dtype=_I64)
+        self._uids: Optional[List[str]] = None
+        self._intents = None
+
+    def __len__(self) -> int:
+        return int(self.rows.shape[0])
+
+    def __bool__(self) -> bool:
+        return self.rows.shape[0] > 0
+
+    def __iter__(self):
+        return iter(self.to_intents())
+
+    def __getitem__(self, i):
+        return self.to_intents()[i]
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, _Column):
+            other = other.to_intents()
+        if isinstance(other, (list, tuple)):
+            return self.to_intents() == list(other)
+        return NotImplemented
+
+    def __repr__(self) -> str:  # assertion-message friendliness
+        return f"{type(self).__name__}({self.to_intents()!r})"
+
+    @property
+    def uids(self) -> List[str]:
+        """Task uids for every row — ONE batched ``.tolist()`` then an
+        O(decisions) resolve; cached (the wire needs the strings anyway)."""
+        if self._uids is None:
+            task_uid, _ = _uid_lookup(self.index)
+            self._uids = [task_uid(i) for i in self.rows.tolist()]
+        return self._uids
 
 
-def decode_decisions(snap: Snapshot, decisions) -> Tuple[List[BindIntent], List[EvictIntent]]:
-    """CycleDecisions tensors -> bind/evict intents keyed by task uid —
-    the dense-mask parity oracle.  Vectorized: ``np.nonzero`` over each
-    mask, then batched gathers + ONE ``.tolist()`` per field instead of
-    per-row python indexing (the audit plane's record-assembly idiom)."""
+class BindColumn(_Column):
+    """Columnar bind decisions: task-row + node ordinals, identities on
+    demand."""
+
+    __slots__ = ("node_ords", "_node_names")
+
+    def __init__(self, index, rows, node_ords) -> None:
+        super().__init__(index, rows)
+        self.node_ords = np.asarray(node_ords, dtype=_I64)
+        self._node_names: Optional[List[str]] = None
+
+    @property
+    def node_names(self) -> List[str]:
+        if self._node_names is None:
+            _, node_name = _uid_lookup(self.index)
+            self._node_names = [node_name(n) for n in self.node_ords.tolist()]
+        return self._node_names
+
+    def to_intents(self) -> List[BindIntent]:
+        if self._intents is None:
+            self._intents = [
+                BindIntent(task_uid=u, node_name=n)
+                for u, n in zip(self.uids, self.node_names)
+            ]
+        return self._intents
+
+    def select(self, keep: Sequence[int]) -> "BindColumn":
+        """A new column of the kept row positions (revalidation's
+        surviving subset), in order."""
+        keep = np.asarray(keep, dtype=_I64)
+        return BindColumn(self.index, self.rows[keep], self.node_ords[keep])
+
+    @classmethod
+    def empty(cls, index) -> "BindColumn":
+        return cls(index, np.empty(0, _I64), np.empty(0, _I64))
+
+
+class EvictColumn(_Column):
+    """Columnar evict decisions: task-row ordinals, identities on
+    demand."""
+
+    __slots__ = ()
+
+    def to_intents(self) -> List[EvictIntent]:
+        if self._intents is None:
+            self._intents = [EvictIntent(task_uid=u) for u in self.uids]
+        return self._intents
+
+    def select(self, keep: Sequence[int]) -> "EvictColumn":
+        keep = np.asarray(keep, dtype=_I64)
+        return EvictColumn(self.index, self.rows[keep])
+
+    @classmethod
+    def empty(cls, index) -> "EvictColumn":
+        return cls(index, np.empty(0, _I64))
+
+
+class DecisionBatch:
+    """One cycle's decoded decisions as columns — what flows from decode
+    through revalidation and the fence into batched actuation."""
+
+    __slots__ = ("binds", "evicts")
+
+    def __init__(self, binds: BindColumn, evicts: EvictColumn) -> None:
+        self.binds = binds
+        self.evicts = evicts
+
+
+def decode_batch(snap: Snapshot, decisions) -> DecisionBatch:
+    """CycleDecisions tensors -> decision columns — the dense-mask
+    parity oracle.  Vectorized: ``np.nonzero`` over each mask, then
+    batched gathers; NO per-decision python objects are built here."""
     bind_mask = np.asarray(decisions.bind_mask)
     evict_mask = np.asarray(decisions.evict_mask)
     bind_rows = np.nonzero(bind_mask)[0]
-    bind_nodes = np.asarray(decisions.task_node)[bind_rows].tolist()
-    evict_rows = np.nonzero(evict_mask)[0].tolist()
-    return _build_intents(snap.index, bind_rows.tolist(), bind_nodes, evict_rows)
+    bind_nodes = np.asarray(decisions.task_node)[bind_rows]
+    evict_rows = np.nonzero(evict_mask)[0]
+    return DecisionBatch(
+        BindColumn(snap.index, bind_rows, bind_nodes),
+        EvictColumn(snap.index, evict_rows),
+    )
 
 
 DECODE_LIST_FIELDS = (
@@ -84,11 +193,9 @@ def decode_lists_present(decisions) -> bool:
     )
 
 
-def decode_decisions_compact(
-    snap: Snapshot, decisions
-) -> Optional[Tuple[List[BindIntent], List[EvictIntent]]]:
-    """Intents from the kernel's compact index lists, or ``None`` when
-    the path is unavailable for this decisions pack:
+def decode_batch_compact(snap: Snapshot, decisions) -> Optional[DecisionBatch]:
+    """Decision columns from the kernel's compact index lists, or
+    ``None`` when the path is unavailable for this decisions pack:
 
     * any of the lists is absent (a pre-ints-out peer across the RPC
       boundary omitted them — :func:`decode_lists_present`), or
@@ -96,7 +203,7 @@ def decode_decisions_compact(
       must decode the dense masks instead (and count the overflow).
 
     Cost: two scalar reads + three bounded [count] gathers; the [T]
-    masks are never touched.
+    masks are never touched, and no per-decision objects are built.
     """
     if not decode_lists_present(decisions):
         return None
@@ -106,7 +213,32 @@ def decode_decisions_compact(
     n_evict = int(decisions.evict_count)
     if n_bind > bind_idx.shape[0] or n_evict > evict_idx.shape[0]:
         return None  # overflowed the caps: dense fallback decodes it
-    bind_rows = np.asarray(bind_idx)[:n_bind].tolist()
-    bind_nodes = np.asarray(decisions.bind_node)[:n_bind].tolist()
-    evict_rows = np.asarray(evict_idx)[:n_evict].tolist()
-    return _build_intents(snap.index, bind_rows, bind_nodes, evict_rows)
+    bind_rows = np.asarray(bind_idx)[:n_bind]
+    bind_nodes = np.asarray(decisions.bind_node)[:n_bind]
+    evict_rows = np.asarray(evict_idx)[:n_evict]
+    return DecisionBatch(
+        BindColumn(snap.index, bind_rows, bind_nodes),
+        EvictColumn(snap.index, evict_rows),
+    )
+
+
+def decode_decisions(
+    snap: Snapshot, decisions
+) -> Tuple[List[BindIntent], List[EvictIntent]]:
+    """Legacy intent-list decode (dense oracle) — a thin wrapper that
+    materializes :func:`decode_batch`'s columns.  Kept for parity
+    assertions and object-path callers; the scheduling loop itself ships
+    the columns."""
+    batch = decode_batch(snap, decisions)
+    return batch.binds.to_intents(), batch.evicts.to_intents()
+
+
+def decode_decisions_compact(
+    snap: Snapshot, decisions
+) -> Optional[Tuple[List[BindIntent], List[EvictIntent]]]:
+    """Legacy intent-list decode (compact path), ``None`` on absence or
+    overflow — the materialized twin of :func:`decode_batch_compact`."""
+    batch = decode_batch_compact(snap, decisions)
+    if batch is None:
+        return None
+    return batch.binds.to_intents(), batch.evicts.to_intents()

@@ -40,6 +40,7 @@ and repairs the model (``cache.go:519-547``, ``event_handlers.go:70-88``).
 from __future__ import annotations
 
 import dataclasses
+import os
 import time as _time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -260,6 +261,90 @@ def pod_to_task(pod: dict, job_uid: str, volume_zone: str = "",
     )
 
 
+def pod_to_task_block(pod: dict, job_uid: str, rr_memo: dict) -> TaskInfo:
+    """:func:`pod_to_task` for a claim-free pod inside an ingest block,
+    field-identical to ``pod_to_task(pod, job_uid, "", 0)`` but with the
+    per-row constants folded out: container-request parsing is memoized
+    per distinct raw value shape (churn blocks repeat a handful of
+    container shapes; each hit hands back a private copy so no two tasks
+    share a resreq array), the affinity/toleration sub-parses only run
+    when the spec carries those stanzas, and TaskInfo is built without
+    re-running ``__init__``/``__post_init__`` — every field below is
+    already in the canonical form the constructor would normalize to
+    (``node_affinity`` is terms-of-expressions, on which
+    ``normalize_node_affinity`` is value-identity)."""
+    md = pod.get("metadata", {})
+    spec = pod.get("spec", {})
+    containers = spec.get("containers", [])
+    resreq = None
+    if len(containers) == 1:
+        reqs = containers[0].get("resources", {}).get("requests", {})
+        try:
+            key = (reqs.get("cpu"), reqs.get("memory"), reqs.get("nvidia.com/gpu"))
+            resreq = rr_memo.get(key)
+            if resreq is None:
+                resreq = rr_memo[key] = pod_resreq(pod, 0)
+            resreq = resreq.copy()
+        except TypeError:
+            resreq = None  # unhashable request value: parse straight
+    if resreq is None:
+        resreq = pod_resreq(pod, 0)
+    ports: Tuple[int, ...] = ()
+    if any(c.get("ports") for c in containers):
+        ports = tuple(
+            p["hostPort"]
+            for c in containers
+            for p in c.get("ports", [])
+            if "hostPort" in p
+        )
+    node_aff: Tuple = ()
+    terms: Tuple = ()
+    aff = spec.get("affinity")
+    if aff:
+        required = aff.get("nodeAffinity", {}).get(
+            "requiredDuringSchedulingIgnoredDuringExecution", {}
+        )
+        node_aff = tuple(
+            _match_expressions(term.get("matchExpressions"))
+            for term in required.get("nodeSelectorTerms", [])
+        )
+        terms = _pod_affinity_terms(spec)
+    tol_raw = spec.get("tolerations")
+    tolerations = (
+        [
+            Toleration(
+                key=t.get("key", ""),
+                operator=t.get("operator", "Equal"),
+                value=t.get("value", ""),
+                effect=t.get("effect", ""),
+            )
+            for t in tol_raw
+        ]
+        if tol_raw
+        else []
+    )
+    task = TaskInfo.__new__(TaskInfo)
+    task.__dict__.update(
+        uid=md.get("uid") or f"{md.get('namespace', 'default')}/{md['name']}",
+        job_uid=job_uid,
+        name=md["name"],
+        namespace=md.get("namespace", "default"),
+        resreq=resreq,
+        node_name=spec.get("nodeName", ""),
+        status=pod_status(pod),
+        priority=int(spec.get("priority") or 0),
+        node_selector=dict(spec.get("nodeSelector", {})),
+        node_affinity=node_aff,
+        tolerations=tolerations,
+        host_ports=ports,
+        labels=dict(md.get("labels", {})),
+        affinity_terms=terms,
+        volume_zone="",
+        ordinal=-1,
+    )
+    return task
+
+
 def node_to_info(node: dict) -> NodeInfo:
     md = node.get("metadata", {})
     st = node.get("status", {})
@@ -315,11 +400,17 @@ class LiveCache:
     Drop-in backend for :class:`framework.Scheduler` (same duck-typed
     surface as :class:`SimCluster`)."""
 
-    def __init__(self, api: FakeApiServer, now_fn=None):
+    def __init__(self, api: FakeApiServer, now_fn=None, batch_ingest=None):
         self.api = api
         # injectable clock (chaos plane / tests run on a virtual clock so
         # GC delays and staleness gauges are deterministic)
         self._now = now_fn or _time.time
+        # batched watch ingest (default on; KAT_BATCH_INGEST=0 or the
+        # ctor arg force the per-event scalar path — the parity soak and
+        # the ingest bench drive both)
+        if batch_ingest is None:
+            batch_ingest = os.environ.get("KAT_BATCH_INGEST", "1") != "0"
+        self.batch_ingest = bool(batch_ingest)
         self.cluster = ClusterInfo()
         self.events: List[Event] = []
         self.resync_queue: List[str] = []
@@ -356,6 +447,20 @@ class LiveCache:
         # after every sync() that applied any — the hook idle waiters and
         # the pipelined executor's ingest observability ride on.
         self.on_events = None
+        # resource -> handler, built ONCE (satellite fix: the dispatch
+        # dict used to be rebuilt per event — pure overhead on 10k-event
+        # pumps).  Read-only after construction, so no sanitizer guard.
+        self._handlers = {
+            "pods": self._on_pod,
+            "nodes": self._on_node,
+            "podgroups": self._on_podgroup,
+            "queues": self._on_queue,
+            "namespaces": self._on_namespace,
+            "pdbs": self._on_pdb,
+            "persistentvolumes": self._on_pv,
+            "persistentvolumeclaims": self._on_pvc,
+            "storageclasses": self._on_storageclass,
+        }
         if locking.sanitize_enabled():
             # the live plane is lock-free BY CONTRACT: one pump thread
             # owns all mutation (informer discipline).  Single-writer
@@ -458,10 +563,17 @@ class LiveCache:
             m.counter_add("cache_relists_total")
             self._reset_model()
             return self.sync()
-        for rv, resource, etype, obj in events:
-            self._dispatch(resource, etype, obj)
-            self._watch_rv = rv
-            n += 1
+        if self.batch_ingest:
+            n = self._apply_event_blocks(events)
+        else:
+            for rv, resource, etype, obj in events:
+                self._dispatch(resource, etype, obj)
+                self._watch_rv = rv
+                n += 1
+            if n:
+                m.counter_add(
+                    "cache_ingest_rows_total", n, labels={"path": "scalar"}
+                )
         m.counter_add("cache_watch_events_total", n, labels={"phase": "watch"})
         if n and self.on_events is not None:
             self.on_events(n)
@@ -495,21 +607,140 @@ class LiveCache:
     def _dispatch(self, resource: str, etype: str, obj: dict) -> None:
         # ingest-thread role + ingest stage (analysis/effects.py): no
         # blocking calls, no per-element allocation in hot loops — every
-        # watch event funnels through here (KAT-EFF-001/003)
-        handler = {
-            "pods": self._on_pod,
-            "nodes": self._on_node,
-            "podgroups": self._on_podgroup,
-            "queues": self._on_queue,
-            "namespaces": self._on_namespace,
-            "pdbs": self._on_pdb,
-            "persistentvolumes": self._on_pv,
-            "persistentvolumeclaims": self._on_pvc,
-            "storageclasses": self._on_storageclass,
-        }.get(resource)
+        # scalar-path watch event funnels through here (KAT-EFF-001/003)
+        handler = self._handlers.get(resource)
         if handler is None:
             return  # kinds the scheduler does not watch (e.g. configmaps)
         handler(etype, obj)
+
+    # ---- batched ingest (the columnar event-block path) ----
+
+    def _apply_event_blocks(self, events) -> int:
+        """Batched WATCH application: runs of row-local pod MODIFYs (the
+        churn-dominant shape — status flips, kubelet phase updates)
+        accumulate into one columnar block applied by
+        :meth:`_on_pod_block` with ONE batched delta-sink call; any
+        other event flushes the pending block first and takes the
+        scalar path, so the apiserver's total event order is preserved
+        and completeness never bears correctness.  ``_watch_rv`` only
+        advances past a blocked event once its block has applied."""
+        n = 0
+        batched = 0
+        block: List[dict] = []
+        block_rv = 0
+        for rv, resource, etype, obj in events:
+            if (
+                resource == "pods"
+                and etype == MODIFIED
+                and self._pod_block_eligible(obj)
+            ):
+                block.append(obj)
+                block_rv = rv
+                continue
+            if block:
+                self._on_pod_block(block)
+                n += len(block)
+                batched += len(block)
+                self._watch_rv = block_rv
+                block = []
+            self._dispatch(resource, etype, obj)
+            self._watch_rv = rv
+            n += 1
+        if block:
+            self._on_pod_block(block)
+            n += len(block)
+            batched += len(block)
+            self._watch_rv = block_rv
+        if n:
+            m = metrics()
+            if batched:
+                m.counter_add(
+                    "cache_ingest_rows_total", batched,
+                    labels={"path": "batched"},
+                )
+            if n - batched:
+                m.counter_add(
+                    "cache_ingest_rows_total", n - batched,
+                    labels={"path": "scalar"},
+                )
+        return n
+
+    def _pod_block_eligible(self, pod: dict) -> bool:
+        """Cheap structural probes deciding whether a pod MODIFY is
+        row-local (blockable) or must take the scalar handler.  Every
+        check mirrors a structural/classification branch of
+        :meth:`_on_pod` — anything that could change set membership,
+        job membership, the volume plane, or materialize a placeholder
+        node falls out to the scalar path.  Eligibility is stable
+        across a block: blocked events never add/remove model members,
+        so a verdict taken at stream-walk time still holds at flush."""
+        md = pod.get("metadata", {})
+        name = md.get("name")
+        if not name:
+            return False  # malformed: let the scalar path raise/refuse
+        uid = md.get("uid") or f"{md.get('namespace', 'default')}/{name}"
+        old = self._task_by_uid.get(uid)
+        if old is None:
+            return False  # not ours or not modeled: membership may change
+        if uid in self._raw_pod or pod_claims(pod):
+            return False  # volume plane implicated: retranslation path
+        spec = pod.get("spec", {})
+        if spec.get("schedulerName", "") != options().scheduler_name:
+            return False  # ours -> foreign flip is structural
+        if _job_uid_for_pod(pod) != old.job_uid:
+            return False  # job membership change is structural
+        if old.job_uid not in self.cluster.jobs:
+            return False  # shadow-job creation: scalar handles it
+        node = spec.get("nodeName") or ""
+        if node and node not in self.cluster.nodes:
+            return False  # placeholder-node materialization is structural
+        return True
+
+    def _on_pod_block(self, pods: List[dict]) -> None:
+        """Apply one columnar block of eligible pod MODIFYs: per row the
+        same updatePod == deletePod + addPod model mutation as
+        :meth:`_on_pod_inner` (restricted to the row-local shape
+        :meth:`_pod_block_eligible` admitted), with the whole block's
+        row dirt emitted as ONE ``task_dirty_rows`` delta-sink call —
+        the upstream half of the columnar cycle.  The only per-entity
+        python left is the wire translation (``pod_to_task``)."""
+        sink = self.delta_sink
+        col_uids: List[str] = []
+        col_nodes: List[str] = []
+        rr_memo: dict = {}  # block-scoped container-request parse memo
+        for pod in pods:
+            md = pod.get("metadata", {})
+            uid = md.get("uid") or f"{md.get('namespace', 'default')}/{md['name']}"
+            old = self._task_by_uid.get(uid)
+            if old is None:
+                # raced out of eligibility (defensive; the pump is
+                # single-threaded): the scalar handler classifies it
+                self._on_pod(MODIFIED, pod)
+                continue
+            old_node = old.node_name
+            if old_node and old_node in self.cluster.nodes:
+                node = self.cluster.nodes[old_node]
+                if uid in node.tasks:
+                    node.remove_task(old)
+            job = self.cluster.jobs[old.job_uid]
+            # eligibility guaranteed a claim-free pod: zone ""/0 attach,
+            # exactly what _volume_info returns for one
+            t = pod_to_task_block(pod, old.job_uid, rr_memo)
+            job.add_task(t)  # dict upsert: replaces the old row
+            job.priority = max(job.priority, t.priority)
+            if t.node_name:
+                self._host_task(t)
+            self._task_by_uid[uid] = t
+            self._pod_ref[uid] = (t.namespace, md["name"])
+            if sink is not None:
+                col_uids.append(uid)
+                col_nodes.append(old_node)
+                if t.node_name and t.node_name != old_node:
+                    # rare (an external rebind): same classification the
+                    # scalar wrapper emits
+                    sink.node_dirty(t.node_name)
+        if sink is not None and col_uids:
+            sink.task_dirty_rows(col_uids, col_nodes)
 
     # ---- handlers (event_handlers.go) ----
 
@@ -892,6 +1123,41 @@ class LiveCache:
                 failed.append(e.task_uid)
                 continue
             self.record_event("Evict", e.task_uid, "Evict")
+        return failed
+
+    def apply_binds_columnar(self, col):
+        """:meth:`apply_binds` over a decode ``BindColumn``: no intent
+        objects — the column's identity vectors drive the POST loop and
+        wire objects materialize only inside each apiserver call."""
+        failed = []
+        nodes = col.node_names
+        for k, uid in enumerate(col.uids):
+            ref = self._pod_ref.get(uid)
+            if ref is None:
+                failed.append(uid)
+                continue  # pod vanished between snapshot and actuation
+            try:
+                self.api.bind_pod(ref[0], ref[1], nodes[k])
+            except ApiError as err:
+                self._defer_resync(uid, "Bind", str(err))
+                failed.append(uid)
+        return failed
+
+    def apply_evicts_columnar(self, col):
+        """:meth:`apply_evicts` over a decode ``EvictColumn``."""
+        failed = []
+        for uid in col.uids:
+            ref = self._pod_ref.get(uid)
+            if ref is None:
+                failed.append(uid)
+                continue
+            try:
+                self.api.evict_pod(ref[0], ref[1])
+            except ApiError as err:
+                self._defer_resync(uid, "Evict", str(err))
+                failed.append(uid)
+                continue
+            self.record_event("Evict", uid, "Evict")
         return failed
 
     def update_job_status(self, job_uid: str, status) -> None:

@@ -164,6 +164,20 @@ class SimCluster:
         if self.delta_sink is not None:
             self.delta_sink.task_dirty(uid, node_name)
 
+    def _emit_task_rows(self, uids: List[str], node_names: List[str]) -> None:
+        """Batched delta emission: ONE ``task_dirty_rows`` sink call for
+        a whole commit's row dirt (the columnar actuation paths), with a
+        scalar fallback for sinks predating the batched surface.  Set
+        semantics are identical to per-row ``_emit_task`` calls."""
+        if self.delta_sink is None or not uids:
+            return
+        rows = getattr(self.delta_sink, "task_dirty_rows", None)
+        if rows is not None:
+            rows(uids, node_names)
+        else:
+            for u, n in zip(uids, node_names):
+                self.delta_sink.task_dirty(u, n)
+
     def update_pod_condition(self, task_uid: str, message: str) -> None:
         """Record the PodScheduled=False condition (the fakeStatusUpdater
         analog of cache.go:456-474's taskUnschedulable)."""
@@ -443,6 +457,172 @@ class SimCluster:
                 task.status = TaskStatus.RELEASING
             self._emit_task(e.task_uid, task.node_name)
             self.record_event("Evict", e.task_uid, "Evict")
+        return failed
+
+    def _resolve_rows(self, col) -> List[TaskInfo]:
+        """Resolve a column's rows to the CURRENT model task objects.
+
+        The snapshot index entry for each row supplies the (uid, job_uid)
+        identity hint, so the common case is two dict probes per row
+        instead of the O(cluster) ``_task_index`` build; a hint miss (the
+        live model replaced or re-owned the task since the snapshot)
+        falls back to the full index once, preserving the object path's
+        exact KeyError behavior for truly-vanished uids."""
+        snap_tasks = col.index.tasks
+        jobs = self.cluster.jobs
+        out: List[TaskInfo] = []
+        index = None
+        for r in col.rows.tolist():
+            hint = snap_tasks[r]
+            job = jobs.get(hint.job_uid)
+            task = job.tasks.get(hint.uid) if job is not None else None
+            if task is None:
+                if index is None:
+                    index = self._task_index()
+                task = index.get(hint.uid)
+                if task is None:
+                    raise KeyError(hint.uid)
+            out.append(task)
+        return out
+
+    def _bind_batch_certificate(self, uids, nodes, tasks, reqs):
+        """Prove (read-only) that committing the whole bind column can
+        fail NOWHERE, so the batched commit may skip every per-row check.
+
+        The certificate requires: no injected binder/volume failures
+        armed; no task carries a volume-zone pin (zone re-checks are the
+        one volume failure independent of capacity); every target node
+        exists; no uid already sits on its target node nor repeats in
+        the batch; and every touched node can absorb the SUM of its rows
+        (``sums < idle + eps`` per node — which implies every sequential
+        per-row ``sub_checked`` prefix AND every attach-axis re-check in
+        ``allocate_volumes`` would pass too).  Returns the per-row node
+        objects + per-node group arrays on success, None on any doubt —
+        the caller then routes through the scalar object path, which
+        reproduces the exact failure semantics (diversion order,
+        raise row) bit-for-bit."""
+        vb = self.volume_binder
+        if vb.fail_allocate_uids or vb.fail_bind_uids or self.binder.fail_uids:
+            return None
+        if vb.sim is not None and any(t.volume_zone for t in tasks):
+            return None
+        if len(set(uids)) != len(uids):
+            return None
+        cluster_nodes = self.cluster.nodes
+        group_of: Dict[str, int] = {}
+        g_nodes: List[NodeInfo] = []
+        g_of = np.empty(len(uids), np.intp)
+        for k, nm in enumerate(nodes):
+            g = group_of.get(nm)
+            if g is None:
+                node = cluster_nodes.get(nm)
+                if node is None:
+                    return None
+                g = group_of[nm] = len(g_nodes)
+                g_nodes.append(node)
+            if uids[k] in g_nodes[g].tasks:
+                return None
+            g_of[k] = g
+        sums = np.zeros((len(g_nodes), reqs.shape[1]), dtype=reqs.dtype)
+        np.add.at(sums, g_of, reqs)
+        idle_mat = np.stack([n.idle for n in g_nodes])
+        if not bool(np.all(sums < idle_mat + res.EPSILON)):
+            return None
+        return g_nodes, g_of, sums
+
+    def apply_binds_columnar(self, col):
+        """:meth:`apply_binds` over a decode ``BindColumn``: no intent
+        objects exist; the column's cached uid/node identity vectors
+        (one batched resolve each) drive a flat commit loop, node
+        accounting lands as ONE vectorized idle/used update per touched
+        node, and the whole commit's row dirt reaches the arena as ONE
+        batched delta-sink call.  A failure-freedom certificate
+        (:meth:`_bind_batch_certificate`) gates the fast commit; any
+        doubt — injected failures armed, volume-zone pins, missing
+        node, duplicate uid, or a batch the touched nodes cannot
+        absorb — falls back to the scalar object path wholesale, so
+        gang-atomic diversion and raise semantics stay bit-identical.
+        Observable equivalences the fast path relies on: resource
+        quantities are integral (milli-CPU / bytes) in float64, so the
+        per-node summed subtract equals the scalar row-by-row chain
+        exactly; and rows are committed in the scalar path's
+        job-grouped order so binder records, node.tasks insertion
+        order, and delta emission all match.  Returns the uids that
+        did NOT actuate."""
+        if not len(col):
+            return []
+        uids, nodes = col.uids, col.node_names
+        tasks = self._resolve_rows(col)
+        reqs = np.stack([t.resreq for t in tasks])
+        cert = self._bind_batch_certificate(uids, nodes, tasks, reqs)
+        if cert is None:
+            return self.apply_binds(
+                [BindIntent(u, n) for u, n in zip(uids, nodes)]
+            )
+        g_nodes, g_of, sums = cert
+        # scalar commit order: jobs by first appearance, rows in order
+        # within each job (apply_binds' by_job dict iteration)
+        by_job: Dict[str, List[int]] = {}
+        for k, task in enumerate(tasks):
+            by_job.setdefault(task.job_uid, []).append(k)
+        order = [k for ks in by_job.values() for k in ks]
+        vb = self.volume_binder
+        if vb is not None:
+            vb.allocated.extend((uids[k], nodes[k]) for k in order)
+            vb.bound.extend(uids[k] for k in order)
+        binder_binds = self.binder.binds
+        new = TaskInfo.__new__
+        bound = TaskStatus.BOUND
+        for k in order:
+            task = tasks[k]
+            nm = nodes[k]
+            binder_binds[task.uid] = nm
+            task.status = bound
+            task.node_name = nm
+            # the scalar path's clone(): same shallow field sharing,
+            # fresh resreq — __post_init__ re-normalization is skipped
+            # because the source is already canonical, and copy.copy's
+            # __reduce_ex__ round-trip is skipped because TaskInfo is a
+            # plain __dict__ dataclass
+            c = new(TaskInfo)
+            c.__dict__.update(task.__dict__)
+            c.resreq = task.resreq.copy()
+            g_nodes[g_of[k]].tasks[task.uid] = c
+        for g, node in enumerate(g_nodes):
+            node.idle = node.idle - sums[g]
+            node.used = node.used + sums[g]
+        self._emit_task_rows([uids[k] for k in order], [nodes[k] for k in order])
+        return []
+
+    def apply_evicts_columnar(self, col):
+        """:meth:`apply_evicts` over a decode ``EvictColumn`` — same
+        model transitions and resync diversion, batched delta emission.
+        Returns the uids that did NOT actuate."""
+        failed: List[str] = []
+        if not len(col):
+            return failed
+        tasks = self._resolve_rows(col)
+        emit_u: List[str] = []
+        emit_n: List[str] = []
+        for k, uid in enumerate(col.uids):
+            task = tasks[k]
+            try:
+                self.evictor.evict(uid)
+            except BindFailure as err:
+                self._defer_resync(uid, "Evict", str(err))
+                failed.append(uid)
+                continue
+            if task.node_name:
+                node = self.cluster.nodes[task.node_name]
+                node.remove_task(task)
+                task.status = TaskStatus.RELEASING
+                node.add_task(task)
+            else:
+                task.status = TaskStatus.RELEASING
+            emit_u.append(uid)
+            emit_n.append(task.node_name)
+            self.record_event("Evict", uid, "Evict")
+        self._emit_task_rows(emit_u, emit_n)
         return failed
 
     # ---- failure handling (errTasks resync, cache.go:519-547) ----

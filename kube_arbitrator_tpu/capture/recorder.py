@@ -133,7 +133,12 @@ class SessionCapture:
     def on_cycle(self, seq: int, corr: str, ts: float, snap, dec) -> int:
         """Record one committed cycle; returns bytes written (0 when the
         cycle was dropped).  Never raises: a broken sink drops cycles
-        and warns once per episode, it does not fail scheduling."""
+        and warns once per episode, it does not fail scheduling.
+
+        The tee consumes only the pack tensors + decisions — never the
+        decoded bind/evict stream — so it is columnar by construction:
+        the zero-object actuation path (cache/decode.BindColumn) changes
+        nothing here, and replay re-decodes the same columns."""
         if self._closed:
             return 0
         try:

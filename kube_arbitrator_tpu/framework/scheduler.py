@@ -461,12 +461,28 @@ class Scheduler:
                 )
 
     def _actuate(self, binds, evicts) -> set:
-        """Apply the intents; returns the uids that did NOT actuate
+        """Apply the decisions; returns the uids that did NOT actuate
         (backends divert failures to the errTasks resync FIFO — the
-        audit plane needs to know the store never saw them)."""
+        audit plane needs to know the store never saw them).
+
+        Columnar decisions (cache/decode.BindColumn/EvictColumn) route
+        to the backend's batched ``apply_*_columnar`` entry points when
+        it has them (SimCluster, LiveCache) — zero intent objects,
+        wire materialization per apiserver call; intent lists (custom
+        backends, tests, replay) keep the object path."""
+        from ..cache.decode import BindColumn, EvictColumn
+
         with tracer().span("actuate", binds=len(binds), evicts=len(evicts)):
-            failed = set(self.sim.apply_binds(binds) or ())
-            failed |= set(self.sim.apply_evicts(evicts) or ())
+            apply_b = getattr(self.sim, "apply_binds_columnar", None)
+            if apply_b is not None and isinstance(binds, BindColumn):
+                failed = set(apply_b(binds) or ())
+            else:
+                failed = set(self.sim.apply_binds(binds) or ())
+            apply_e = getattr(self.sim, "apply_evicts_columnar", None)
+            if apply_e is not None and isinstance(evicts, EvictColumn):
+                failed |= set(apply_e(evicts) or ())
+            else:
+                failed |= set(self.sim.apply_evicts(evicts) or ())
         return failed
 
     def _write_back(

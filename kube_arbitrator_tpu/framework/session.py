@@ -13,7 +13,7 @@ import dataclasses
 import os
 import time
 import uuid
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -25,7 +25,7 @@ from ..api.types import (
     counts_as_ready,
     is_allocated_status,
 )
-from ..cache.decode import decode_decisions, decode_decisions_compact
+from ..cache.decode import decode_batch, decode_batch_compact
 from ..cache.sim import BindIntent, EvictIntent
 from ..cache.snapshot import Snapshot, build_snapshot
 from ..ops.cycle import CycleDecisions
@@ -121,8 +121,11 @@ class CycleResult:
     session_uid: str
     snapshot: Snapshot
     decisions: CycleDecisions
-    binds: List[BindIntent]
-    evicts: List[EvictIntent]
+    # Sequence, not List: the scheduling loop ships columnar
+    # BindColumn/EvictColumn (cache/decode.py) — iteration still yields
+    # intents, but columnar consumers read .uids/.node_names directly
+    binds: Sequence[BindIntent]
+    evicts: Sequence[EvictIntent]
     job_status: Dict[str, PodGroupStatus]
     # uid -> "why unschedulable" for EVERY unplaced pending pod of every
     # gang-unready job: the PodScheduled=False condition channel
@@ -314,25 +317,34 @@ class Session:
         remains the fallback for overflowed caps or a pre-ints-out peer
         across the RPC boundary, and the parity ORACLE the fast path is
         held to (``KAT_DECODE_PARITY=1`` cross-checks every cycle — the
-        decode parity suite and the chaos plane run with it set)."""
+        decode parity suite and the chaos plane run with it set).
+
+        Both paths return COLUMNS (cache/decode.BindColumn/EvictColumn):
+        no intent objects are built here — revalidation, the fence, and
+        batched actuation consume the ordinals, and the wire materializes
+        identities per apiserver call."""
         from ..utils.metrics import metrics
         from ..utils.tracing import tracer
 
         with tracer().span("decode"):
-            out = decode_decisions_compact(snap, dec)
-            if out is not None:
-                binds, evicts = out
+            batch = decode_batch_compact(snap, dec)
+            if batch is not None:
+                binds, evicts = batch.binds, batch.evicts
                 metrics().counter_add(
                     "decode_path_total", labels={"path": "compact"}
                 )
                 if _decode_parity_armed():
-                    ref_b, ref_e = decode_decisions(snap, dec)
-                    if binds != ref_b or evicts != ref_e:
+                    ref = decode_batch(snap, dec)
+                    if not (
+                        np.array_equal(binds.rows, ref.binds.rows)
+                        and np.array_equal(binds.node_ords, ref.binds.node_ords)
+                        and np.array_equal(evicts.rows, ref.evicts.rows)
+                    ):
                         raise AssertionError(
                             "decode contract violation: compact ints-out "
-                            "intents diverged from the dense-mask oracle "
-                            f"({len(binds)}/{len(ref_b)} binds, "
-                            f"{len(evicts)}/{len(ref_e)} evicts)"
+                            "columns diverged from the dense-mask oracle "
+                            f"({len(binds)}/{len(ref.binds)} binds, "
+                            f"{len(evicts)}/{len(ref.evicts)} evicts)"
                         )
             else:
                 from ..cache.decode import decode_lists_present
@@ -345,7 +357,8 @@ class Session:
                 metrics().counter_add(
                     "decode_path_total", labels={"path": "dense"}
                 )
-                binds, evicts = decode_decisions(snap, dec)
+                ref = decode_batch(snap, dec)
+                binds, evicts = ref.binds, ref.evicts
         if self.phase_hook is not None:
             self.phase_hook("decode")
         return binds, evicts

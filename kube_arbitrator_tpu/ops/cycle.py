@@ -135,13 +135,14 @@ class CycleDecisions:
     # entitlement accounting, arxiv 2008.09213).
     queue_deserved: jax.Array  # f32[Q, R]
     queue_alloc: jax.Array    # f32[Q, R]
-    # ---- ints-out decode lists (cache/decode.decode_decisions_compact) ----
+    # ---- ints-out decode lists (cache/decode.decode_batch_compact) ----
     # Compact, length-prefixed bind/evict index lists computed in-graph by
     # cumsum-compaction, so the host actuation decode is one bounded
-    # gather + batched .tolist() over O(decisions) elements instead of
-    # np.nonzero + per-row work over the [T] masks.  Slots are -1-padded;
-    # entries appear in ascending task-ordinal order (the dense decode's
-    # np.nonzero order, which keeps the two paths intent-identical).  The
+    # gather into columnar BindColumn/EvictColumn ordinals (identities
+    # resolve lazily, at the apiserver wire) instead of np.nonzero +
+    # per-row work over the [T] masks.  Slots are -1-padded; entries
+    # appear in ascending task-ordinal order (the dense decode's
+    # np.nonzero order, which keeps the two paths decision-identical).  The
     # counts are the FULL mask populations: count > list length means the
     # cycle overflowed its cap and the host must fall back to the dense
     # mask decode (counted in ``decode_overflow_total``).  Caps are a

@@ -52,7 +52,7 @@ from ..framework.session import CycleResult, Session
 from ..utils.metrics import metrics
 from ..utils.tracing import tracer
 from .journal import DeltaJournal
-from .revalidate import Discard, revalidate_decisions
+from .revalidate import Discard, revalidate_batch, revalidate_decisions
 
 PIPELINE_STAGES = ("ingest", "freeze", "decide", "revalidate", "actuate", "close")
 
@@ -308,9 +308,17 @@ class PipelinedExecutor:
                     "pipeline.revalidate", seq=ep.seq,
                     binds=len(binds0), evicts=len(evicts0),
                 ):
-                    binds, evicts, step_discards = revalidate_decisions(
-                        sched.sim.cluster, binds0, evicts0, self.journal
-                    )
+                    # columnar decode output takes the columnar gate
+                    # (same verdicts, no intent objects); object lists
+                    # (replay, custom deciders) keep the object gate
+                    if hasattr(binds0, "select"):
+                        binds, evicts, step_discards = revalidate_batch(
+                            sched.sim.cluster, binds0, evicts0, self.journal
+                        )
+                    else:
+                        binds, evicts, step_discards = revalidate_decisions(
+                            sched.sim.cluster, binds0, evicts0, self.journal
+                        )
                 t_reval = time.perf_counter()
                 sched._commit_fence(len(binds), len(evicts))
                 failed_actuations = sched._actuate(binds, evicts)

@@ -47,6 +47,15 @@ class DeltaJournal:
             self.dirty_nodes.add(node_name)
         self.events += 1
 
+    def task_dirty_rows(self, uids, node_names=()) -> None:
+        """Batched twin of :meth:`task_dirty`: parallel uid/node vectors
+        from a columnar producer (batched ingest blocks, columnar
+        actuation).  Set semantics and the event count match the
+        equivalent scalar call sequence exactly."""
+        self.dirty_tasks.update(uids)
+        self.dirty_nodes.update(n for n in node_names if n)
+        self.events += len(uids)
+
     def node_dirty(self, name: str) -> None:
         self.dirty_nodes.add(name)
         self.events += 1

@@ -114,6 +114,74 @@ class _TaskLookup:
         return self._cluster.task_by_uid(uid)
 
 
+def _check_bind(
+    uid: str,
+    node_name: str,
+    t_checked: bool,
+    n_checked: bool,
+    index: "_TaskLookup",
+    cluster,
+    tentative_res: Dict[str, np.ndarray],
+    tentative_cnt: Dict[str, int],
+) -> Tuple[Optional[str], str]:
+    """One bind's revalidation checks + tentative accounting — the ONE
+    rule body both the object gate and the columnar gate run, so their
+    keep/discard verdicts (and discard details) cannot diverge.  Returns
+    (reason, detail); a None reason means KEPT, and the node's tentative
+    ledger was charged (when the task resolved and the node was
+    implicated)."""
+    reason = detail = None
+    task = index.get(uid)
+    if t_checked:
+        if task is None:
+            reason = "task_gone"
+        elif task.status != TaskStatus.PENDING or task.node_name:
+            reason = "already_bound"
+            detail = f"status={task.status.name} node={task.node_name or '-'}"
+    if reason is None and n_checked:
+        node = cluster.nodes.get(node_name)
+        if node is None:
+            reason = "node_gone"
+        elif node.unschedulable:
+            reason = "node_unsched"
+        elif task is not None:
+            # current headroom: idle + releasing (eviction-backed
+            # placements are legitimate — the victim's resources are
+            # committed to a claimant) minus what this commit already
+            # accepted onto the node
+            avail = node.idle + node.releasing
+            used_here = tentative_res.get(node_name)
+            if used_here is not None:
+                avail = avail - used_here
+            n_here = len(node.tasks) + tentative_cnt.get(node_name, 0)
+            if not res.less_equal(np.asarray(task.resreq), avail):
+                reason = "capacity_shrunk"
+                detail = f"resreq {np.asarray(task.resreq).tolist()} > avail {avail.tolist()}"
+            elif n_here >= node.max_tasks:
+                reason = "capacity_shrunk"
+                detail = f"pod count {n_here} >= max_tasks {node.max_tasks}"
+    if reason is None:
+        # binds this commit already accepted per node, so two stale binds
+        # cannot pass one shrunken node's capacity check independently
+        if task is not None and n_checked:
+            prev = tentative_res.get(node_name)
+            r = np.asarray(task.resreq)
+            tentative_res[node_name] = r if prev is None else prev + r
+            tentative_cnt[node_name] = tentative_cnt.get(node_name, 0) + 1
+        return None, ""
+    return reason, detail or ""
+
+
+def _check_evict(uid: str, index: "_TaskLookup") -> Tuple[Optional[str], str]:
+    """One evict's revalidation checks (shared by both gates)."""
+    task = index.get(uid)
+    if task is None:
+        return "task_gone", ""
+    if task.status not in _EVICTABLE:
+        return "not_evictable", f"status={task.status.name}"
+    return None, ""
+
+
 def revalidate_decisions(
     cluster,
     binds: Sequence,
@@ -140,8 +208,6 @@ def revalidate_decisions(
     index = _TaskLookup(cluster, expected)
     discards: List[Discard] = []
     kept_binds: List = []
-    # binds this commit already accepted per node, so two stale binds
-    # cannot pass one shrunken node's capacity check independently
     tentative_res: Dict[str, np.ndarray] = {}
     tentative_cnt: Dict[str, int] = {}
     for b in binds:
@@ -150,65 +216,103 @@ def revalidate_decisions(
         if not t_checked and not n_checked:
             kept_binds.append(b)  # untouched by the window: passes as-is
             continue
-        reason = detail = None
-        task = index.get(b.task_uid)
-        if t_checked:
-            if task is None:
-                reason = "task_gone"
-            elif task.status != TaskStatus.PENDING or task.node_name:
-                reason = "already_bound"
-                detail = f"status={task.status.name} node={task.node_name or '-'}"
-        if reason is None and n_checked:
-            node = cluster.nodes.get(b.node_name)
-            if node is None:
-                reason = "node_gone"
-            elif node.unschedulable:
-                reason = "node_unsched"
-            elif task is not None:
-                # current headroom: idle + releasing (eviction-backed
-                # placements are legitimate — the victim's resources are
-                # committed to a claimant) minus what this commit already
-                # accepted onto the node
-                avail = node.idle + node.releasing
-                used_here = tentative_res.get(b.node_name)
-                if used_here is not None:
-                    avail = avail - used_here
-                n_here = len(node.tasks) + tentative_cnt.get(b.node_name, 0)
-                if not res.less_equal(np.asarray(task.resreq), avail):
-                    reason = "capacity_shrunk"
-                    detail = f"resreq {np.asarray(task.resreq).tolist()} > avail {avail.tolist()}"
-                elif n_here >= node.max_tasks:
-                    reason = "capacity_shrunk"
-                    detail = f"pod count {n_here} >= max_tasks {node.max_tasks}"
+        reason, detail = _check_bind(
+            b.task_uid, b.node_name, t_checked, n_checked,
+            index, cluster, tentative_res, tentative_cnt,
+        )
         if reason is None:
             kept_binds.append(b)
-            if task is not None and n_checked:
-                prev = tentative_res.get(b.node_name)
-                r = np.asarray(task.resreq)
-                tentative_res[b.node_name] = r if prev is None else prev + r
-                tentative_cnt[b.node_name] = tentative_cnt.get(b.node_name, 0) + 1
         else:
             discards.append(
                 Discard(kind="bind", task_uid=b.task_uid, reason=reason,
-                        detail=detail or "")
+                        detail=detail)
             )
     kept_evicts: List = []
     for e in evicts:
         if not (check_all or e.task_uid in dirty_tasks):
             kept_evicts.append(e)
             continue
-        task = index.get(e.task_uid)
-        if task is None:
-            discards.append(
-                Discard(kind="evict", task_uid=e.task_uid, reason="task_gone")
-            )
-        elif task.status not in _EVICTABLE:
-            discards.append(
-                Discard(
-                    kind="evict", task_uid=e.task_uid, reason="not_evictable",
-                    detail=f"status={task.status.name}",
-                )
-            )
-        else:
+        reason, detail = _check_evict(e.task_uid, index)
+        if reason is None:
             kept_evicts.append(e)
+        else:
+            discards.append(
+                Discard(kind="evict", task_uid=e.task_uid, reason=reason,
+                        detail=detail)
+            )
     return kept_binds, kept_evicts, discards
+
+
+def revalidate_batch(
+    cluster,
+    binds,
+    evicts,
+    journal,
+) -> Tuple[object, object, List[Discard]]:
+    """The columnar gate: same verdicts as :func:`revalidate_decisions`
+    (both run :func:`_check_bind`/:func:`_check_evict`), consuming and
+    returning :class:`..cache.decode.BindColumn` / ``EvictColumn``
+    instead of intent lists — no per-decision objects are built for the
+    decisions that survive.
+
+    Implication is resolved as batched membership probes over the
+    columns' cached uid/node identity vectors (strings the apiserver
+    wire needs anyway); only implicated rows pay a model lookup.  A
+    quiescent window returns the input columns untouched (identity, not
+    copies)."""
+    if journal is None or journal.empty:
+        return binds, evicts, []
+    check_all = bool(journal.structural)
+    dirty_tasks = journal.dirty_tasks
+    dirty_nodes = journal.dirty_nodes
+    nb, ne = len(binds), len(evicts)
+    b_uids, b_nodes = binds.uids, binds.node_names
+    e_uids = evicts.uids
+    if check_all:
+        bt = bn = [True] * nb
+        et = [True] * ne
+    else:
+        # batched gathers against the journal's implicated sets
+        bt = [u in dirty_tasks for u in b_uids]
+        bn = [n in dirty_nodes for n in b_nodes]
+        et = [u in dirty_tasks for u in e_uids]
+    expected = sum(t or n for t, n in zip(bt, bn)) + sum(et)
+    if expected == 0:
+        return binds, evicts, []
+    index = _TaskLookup(cluster, expected)
+    discards: List[Discard] = []
+    tentative_res: Dict[str, np.ndarray] = {}
+    tentative_cnt: Dict[str, int] = {}
+    keep_b: List[int] = []
+    for k in range(nb):
+        t_checked, n_checked = bt[k], bn[k]
+        if not t_checked and not n_checked:
+            keep_b.append(k)
+            continue
+        reason, detail = _check_bind(
+            b_uids[k], b_nodes[k], t_checked, n_checked,
+            index, cluster, tentative_res, tentative_cnt,
+        )
+        if reason is None:
+            keep_b.append(k)
+        else:
+            discards.append(
+                Discard(kind="bind", task_uid=b_uids[k], reason=reason,
+                        detail=detail)
+            )
+    keep_e: List[int] = []
+    for k in range(ne):
+        if not et[k]:
+            keep_e.append(k)
+            continue
+        reason, detail = _check_evict(e_uids[k], index)
+        if reason is None:
+            keep_e.append(k)
+        else:
+            discards.append(
+                Discard(kind="evict", task_uid=e_uids[k], reason=reason,
+                        detail=detail)
+            )
+    out_b = binds if len(keep_b) == nb else binds.select(keep_b)
+    out_e = evicts if len(keep_e) == ne else evicts.select(keep_e)
+    return out_b, out_e, discards

@@ -393,8 +393,16 @@ def build_audit_record(seq: int, corr: Optional[str], ts: float, result) -> Audi
     the record reconciles with what actually hit the apiserver."""
     snap, dec = result.snapshot, result.decisions
     failed = getattr(result, "failed_actuations", None) or set()
-    actuated_binds = {b.task_uid for b in result.binds} - failed
-    actuated_evicts = {e.task_uid for e in result.evicts} - failed
+    # columnar decisions (cache/decode.BindColumn/EvictColumn) expose
+    # the uid vector directly — no intent objects; object lists iterate
+    b_uids = getattr(result.binds, "uids", None)
+    e_uids = getattr(result.evicts, "uids", None)
+    if b_uids is None:
+        b_uids = [b.task_uid for b in result.binds]
+    if e_uids is None:
+        e_uids = [e.task_uid for e in result.evicts]
+    actuated_binds = set(b_uids) - failed
+    actuated_evicts = set(e_uids) - failed
     return AuditRecord(
         seq=seq,
         corr_id=corr or "",

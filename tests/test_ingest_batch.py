@@ -1,0 +1,625 @@
+"""Columnar actuation & batched watch ingest: parity suites.
+
+The PR's honest bar is "decisions bit-identical": the columnar
+representation (cache/decode.BindColumn/EvictColumn) and the batched
+event-block ingest (LiveCache._apply_event_blocks) are pure cost
+optimizations — every observable (model state, arena pack bytes,
+revalidation verdicts, actuation effects, delta-journal sets) must match
+the object/scalar paths exactly.  Four planes are pinned here:
+
+* the batched delta sink (``task_dirty_rows``) vs the scalar call
+  sequence, on both the journal and the arena;
+* the columnar revalidation gate vs the object gate — same kept sets,
+  same discard kinds/reasons/details, across targeted scenarios for
+  every discard reason and a randomized mix;
+* columnar actuation on :class:`SimCluster` vs the object path —
+  identical model mutations, failure diversion, events, and arena dirt,
+  including the gang-atomic volume-failure branch;
+* the randomized event-stream soak: batched ingest == scalar ingest on
+  the same apiserver stream (model digest, arena pack tensors, and the
+  decisions a cycle computes from them) across 3 seeds.
+"""
+import dataclasses
+import random
+
+import numpy as np
+import pytest
+
+from kube_arbitrator_tpu.api.types import TaskStatus
+from kube_arbitrator_tpu.cache import (
+    FakeApiServer,
+    LiveCache,
+    build_snapshot,
+    generate_cluster,
+)
+from kube_arbitrator_tpu.cache.arena import SnapshotArena
+from kube_arbitrator_tpu.cache.decode import (
+    BindColumn,
+    DecisionBatch,
+    EvictColumn,
+    decode_batch,
+    decode_decisions,
+)
+from kube_arbitrator_tpu.cache.sim import BindIntent, EvictIntent
+from kube_arbitrator_tpu.framework.conf import load_conf
+from kube_arbitrator_tpu.ops.cycle import schedule_cycle
+from kube_arbitrator_tpu.options import reset_options
+from kube_arbitrator_tpu.pipeline import DeltaJournal
+from kube_arbitrator_tpu.pipeline.revalidate import (
+    revalidate_batch,
+    revalidate_decisions,
+)
+from kube_arbitrator_tpu.utils.metrics import metrics
+
+GB = 1024**3
+
+FULL_CONF = load_conf(
+    'actions: "reclaim, allocate, backfill, preempt"\n'
+    "tiers:\n"
+    "- plugins:\n"
+    "  - name: priority\n"
+    "  - name: gang\n"
+    "- plugins:\n"
+    "  - name: drf\n"
+    "  - name: predicates\n"
+    "  - name: proportion\n"
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    reset_options()
+    metrics().reset()
+    yield
+    reset_options()
+    metrics().reset()
+
+
+# ---------------------------------------------------------------------------
+# the batched delta sink
+
+
+def test_task_dirty_rows_matches_scalar_sequence():
+    """journal + arena: one batched call == the equivalent scalar
+    sequence (dirty sets AND the journal's event count)."""
+    sim_a = generate_cluster(num_nodes=4, num_jobs=3, tasks_per_job=3,
+                             num_queues=2, seed=1)
+    sim_b = generate_cluster(num_nodes=4, num_jobs=3, tasks_per_job=3,
+                             num_queues=2, seed=1)
+    arena_a = SnapshotArena(sim_a, verify_every=0)
+    arena_b = SnapshotArena(sim_b, verify_every=0)
+    arena_a.snapshot()  # clear the seed-structural state
+    arena_b.snapshot()
+    ja, jb = DeltaJournal(), DeltaJournal()
+    arena_a.journal, arena_b.journal = ja, jb
+    uids = ["u1", "u2", "u3", "u2"]
+    nodes = ["n1", "", "n2", "n1"]
+    arena_a.task_dirty_rows(uids, nodes)
+    for u, n in zip(uids, nodes):
+        arena_b.task_dirty(u, n)
+    assert ja.dirty_tasks == jb.dirty_tasks == {"u1", "u2", "u3"}
+    assert ja.dirty_nodes == jb.dirty_nodes == {"n1", "n2"}
+    assert ja.events == jb.events == 4
+    assert arena_a._dirty_tasks == arena_b._dirty_tasks
+    assert arena_a._dirty_nodes == arena_b._dirty_nodes
+
+
+def test_task_dirty_rows_respects_structural_state():
+    """After a structural event the arena must NOT re-grow dirty sets
+    (the next pack rebuilds anyway) — but the journal tee still records
+    (the commit gate needs the window's deltas regardless)."""
+    sim = generate_cluster(num_nodes=2, num_jobs=2, tasks_per_job=2,
+                           num_queues=1, seed=2)
+    arena = SnapshotArena(sim, verify_every=0)
+    arena.snapshot()
+    j = DeltaJournal()
+    arena.journal = j
+    arena.structural("relist")
+    arena.task_dirty_rows(["u1"], ["n1"])
+    assert not arena._dirty_tasks and not arena._dirty_nodes
+    assert j.dirty_tasks == {"u1"} and j.dirty_nodes == {"n1"}
+
+
+# ---------------------------------------------------------------------------
+# columnar revalidation parity
+
+
+def _columns_from_intents(snap, binds, evicts):
+    """Build BindColumn/EvictColumn carrying exactly the given intents
+    (ordinals resolved through the snapshot index)."""
+    t_ord = {t.uid: i for i, t in enumerate(snap.index.tasks)}
+    n_ord = {n.name: i for i, n in enumerate(snap.index.nodes)}
+    bc = BindColumn(
+        snap.index,
+        np.asarray([t_ord[b.task_uid] for b in binds], np.int64),
+        np.asarray([n_ord[b.node_name] for b in binds], np.int64),
+    )
+    ec = EvictColumn(
+        snap.index,
+        np.asarray([t_ord[e.task_uid] for e in evicts], np.int64),
+    )
+    return bc, ec
+
+
+def _assert_gates_agree(cluster, snap, binds, evicts, journal):
+    bc, ec = _columns_from_intents(snap, binds, evicts)
+    kept_b, kept_e, disc_obj = revalidate_decisions(
+        cluster, binds, evicts, journal
+    )
+    col_b, col_e, disc_col = revalidate_batch(cluster, bc, ec, journal)
+    assert [(b.task_uid, b.node_name) for b in kept_b] == list(
+        zip(col_b.uids, col_b.node_names)
+    )
+    assert [e.task_uid for e in kept_e] == col_e.uids
+    assert [(d.kind, d.task_uid, d.reason, d.detail) for d in disc_obj] == [
+        (d.kind, d.task_uid, d.reason, d.detail) for d in disc_col
+    ]
+    return disc_col
+
+
+def test_revalidate_columnar_parity_every_reason():
+    """One world staged so the gate fires every bind/evict discard
+    reason (plus untouched pass-throughs): both gates must agree on
+    kept order, reasons, AND detail strings."""
+    sim = generate_cluster(num_nodes=6, num_jobs=4, tasks_per_job=4,
+                           num_queues=2, seed=5, running_fraction=0.5)
+    snap = build_snapshot(sim.cluster)
+    index = {u: t for j in sim.cluster.jobs.values()
+             for u, t in j.tasks.items()}
+    pending = [t for t in index.values() if t.status == TaskStatus.PENDING]
+    running = [t for t in index.values() if t.status == TaskStatus.RUNNING]
+    assert len(pending) >= 6 and len(running) >= 2
+    gone, bound, on_dead, on_cordon, fat, clean = pending[:6]
+    j = DeltaJournal()
+    # task_gone
+    sim.cluster.jobs[gone.job_uid].tasks.pop(gone.uid)
+    j.task_dirty(gone.uid)
+    # already_bound
+    bound.status = TaskStatus.BOUND
+    bound.node_name = "node-00001"
+    j.task_dirty(bound.uid)
+    # node_gone / node_unsched
+    sim.cluster.nodes.pop("node-00000")
+    sim.cluster.nodes["node-00001"].unschedulable = True
+    j.node_dirty("node-00000")
+    j.node_dirty("node-00001")
+    # capacity_shrunk (resource axis)
+    node2 = sim.cluster.nodes["node-00002"]
+    node2.idle = np.asarray(fat.resreq) * 0.5
+    node2.releasing = np.zeros_like(node2.idle)
+    j.node_dirty("node-00002")
+    # not_evictable
+    running[0].status = TaskStatus.RELEASING
+    j.task_dirty(running[0].uid)
+    binds = [
+        BindIntent(task_uid=gone.uid, node_name="node-00003"),
+        BindIntent(task_uid=bound.uid, node_name="node-00003"),
+        BindIntent(task_uid=on_dead.uid, node_name="node-00000"),
+        BindIntent(task_uid=on_cordon.uid, node_name="node-00001"),
+        BindIntent(task_uid=fat.uid, node_name="node-00002"),
+        BindIntent(task_uid=clean.uid, node_name="node-00003"),  # untouched
+    ]
+    evicts = [
+        EvictIntent(task_uid=running[0].uid),
+        EvictIntent(task_uid=running[1].uid),  # untouched
+    ]
+    discards = _assert_gates_agree(sim.cluster, snap, binds, evicts, j)
+    assert sorted(d.reason for d in discards) == sorted([
+        "task_gone", "already_bound", "node_gone", "node_unsched",
+        "capacity_shrunk", "not_evictable",
+    ])
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_revalidate_columnar_parity_randomized(seed):
+    """Randomized churn: random dirty sets (including the structural
+    check-everything flip) over random intent mixes — gates must agree
+    verbatim.  Tentative capacity accounting is order-dependent, so the
+    kept ORDER equality here is load-bearing."""
+    rng = random.Random(seed)
+    sim = generate_cluster(num_nodes=8, num_jobs=6, tasks_per_job=4,
+                           num_queues=2, seed=seed, running_fraction=0.4)
+    snap = build_snapshot(sim.cluster)
+    index = {u: t for j in sim.cluster.jobs.values()
+             for u, t in j.tasks.items()}
+    pending = [t for t in index.values() if t.status == TaskStatus.PENDING]
+    running = [t for t in index.values() if t.status == TaskStatus.RUNNING]
+    node_names = sorted(sim.cluster.nodes)
+    for round_i in range(5):
+        j = DeltaJournal()
+        if rng.random() < 0.2:
+            j.structural_event("chaos")
+        for t in rng.sample(pending, k=min(4, len(pending))):
+            j.task_dirty(t.uid)
+        for n in rng.sample(node_names, k=2):
+            j.node_dirty(n)
+        # random micro-churn the gate must adjudicate
+        victim = rng.choice(pending)
+        victim.status = rng.choice(
+            [TaskStatus.PENDING, TaskStatus.BOUND, TaskStatus.RUNNING]
+        )
+        cordoned = rng.choice(node_names)
+        sim.cluster.nodes[cordoned].unschedulable = rng.random() < 0.5
+        binds = [
+            BindIntent(task_uid=t.uid, node_name=rng.choice(node_names))
+            for t in rng.sample(pending, k=min(8, len(pending)))
+        ]
+        evicts = [
+            EvictIntent(task_uid=t.uid)
+            for t in rng.sample(running, k=min(4, len(running)))
+        ]
+        _assert_gates_agree(sim.cluster, snap, binds, evicts, j)
+
+
+def test_revalidate_batch_quiescent_returns_inputs_untouched():
+    sim = generate_cluster(num_nodes=4, num_jobs=3, tasks_per_job=3,
+                           num_queues=2, seed=9)
+    snap = build_snapshot(sim.cluster)
+    batch = decode_batch(snap, schedule_cycle(snap.tensors))
+    out_b, out_e, disc = revalidate_batch(
+        sim.cluster, batch.binds, batch.evicts, DeltaJournal()
+    )
+    assert out_b is batch.binds and out_e is batch.evicts and not disc
+
+
+# ---------------------------------------------------------------------------
+# columnar actuation parity (SimCluster)
+
+
+def _world_pair(seed=3):
+    mk = lambda: generate_cluster(num_nodes=8, num_jobs=6, tasks_per_job=4,
+                                  num_queues=2, seed=seed)
+    return mk(), mk()
+
+
+def _model_digest(cluster):
+    return {
+        "jobs": {
+            ju: {
+                u: (t.status.name, t.node_name,
+                    np.asarray(t.resreq).tolist())
+                for u, t in sorted(j.tasks.items())
+            }
+            for ju, j in sorted(cluster.jobs.items())
+        },
+        "nodes": {
+            n: (nd.idle.tolist(), nd.used.tolist(), nd.releasing.tolist(),
+                sorted(nd.tasks))
+            for n, nd in sorted(cluster.nodes.items())
+        },
+    }
+
+
+def test_columnar_actuation_matches_object_path():
+    """Same kernel decisions applied columnar vs object: identical model
+    state, events, resync queues, failed sets, and arena dirt — with a
+    volume-bind failure injected so the gang-atomic branch is covered."""
+    sim_col, sim_obj = _world_pair()
+    arena_col = SnapshotArena(sim_col, verify_every=0)
+    arena_obj = SnapshotArena(sim_obj, verify_every=0)
+    snap_c = arena_col.snapshot()
+    snap_o = arena_obj.snapshot()
+    dec_c = schedule_cycle(snap_c.tensors, tiers=FULL_CONF.tiers,
+                           actions=FULL_CONF.actions)
+    batch = decode_batch(snap_c, dec_c)
+    binds, evicts = decode_decisions(
+        snap_o, schedule_cycle(snap_o.tensors, tiers=FULL_CONF.tiers,
+                               actions=FULL_CONF.actions)
+    )
+    assert len(batch.binds) == len(binds) and len(batch.binds) > 0
+    # divert one mid-stream job's volumes: the whole job must fail
+    # identically on both paths
+    fail_uid = binds[len(binds) // 2].task_uid
+    sim_col.volume_binder.fail_allocate_uids.add(fail_uid)
+    sim_obj.volume_binder.fail_allocate_uids.add(fail_uid)
+    failed_c = sim_col.apply_binds_columnar(batch.binds)
+    failed_c += sim_col.apply_evicts_columnar(batch.evicts)
+    failed_o = sim_obj.apply_binds(binds)
+    failed_o += sim_obj.apply_evicts(evicts)
+    assert failed_c == failed_o and fail_uid in failed_c
+    assert _model_digest(sim_col.cluster) == _model_digest(sim_obj.cluster)
+    assert [dataclasses.astuple(e) for e in sim_col.events] == [
+        dataclasses.astuple(e) for e in sim_obj.events
+    ]
+    assert sim_col.resync_queue == sim_obj.resync_queue
+    assert arena_col._dirty_tasks == arena_obj._dirty_tasks
+    assert arena_col._dirty_nodes == arena_obj._dirty_nodes
+    # and the packs both arenas build next are byte-identical
+    pc, po = arena_col.snapshot(), arena_obj.snapshot()
+    for f in dataclasses.fields(pc.tensors):
+        a = getattr(pc.tensors, f.name)
+        b = getattr(po.tensors, f.name)
+        if a is None or not hasattr(a, "shape"):
+            continue
+        assert np.array_equal(np.asarray(a), np.asarray(b)), f.name
+
+
+def test_column_sequence_compat():
+    """The columns stay drop-in for object-path consumers: len/bool/
+    iteration/indexing/== against intent lists."""
+    sim = generate_cluster(num_nodes=4, num_jobs=4, tasks_per_job=3,
+                           num_queues=2, seed=4)
+    snap = build_snapshot(sim.cluster)
+    dec = schedule_cycle(snap.tensors)
+    batch = decode_batch(snap, dec)
+    binds, evicts = decode_decisions(snap, dec)
+    assert len(batch.binds) == len(binds)
+    assert list(batch.binds) == binds
+    assert batch.binds == binds and batch.evicts == evicts
+    if binds:
+        assert batch.binds[0] == binds[0]
+        assert bool(batch.binds)
+    empty = EvictColumn.empty(snap.index)
+    assert not empty and empty == [] and len(empty) == 0
+    sel = batch.binds.select(list(range(0, len(batch.binds), 2)))
+    assert [b.task_uid for b in sel] == [b.task_uid for b in binds[::2]]
+    assert isinstance(batch, DecisionBatch)
+
+
+def test_pod_to_task_block_field_identical():
+    """The block path's memoized wire translation must be field-identical
+    to pod_to_task for every spec shape it can admit — plain, decorated
+    (affinity/tolerations/ports/selector), multi-container, and repeated
+    shapes through the shared memo."""
+    from kube_arbitrator_tpu.cache.live import pod_to_task, pod_to_task_block
+
+    plain = {
+        "metadata": {"name": "a", "namespace": "ns", "uid": "u1",
+                     "labels": {"app": "x"}},
+        "spec": {"schedulerName": "kube-batch", "nodeName": "n1",
+                 "priority": 3,
+                 "containers": [{"resources": {"requests": {
+                     "cpu": "500m", "memory": "2Gi"}}}]},
+        "status": {"phase": "Running"},
+    }
+    decorated = {
+        "metadata": {"name": "b", "uid": "u2"},
+        "spec": {
+            "nodeSelector": {"disk": "ssd"},
+            "tolerations": [{"key": "k", "operator": "Exists",
+                             "effect": "NoSchedule"}],
+            "affinity": {
+                "nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [{"matchExpressions": [
+                            {"key": "zone", "operator": "In",
+                             "values": ["z1", "z2"]}]}]}},
+                "podAntiAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [
+                        {"labelSelector": {"matchLabels": {"app": "x"}},
+                         "topologyKey": "kubernetes.io/hostname"}]},
+            },
+            "containers": [
+                {"resources": {"requests": {"cpu": "1",
+                                            "nvidia.com/gpu": "2"}},
+                 "ports": [{"hostPort": 8080}]},
+                {"resources": {"requests": {"memory": "1Gi"}}},
+            ],
+        },
+        "status": {"phase": "Pending"},
+    }
+    memo: dict = {}
+    for pod in (plain, decorated, plain):  # 3rd run exercises a memo hit
+        ref = pod_to_task(pod, "job-1", "", 0)
+        fast = pod_to_task_block(pod, "job-1", memo)
+        for f in dataclasses.fields(ref):
+            a, b = getattr(ref, f.name), getattr(fast, f.name)
+            if f.name == "resreq":
+                assert np.array_equal(a, b)
+            else:
+                assert a == b, f.name
+        assert fast.resreq is not ref.resreq  # no shared arrays
+    fast1 = pod_to_task_block(plain, "job-1", memo)
+    fast2 = pod_to_task_block(plain, "job-1", memo)
+    assert fast1.resreq is not fast2.resreq  # memo hands out copies
+
+
+# ---------------------------------------------------------------------------
+# the randomized event-stream ingest soak
+
+
+def _pod(name, group, node="", phase="Pending", cpu="1", memory="1Gi",
+         scheduler="kube-batch", priority=1):
+    return {
+        "metadata": {
+            "name": name,
+            "namespace": "default",
+            "uid": f"uid-{name}",
+            "annotations": {"scheduling.k8s.io/group-name": group}
+            if group else {},
+            "labels": {},
+        },
+        "spec": {
+            "schedulerName": scheduler,
+            "nodeName": node,
+            "priority": priority,
+            "containers": [
+                {"resources": {"requests": {"cpu": cpu, "memory": memory}}}
+            ],
+        },
+        "status": {"phase": phase},
+    }
+
+
+def _node(name, cpu="8", memory="16Gi", unschedulable=False):
+    return {
+        "metadata": {"name": name, "labels": {}},
+        "status": {"allocatable": {"cpu": cpu, "memory": memory,
+                                   "pods": 110}},
+        "spec": {"unschedulable": unschedulable} if unschedulable else {},
+    }
+
+
+def _live_digest(live):
+    c = live.cluster
+    return {
+        "jobs": {
+            ju: (j.queue_uid, j.min_available, j.priority, {
+                u: (t.status.name, t.node_name,
+                    np.asarray(t.resreq).tolist(), t.priority)
+                for u, t in sorted(j.tasks.items())
+            })
+            for ju, j in sorted(c.jobs.items())
+        },
+        "nodes": {
+            n: (nd.idle.tolist(), nd.used.tolist(), nd.releasing.tolist(),
+                sorted(nd.tasks), nd.unschedulable)
+            for n, nd in sorted(c.nodes.items())
+        },
+        "others": sorted(t.uid for t in c.others),
+        "queues": sorted(c.queues),
+        "refs": dict(sorted(live._pod_ref.items())),
+        "rv": live._watch_rv,
+    }
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_ingest_soak_batched_equals_scalar(seed):
+    """Two LiveCaches draining the SAME apiserver stream — one batched,
+    one per-event — must agree after every pump on the full model
+    digest AND the arena pack tensors; the cycle decisions computed
+    from the final packs agree too.  The stream mixes row-local MODIFYs
+    (the blockable shape) with structural churn (creates, deletes, job
+    flips, cordons, foreign pods) so block flush boundaries are
+    exercised, and the test asserts the batched path actually batched."""
+    rng = random.Random(1000 + seed)
+    api = FakeApiServer()
+    for i in range(4):
+        api.create("nodes", _node(f"n{i}"))
+    api.create("queues", {"metadata": {"name": "default"},
+                          "spec": {"weight": 1}})
+    pods = {}  # name -> current dict
+    for g in range(3):
+        api.create("podgroups", {
+            "metadata": {"name": f"pg{g}", "namespace": "default",
+                         "creationTimestamp": 1.0},
+            "spec": {"minMember": 1},
+            "status": {},
+        })
+        for i in range(4):
+            p = _pod(f"p{g}-{i}", f"pg{g}")
+            pods[p["metadata"]["name"]] = p
+            api.create("pods", p)
+    batched = LiveCache(api, batch_ingest=True)
+    scalar = LiveCache(api, batch_ingest=False)
+    arena_b = SnapshotArena(batched, verify_every=1)  # verify every pack
+    arena_s = SnapshotArena(scalar, verify_every=1)
+    batched.sync()
+    scalar.sync()
+    n_new = 0
+    for round_i in range(12):
+        for _ in range(rng.randint(2, 6)):
+            op = rng.random()
+            if op < 0.55 and pods:
+                # row-local MODIFY: phase/priority/node churn on an
+                # existing pod (the blockable shape)
+                name = rng.choice(sorted(pods))
+                p = pods[name]
+                p = _pod(
+                    name,
+                    p["metadata"]["annotations"].get(
+                        "scheduling.k8s.io/group-name"),
+                    node=p["spec"]["nodeName"] or (
+                        rng.choice(["", "n0", "n1"])
+                        if rng.random() < 0.4 else ""),
+                    phase=rng.choice(["Pending", "Running", "Succeeded"]),
+                    priority=rng.randint(1, 3),
+                    scheduler=p["spec"]["schedulerName"],
+                )
+                pods[name] = p
+                api.update("pods", p)
+            elif op < 0.7:
+                # structural: a new pod (sometimes foreign/assigned)
+                n_new += 1
+                foreign = rng.random() < 0.3
+                p = _pod(
+                    f"new-{n_new}",
+                    None if foreign else f"pg{rng.randrange(3)}",
+                    node=f"n{rng.randrange(4)}" if foreign else "",
+                    phase="Running" if foreign else "Pending",
+                    scheduler="default-scheduler" if foreign
+                    else "kube-batch",
+                )
+                pods[p["metadata"]["name"]] = p
+                api.create("pods", p)
+            elif op < 0.8 and pods:
+                name = rng.choice(sorted(pods))
+                api.delete("pods", "default", name)
+                pods.pop(name)
+            elif op < 0.9:
+                # job-membership flip: the scalar-fallback structural path
+                name = rng.choice(sorted(pods)) if pods else None
+                if name:
+                    p = pods[name]
+                    p = _pod(name, f"pg{rng.randrange(3)}",
+                             node=p["spec"]["nodeName"],
+                             phase=p["status"]["phase"],
+                             scheduler=p["spec"]["schedulerName"])
+                    pods[name] = p
+                    api.update("pods", p)
+            else:
+                api.update("nodes", _node(
+                    f"n{rng.randrange(4)}",
+                    unschedulable=rng.random() < 0.5,
+                ))
+        nb = batched.sync()
+        ns = scalar.sync()
+        assert nb == ns, f"round {round_i}: applied counts diverged"
+        assert _live_digest(batched) == _live_digest(scalar), (
+            f"round {round_i}: model digests diverged"
+        )
+        pb = arena_b.snapshot()  # verify_every=1: oracle-checked packs
+        ps = arena_s.snapshot()
+        for f in dataclasses.fields(pb.tensors):
+            a = getattr(pb.tensors, f.name)
+            b = getattr(ps.tensors, f.name)
+            if a is None or not hasattr(a, "shape"):
+                continue
+            assert np.array_equal(np.asarray(a), np.asarray(b)), (
+                f"round {round_i}: pack tensor {f.name} diverged"
+            )
+    # the soak must have exercised the block path, not just fallen back
+    assert metrics().counter_value(
+        "cache_ingest_rows_total", {"path": "batched"}
+    ) > 0
+    # decisions from the final packs are bit-identical
+    dec_b = schedule_cycle(pb.tensors, tiers=FULL_CONF.tiers,
+                           actions=FULL_CONF.actions)
+    dec_s = schedule_cycle(ps.tensors, tiers=FULL_CONF.tiers,
+                           actions=FULL_CONF.actions)
+    assert np.array_equal(np.asarray(dec_b.bind_mask),
+                          np.asarray(dec_s.bind_mask))
+    assert np.array_equal(np.asarray(dec_b.evict_mask),
+                          np.asarray(dec_s.evict_mask))
+    assert np.array_equal(np.asarray(dec_b.task_node),
+                          np.asarray(dec_s.task_node))
+
+
+def test_live_scheduler_cycle_with_batched_ingest_binds():
+    """End-to-end: a Scheduler over a batched-ingest LiveCache binds
+    through the apiserver and the watch round-trip (bound -> Running
+    MODIFYs, the canonical blockable events) lands in the model."""
+    from kube_arbitrator_tpu.framework import Scheduler
+
+    api = FakeApiServer()
+    for i in range(2):
+        api.create("nodes", _node(f"n{i}"))
+    api.create("queues", {"metadata": {"name": "default"},
+                          "spec": {"weight": 1}})
+    api.create("podgroups", {
+        "metadata": {"name": "pg1", "namespace": "default",
+                     "creationTimestamp": 1.0},
+        "spec": {"minMember": 1}, "status": {},
+    })
+    for i in range(4):
+        api.create("pods", _pod(f"p{i}", "pg1"))
+    live = LiveCache(api, batch_ingest=True)
+    sched = Scheduler(live)
+    result = sched.run_once()
+    assert len(result.binds) == 4
+    live.sync()  # drain the bind/Running round-trip as event blocks
+    job = live.cluster.jobs["default/pg1"]
+    assert all(t.status == TaskStatus.RUNNING for t in job.tasks.values())
+    assert metrics().counter_value(
+        "cache_ingest_rows_total", {"path": "batched"}
+    ) > 0
