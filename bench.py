@@ -47,6 +47,13 @@ cycles/s and per-tenant cycle-latency p50/p99.  BENCH_POOL_GRID
 ("TxN", default 2000x200), and BENCH_POOL_CYCLES shape it; rows land in
 BENCH_HISTORY.jsonl so the perf sentinel baselines pool throughput.
 
+BENCH_WHATIF=1 switches to the what-if shadow-serving mode (whatif/):
+shadow answers/s through a decision pool, each answer deciding its
+overlay + baseline legs in one pool flush over a frozen snapshot, with
+the fraction that stacked into a single batched XLA launch.
+BENCH_WHATIF_RUNG ("TxN", default 2000x200), BENCH_WHATIF_QUEUES, and
+BENCH_WHATIF_SERVES shape it.
+
 Wedge containment: the measurement loop runs in a CHILD process that
 streams every completed row to a spill file; the parent enforces
 BENCH_TIMEOUT_S (default 2700 s) and, if the child hangs (the axon TPU
@@ -335,7 +342,90 @@ def main() -> None:
         sys.exit(_pool_main())
     if os.environ.get("BENCH_SHARD") == "1":
         sys.exit(_shard_main())
+    if os.environ.get("BENCH_WHATIF") == "1":
+        sys.exit(_whatif_main())
     _measure_main()
+
+
+# ---------------------------------------------------------------------------
+# what-if shadow serving mode (BENCH_WHATIF=1)
+
+
+def _whatif_main() -> int:
+    """Shadow-QPS rung: what-if answers/s through a decision pool, each
+    answer = overlay + baseline legs decided in ONE pool flush over a
+    frozen snapshot (whatif/shadow.py).  A value-only overlay keeps the
+    pack shape key, so the two legs stack into one batched XLA launch —
+    ``shared_launch_fraction`` reports how often that held.  Env:
+    BENCH_WHATIF_RUNG ("TxN", default 2000x200), BENCH_WHATIF_QUEUES,
+    BENCH_WHATIF_SERVES.  The row lands in BENCH_HISTORY.jsonl so the
+    perf sentinel baselines counterfactual serving."""
+    t, n = os.environ.get("BENCH_WHATIF_RUNG", "2000x200").lower().split("x")
+    T, N = int(t), int(n)
+    queues = int(os.environ.get("BENCH_WHATIF_QUEUES", 8))
+    serves = int(os.environ.get("BENCH_WHATIF_SERVES", 12))
+
+    from kube_arbitrator_tpu.cache import build_snapshot
+    from kube_arbitrator_tpu.cache.sim import generate_cluster
+    from kube_arbitrator_tpu.framework.conf import SchedulerConfig
+    from kube_arbitrator_tpu.rpc.pool import DecisionPool
+    from kube_arbitrator_tpu.utils.audit import _queue_names
+    from kube_arbitrator_tpu.whatif import Overlay, ShadowEngine
+
+    jobs = max(1, T // 100)
+    sim = generate_cluster(
+        num_nodes=N, num_jobs=jobs, tasks_per_job=100, num_queues=queues,
+        seed=4242,
+    )
+    snap = build_snapshot(sim.cluster)
+    pool = DecisionPool(replicas=1, threaded=False)
+    engine = ShadowEngine(pool, SchedulerConfig.default())
+    qnames = _queue_names(snap)
+    ov = Overlay(queue_weights=((qnames[0], 2.0),)) if qnames else Overlay()
+    for _ in range(2):  # compile both legs' shared program
+        engine.serve("bench", snap, overlay=ov)
+    t0 = time.perf_counter()
+    answers = [engine.serve("bench", snap, overlay=ov) for _ in range(serves)]
+    wall_s = time.perf_counter() - t0
+    pool.close()
+    served = [a for a in answers if a.outcome == "served"]
+    row = {
+        "metric": f"whatif_shadow@{T}x{N}",
+        "value": round(serves / wall_s, 2),
+        "unit": "answers/s",
+        # per-answer wall latency — the timing column the perf
+        # sentinel's history rows key on
+        "cycle_ms": round(wall_s / serves * 1000.0, 3),
+        "wall_s": round(wall_s, 3),
+        "serves": serves,
+        "served": len(served),
+        "kernel_ms_mean": round(
+            sum(a.kernel_ms for a in served) / len(served), 3
+        ) if served else None,
+        "shared_launch_fraction": round(
+            sum(1 for a in served if a.shared_launch) / len(served), 3
+        ) if served else 0.0,
+        "batch_mean": round(
+            sum(a.batch for a in served) / len(served), 2
+        ) if served else 0.0,
+        "provenance": "each answer decides overlay+baseline legs through one "
+        "DecisionPool flush over a frozen snapshot; shared_launch_fraction "
+        "is how often both legs landed in ONE batched XLA launch",
+    }
+    _emit(row, stream=sys.stderr)
+    _spill(row)
+    summary = {
+        "metric": "whatif_shadow",
+        "value": row["value"],
+        "unit": "answers/s",
+        "note": "shadow what-if answers/s (overlay + baseline per answer)",
+        "rung": row,
+        "devices": _device_desc(),
+    }
+    _emit(summary)
+    _spill({"primary": summary, "final": True})
+    _history_append([row])
+    return 0
 
 
 # ---------------------------------------------------------------------------

@@ -653,6 +653,135 @@ EOF
   fi
 fi
 
+# WHATIF_SMOKE=1: the what-if control plane — a shadow-vs-live parity
+# probe (empty overlay must reproduce the live decision bit-for-bit,
+# value-only overlay must share the live launch), the ledger-admission
+# hysteresis canary (enter -> hold -> resume; no flap), a capacity-plan
+# replay over a fresh recording, the shadow-isolation chaos canary
+# (--disable shadow-isolation arms an in-place mutation seam and MUST
+# breach), and the KAT lints over the whatif package.
+rc_whatif=0
+if [ "${WHATIF_SMOKE:-0}" = "1" ]; then
+  env JAX_PLATFORMS=cpu python - <<'EOF' || rc_whatif=$?
+from kube_arbitrator_tpu.cache import build_snapshot, generate_cluster
+from kube_arbitrator_tpu.framework.conf import SchedulerConfig
+from kube_arbitrator_tpu.rpc.pool import DecisionPool, np_equal_decisions
+from kube_arbitrator_tpu.utils.audit import _queue_names, decision_digest
+from kube_arbitrator_tpu.whatif import Overlay, ShadowEngine
+
+cfg = SchedulerConfig.default()
+sim = generate_cluster(num_nodes=8, num_jobs=6, tasks_per_job=5,
+                       num_queues=4, seed=0)
+snap = build_snapshot(sim.cluster)
+pool = DecisionPool(replicas=1, threaded=False)
+try:
+    live = pool.decide_many([("live", snap.tensors, cfg, None)])[0]
+    assert live.error is None, live.error
+    engine = ShadowEngine(pool, cfg)
+    ans = engine.serve("live", snap, overlay=Overlay())
+    assert ans.outcome == "served", ans.error
+    assert ans.identical and ans.shared_launch, "empty overlay diverged"
+    assert ans.base_digest == decision_digest(snap, live.decisions)
+    assert np_equal_decisions(ans.decisions, live.decisions)
+    ov = Overlay(queue_weights=((_queue_names(snap)[0], 2.0),))
+    ans2 = engine.serve("live", snap, overlay=ov)
+    assert ans2.outcome == "served" and ans2.shared_launch
+    assert decision_digest(snap, live.decisions) == ans2.base_digest
+finally:
+    pool.close()
+print("whatif smoke: shadow-vs-live parity + shared launch ok")
+EOF
+  env JAX_PLATFORMS=cpu python - <<'EOF' || rc_whatif=$?
+from kube_arbitrator_tpu.utils.metrics import MetricsRegistry
+from kube_arbitrator_tpu.whatif import LedgerAdmission
+
+
+class W:
+    def __init__(self, seq, tenants):
+        self.seq, self.tenants = seq, tenants
+
+
+class F:
+    window = None
+    def last_window(self):
+        return self.window
+
+
+fleet = F()
+adm = LedgerAdmission(slo_ms=1000.0, fleet=fleet, starvation_slo_s=60.0,
+                      enter_delta=0.10, exit_delta=0.02, min_hold=2,
+                      registry=MetricsRegistry())
+hot = [{"tenant": "hog", "delta": 0.3},
+       {"tenant": "victim", "delta": -0.3, "starvation_s": 90.0}]
+cool = [{"tenant": "hog", "delta": 0.0}, {"tenant": "victim", "delta": 0.0}]
+fleet.window = W(1, hot)
+assert adm.should_shed("hog") and adm.shed_reason("hog") == "ledger_defer"
+fleet.window = W(2, cool)
+assert adm.should_shed("hog"), "released before min_hold"
+fleet.window = W(3, cool)
+assert not adm.should_shed("hog"), "failed to resume after hold"
+assert [e["action"] for e in adm.decision_log] == ["defer", "defer", "resume"]
+assert not adm.should_shed("whatif:hog"), "shed a shadow tenant"
+print("whatif smoke: ledger admission hysteresis ok")
+EOF
+  # capacity-plan replay over a fresh recording, exercised through the
+  # real CLI in a fresh process (exit 0 + a vs_baseline row per rung)
+  PLAN_DIR="$(mktemp -d /tmp/kat-whatif.XXXXXX)"
+  env JAX_PLATFORMS=cpu python - "${PLAN_DIR}" <<'EOF' || rc_whatif=$?
+import sys
+from kube_arbitrator_tpu.cache import generate_cluster
+from kube_arbitrator_tpu.capture import SessionCapture
+from kube_arbitrator_tpu.framework import Scheduler
+from kube_arbitrator_tpu.framework.conf import dump_conf
+
+sim = generate_cluster(num_nodes=4, num_jobs=8, tasks_per_job=5,
+                       num_queues=2, seed=0)
+sched = Scheduler(sim)
+cap = SessionCapture(sys.argv[1] + "/rec", conf_yaml=dump_conf(sched.config))
+sched.capture = cap
+try:
+    sched.run(max_cycles=6, until_idle=False)
+finally:
+    cap.close()
+EOF
+  env JAX_PLATFORMS=cpu python -m kube_arbitrator_tpu.whatif \
+    --plan "${PLAN_DIR}/rec" --rung node_scale=0.5 \
+    --rung w:queue-000=2.0 --json --out "${PLAN_DIR}/plan.json" \
+    >/dev/null || rc_whatif=$?
+  env python - "${PLAN_DIR}" <<'EOF' || rc_whatif=$?
+import json, sys
+
+report = json.load(open(sys.argv[1] + "/plan.json"))
+rungs = [r["rung"] for r in report["rungs"]]
+assert rungs[0] == "baseline" and len(rungs) == 3, rungs
+assert all("vs_baseline" in r for r in report["rungs"][1:])
+print("whatif smoke: capacity plan over %d cycles, %d rungs ok"
+      % (report["cycles"], len(rungs)))
+EOF
+  rm -rf "${PLAN_DIR}"
+  # shadow-isolation sensitivity canary: arming the in-place mutation
+  # seam MUST breach shadow_isolation — exit code exactly 1.  A clean
+  # exit means the probe can no longer see a shadow cycle leaking into
+  # the live epoch.
+  env JAX_PLATFORMS=cpu python -m kube_arbitrator_tpu.chaos \
+    --seed 0 --cycles 4 --profile pool --disable shadow-isolation \
+    --out-dir /tmp >/dev/null
+  rc_canary=$?
+  if [ "${rc_canary}" -ne 1 ]; then
+    echo "shadow-isolation canary did not breach (exit ${rc_canary})" >&2
+    rc_whatif=1
+  fi
+  env JAX_PLATFORMS=cpu python -m pytest -q tests/test_whatif.py \
+    || rc_whatif=$?
+  python -m kube_arbitrator_tpu.analysis --rules KAT-LCK,KAT-DTY,KAT-EFF \
+    kube_arbitrator_tpu/whatif || rc_whatif=$?
+  if [ "${rc_whatif}" -ne 0 ]; then
+    echo "whatif smoke job: FAILED (exit ${rc_whatif})" >&2
+  else
+    echo "whatif smoke job: ok (parity probe + admission hysteresis + plan replay + isolation canary + suite + kat-lint)"
+  fi
+fi
+
 if [ "${LINT_ONLY:-0}" = "1" ]; then
   # The fast lane names the effects family in its own job line: a
   # budget regression (hot-loop allocation, undeclared sync, blocked
@@ -679,6 +808,7 @@ if [ "${LINT_ONLY:-0}" = "1" ]; then
   if [ "${rc_race}" -ne 0 ]; then exit "${rc_race}"; fi
   if [ "${rc_replay}" -ne 0 ]; then exit "${rc_replay}"; fi
   if [ "${rc_ingest}" -ne 0 ]; then exit "${rc_ingest}"; fi
+  if [ "${rc_whatif}" -ne 0 ]; then exit "${rc_whatif}"; fi
   exit "${rc_pipe}"
 fi
 
@@ -702,4 +832,5 @@ if [ "${rc_shard}" -ne 0 ]; then exit "${rc_shard}"; fi
 if [ "${rc_race}" -ne 0 ]; then exit "${rc_race}"; fi
 if [ "${rc_replay}" -ne 0 ]; then exit "${rc_replay}"; fi
 if [ "${rc_ingest}" -ne 0 ]; then exit "${rc_ingest}"; fi
+if [ "${rc_whatif}" -ne 0 ]; then exit "${rc_whatif}"; fi
 exit "${rc_test}"

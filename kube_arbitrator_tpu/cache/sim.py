@@ -594,34 +594,128 @@ class SimCluster:
         self._emit_task_rows([uids[k] for k in order], [nodes[k] for k in order])
         return []
 
+    def _evict_batch_certificate(self, uids, tasks):
+        """Prove (read-only) that committing the whole evict column can
+        fail NOWHERE, so the batched commit may skip every per-row
+        try/except and node-accounting chain.
+
+        The certificate requires: no injected evictor failures armed; no
+        uid repeats in the batch; every on-node row's node exists and
+        holds a resident clone of the uid; no resident clone is already
+        RELEASING or PIPELINED (those take different remove_task
+        branches — and re-evicting a releasing task is not the fast
+        path's business); and each clone's resreq equals the model
+        task's (so the remove/add accounting cancels exactly).  Under
+        those facts the scalar chain's net node effect is exactly
+        ``releasing += Σ resreq`` per touched node — idle and used
+        cancel bit-for-bit because resource quantities are integral
+        float64 — so the batch may commit it as ONE vectorized update
+        per node.  Returns (per-row node-or-None, touched nodes,
+        per-node releasing sums) on success, None on any doubt — the
+        caller then routes through the scalar path wholesale, which
+        reproduces the exact failure semantics (resync diversion order,
+        partial-batch actuation) bit-for-bit."""
+        if self.evictor.fail_uids:
+            return None
+        if len(set(uids)) != len(uids):
+            return None
+        cluster_nodes = self.cluster.nodes
+        group_of: Dict[str, int] = {}
+        g_nodes: List[NodeInfo] = []
+        g_rows: List[int] = []
+        req_rows: List[np.ndarray] = []
+        row_nodes: List[Optional[NodeInfo]] = []
+        for k, task in enumerate(tasks):
+            nm = task.node_name
+            if not nm:
+                row_nodes.append(None)
+                continue
+            node = cluster_nodes.get(nm)
+            if node is None:
+                return None
+            clone = node.tasks.get(uids[k])
+            if clone is None:
+                return None
+            if clone.status in (TaskStatus.RELEASING, TaskStatus.PIPELINED):
+                return None
+            if not np.array_equal(clone.resreq, task.resreq):
+                return None
+            g = group_of.get(nm)
+            if g is None:
+                g = group_of[nm] = len(g_nodes)
+                g_nodes.append(node)
+            row_nodes.append(node)
+            g_rows.append(g)
+            req_rows.append(task.resreq)
+        sums = None
+        if g_nodes:
+            sums = np.zeros(
+                (len(g_nodes), req_rows[0].shape[0]), dtype=req_rows[0].dtype
+            )
+            np.add.at(sums, np.asarray(g_rows, np.intp), np.stack(req_rows))
+        return row_nodes, g_nodes, sums
+
     def apply_evicts_columnar(self, col):
         """:meth:`apply_evicts` over a decode ``EvictColumn`` — same
         model transitions and resync diversion, batched delta emission.
-        Returns the uids that did NOT actuate."""
+        A failure-freedom certificate (:meth:`_evict_batch_certificate`)
+        gates a batch commit whose node accounting lands as ONE
+        vectorized ``releasing`` update per touched node; any doubt
+        (injected evictor failures, duplicate uids, missing node or
+        resident clone, already-releasing rows) falls back to the
+        scalar chain wholesale.  Returns the uids that did NOT
+        actuate."""
         failed: List[str] = []
         if not len(col):
             return failed
         tasks = self._resolve_rows(col)
         emit_u: List[str] = []
         emit_n: List[str] = []
+        cert = self._evict_batch_certificate(col.uids, tasks)
+        if cert is None:
+            for k, uid in enumerate(col.uids):
+                task = tasks[k]
+                try:
+                    self.evictor.evict(uid)
+                except BindFailure as err:
+                    self._defer_resync(uid, "Evict", str(err))
+                    failed.append(uid)
+                    continue
+                if task.node_name:
+                    node = self.cluster.nodes[task.node_name]
+                    node.remove_task(task)
+                    task.status = TaskStatus.RELEASING
+                    node.add_task(task)
+                else:
+                    task.status = TaskStatus.RELEASING
+                emit_u.append(uid)
+                emit_n.append(task.node_name)
+                self.record_event("Evict", uid, "Evict")
+            self._emit_task_rows(emit_u, emit_n)
+            return failed
+        row_nodes, g_nodes, sums = cert
+        new = TaskInfo.__new__
+        releasing = TaskStatus.RELEASING
         for k, uid in enumerate(col.uids):
             task = tasks[k]
-            try:
-                self.evictor.evict(uid)
-            except BindFailure as err:
-                self._defer_resync(uid, "Evict", str(err))
-                failed.append(uid)
-                continue
-            if task.node_name:
-                node = self.cluster.nodes[task.node_name]
-                node.remove_task(task)
-                task.status = TaskStatus.RELEASING
-                node.add_task(task)
-            else:
-                task.status = TaskStatus.RELEASING
+            self.evictor.evict(uid)  # certified not to raise; still records
+            task.status = releasing
+            node = row_nodes[k]
+            if node is not None:
+                # the scalar chain pops the resident clone and re-adds a
+                # fresh clone of the (now RELEASING) task — the uid moves
+                # to the END of node.tasks; reproduce both, with the
+                # bind path's cheap clone (source already canonical)
+                node.tasks.pop(uid)
+                c = new(TaskInfo)
+                c.__dict__.update(task.__dict__)
+                c.resreq = task.resreq.copy()
+                node.tasks[uid] = c
             emit_u.append(uid)
             emit_n.append(task.node_name)
             self.record_event("Evict", uid, "Evict")
+        for g, node in enumerate(g_nodes):
+            node.releasing = node.releasing + sums[g]
         self._emit_task_rows(emit_u, emit_n)
         return failed
 

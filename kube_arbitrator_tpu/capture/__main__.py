@@ -10,24 +10,9 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-from typing import Dict
 
 from .format import CaptureError
-
-
-def _parse_queue_weights(specs) -> Dict[str, float]:
-    out: Dict[str, float] = {}
-    for spec in specs:
-        name, sep, mult = spec.partition("=")
-        if not sep or not name:
-            raise CaptureError(
-                f"bad --queue-weight {spec!r}: want <queue>=<multiplier>"
-            )
-        try:
-            out[name] = float(mult)
-        except ValueError as err:
-            raise CaptureError(f"bad --queue-weight {spec!r}: {err}") from err
-    return out
+from ..whatif.overlay import Overlay, OverlayError
 
 
 def _print_verify(report: dict, as_json: bool) -> None:
@@ -102,6 +87,21 @@ def main(argv=None) -> int:
     p.add_argument(
         "--queue-weight", action="append", default=[], metavar="QUEUE=MULT",
         help="differential overlay: multiply one queue's weight "
+        "(repeatable; shared whatif overlay schema)",
+    )
+    p.add_argument(
+        "--quota", action="append", default=[], metavar="QUEUE=WEIGHT",
+        help="differential overlay: SET one queue's weight (the quota "
+        "knob) to an absolute value (repeatable)",
+    )
+    p.add_argument(
+        "--drain", action="append", default=[], metavar="NODE",
+        help="differential overlay: mark a node unschedulable "
+        "(repeatable)",
+    )
+    p.add_argument(
+        "--admit", action="append", default=[], metavar="JOB_UID",
+        help="differential overlay: waive a job's gang floor "
         "(repeatable)",
     )
     p.add_argument(
@@ -126,10 +126,15 @@ def main(argv=None) -> int:
         if args.diff:
             from .replay import replay_differential
 
+            # the ONE overlay parser (whatif/overlay.py) — this CLI and
+            # the whatif CLIs cannot drift on what a spec means
             rc, report = replay_differential(
                 args.replay,
                 conf_overlay=args.conf,
-                queue_weights=_parse_queue_weights(args.queue_weight),
+                overlay=Overlay.parse(
+                    queue_weight=args.queue_weight, quota=args.quota,
+                    drain=args.drain, admit=args.admit,
+                ),
                 limit=args.limit,
             )
             if args.json:
@@ -150,7 +155,7 @@ def main(argv=None) -> int:
             with open(args.out, "w") as f:
                 json.dump(report, f, sort_keys=True, indent=1)
         return rc
-    except CaptureError as err:
+    except (CaptureError, OverlayError) as err:
         print(f"error: {err}", file=sys.stderr)
         return 2
     except OSError as err:

@@ -357,16 +357,28 @@ def replay_differential(
     path: str,
     conf_overlay: str = "",
     queue_weights: Optional[Dict[str, float]] = None,
+    overlay=None,
     limit: int = 0,
     max_cycle_rows: int = 50,
 ) -> Tuple[int, dict]:
     """Re-run the recorded window under an overlay (changed conf and/or
-    queue-weight multipliers) and diff it against the recorded decisions:
-    the per-queue fairness ledger side-by-side plus bind/evict edge
-    adds/removes.  Returns (exit code, report)."""
+    a whatif overlay — queue weights, quotas, drains, gang admits) and
+    diff it against the recorded decisions: the per-queue fairness
+    ledger side-by-side plus bind/evict edge adds/removes.  Returns
+    (exit code, report).
+
+    Overlay application is the SHARED schema (whatif/overlay.Overlay)
+    — the ``queue_weights`` dict form is a back-compat spelling of the
+    same thing, so this entry point cannot drift from the shadow
+    engine's."""
+    from ..whatif.overlay import Overlay, OverlayError
+
     man = load_manifest(path)
     config = _load_config(man, conf_overlay)
-    queue_weights = queue_weights or {}
+    if overlay is None:
+        overlay = Overlay(
+            queue_weights=tuple(sorted((queue_weights or {}).items()))
+        )
     session = _session(config)
     fair: Dict[str, dict] = {}
     bind_added = bind_removed = evict_added = evict_removed = 0
@@ -374,23 +386,10 @@ def replay_differential(
     cycles = 0
     samples: List[dict] = []
     for rc in iter_cycles(path, limit=limit):
-        snap = rc.snap
-        if queue_weights:
-            from ..utils.audit import _queue_names
-
-            qnames = _queue_names(snap)
-            qw = np.array(np.asarray(snap.tensors.queue_weight), copy=True)
-            for qname, mult in queue_weights.items():
-                if qname not in qnames:
-                    raise CaptureError(
-                        f"--queue-weight {qname}: no such queue in the "
-                        f"recorded window (queues: {', '.join(qnames)})"
-                    )
-                qi = qnames.index(qname)
-                qw[qi] = qw[qi] * mult
-            snap = dataclasses.replace(
-                snap, tensors=dataclasses.replace(snap.tensors, queue_weight=qw)
-            )
+        try:
+            snap = overlay.apply(rc.snap)
+        except OverlayError as err:
+            raise CaptureError(str(err)) from err
         dec, _, _ = session.decide_phase(snap, snap.tensors, None)
         cycles += 1
         # fairness ledger, base (recorded channels) vs overlay (replayed)
@@ -459,7 +458,7 @@ def replay_differential(
         "conf_fingerprint_recorded": man.get("conf_fingerprint", ""),
         "overlay": {
             "conf": os.path.basename(conf_overlay) if conf_overlay else None,
-            "queue_weights": queue_weights,
+            **overlay.to_dict(),
         },
         # mean-over-cycles dominant shares per queue, both sides + delta
         "fairness": queues,

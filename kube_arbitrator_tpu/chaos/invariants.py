@@ -276,6 +276,56 @@ class InvariantChecker:
             )
         return out
 
+    def check_shadow_isolation(
+        self, cycle: int, tenant: str, answer, live_digest: str,
+        audit_len: tuple, event_len: tuple, pack_digest: tuple,
+    ) -> List[Breach]:
+        """The what-if plane's isolation contract: a shadow cycle served
+        over ``tenant``'s frozen epoch must never actuate (``event_len``
+        — apiserver event count before/after the serve), never appear in
+        the audit stream (``audit_len`` — audit ring length pair), and
+        never mutate the live epoch (``pack_digest`` — content digest of
+        the live pack's overlay-relevant tensors before/after).  The
+        baseline leg must also be bit-identical to the live decision
+        (``answer.base_digest == live_digest``): same pack + same conf
+        through the same pool is the same launch, so ANY drift means the
+        shadow path is not actually counterfactual-only."""
+        out: List[Breach] = []
+        if getattr(answer, "outcome", "error") != "served":
+            self._breach(
+                out, "shadow_isolation", cycle,
+                f"tenant {tenant} shadow probe not served: "
+                f"{getattr(answer, 'outcome', '?')} "
+                f"({getattr(answer, 'error', '')})",
+            )
+            return out
+        if audit_len[0] != audit_len[1]:
+            self._breach(
+                out, "shadow_isolation", cycle,
+                f"tenant {tenant} shadow serve grew the audit ring "
+                f"{audit_len[0]} -> {audit_len[1]} (shadow cycles must "
+                "never appear in the audit stream)",
+            )
+        if event_len[0] != event_len[1]:
+            self._breach(
+                out, "shadow_isolation", cycle,
+                f"tenant {tenant} shadow serve actuated: apiserver event "
+                f"log grew {event_len[0]} -> {event_len[1]}",
+            )
+        if pack_digest[0] != pack_digest[1]:
+            self._breach(
+                out, "shadow_isolation", cycle,
+                f"tenant {tenant} shadow serve mutated the live epoch: "
+                f"pack digest {pack_digest[0]} -> {pack_digest[1]}",
+            )
+        if getattr(answer, "base_digest", "") != live_digest:
+            self._breach(
+                out, "shadow_isolation", cycle,
+                f"tenant {tenant} shadow baseline diverged from the live "
+                f"decision: {answer.base_digest} != {live_digest}",
+            )
+        return out
+
     def check_overcommit(self, api, cycle: int) -> List[Breach]:
         out: List[Breach] = []
         pods, _ = api.list("pods")

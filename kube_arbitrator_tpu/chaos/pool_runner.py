@@ -28,11 +28,24 @@ and held to ``fleet_ledger_consistency`` — each tenant's window row's
 served/shed counts reconcile 1:1 against the tenant world's committed
 cycle and the pool decision log.  ``--disable fleet-ledger`` drops the
 first tenant's row from every closed window; that canary MUST breach.
+
+The what-if control plane (whatif/) rides every run too: one shadow
+probe per cycle re-decides the first committed tenant's frozen epoch
+under a queue-weight overlay through the SAME shared pool, and the
+``shadow_isolation`` invariant holds the serve to the isolation
+contract — audit ring, apiserver event log, and live pack content
+untouched, baseline leg bit-identical to the live decision.
+``--disable shadow-isolation`` arms the engine's ``unsafe_inplace``
+seam (the overlay is written INTO the live pack); that canary MUST
+breach.
 """
 from __future__ import annotations
 
+import hashlib
 import os
 from typing import List, Optional, Sequence
+
+import numpy as np
 
 from ..cache.arena import ArenaDivergence, SnapshotArena
 from ..cache.live import LiveCache
@@ -89,6 +102,24 @@ class _Tenant:
             audit=self.audit,
         )
         self.checker = InvariantChecker()
+        # the last committed CycleResult — the frozen epoch the what-if
+        # shadow probe re-decides each cycle
+        self.last_result = None
+
+
+# the live-epoch content digest the shadow_isolation invariant holds
+# stable across a shadow serve: exactly the tensors an Overlay can touch
+_PROBE_FIELDS = (
+    "queue_weight", "node_unsched", "job_min_available",
+    "node_idle", "node_alloc", "node_valid",
+)
+
+
+def _pack_digest(tensors) -> str:
+    h = hashlib.blake2b(digest_size=8)
+    for name in _PROBE_FIELDS:
+        h.update(np.asarray(getattr(tensors, name)).tobytes())
+    return h.hexdigest()
 
 
 def run_pool_chaos(
@@ -136,6 +167,19 @@ def run_pool_chaos(
     for t in tenants:
         if not t.elector.acquire_blocking(timeout_s=120.0):
             raise RuntimeError(f"pool chaos: {t.id} initial acquisition failed")
+    # the what-if shadow engine rides the SAME pool as live traffic —
+    # that sharing is exactly what the shadow_isolation invariant then
+    # polices (one probe per cycle, fault-free phase-2 timing); chaos
+    # tenants all decide under the same config, so shadow packs batch
+    # with live ones
+    from ..utils.audit import _queue_names, decision_digest
+    from ..whatif.overlay import Overlay
+    from ..whatif.shadow import ShadowEngine
+
+    shadow = ShadowEngine(pool, tenants[0].sched.config, now_fn=clock.now)
+    # sensitivity canary: apply the probe overlay IN PLACE on the live
+    # pack — the shadow_isolation checker MUST breach
+    shadow.unsafe_inplace = "shadow-isolation" in disabled
     outcomes: List[str] = []
     digests: List[str] = []
     detections: List[dict] = []
@@ -173,7 +217,7 @@ def run_pool_chaos(
                         f"pool chaos: {t.id} could not re-acquire leadership"
                     )
             try:
-                t.sched.run_once()
+                t.last_result = t.sched.run_once()
             except LeaderLost:
                 fenced = True
                 outcome = "fenced"
@@ -205,6 +249,7 @@ def run_pool_chaos(
         cycle_outcomes: List[str] = []
         cycle_events: List[tuple] = []
         settled: List[tuple] = []
+        probed = False
         for t, rv0, prev_audit, fenced, outcome in zip(
             tenants, rv0s, prev_audits, fenceds, tenant_outcomes
         ):
@@ -245,6 +290,36 @@ def run_pool_chaos(
                 window, t.id, cycle, committed=(outcome == "ok"),
                 pool_entries=pool_entries,
             )
+            # the what-if invariant: one shadow probe per cycle (first
+            # committed tenant, fixed order — deterministic) over the
+            # frozen epoch the live cycle just decided; the serve must
+            # leave the audit ring, the apiserver, and the pack content
+            # untouched, and its baseline leg must reproduce the live
+            # decision bit-for-bit
+            if not probed and outcome == "ok" and t.last_result is not None:
+                probed = True
+                res = t.last_result
+                qnames = _queue_names(res.snapshot)
+                probe_ov = (
+                    Overlay(queue_weights=((qnames[0], 2.0),))
+                    if qnames else Overlay()
+                )
+                audit0 = len(t.audit._ring)
+                events0 = len(t.api.event_log)
+                pack0 = _pack_digest(res.snapshot.tensors)
+                answer = shadow.serve(
+                    t.id, res.snapshot, overlay=probe_ov,
+                    corr=f"whatif-c{cycle}",
+                )
+                breaches += t.checker.check_shadow_isolation(
+                    cycle, t.id, answer,
+                    live_digest=decision_digest(
+                        res.snapshot, res.decisions
+                    ),
+                    audit_len=(audit0, len(t.audit._ring)),
+                    event_len=(events0, len(t.api.event_log)),
+                    pack_digest=(pack0, _pack_digest(res.snapshot.tensors)),
+                )
             cycle_outcomes.append(f"{t.id}:{outcome}")
             cycle_events.extend((t.id,) + tuple(e) for e in events)
         joined = "|".join(cycle_outcomes)

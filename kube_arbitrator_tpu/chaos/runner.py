@@ -66,8 +66,13 @@ REPRO_VERSION = 1
 # soak — the seeded lock-inversion canary must then go unwitnessed and
 # the sanitizer_witness invariant MUST breach (a witness that cannot see
 # a planted inversion is blind).
+# "shadow-isolation" (pool profiles) arms the what-if engine's
+# unsafe_inplace seam — the per-cycle shadow probe then applies its
+# overlay by writing INTO the live pack's arrays, and the
+# shadow_isolation checker MUST catch the live-epoch mutation.
 DISABLE_CHOICES = (
-    "arena-verify", "audit-edges", "pool-log", "fleet-ledger", "sanitizer"
+    "arena-verify", "audit-edges", "pool-log", "fleet-ledger", "sanitizer",
+    "shadow-isolation",
 )
 
 

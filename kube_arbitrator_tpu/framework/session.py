@@ -29,7 +29,7 @@ from ..cache.decode import decode_batch, decode_batch_compact
 from ..cache.sim import BindIntent, EvictIntent
 from ..cache.snapshot import Snapshot, build_snapshot
 from ..ops.cycle import CycleDecisions
-from ..ops.diagnostics import HostView, explain_job
+from ..ops.diagnostics import HostView, _fit_messages
 
 # Cap on per-cycle FitError explanations: the first N unready gangs get the
 # full reason histogram; beyond that only the count message (bounds close
@@ -465,32 +465,32 @@ class Session:
         ready0_l = n_ready0.tolist()
         ntasks_l = n_tasks.tolist()
         cache = self.status_cache
-        # A quiet cycle moved nothing the statuses can observe: no binds,
-        # no evicts, AND the node-side state the explain messages read is
-        # byte-identical to the last cycle's (externally-driven changes —
-        # a cordon, a drain, capacity drift via the watch — change node
-        # state WITHOUT binds/evicts, and a gang's Unschedulable message
-        # embeds the per-node reason histogram).  The node digest closes
-        # that hole: one blake2b over the consulted node arrays (~O(N·R)
-        # hash, microseconds at the 50k rung).
-        quiet = cache is not None and not (
-            bool(np.asarray(dec.bind_mask).any())
-            or bool(np.asarray(dec.evict_mask).any())
-        )
-        if quiet:
+        # The node-side state the explain messages read, digested: one
+        # blake2b over the consulted node arrays (~O(N·R) hash,
+        # microseconds at the 50k rung), computed EVERY cycle.  A match
+        # means nothing the reason histograms consult moved — no binds,
+        # no evicts on any node, and no externally-driven change (a
+        # cordon, a drain, capacity drift via the watch) — so an unready
+        # gang whose count signature is also unchanged can skip even on
+        # cycles that bound or evicted elsewhere (any edge that lands on
+        # a node perturbs node_idle/num_tasks and misses the digest).
+        nodes_unchanged = False
+        if cache is not None:
             import hashlib
 
-            h = hashlib.blake2b(digest_size=16)
+            hd = hashlib.blake2b(digest_size=16)
             t = snap.tensors
             for arr in (
                 dec.node_idle, dec.node_num_tasks, dec.node_ports,
                 t.node_unsched, t.node_valid, t.node_max_tasks,
                 t.node_klass, t.class_fit,
             ):
-                h.update(np.asarray(arr).tobytes())
-            node_sig = h.hexdigest()
-            quiet = cache.get("__node_sig__") == node_sig
+                hd.update(np.asarray(arr).tobytes())
+            node_sig = hd.hexdigest()
+            nodes_unchanged = cache.get("__node_sig__") == node_sig
             cache["__node_sig__"] = node_sig
+        to_emit: List[list] = []    # [job, o, sig, min_avail, msg]
+        explain_at: List[Tuple[int, int]] = []  # (to_emit idx, ordinal)
         for job in snap.index.jobs:
             o = job.ordinal
             sig = (
@@ -498,16 +498,16 @@ class Session:
                 fail_l[o], ready0_l[o], ntasks_l[o],
             )
             if cache is not None and cache.get(job.uid) == sig and (
-                quiet or ready_l[o] or not min_l[o]
+                nodes_unchanged or ready_l[o] or not min_l[o]
             ):
                 # Unchanged: zero objects constructed.  A ready gang's
                 # status (and a min_available==0 job's) is a pure
                 # function of the signature, so it skips on ACTIVE
                 # cycles too; an unready gang's Unschedulable message
                 # embeds the per-node reason histogram, so it
-                # additionally needs the quiet node digest.
+                # additionally needs the node digest to match.
                 continue
-            unsched_cond = None
+            msg = None
             min_avail = min_l[o]
             if not ready_l[o] and min_avail > 0:
                 # gang.go:169-190: stamp Unschedulable for unready gangs,
@@ -516,12 +516,46 @@ class Session:
                 missing = min_avail - ready0_l[o]
                 msg = f"{missing}/{ntasks_l[o]} tasks in gang unschedulable"
                 if explained < MAX_EXPLAINED_JOBS:
-                    if host is None:
-                        host = HostView.build(snap, dec)
-                    why = explain_job(snap, dec, o, host=host)
                     explained += 1
+                    explain_at.append((len(to_emit), o))
+            to_emit.append([job, o, sig, min_avail, msg])
+        if explain_at:
+            # The explain pass, vectorized: ONE host pass finds every
+            # explained gang's first unplaced pending row and ONE
+            # _fit_messages call builds all their histograms — replacing
+            # the per-job explain_job chain (an O(T) scan plus a k=1
+            # histogram pass EACH) on active cycles.
+            if host is None:
+                host = HostView.build(snap, dec)
+            unplaced = (
+                host.task_valid
+                & (host.task_status0 == int(TaskStatus.PENDING))
+                & (host.task_status1 == int(TaskStatus.PENDING))
+            )
+            rows = np.nonzero(unplaced)[0]
+            first_row = np.full(n_jobs, -1, np.int64)
+            if len(rows):
+                # rows ascend; reversed assignment leaves each job's
+                # FIRST unplaced row — explain_job's idx[0] exactly
+                first_row[host.task_job[rows[::-1]]] = rows[::-1]
+            ks = [
+                (i, int(first_row[o])) for i, o in explain_at
+                if 0 <= o < n_jobs and first_row[o] >= 0
+            ]
+            if ks:
+                ridx = np.asarray([r for _, r in ks], np.int64)
+                whys = _fit_messages(
+                    host.task_resreq[ridx],
+                    host.task_klass[ridx],
+                    host.task_ports[ridx],
+                    host,
+                )
+                for (i, _), why in zip(ks, whys):
                     if why:
-                        msg = f"{msg}: {why}"
+                        to_emit[i][4] = f"{to_emit[i][4]}: {why}"
+        for job, o, sig, min_avail, msg in to_emit:
+            unsched_cond = None
+            if msg is not None:
                 unsched_cond = PodGroupCondition(
                     type=COND_UNSCHEDULABLE,
                     status=True,

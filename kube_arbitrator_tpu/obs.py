@@ -145,6 +145,7 @@ class _ObsHandler(BaseHTTPRequestHandler):
         pool = self.server.obs_pool  # type: ignore[attr-defined]
         fleet = self.server.obs_fleet  # type: ignore[attr-defined]
         capture = self.server.obs_capture  # type: ignore[attr-defined]
+        whatif = self.server.obs_whatif  # type: ignore[attr-defined]
         replica_id = self.server.obs_replica_id  # type: ignore[attr-defined]
         path, _, query = self.path.partition("?")
         path = path.rstrip("/") or "/"
@@ -161,7 +162,7 @@ class _ObsHandler(BaseHTTPRequestHandler):
                          "/debug/cycles", "/debug/trace", "/debug/audit",
                          "/debug/kernels", "/debug/timeseries", "/debug/pool",
                          "/debug/fleet", "/debug/fleet/tenants",
-                         "/debug/capture"):
+                         "/debug/capture", "/debug/whatif"):
             route = "other"
         registry.counter_add("obs_requests_total", labels={"path": route})
 
@@ -206,6 +207,16 @@ class _ObsHandler(BaseHTTPRequestHandler):
                 else fleet.status()
             )
             self._send_json(200, body)
+            return
+        if path == "/debug/whatif":
+            if whatif is None:
+                self._send_json(200, {
+                    "requests": [],
+                    "error": "no shadow engine wired (pass whatif= to "
+                             "serve_obs)",
+                })
+                return
+            self._send_json(200, whatif.status())
             return
         if path == "/debug/capture":
             if capture is None:
@@ -312,6 +323,7 @@ def serve_obs(
     pool=None,
     fleet=None,
     capture=None,
+    whatif=None,
     replica_id: str = "",
 ) -> Tuple[ThreadingHTTPServer, threading.Thread, str]:
     """Serve the observability plane; returns (server, thread, base_url).
@@ -328,8 +340,10 @@ def serve_obs(
     :class:`utils.fleet.FleetPlane` for ``/debug/fleet`` +
     ``/debug/fleet/tenants``; ``capture`` a
     :class:`capture.recorder.SessionCapture` for ``/debug/capture``;
-    ``replica_id`` stamps /healthz + /readyz in multi-replica
-    deployments."""
+    ``whatif`` a :class:`whatif.shadow.ShadowEngine` for
+    ``/debug/whatif`` (its status folds in the ledger admission's
+    decision log when one is attached); ``replica_id`` stamps /healthz +
+    /readyz in multi-replica deployments."""
     server = ThreadingHTTPServer((host, port), _ObsHandler)
     server.obs_registry = registry if registry is not None else metrics()  # type: ignore[attr-defined]
     server.obs_flight = flight  # type: ignore[attr-defined]
@@ -341,6 +355,7 @@ def serve_obs(
     server.obs_pool = pool  # type: ignore[attr-defined]
     server.obs_fleet = fleet  # type: ignore[attr-defined]
     server.obs_capture = capture  # type: ignore[attr-defined]
+    server.obs_whatif = whatif  # type: ignore[attr-defined]
     server.obs_replica_id = replica_id  # type: ignore[attr-defined]
     if locking.sanitize_enabled():
         # the obs_* wiring is written once, here, before the serve thread
@@ -352,7 +367,7 @@ def serve_obs(
                 "obs_registry", "obs_flight", "obs_tracer",
                 "obs_status_fn", "obs_profiler", "obs_timeseries",
                 "obs_audit", "obs_pool", "obs_fleet", "obs_capture",
-                "obs_replica_id",
+                "obs_whatif", "obs_replica_id",
             ),
             name="ObsServer",
         )

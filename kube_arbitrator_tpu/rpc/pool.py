@@ -641,12 +641,17 @@ class DecisionPool:
         )
         if self.admission is not None and self.admission.should_shed(tenant):
             burn = self.admission.burn(tenant)
+            # an admission policy that distinguishes WHY (the ledger-
+            # driven deferral, whatif/admission.py) reports it through
+            # the optional shed_reason hook; the plain burn shedder has
+            # only one reason
+            reason_fn = getattr(self.admission, "shed_reason", None)
             entry = {
                 "tenant": tenant,
                 "seq": seq,
                 "cycle": self.cycle,
                 "corr": req.corr,
-                "reason": "slo_burn",
+                "reason": reason_fn(tenant) if callable(reason_fn) else "slo_burn",
                 "burn": None if burn is None else round(burn, 3),
             }
             with self._lock:

@@ -1,0 +1,28 @@
+"""The what-if control plane: counterfactual scheduling as a product.
+
+Three products on one engine (ROADMAP item 2):
+
+* :mod:`.shadow` — shadow-cycle serving: re-decide a frozen arena epoch
+  under a structured overlay through the live decision pool, batched
+  with live traffic.
+* :mod:`.admission` — ledger-driven admission: defer/reject work that
+  would push another tenant past its starvation SLO, with hysteresis.
+* :mod:`.plan` — capacity-planning replay: recorded windows against
+  hypothetical fleets (``python -m kube_arbitrator_tpu.whatif --plan``).
+
+:mod:`.overlay` is the ONE overlay schema all of them (and capture's
+differential replay) share.
+"""
+from .overlay import Overlay, OverlayError
+from .shadow import ShadowAnswer, ShadowClient, ShadowEngine, SHADOW_PREFIX
+from .admission import LedgerAdmission
+
+__all__ = [
+    "Overlay",
+    "OverlayError",
+    "ShadowAnswer",
+    "ShadowClient",
+    "ShadowEngine",
+    "SHADOW_PREFIX",
+    "LedgerAdmission",
+]

@@ -1,0 +1,76 @@
+"""``python -m kube_arbitrator_tpu.whatif`` — capacity-planning replay.
+
+Exit codes (the capture CLI's convention): 0 = plan report emitted,
+2 = usage / capture-format / overlay error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .overlay import OverlayError
+from .plan import DEFAULT_RUNGS, format_plan, plan_replay
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m kube_arbitrator_tpu.whatif",
+        description="replay a recorded capture against hypothetical "
+        "fleets and report per-rung fairness, starvation, and pending "
+        "depth",
+    )
+    p.add_argument(
+        "--plan", required=True, metavar="DIR",
+        help="capture directory (manifest.json + chunk files)",
+    )
+    p.add_argument(
+        "--rung", action="append", default=[], metavar="SPEC",
+        help="one hypothetical fleet: comma-separated node_scale=<k>, "
+        "flavor_scale=<k>, w:<queue>=<mult>, quota:<queue>=<weight>, "
+        "drain:<node>, admit:<job>; 'baseline' is the identity rung "
+        f"(default ladder: {', '.join(DEFAULT_RUNGS)})",
+    )
+    p.add_argument(
+        "--conf", default="", metavar="YAML",
+        help="conf overlay file (default: the recorded conf)",
+    )
+    p.add_argument(
+        "--limit", type=int, default=0,
+        help="replay at most N recorded cycles per rung (0 = all)",
+    )
+    p.add_argument("--out", default="", help="write the JSON report here")
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable stdout"
+    )
+    args = p.parse_args(argv)
+    from .plan import BASELINE
+
+    rungs = list(args.rung) or list(DEFAULT_RUNGS)
+    if BASELINE not in [r.strip() or BASELINE for r in rungs]:
+        # the baseline rung anchors every vs_baseline delta
+        rungs.insert(0, BASELINE)
+    try:
+        from ..capture.format import CaptureError
+        from ..platform import enable_persistent_cache, ensure_jax_backend
+
+        ensure_jax_backend()
+        enable_persistent_cache()
+        rc, report = plan_replay(
+            args.plan, rungs=rungs, conf_overlay=args.conf, limit=args.limit
+        )
+        if args.json:
+            print(json.dumps(report, sort_keys=True))
+        else:
+            print(format_plan(report))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, sort_keys=True, indent=1)
+        return rc
+    except (CaptureError, OverlayError, OSError) as err:
+        print(f"error: {err}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,219 @@
+"""Ledger-driven admission: deserved-share-aware pool load control.
+
+:class:`~kube_arbitrator_tpu.rpc.pool.TenantAdmission` sheds a tenant
+when ITS OWN latency burn proves serving it is pointless.  This module
+extends that policy with the fleet ledger's cross-tenant view (PR 15,
+utils/fleet.py): a tenant that is realizing MORE than its water-filled
+entitlement while another tenant's starvation clock has blown past the
+starvation SLO is deferred — the dynamic fractional-share argument
+(arxiv 1106.4985): admission should reason about deserved shares, not
+just raw burn, because the over-served tenant's next cycle is exactly
+the capacity the starving tenant is owed.
+
+Mechanics:
+
+* decisions are made once per closed fleet window (the ledger's own
+  cadence) and cached, so per-request ``should_shed`` calls are cheap
+  and stable within a window;
+* hysteresis: deferral starts only past ``enter_delta`` over-use, ends
+  only under ``exit_delta`` (or when nobody starves), and holds for at
+  least ``min_hold`` windows — a tenant bouncing on the threshold is
+  not flapped;
+* severity: when the worst starvation clock exceeds ``reject_factor``
+  times the SLO the action escalates from ``defer`` to ``reject`` —
+  same shed mechanically, but logged and counted separately so
+  operators can alert on rejects alone;
+* every transition and every holding window lands in a bounded decision
+  log (served at ``/debug/whatif``) and in
+  ``whatif_admission_total{action}``.
+
+The pool consumes this through the exact ``TenantAdmission`` interface
+(``observe`` / ``burn`` / ``should_shed``) plus the optional
+``shed_reason`` hook, so wiring it in is constructor substitution, not
+a pool change.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..rpc.pool import TenantAdmission
+from ..utils import locking
+from ..utils.metrics import MetricsRegistry, metrics
+from .shadow import is_shadow_tenant
+
+LOG_CAPACITY = 256
+
+
+class LedgerAdmission(TenantAdmission):
+    """SLO-burn shedding + fleet-ledger deferral with hysteresis."""
+
+    def __init__(
+        self,
+        slo_ms: float,
+        fleet=None,
+        starvation_slo_s: float = 60.0,
+        enter_delta: float = 0.10,
+        exit_delta: float = 0.02,
+        min_hold: int = 2,
+        reject_factor: float = 2.0,
+        registry: Optional[MetricsRegistry] = None,
+        **kw,
+    ):
+        super().__init__(slo_ms, **kw)
+        self.fleet = fleet
+        self.starvation_slo_s = float(starvation_slo_s)
+        self.enter_delta = float(enter_delta)
+        self.exit_delta = float(exit_delta)
+        self.min_hold = max(int(min_hold), 1)
+        self.reject_factor = float(reject_factor)
+        self.registry = registry
+        # ledger-decision state; the base class lock guards ITS rings,
+        # this one guards ours (never held across a fleet call)
+        self._led_lock = locking.Lock("whatif.admission.lock")
+        self._window_seq = -1
+        # tenant -> cached window verdict ("admit"|"defer"|"reject")
+        self._verdicts: Dict[str, str] = {}
+        # tenant -> consecutive windows the deferral has held
+        self._held: Dict[str, int] = {}
+        self._reasons: Dict[str, str] = {}
+        self.decision_log: List[dict] = []
+
+    # ---- metrics / log ----
+
+    def _metrics(self) -> MetricsRegistry:
+        return self.registry if self.registry is not None else metrics()
+
+    def _record(self, entry: dict) -> None:
+        self._metrics().counter_add(
+            "whatif_admission_total", labels={"action": entry["action"]}
+        )
+        with self._led_lock:
+            self.decision_log.append(entry)
+            del self.decision_log[:-LOG_CAPACITY]
+
+    # ---- the pool-facing interface ----
+
+    def shed_reason(self, tenant: str) -> str:
+        """The pool's shed-log ``reason`` for the last shed verdict."""
+        with self._led_lock:
+            return self._reasons.get(tenant, "slo_burn")
+
+    def should_shed(self, tenant: str) -> bool:
+        if super().should_shed(tenant):
+            with self._led_lock:
+                self._reasons[tenant] = "slo_burn"
+            return True
+        if self.fleet is None or is_shadow_tenant(tenant):
+            # shadow legs are read-only load; deferring them starves
+            # the what-if plane without freeing any entitlement
+            return False
+        verdict = self._ledger_verdict(tenant)
+        if verdict == "admit":
+            return False
+        with self._led_lock:
+            self._reasons[tenant] = f"ledger_{verdict}"
+        return True
+
+    # ---- the per-window ledger policy ----
+
+    def _ledger_verdict(self, tenant: str) -> str:
+        window = self.fleet.last_window()
+        if window is None:
+            return "admit"
+        with self._led_lock:
+            if window.seq == self._window_seq and tenant in self._verdicts:
+                return self._verdicts[tenant]
+            if window.seq != self._window_seq:
+                # a new ledger window: every tenant re-evaluates against
+                # it (held counts survive — they are the hysteresis)
+                self._window_seq = window.seq
+                self._verdicts.clear()
+        verdict = self._evaluate(tenant, window)
+        with self._led_lock:
+            self._verdicts[tenant] = verdict
+        return verdict
+
+    def _evaluate(self, tenant: str, window) -> str:
+        rows = [r for r in window.tenants if not is_shadow_tenant(r["tenant"])]
+        mine = next((r for r in rows if r["tenant"] == tenant), None)
+        if mine is None:
+            return "admit"
+        starving = [
+            r for r in rows
+            if r["tenant"] != tenant
+            and r.get("delta", 0.0) < 0
+            and r.get("starvation_s", 0.0) > self.starvation_slo_s
+        ]
+        over = float(mine.get("delta", 0.0))
+        with self._led_lock:
+            held = self._held.get(tenant, 0)
+        deferring = held > 0
+        worst = max((r["starvation_s"] for r in starving), default=0.0)
+        if not deferring:
+            if starving and over > self.enter_delta:
+                action = (
+                    "reject"
+                    if worst > self.reject_factor * self.starvation_slo_s
+                    else "defer"
+                )
+                with self._led_lock:
+                    self._held[tenant] = 1
+                self._record(self._entry(tenant, window, action, over, starving, 1))
+                return action
+            return "admit"
+        # holding: exit only once the pressure is gone AND the hold
+        # matured — the hysteresis half
+        if held >= self.min_hold and (not starving or over < self.exit_delta):
+            with self._led_lock:
+                self._held.pop(tenant, None)
+            self._record(self._entry(tenant, window, "resume", over, starving, held))
+            return "admit"
+        held += 1
+        with self._led_lock:
+            self._held[tenant] = held
+        action = (
+            "reject"
+            if worst > self.reject_factor * self.starvation_slo_s
+            else "defer"
+        )
+        self._record(self._entry(tenant, window, action, over, starving, held))
+        return action
+
+    def _entry(
+        self, tenant: str, window, action: str, over: float,
+        starving: List[dict], held: int,
+    ) -> dict:
+        return {
+            "ts": round(self.now(), 3),
+            "window": window.seq,
+            "tenant": tenant,
+            "action": action,
+            "reason": (
+                "over-entitlement while tenants starve"
+                if action in ("defer", "reject")
+                else "pressure cleared"
+            ),
+            "delta": round(over, 6),
+            "starving": [
+                {
+                    "tenant": r["tenant"],
+                    "starvation_s": r.get("starvation_s", 0.0),
+                    "delta": r.get("delta", 0.0),
+                }
+                for r in starving[:8]
+            ],
+            "held_windows": held,
+        }
+
+    # ---- the /debug/whatif document ----
+
+    def status(self) -> dict:
+        with self._led_lock:
+            return {
+                "starvation_slo_s": self.starvation_slo_s,
+                "enter_delta": self.enter_delta,
+                "exit_delta": self.exit_delta,
+                "min_hold": self.min_hold,
+                "deferring": dict(self._held),
+                "decisions_tail": list(self.decision_log[-32:]),
+            }

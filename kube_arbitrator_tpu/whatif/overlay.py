@@ -1,0 +1,388 @@
+"""The ONE overlay schema for counterfactual scheduling.
+
+Every entry point that re-decides a pack under a hypothetical — the
+capture plane's differential replay (``--diff --queue-weight``), the
+shadow-cycle engine (whatif/shadow.py), and the capacity-planning
+replay (whatif/plan.py ``--plan --rung``) — parses and applies its
+overlay through this module.  One parser, one validator, one
+application function: the drift test (tests/test_whatif.py) pins both
+CLIs to it, so "what the simulation simulated" can never quietly mean
+two different things in two tools.
+
+Overlay kinds (all composable in one overlay):
+
+* ``queue_weights`` — multiply a queue's proportion weight by ``k``
+  ("what if this queue's weight doubled").
+* ``resize_quota`` — SET a queue's weight to an absolute value.  The
+  weight is this system's quota knob (the proportion plugin water-fills
+  deserved shares by weight), so resizing a quota IS rewriting the
+  weight rather than scaling it.
+* ``drain_nodes`` — mark named nodes unschedulable (``node_unsched``),
+  exactly what a kubectl drain does to the allocate kernel's view.
+* ``admit_jobs`` — waive named jobs' gang floors
+  (``job_min_available`` -> 0): "what if this job were admitted".
+* ``node_scale`` / ``flavor_scale`` — hypothetical-fleet transforms for
+  capacity planning: scale the node COUNT (mask a fraction off, or tile
+  fresh empty clones of the valid nodes) or every node's capacity
+  vector (idle grows by ``alloc*(k-1)`` so current usage is preserved).
+
+Application is pure: ``apply`` returns a NEW Snapshot built from
+``dataclasses.replace`` — the input pack is never written, which is the
+first half of the shadow plane's isolation contract.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class OverlayError(ValueError):
+    """A malformed or inapplicable overlay (unknown queue/node/job,
+    unparsable spec).  CLIs map it to exit code 2, the shadow engine to
+    a ``rejected`` outcome — never a crash mid-serve."""
+
+
+# the spec grammar shared by every CLI flag that builds an overlay:
+#   queue_weights / resize_quota:  <queue>=<float>
+#   drain_nodes / admit_jobs:      <name>[,<name>...]
+#   node_scale / flavor_scale:     <float>
+_KIND_HELP = (
+    "queue-weight <queue>=<mult>, quota <queue>=<weight>, "
+    "drain <node>, admit <job-uid>, node_scale=<k>, flavor_scale=<k>"
+)
+
+
+def _parse_pairs(specs: Sequence[str], flag: str) -> Tuple[Tuple[str, float], ...]:
+    out: List[Tuple[str, float]] = []
+    seen = set()
+    for spec in specs:
+        name, sep, val = spec.partition("=")
+        if not sep or not name:
+            raise OverlayError(f"bad {flag} {spec!r}: want <name>=<number>")
+        try:
+            f = float(val)
+        except ValueError as err:
+            raise OverlayError(f"bad {flag} {spec!r}: {err}") from err
+        if not np.isfinite(f) or f < 0:
+            raise OverlayError(f"bad {flag} {spec!r}: want a finite value >= 0")
+        if name in seen:
+            raise OverlayError(f"duplicate {flag} for {name!r}")
+        seen.add(name)
+        out.append((name, f))
+    return tuple(out)
+
+
+def _parse_names(specs: Sequence[str], flag: str) -> Tuple[str, ...]:
+    out: List[str] = []
+    for spec in specs:
+        for name in spec.split(","):
+            name = name.strip()
+            if not name:
+                raise OverlayError(f"bad {flag} {spec!r}: empty name")
+            if name not in out:
+                out.append(name)
+    return tuple(out)
+
+
+@dataclasses.dataclass(frozen=True)
+class Overlay:
+    """One validated counterfactual, hashable and JSON-ready."""
+
+    queue_weights: Tuple[Tuple[str, float], ...] = ()
+    resize_quota: Tuple[Tuple[str, float], ...] = ()
+    drain_nodes: Tuple[str, ...] = ()
+    admit_jobs: Tuple[str, ...] = ()
+    node_scale: float = 1.0
+    flavor_scale: float = 1.0
+
+    # -- construction ----------------------------------------------------
+    @classmethod
+    def parse(
+        cls,
+        queue_weight: Sequence[str] = (),
+        quota: Sequence[str] = (),
+        drain: Sequence[str] = (),
+        admit: Sequence[str] = (),
+        node_scale: float = 1.0,
+        flavor_scale: float = 1.0,
+    ) -> "Overlay":
+        """The ONE CLI-spec parser; see ``_KIND_HELP`` for the grammar."""
+        for flag, v in (("node_scale", node_scale), ("flavor_scale", flavor_scale)):
+            try:
+                v = float(v)
+            except (TypeError, ValueError) as err:
+                raise OverlayError(f"bad {flag} {v!r}: {err}") from err
+            if not np.isfinite(v) or v <= 0:
+                raise OverlayError(f"bad {flag} {v!r}: want a finite value > 0")
+        return cls(
+            queue_weights=_parse_pairs(queue_weight, "--queue-weight"),
+            resize_quota=_parse_pairs(quota, "--quota"),
+            drain_nodes=_parse_names(drain, "--drain"),
+            admit_jobs=_parse_names(admit, "--admit"),
+            node_scale=float(node_scale),
+            flavor_scale=float(flavor_scale),
+        )
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Overlay":
+        """Build from a request body / rung spec dict (the RPC shape)."""
+        if not isinstance(d, dict):
+            raise OverlayError(f"overlay must be an object, got {type(d).__name__}")
+        unknown = set(d) - {f.name for f in dataclasses.fields(cls)}
+        if unknown:
+            raise OverlayError(f"unknown overlay keys {sorted(unknown)}; want {_KIND_HELP}")
+        qw = d.get("queue_weights", {})
+        rq = d.get("resize_quota", {})
+        if isinstance(qw, dict):
+            qw = [f"{k}={v}" for k, v in qw.items()]
+        if isinstance(rq, dict):
+            rq = [f"{k}={v}" for k, v in rq.items()]
+        return cls.parse(
+            queue_weight=list(qw),
+            quota=list(rq),
+            drain=list(d.get("drain_nodes", ())),
+            admit=list(d.get("admit_jobs", ())),
+            node_scale=d.get("node_scale", 1.0),
+            flavor_scale=d.get("flavor_scale", 1.0),
+        )
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def empty(self) -> bool:
+        return (
+            not self.queue_weights and not self.resize_quota
+            and not self.drain_nodes and not self.admit_jobs
+            and self.node_scale == 1.0 and self.flavor_scale == 1.0
+        )
+
+    @property
+    def kind(self) -> str:
+        """The metrics label: the single active kind, else ``mixed``."""
+        kinds = [
+            name
+            for name, active in (
+                ("queue_weight", bool(self.queue_weights)),
+                ("resize_quota", bool(self.resize_quota)),
+                ("drain_nodes", bool(self.drain_nodes)),
+                ("admit_jobs", bool(self.admit_jobs)),
+                ("fleet", self.node_scale != 1.0 or self.flavor_scale != 1.0),
+            )
+            if active
+        ]
+        if not kinds:
+            return "empty"
+        return kinds[0] if len(kinds) == 1 else "mixed"
+
+    def to_dict(self) -> dict:
+        return {
+            "queue_weights": dict(self.queue_weights),
+            "resize_quota": dict(self.resize_quota),
+            "drain_nodes": list(self.drain_nodes),
+            "admit_jobs": list(self.admit_jobs),
+            "node_scale": self.node_scale,
+            "flavor_scale": self.flavor_scale,
+        }
+
+    def describe(self) -> str:
+        if self.empty:
+            return "empty"
+        parts = []
+        for q, k in self.queue_weights:
+            parts.append(f"w({q})x{k:g}")
+        for q, k in self.resize_quota:
+            parts.append(f"quota({q})={k:g}")
+        if self.drain_nodes:
+            parts.append(f"drain[{len(self.drain_nodes)}]")
+        if self.admit_jobs:
+            parts.append(f"admit[{len(self.admit_jobs)}]")
+        if self.node_scale != 1.0:
+            parts.append(f"nodes x{self.node_scale:g}")
+        if self.flavor_scale != 1.0:
+            parts.append(f"flavor x{self.flavor_scale:g}")
+        return ", ".join(parts)
+
+    # -- resolution against a pack --------------------------------------
+    def _queue_ordinals(self, snap) -> Dict[str, int]:
+        from ..utils.audit import _queue_names
+
+        return {name: i for i, name in enumerate(_queue_names(snap))}
+
+    def validate_against(self, snap) -> None:
+        """Every named entity must exist in the pack; raises
+        :class:`OverlayError` naming the missing one (and what DOES
+        exist, bounded) otherwise."""
+        if self.queue_weights or self.resize_quota:
+            qnames = self._queue_ordinals(snap)
+            for q, _ in (*self.queue_weights, *self.resize_quota):
+                if q not in qnames:
+                    raise OverlayError(
+                        f"overlay queue {q!r}: no such queue in the pack "
+                        f"(queues: {', '.join(sorted(qnames)[:8])})"
+                    )
+        if self.drain_nodes:
+            nodes = getattr(snap.index, "nodes", None)
+            if nodes is None:
+                have = {
+                    snap.index.node_name(n)
+                    for n in range(int(np.asarray(snap.tensors.node_valid).shape[0]))
+                }
+            else:
+                have = {n.name for n in nodes}
+            for name in self.drain_nodes:
+                if name not in have:
+                    raise OverlayError(f"overlay drain node {name!r}: no such node in the pack")
+        if self.admit_jobs:
+            jobs = getattr(snap.index, "jobs", None)
+            if jobs is None:
+                raise OverlayError(
+                    "overlay admit_jobs needs job tables; this pack was "
+                    "recorded without them (ordinal-flavor capture)"
+                )
+            have_jobs = {j.uid for j in jobs}
+            for uid in self.admit_jobs:
+                if uid not in have_jobs:
+                    raise OverlayError(f"overlay admit job {uid!r}: no such job in the pack")
+
+    def apply(self, snap):
+        """Return a NEW Snapshot with the overlay applied (validates
+        first).  The input snapshot and its tensors are never written —
+        every changed field is a fresh array on a ``dataclasses.replace``
+        copy."""
+        self.validate_against(snap)
+        if self.empty:
+            return snap
+        t = snap.tensors
+        patch: Dict[str, np.ndarray] = {}
+        index = snap.index
+        if self.queue_weights or self.resize_quota:
+            qord = self._queue_ordinals(snap)
+            qw = np.array(np.asarray(t.queue_weight), copy=True)
+            for q, mult in self.queue_weights:
+                qw[qord[q]] = qw[qord[q]] * mult
+            for q, val in self.resize_quota:
+                qw[qord[q]] = np.float32(val)
+            patch["queue_weight"] = qw
+        if self.drain_nodes:
+            nodes = getattr(index, "nodes", None)
+            if nodes is not None:
+                name_of = {n.name: i for i, n in enumerate(nodes)}
+            else:
+                name_of = {
+                    index.node_name(n): n
+                    for n in range(int(np.asarray(t.node_valid).shape[0]))
+                }
+            unsched = np.array(np.asarray(t.node_unsched), copy=True)
+            for name in self.drain_nodes:
+                unsched[name_of[name]] = True
+            patch["node_unsched"] = unsched
+        if self.admit_jobs:
+            by_uid = {j.uid: i for i, j in enumerate(index.jobs)}
+            mins = np.array(np.asarray(t.job_min_available), copy=True)
+            for uid in self.admit_jobs:
+                mins[by_uid[uid]] = 0
+            patch["job_min_available"] = mins
+        tens = dataclasses.replace(t, **patch) if patch else t
+        if self.flavor_scale != 1.0:
+            tens = _scale_flavor(tens, self.flavor_scale)
+        if self.node_scale != 1.0:
+            tens, index = _scale_nodes(tens, index, self.node_scale)
+        return dataclasses.replace(snap, tensors=tens, index=index)
+
+
+def _scale_flavor(t, k: float):
+    """Every node's capacity vector scaled by ``k`` with current usage
+    preserved: ``alloc' = alloc*k``, ``idle' = idle + alloc*(k-1)``
+    (clamped at zero for shrinks past current usage)."""
+    alloc = np.asarray(t.node_alloc).astype(np.float32)
+    grow = (alloc * np.float32(k - 1.0)).astype(np.float32)
+    idle = np.maximum(
+        np.asarray(t.node_idle).astype(np.float32) + grow, np.float32(0)
+    ).astype(np.float32)
+    return dataclasses.replace(
+        t,
+        node_alloc=(alloc * np.float32(k)).astype(np.float32),
+        node_idle=idle,
+    )
+
+
+# the [N]-axis fields a node-count rescale must transform together; the
+# KAT-CTR schema (analysis/contracts.SNAPSHOT_SCHEMA) is the ground
+# truth for which fields ride the N axis
+_NODE_AXIS_FIELDS = (
+    "node_idle", "node_releasing", "node_alloc", "node_max_tasks",
+    "node_num_tasks", "node_klass", "node_ports", "node_unsched",
+    "node_valid",
+)
+
+
+def _scale_nodes(t, index, k: float):
+    """Hypothetical node count: ``k < 1`` masks the top fraction of valid
+    nodes off (no reshape); ``k > 1`` tiles EMPTY clones of the valid
+    nodes onto the end of every [N]-axis tensor (clones start idle:
+    ``idle = alloc``, no tasks, no ports; topology domains and static
+    anti-affinity are cleared on clones — a hypothetical node has no
+    recorded pods).  Decisions over scaled packs are a capacity model,
+    not a bit-identity surface."""
+    valid = np.asarray(t.node_valid)
+    vidx = np.nonzero(valid)[0]
+    n_valid = int(vidx.size)
+    target = max(int(round(n_valid * k)), 1)
+    if target == n_valid:
+        return t, index
+    if target < n_valid:
+        drop = vidx[target:]
+        nv = np.array(valid, copy=True)
+        nv[drop] = False
+        unsched = np.array(np.asarray(t.node_unsched), copy=True)
+        unsched[drop] = True
+        return dataclasses.replace(t, node_valid=nv, node_unsched=unsched), index
+    extra = target - n_valid
+    src = vidx[np.arange(extra) % n_valid]  # clone round-robin over valid nodes
+    patch: Dict[str, np.ndarray] = {}
+    for name in _NODE_AXIS_FIELDS:
+        a = np.asarray(getattr(t, name))
+        patch[name] = np.concatenate([a, a[src]], axis=0)
+    patch["node_num_tasks"] = np.concatenate(
+        [np.asarray(t.node_num_tasks), np.zeros(extra, np.int32)]
+    )
+    patch["node_ports"] = np.concatenate(
+        [np.asarray(t.node_ports),
+         np.zeros((extra,) + np.asarray(t.node_ports).shape[1:], np.int32)]
+    )
+    patch["node_idle"] = np.concatenate(
+        [np.asarray(t.node_idle), np.asarray(t.node_alloc)[src].astype(np.float32)]
+    )
+    patch["node_releasing"] = np.concatenate(
+        [np.asarray(t.node_releasing),
+         np.zeros((extra,) + np.asarray(t.node_releasing).shape[1:], np.float32)]
+    )
+    nd = np.asarray(t.node_dom)
+    patch["node_dom"] = np.concatenate(
+        [nd, np.full((nd.shape[0], extra), -1, np.int32)], axis=1
+    ) if nd.size else nd
+    so = np.asarray(t.symm_ok)
+    patch["symm_ok"] = np.concatenate(
+        [so, np.ones((so.shape[0], extra), bool)], axis=1
+    ) if so.size else so
+    tens = dataclasses.replace(t, **patch)
+    new_index = index
+    nodes = getattr(index, "nodes", None)
+    if nodes is not None:
+        clones = [
+            dataclasses.replace(nodes[i], name=f"{nodes[i].name}+whatif{j}")
+            if dataclasses.is_dataclass(nodes[i])
+            else type(nodes[i])(
+                **{**nodes[i].__dict__, "name": f"{nodes[i].name}+whatif{j}"}
+            )
+            for j, i in enumerate(src)
+        ]
+        new_index = dataclasses.replace(index, nodes=list(nodes) + clones)
+    return tens, new_index
+
+
+def parse_queue_weight_specs(specs: Sequence[str]) -> Dict[str, float]:
+    """Back-compat shim for callers that want the bare dict (capture's
+    differential replay signature) — still the ONE parser underneath."""
+    return dict(_parse_pairs(specs, "--queue-weight"))

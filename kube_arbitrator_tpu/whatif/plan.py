@@ -1,0 +1,232 @@
+"""Capacity-planning replay: recorded windows against hypothetical fleets.
+
+Gavel's policy-simulation methodology (arxiv 2008.09213) applied to the
+capture plane: replay a recorded DeltaJournal window cycle-by-cycle,
+but under a ladder of fleet overlays — node-count scales, flavor
+(capacity) scales, queue-weight/quota rewrites, drains, gang admits —
+and report, per rung, what the fleet ledger's headline quantities would
+have been: per-queue fairness shares, starvation streaks, pending
+depth, and bind/evict volume.  This is how an operator answers "how
+many nodes do we actually need" or "which policy weights clear the
+backlog" from a recording instead of a production experiment.
+
+Every rung's overlay is the SHARED schema (whatif/overlay.Overlay);
+the rung-spec grammar here is only flag sugar that delegates value
+parsing and validation to it.  Replay mechanics (pack reconstruction,
+the real decide phases, exit codes) are the capture plane's.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .overlay import Overlay, OverlayError
+
+# baseline first: every other rung's deltas are read against it
+BASELINE = "baseline"
+DEFAULT_RUNGS = (BASELINE, "node_scale=0.5", "node_scale=2.0")
+
+
+def parse_rung(spec: str) -> Tuple[str, Overlay]:
+    """``--rung`` sugar -> (label, Overlay).  Grammar: a comma-separated
+    list of ``node_scale=<k>``, ``flavor_scale=<k>``, ``w:<queue>=<mult>``,
+    ``quota:<queue>=<weight>``, ``drain:<node>``, ``admit:<job>``; the
+    bare word ``baseline`` (or an empty spec) is the identity rung.
+    Value parsing and validation live in :meth:`Overlay.parse` — this
+    function only splits the spec."""
+    label = spec.strip() or BASELINE
+    if label == BASELINE:
+        return label, Overlay()
+    qw: List[str] = []
+    quota: List[str] = []
+    drain: List[str] = []
+    admit: List[str] = []
+    node_scale = 1.0
+    flavor_scale = 1.0
+    for part in label.split(","):
+        part = part.strip()
+        if part.startswith("w:"):
+            qw.append(part[2:])
+        elif part.startswith("quota:"):
+            quota.append(part[len("quota:"):])
+        elif part.startswith("drain:"):
+            drain.append(part[len("drain:"):])
+        elif part.startswith("admit:"):
+            admit.append(part[len("admit:"):])
+        elif part.startswith("node_scale="):
+            node_scale = part.partition("=")[2]
+        elif part.startswith("flavor_scale="):
+            flavor_scale = part.partition("=")[2]
+        else:
+            raise OverlayError(
+                f"bad --rung component {part!r}: want node_scale=, "
+                "flavor_scale=, w:<queue>=<mult>, quota:<queue>=<w>, "
+                "drain:<node>, or admit:<job>"
+            )
+    return label, Overlay.parse(
+        queue_weight=qw, quota=quota, drain=drain, admit=admit,
+        node_scale=node_scale, flavor_scale=flavor_scale,
+    )
+
+
+class _QueueStats:
+    """Per-queue aggregation across one rung's replay."""
+
+    __slots__ = (
+        "share_deserved", "share_allocated", "pending_sum", "pending_max",
+        "starve_run", "starve_max", "starve_s_run", "starve_s_max",
+    )
+
+    def __init__(self):
+        self.share_deserved = 0.0
+        self.share_allocated = 0.0
+        self.pending_sum = 0
+        self.pending_max = 0
+        self.starve_run = 0          # consecutive starved cycles, running
+        self.starve_max = 0
+        self.starve_s_run = 0.0      # recorded-wall-clock span of the run
+        self.starve_s_max = 0.0
+
+
+def _bind_queues(snap, dec) -> np.ndarray:
+    """Per-queue bind counts this cycle — the progress signal the
+    starvation streak resets on."""
+    t = snap.tensors
+    mask = np.asarray(dec.bind_mask)
+    if not mask.any():
+        return np.zeros(int(np.asarray(t.queue_valid).shape[0]), np.int64)
+    tq = np.asarray(t.job_queue)[np.asarray(t.task_job)[np.nonzero(mask)[0]]]
+    return np.bincount(tq, minlength=int(np.asarray(t.queue_valid).shape[0]))
+
+
+def plan_replay(
+    path: str,
+    rungs: Optional[List[str]] = None,
+    conf_overlay: str = "",
+    limit: int = 0,
+) -> Tuple[int, dict]:
+    """Replay ``path``'s recorded window once per rung; returns
+    (exit code, report).  0 = report emitted; :class:`CaptureError` /
+    :class:`OverlayError` escape for the CLI's exit-2 convention."""
+    from ..capture.replay import _load_config, _session, iter_cycles
+    from ..capture.format import load_manifest
+    from ..utils.audit import _queue_names, fairness_ledger
+
+    man = load_manifest(path)
+    config = _load_config(man, conf_overlay)
+    session = _session(config)
+    specs = list(rungs) if rungs else list(DEFAULT_RUNGS)
+    parsed = [parse_rung(s) for s in specs]
+    out_rungs: List[dict] = []
+    cycles = 0
+    for label, ov in parsed:
+        queues: Dict[str, _QueueStats] = {}
+        binds_total = 0
+        evicts_total = 0
+        pending_depth_sum = 0
+        pending_depth_max = 0
+        cycles = 0
+        prev_ts: Optional[float] = None
+        for rc in iter_cycles(path, limit=limit):
+            snap = ov.apply(rc.snap)  # validates; pure
+            dec, _, _ = session.decide_phase(snap, snap.tensors, None)
+            cycles += 1
+            dt = 0.0 if prev_ts is None else max(rc.ts - prev_ts, 0.0)
+            prev_ts = rc.ts
+            rows = fairness_ledger(snap, dec)
+            qord = {n: i for i, n in enumerate(_queue_names(snap))}
+            qbinds = _bind_queues(snap, dec)
+            binds = int(np.asarray(dec.bind_mask).sum())
+            evicts = int(np.asarray(dec.evict_mask).sum())
+            binds_total += binds
+            evicts_total += evicts
+            depth = sum(r["pending"] for r in rows)
+            pending_depth_sum += depth
+            pending_depth_max = max(pending_depth_max, depth)
+            for r in rows:
+                st = queues.setdefault(r["queue"], _QueueStats())
+                st.share_deserved += r["share_deserved"]
+                st.share_allocated += r["share_allocated"]
+                st.pending_sum += r["pending"]
+                st.pending_max = max(st.pending_max, r["pending"])
+                qi = qord.get(r["queue"], -1)
+                progressed = 0 <= qi < len(qbinds) and qbinds[qi] > 0
+                starving = (
+                    r["pending"] > 0 and r["delta"] < 0 and not progressed
+                )
+                if starving:
+                    st.starve_run += 1
+                    st.starve_s_run += dt
+                    st.starve_max = max(st.starve_max, st.starve_run)
+                    st.starve_s_max = max(st.starve_s_max, st.starve_s_run)
+                else:
+                    st.starve_run = 0
+                    st.starve_s_run = 0.0
+        if cycles == 0:
+            from ..capture.format import CaptureError
+
+            raise CaptureError(f"{path}: capture holds no replayable cycles")
+        out_rungs.append({
+            "rung": label,
+            "overlay": ov.to_dict(),
+            "fairness": {
+                q: {
+                    "share_deserved": round(st.share_deserved / cycles, 6),
+                    "share_allocated": round(st.share_allocated / cycles, 6),
+                    "pending_mean": round(st.pending_sum / cycles, 3),
+                    "pending_max": st.pending_max,
+                    "starved_cycles_max": st.starve_max,
+                    "starved_s_max": round(st.starve_s_max, 3),
+                }
+                for q, st in sorted(queues.items())
+            },
+            "pending": {
+                "depth_mean": round(pending_depth_sum / cycles, 3),
+                "depth_max": pending_depth_max,
+            },
+            "binds": binds_total,
+            "evicts": evicts_total,
+        })
+    base = out_rungs[0]
+    for rung in out_rungs[1:]:
+        rung["vs_baseline"] = {
+            "binds": rung["binds"] - base["binds"],
+            "evicts": rung["evicts"] - base["evicts"],
+            "pending_depth_mean": round(
+                rung["pending"]["depth_mean"] - base["pending"]["depth_mean"], 3
+            ),
+        }
+    return 0, {
+        "version": 1,
+        "mode": "plan",
+        "cycles": cycles,
+        "conf_fingerprint_recorded": man.get("conf_fingerprint", ""),
+        "rungs": out_rungs,
+    }
+
+
+def format_plan(report: dict) -> str:
+    lines = [
+        f"capacity plan over {report['cycles']} recorded cycles "
+        f"(conf {report['conf_fingerprint_recorded']}):"
+    ]
+    for rung in report["rungs"]:
+        lines.append(
+            f"  rung {rung['rung']}: binds {rung['binds']}, evicts "
+            f"{rung['evicts']}, pending depth mean "
+            f"{rung['pending']['depth_mean']} max {rung['pending']['depth_max']}"
+        )
+        for q, row in rung["fairness"].items():
+            lines.append(
+                f"    queue {q}: deserved {row['share_deserved']:.4f} "
+                f"allocated {row['share_allocated']:.4f} pending~"
+                f"{row['pending_mean']} starved<= {row['starved_cycles_max']} cyc"
+            )
+        if "vs_baseline" in rung:
+            vb = rung["vs_baseline"]
+            lines.append(
+                f"    vs baseline: binds {vb['binds']:+d}, pending depth "
+                f"{vb['pending_depth_mean']:+.3f}"
+            )
+    return "\n".join(lines)

@@ -1,0 +1,336 @@
+"""Shadow-cycle serving: counterfactual decides over frozen epochs.
+
+The read-mostly half of the what-if control plane.  A shadow request
+takes a tenant's frozen snapshot (the arena's freeze/swap epochs make
+the clone free — ``snapshot()`` packs are stable after later packs, so
+"clone" is just holding the reference), applies a validated
+:class:`~kube_arbitrator_tpu.whatif.overlay.Overlay`, and re-decides
+through the SAME :class:`~kube_arbitrator_tpu.rpc.pool.DecisionPool`
+that serves live traffic:
+
+* shadow packs carry the same ``pack_shape_key`` as live packs of the
+  same shape and conf, so shadow load stacks into the same batched XLA
+  launches — what-if traffic rides live traffic's compiled programs and
+  padding buckets instead of competing with them;
+* the overlay and baseline sides of one question are submitted in ONE
+  pool flush, so they usually share a single launch too (a value-only
+  overlay such as a queue-weight multiply never changes the shape key);
+* answers are expressed as the capture plane's differential products:
+  per-queue fairness-ledger deltas plus added/removed bind/evict edges,
+  with both sides' wall-clock-free decision digests.
+
+Isolation contract (enforced by the chaos ``shadow_isolation``
+invariant): a shadow cycle must never actuate, never mutate a live
+epoch, and never appear in the audit stream.  By construction the
+engine holds no cluster, no apiserver client, and no audit log; overlay
+application is pure (fresh arrays on a ``dataclasses.replace`` copy);
+and shadow tenants are namespaced (``whatif:<tenant>``) so pool logs,
+metrics, and the fleet ledger attribute shadow load distinctly.
+``unsafe_inplace`` is the sensitivity seam (``--disable
+shadow-isolation``): it applies the overlay by WRITING INTO the live
+pack's arrays, which the invariant checker MUST catch.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import locking
+from ..utils.metrics import MetricsRegistry, metrics
+from .overlay import Overlay, OverlayError
+
+# shadow tenants are namespaced: nothing that aggregates by tenant can
+# confuse what-if load with live load
+SHADOW_PREFIX = "whatif:"
+# the baseline leg of one question, distinct from the overlay leg so
+# pool logs show both
+BASE_SUFFIX = "#base"
+
+MAX_EDGE_SAMPLES = 20
+LOG_CAPACITY = 256
+
+
+def is_shadow_tenant(tenant: str) -> bool:
+    return tenant.startswith(SHADOW_PREFIX)
+
+
+@dataclasses.dataclass
+class ShadowAnswer:
+    """One answered what-if, JSON-ready via :meth:`to_dict` (the raw
+    decision objects ride as attributes for parity suites but stay out
+    of the wire form)."""
+
+    tenant: str
+    kind: str
+    outcome: str                     # served | rejected | error
+    overlay: dict
+    error: str = ""
+    base_digest: str = ""
+    overlay_digest: str = ""
+    identical: bool = False
+    fairness: Dict[str, dict] = dataclasses.field(default_factory=dict)
+    edges: dict = dataclasses.field(default_factory=dict)
+    kernel_ms: float = 0.0
+    batch: int = 0
+    batch_id: Optional[str] = None
+    shared_launch: bool = False      # overlay+base legs in ONE launch
+    corr: Optional[str] = None
+    # parity-suite attributes (not serialized):
+    decisions: object = None
+    base_decisions: object = None
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d.pop("decisions", None)
+        d.pop("base_decisions", None)
+        return d
+
+
+def _decision_arrays(dec, names: Tuple[str, ...]) -> Dict[str, np.ndarray]:
+    return {n: np.asarray(getattr(dec, n)) for n in names}
+
+
+def _edge_sets(snap, dec) -> Tuple[set, set]:
+    """Bind/evict edge sets of one decision — capture's ONE definition
+    (capture/replay._edges), reused verbatim."""
+    from ..capture.replay import _edges
+
+    return _edges(
+        snap, _decision_arrays(dec, ("bind_mask", "task_node", "evict_mask"))
+    )
+
+
+def _fairness_diff(base_rows: List[dict], over_rows: List[dict]) -> Dict[str, dict]:
+    """Per-queue {base, overlay, delta} over the ledger's share columns —
+    the differential replay's report shape, for one cycle."""
+    keys = ("share_deserved", "share_allocated", "pending")
+    out: Dict[str, dict] = {}
+    base = {r["queue"]: r for r in base_rows}
+    over = {r["queue"]: r for r in over_rows}
+    for q in sorted(set(base) | set(over)):
+        b = {k: base.get(q, {}).get(k, 0) for k in keys}
+        o = {k: over.get(q, {}).get(k, 0) for k in keys}
+        out[q] = {
+            "base": b,
+            "overlay": o,
+            "delta": {k: round(o[k] - b[k], 6) for k in keys},
+        }
+    return out
+
+
+class ShadowEngine:
+    """Serves shadow cycles through a live :class:`DecisionPool`.
+
+    Construction takes the pool and the scheduler config the live
+    tenants decide under; ``serve`` takes a frozen snapshot and an
+    overlay.  The engine keeps a bounded answer log plus counters for
+    ``/debug/whatif`` and the grafana panels."""
+
+    def __init__(
+        self,
+        pool,
+        config,
+        registry: Optional[MetricsRegistry] = None,
+        admission=None,
+        now_fn=None,
+    ):
+        self.pool = pool
+        self.config = config
+        self.registry = registry
+        # an attached LedgerAdmission folds its decision log into
+        # /debug/whatif (purely observational — the POOL consumes it)
+        self.admission = admission
+        self.now = now_fn or time.time
+        # chaos sensitivity seam (--disable shadow-isolation): apply the
+        # overlay IN PLACE on the live pack — the shadow_isolation
+        # invariant MUST catch the live-epoch mutation
+        self.unsafe_inplace = False
+        self._lock = locking.Lock("whatif.shadow.lock")
+        self._log: List[dict] = []
+        self._counts: Dict[Tuple[str, str], int] = {}
+
+    # ---- metrics ----
+
+    def _metrics(self) -> MetricsRegistry:
+        return self.registry if self.registry is not None else metrics()
+
+    def _count(self, kind: str, outcome: str) -> None:
+        self._metrics().counter_add(
+            "whatif_requests_total", labels={"kind": kind, "outcome": outcome}
+        )
+        with self._lock:
+            self._counts[(kind, outcome)] = self._counts.get((kind, outcome), 0) + 1
+
+    # ---- the serving entry ----
+
+    def serve(
+        self,
+        tenant: str,
+        snap,
+        overlay=None,
+        corr: Optional[str] = None,
+        live_decisions=None,
+    ) -> ShadowAnswer:
+        """Answer one what-if against ``tenant``'s frozen ``snap``.
+
+        ``overlay`` is an :class:`Overlay` or a request-body dict; a
+        malformed one resolves to ``outcome="rejected"``, never an
+        exception mid-serve.  ``live_decisions`` (the cycle the live
+        loop just committed over the SAME snapshot) skips the baseline
+        leg; without it the engine decides both legs in one pool flush
+        — a value-only overlay then shares one XLA launch with its own
+        baseline."""
+        try:
+            ov = overlay if isinstance(overlay, Overlay) else Overlay.from_dict(dict(overlay or {}))
+            ov.validate_against(snap)
+        except OverlayError as err:
+            kind = ov.kind if isinstance(overlay, Overlay) else "invalid"
+            self._count(kind, "rejected")
+            ans = ShadowAnswer(
+                tenant=tenant, kind=kind, outcome="rejected",
+                overlay={} if not isinstance(overlay, Overlay) else overlay.to_dict(),
+                error=str(err), corr=corr,
+            )
+            self._remember(ans)
+            return ans
+        shadow = SHADOW_PREFIX + tenant
+        if self.unsafe_inplace and ov.queue_weights:
+            # sensitivity seam: the forbidden move — write the overlay
+            # into the live epoch instead of a pure copy
+            from ..utils.audit import _queue_names
+
+            qnames = _queue_names(snap)
+            q, mult = ov.queue_weights[0]
+            arr = np.asarray(snap.tensors.queue_weight)
+            try:
+                arr[qnames.index(q)] = arr[qnames.index(q)] * mult
+            except (ValueError, TypeError):
+                pass
+            over_snap = snap
+        else:
+            over_snap = ov.apply(snap)
+        reqs: List[Tuple] = [
+            (shadow, over_snap.tensors, self.config, None, corr)
+        ]
+        need_base = live_decisions is None
+        if need_base:
+            reqs.append(
+                (shadow + BASE_SUFFIX, snap.tensors, self.config, None, corr)
+            )
+        built = self.pool.decide_many(reqs)
+        over_req = built[0]
+        base_req = built[1] if need_base else None
+        err = over_req.error or (base_req.error if base_req is not None else None)
+        if err is not None:
+            self._count(ov.kind, "error")
+            ans = ShadowAnswer(
+                tenant=tenant, kind=ov.kind, outcome="error",
+                overlay=ov.to_dict(), error=str(err), corr=corr,
+            )
+            self._remember(ans)
+            return ans
+        base_dec = live_decisions if live_decisions is not None else base_req.decisions
+        ans = self._answer(
+            tenant, ov, snap, over_snap, base_dec, over_req, base_req, corr
+        )
+        self._metrics().observe(
+            "whatif_shadow_batch_occupancy", float(over_req.batch)
+        )
+        self._count(ov.kind, "served")
+        self._remember(ans)
+        return ans
+
+    def _answer(
+        self, tenant: str, ov: Overlay, snap, over_snap, base_dec,
+        over_req, base_req, corr,
+    ) -> ShadowAnswer:
+        from ..utils.audit import decision_digest, fairness_ledger
+
+        over_dec = over_req.decisions
+        base_digest = decision_digest(snap, base_dec)
+        over_digest = decision_digest(over_snap, over_dec)
+        b0, e0 = _edge_sets(snap, base_dec)
+        b1, e1 = _edge_sets(over_snap, over_dec)
+        add_b, rem_b = sorted(b1 - b0), sorted(b0 - b1)
+        add_e, rem_e = sorted(e1 - e0), sorted(e0 - e1)
+        edges = {
+            "binds_added": len(add_b),
+            "binds_removed": len(rem_b),
+            "evicts_added": len(add_e),
+            "evicts_removed": len(rem_e),
+            "samples": [
+                {"kind": "bind_added", "task": t, "node": n}
+                for t, n in add_b[:MAX_EDGE_SAMPLES]
+            ] + [
+                {"kind": "bind_removed", "task": t, "node": n}
+                for t, n in rem_b[:MAX_EDGE_SAMPLES]
+            ],
+        }
+        return ShadowAnswer(
+            tenant=tenant,
+            kind=ov.kind,
+            outcome="served",
+            overlay=ov.to_dict(),
+            base_digest=base_digest,
+            overlay_digest=over_digest,
+            identical=base_digest == over_digest,
+            fairness=_fairness_diff(
+                fairness_ledger(snap, base_dec),
+                fairness_ledger(over_snap, over_dec),
+            ),
+            edges=edges,
+            kernel_ms=over_req.kernel_ms,
+            batch=over_req.batch,
+            batch_id=over_req.batch_id,
+            shared_launch=(
+                base_req is not None
+                and base_req.batch_id is not None
+                and base_req.batch_id == over_req.batch_id
+            ),
+            corr=corr,
+            decisions=over_dec,
+            base_decisions=base_dec,
+        )
+
+    def _remember(self, ans: ShadowAnswer) -> None:
+        entry = ans.to_dict()
+        entry["ts"] = self.now()
+        with self._lock:
+            self._log.append(entry)
+            del self._log[:-LOG_CAPACITY]
+
+    # ---- the /debug/whatif document ----
+
+    def status(self) -> dict:
+        with self._lock:
+            counts = [
+                {"kind": k, "outcome": o, "count": n}
+                for (k, o), n in sorted(self._counts.items())
+            ]
+            tail = list(self._log[-32:])
+        doc = {
+            "requests": counts,
+            "answers_tail": tail,
+        }
+        if self.admission is not None and hasattr(self.admission, "status"):
+            doc["admission"] = self.admission.status()
+        return doc
+
+
+class ShadowClient:
+    """The per-tenant facade, mirroring :class:`PoolClient`'s shape: one
+    object a tenant-facing RPC handler holds to ask what-ifs about ITS
+    frozen epochs."""
+
+    def __init__(self, engine: ShadowEngine, tenant: str):
+        self.engine = engine
+        self.tenant = tenant
+
+    def ask(self, snap, overlay=None, corr=None, live_decisions=None) -> ShadowAnswer:
+        return self.engine.serve(
+            self.tenant, snap, overlay=overlay, corr=corr,
+            live_decisions=live_decisions,
+        )

@@ -605,9 +605,10 @@ def test_real_tree_lock_graph_has_named_nodes_and_no_cycles():
 
 
 def test_real_tree_is_clean():
-    # clean modulo the committed baseline, which holds exactly the
-    # justified KAT-EFF-001 allocation floors (decode intent
-    # construction, close-census status objects) — see
+    # clean modulo the committed baseline — currently EMPTY: the last
+    # justified KAT-EFF-001 floors (close-census status objects)
+    # retired when the explain pass vectorized and `_close`'s emit loop
+    # stopped walking the snapshot index directly — see
     # tests/test_effects.py for the fingerprint-exact baseline match
     from kube_arbitrator_tpu.analysis.report import apply_baseline, load_baseline
 

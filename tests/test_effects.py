@@ -325,23 +325,22 @@ def test_eff010_state_rebuild_does_not_smear_taint(tmp_path):
 
 
 def test_real_tree_findings_match_committed_baseline(monkeypatch):
-    """The only real-tree KAT-EFF findings are the two justified
-    allocation floors in .kat-baseline.json (close-census status
-    objects; the decode intent floors retired when `_build_intents`
-    gave way to the columnar `decode_batch` path) — every other
-    stage/role is clean, and the baseline file itself is neither stale
-    nor short."""
+    """The real tree carries ZERO KAT-EFF findings and the committed
+    baseline is empty — the decode intent floors retired when
+    `_build_intents` gave way to the columnar `decode_batch` path, and
+    the close-census status-object floors retired when the explain pass
+    vectorized (`_close` batches `_fit_messages` over the first
+    unplaced row per job instead of calling `explain_job` inside the
+    snapshot-index walk).  A finding here means a new hot-loop
+    allocation crept in: either fix it or justify it IN the baseline,
+    never by widening this assert."""
     from kube_arbitrator_tpu.analysis.report import load_baseline
 
     monkeypatch.chdir(REPO)  # fingerprints embed CWD-relative paths
     _, findings = analyze_paths([str(REPO / "kube_arbitrator_tpu")], EFF)
-    assert rule_ids(findings) <= {"KAT-EFF-001"}
-    by_file = {}
-    for f in findings:
-        by_file.setdefault(os.path.basename(f.path), []).append(f)
-    assert set(by_file) == {"session.py"}
+    assert findings == [], "\n".join(f.format() for f in findings)
     baseline = load_baseline(str(REPO / ".kat-baseline.json"))
-    assert sorted(f.fingerprint() for f in findings) == sorted(baseline)
+    assert sorted(baseline) == []
 
 
 # ---------------------------------------------------------------------------

@@ -623,3 +623,100 @@ def test_live_scheduler_cycle_with_batched_ingest_binds():
     assert metrics().counter_value(
         "cache_ingest_rows_total", {"path": "batched"}
     ) > 0
+
+
+# ---------------------------------------------------------------------------
+# evict columnar: certificate-gated batch commit
+
+
+def _running_world_pair(seed=7):
+    mk = lambda: generate_cluster(num_nodes=8, num_jobs=6, tasks_per_job=4,
+                                  num_queues=2, seed=seed,
+                                  running_fraction=0.5)
+    return mk(), mk()
+
+
+def _running_tasks(sim, n=6):
+    return sorted(
+        (t for j in sim.cluster.jobs.values() for t in j.tasks.values()
+         if t.status == TaskStatus.RUNNING and t.node_name),
+        key=lambda t: t.uid,
+    )[:n]
+
+
+def test_evict_columnar_certificate_batch_commit_parity():
+    """A certifiable evict column must take the batch commit (the
+    certificate proves failure-freedom) and leave model state, events,
+    resync queue, arena dirt, and the NEXT pack identical to the scalar
+    object path."""
+    sim_col, sim_obj = _running_world_pair()
+    arena_col = SnapshotArena(sim_col, verify_every=0)
+    arena_obj = SnapshotArena(sim_obj, verify_every=0)
+    snap = arena_col.snapshot()
+    arena_obj.snapshot()
+    victims = _running_tasks(sim_col)
+    assert len(victims) >= 2
+    intents = [EvictIntent(task_uid=t.uid) for t in victims]
+    _, ec = _columns_from_intents(snap, [], intents)
+    tasks = sim_col._resolve_rows(ec)
+    assert sim_col._evict_batch_certificate(ec.uids, tasks) is not None
+    failed_c = sim_col.apply_evicts_columnar(ec)
+    failed_o = sim_obj.apply_evicts(intents)
+    assert failed_c == failed_o == []
+    assert sim_col.evictor.evicts == sim_obj.evictor.evicts
+    assert _model_digest(sim_col.cluster) == _model_digest(sim_obj.cluster)
+    assert [dataclasses.astuple(e) for e in sim_col.events] == [
+        dataclasses.astuple(e) for e in sim_obj.events
+    ]
+    assert sim_col.resync_queue == sim_obj.resync_queue
+    assert arena_col._dirty_tasks == arena_obj._dirty_tasks
+    assert arena_col._dirty_nodes == arena_obj._dirty_nodes
+    # node.tasks insertion order (the scalar pop/re-add moves the uid to
+    # the end) must match too — the dict order feeds pack iteration
+    for name, node in sim_col.cluster.nodes.items():
+        assert list(node.tasks) == list(sim_obj.cluster.nodes[name].tasks)
+    pc, po = arena_col.snapshot(), arena_obj.snapshot()
+    for f in dataclasses.fields(pc.tensors):
+        a = getattr(pc.tensors, f.name)
+        b = getattr(po.tensors, f.name)
+        if a is None or not hasattr(a, "shape"):
+            continue
+        assert np.array_equal(np.asarray(a), np.asarray(b)), f.name
+
+
+def test_evict_columnar_injected_failure_falls_back_scalar():
+    """An armed evictor failure must void the certificate and route the
+    WHOLE column through the scalar chain — partial actuation, resync
+    diversion, and event order bit-identical to the object path."""
+    sim_col, sim_obj = _running_world_pair(seed=9)
+    victims = _running_tasks(sim_col, n=5)
+    assert len(victims) >= 3
+    fail_uid = victims[len(victims) // 2].uid
+    sim_col.evictor.fail_uids.add(fail_uid)
+    sim_obj.evictor.fail_uids.add(fail_uid)
+    snap = build_snapshot(sim_col.cluster)
+    intents = [EvictIntent(task_uid=t.uid) for t in victims]
+    _, ec = _columns_from_intents(snap, [], intents)
+    tasks = sim_col._resolve_rows(ec)
+    assert sim_col._evict_batch_certificate(ec.uids, tasks) is None
+    failed_c = sim_col.apply_evicts_columnar(ec)
+    failed_o = sim_obj.apply_evicts(intents)
+    assert failed_c == failed_o == [fail_uid]
+    assert sim_col.resync_queue == sim_obj.resync_queue == [fail_uid]
+    assert _model_digest(sim_col.cluster) == _model_digest(sim_obj.cluster)
+    assert [dataclasses.astuple(e) for e in sim_col.events] == [
+        dataclasses.astuple(e) for e in sim_obj.events
+    ]
+
+
+def test_evict_columnar_duplicate_uid_voids_certificate():
+    """Duplicate uids in one column are a doubt the certificate refuses
+    (the second row's remove_task would raise mid-batch); the scalar
+    fallback handles them with its per-row semantics."""
+    sim, _ = _running_world_pair(seed=11)
+    victims = _running_tasks(sim, n=2)
+    snap = build_snapshot(sim.cluster)
+    intents = [EvictIntent(task_uid=victims[0].uid)] * 2
+    _, ec = _columns_from_intents(snap, [], intents)
+    tasks = sim._resolve_rows(ec)
+    assert sim._evict_batch_certificate(ec.uids, tasks) is None

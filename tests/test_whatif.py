@@ -1,0 +1,479 @@
+"""The what-if control plane (kube_arbitrator_tpu/whatif).
+
+Covers the acceptance bar of the what-if PR:
+
+* **bit-identity soak**: an empty-overlay shadow cycle reproduces the
+  live decision tensors AND the wall-clock-free audit digest exactly —
+  3 seeds × queue widths {8, 64, 512} — and both its legs share one
+  batched launch;
+* **one launch with live traffic**: a live request and a value-only
+  shadow request submitted in the same pool flush land in the SAME
+  batch (equal batch ids) — what-if load rides live traffic's compiled
+  programs;
+* **one overlay schema**: capture's differential replay and the what-if
+  plane parse/validate through the SAME ``Overlay`` (drift test pinning
+  both entry points), and malformed overlays reject without serving;
+* **ledger admission**: hysteresis units — enter past ``enter_delta``
+  only while someone starves, escalate to reject past
+  ``reject_factor``×SLO, hold ``min_hold`` windows, resume when the
+  pressure clears; verdicts are cached per fleet window; shadow tenants
+  are never deferred;
+* **capacity planning**: ``plan_replay`` over a recorded capture
+  produces per-rung fairness/pending/starvation aggregates with
+  vs_baseline deltas, and ``python -m kube_arbitrator_tpu.whatif
+  --plan`` exits 0 in a fresh process;
+* ``/debug/whatif`` serves the engine document (absent-plane idiom
+  included).
+"""
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kube_arbitrator_tpu.cache import build_snapshot, generate_cluster
+from kube_arbitrator_tpu.framework.conf import SchedulerConfig
+from kube_arbitrator_tpu.rpc.pool import DecisionPool, np_equal_decisions
+from kube_arbitrator_tpu.utils.audit import _queue_names, decision_digest
+from kube_arbitrator_tpu.utils.metrics import MetricsRegistry, metrics
+from kube_arbitrator_tpu.whatif import (
+    LedgerAdmission,
+    Overlay,
+    OverlayError,
+    ShadowClient,
+    ShadowEngine,
+)
+
+REPO = str(pathlib.Path(__file__).resolve().parents[1])
+CFG = SchedulerConfig.default()
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    metrics().reset()
+    yield
+    metrics().reset()
+
+
+def _world(seed=0, queues=8, nodes=10, jobs=6, tpj=5):
+    sim = generate_cluster(
+        num_nodes=nodes, num_jobs=jobs, tasks_per_job=tpj,
+        num_queues=queues, seed=seed,
+    )
+    return sim, build_snapshot(sim.cluster)
+
+
+# ---------------------------------------------------------------------------
+# shadow-cycle serving
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("queues", [8, 64, 512])
+def test_shadow_empty_overlay_bit_identity(seed, queues):
+    """The soak: an empty overlay through the shadow path must reproduce
+    the live decision bit-for-bit — tensors (np_equal_decisions) and the
+    audit plane's decision digest — with both shadow legs in ONE
+    launch."""
+    _, snap = _world(seed=seed, queues=queues)
+    pool = DecisionPool(replicas=1, threaded=False)
+    try:
+        live = pool.decide_many([("live", snap.tensors, CFG, None)])[0]
+        assert live.error is None
+        engine = ShadowEngine(pool, CFG)
+        ans = ShadowClient(engine, "live").ask(snap, overlay=Overlay())
+        assert ans.outcome == "served", ans.error
+        assert ans.identical
+        assert ans.shared_launch and ans.batch == 2
+        live_digest = decision_digest(snap, live.decisions)
+        assert ans.base_digest == ans.overlay_digest == live_digest
+        assert np_equal_decisions(ans.base_decisions, live.decisions)
+        assert np_equal_decisions(ans.decisions, live.decisions)
+        for row in ans.fairness.values():
+            assert all(v == 0 for v in row["delta"].values())
+        assert not any(ans.edges[k] for k in (
+            "binds_added", "binds_removed", "evicts_added", "evicts_removed",
+        ))
+    finally:
+        pool.close()
+
+
+def test_shadow_and_live_share_one_launch():
+    """A live request and a value-only shadow overlay submitted in the
+    same pool flush batch into ONE compiled launch: same batch id, batch
+    size covers both — the tentpole's serving economics."""
+    _, snap = _world(seed=3)
+    ov = Overlay(queue_weights=((_queue_names(snap)[0], 2.0),))
+    over_snap = ov.apply(snap)
+    pool = DecisionPool(replicas=1, threaded=False)
+    try:
+        built = pool.decide_many([
+            ("live", snap.tensors, CFG, None),
+            ("whatif:live", over_snap.tensors, CFG, None),
+        ])
+        assert all(r.error is None for r in built)
+        assert built[0].batch_id is not None
+        assert built[0].batch_id == built[1].batch_id
+        assert built[0].batch == built[1].batch == 2
+        served = [
+            e for e in pool.decision_log
+            if e["outcome"] in ("served", "resent")
+        ]
+        assert {e["tenant"] for e in served} == {"live", "whatif:live"}
+        assert len({e["batch_id"] for e in served}) == 1
+    finally:
+        pool.close()
+
+
+def test_shadow_overlay_answer_reports_deltas_and_counters():
+    """A contended world under a big queue-weight multiplier: the answer
+    carries per-queue fairness deltas and bounded edge samples, the
+    engine counts the request, and /debug/whatif style status sees it."""
+    reg = MetricsRegistry()
+    sim, snap = _world(seed=5, queues=2, nodes=4, jobs=8, tpj=5)
+    qname = _queue_names(snap)[0]
+    pool = DecisionPool(replicas=1, threaded=False)
+    try:
+        engine = ShadowEngine(pool, CFG, registry=reg)
+        ans = engine.serve(
+            "t0", snap, overlay=Overlay(queue_weights=((qname, 8.0),)),
+        )
+        assert ans.outcome == "served", ans.error
+        assert ans.kind == "queue_weight"
+        assert ans.shared_launch  # value-only overlay keeps the shape key
+        assert qname in ans.fairness
+        assert set(ans.fairness[qname]) == {"base", "overlay", "delta"}
+        deserved_delta = ans.fairness[qname]["delta"]["share_deserved"]
+        assert deserved_delta > 0  # 8x weight must raise deserved share
+        status = engine.status()
+        assert status["requests"] == [
+            {"kind": "queue_weight", "outcome": "served", "count": 1}
+        ]
+        assert status["answers_tail"][-1]["overlay_digest"] == ans.overlay_digest
+    finally:
+        pool.close()
+
+
+def test_shadow_malformed_overlay_rejected_not_raised():
+    _, snap = _world(seed=1, queues=2, nodes=3, jobs=2, tpj=2)
+    pool = DecisionPool(replicas=1, threaded=False)
+    try:
+        engine = ShadowEngine(pool, CFG)
+        for bad in (
+            {"queue_weights": {"no-such-queue": 2.0}},
+            {"unknown_knob": 1},
+            {"drain_nodes": ["no-such-node"]},
+        ):
+            ans = engine.serve("t0", snap, overlay=bad)
+            assert ans.outcome == "rejected"
+            assert ans.error
+        assert not pool.decision_log  # nothing reached the replicas
+    finally:
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# ONE overlay schema (capture + whatif entry points)
+
+
+def test_overlay_drift_capture_and_whatif_pin_one_schema():
+    """Both CLIs must resolve to the SAME Overlay class, and their
+    spellings of the same ask must parse to EQUAL overlays — the drift
+    test that keeps a second parser from growing back."""
+    import kube_arbitrator_tpu.capture.__main__ as cap_cli
+    from kube_arbitrator_tpu.whatif import overlay as ov_mod
+    from kube_arbitrator_tpu.whatif.plan import parse_rung
+
+    assert cap_cli.Overlay is ov_mod.Overlay
+    assert cap_cli.OverlayError is ov_mod.OverlayError
+    # capture flag spelling == whatif rung spelling == RPC dict spelling
+    flags = Overlay.parse(
+        queue_weight=["qa=2.0"], quota=["qb=3"], drain=["n1"], admit=["j1"],
+    )
+    _, rung = parse_rung("w:qa=2.0,quota:qb=3,drain:n1,admit:j1")
+    body = Overlay.from_dict({
+        "queue_weights": {"qa": 2.0},
+        "resize_quota": {"qb": 3},
+        "drain_nodes": ["n1"],
+        "admit_jobs": ["j1"],
+    })
+    assert flags == rung == body
+    # and capture's differential replay builds through the same type
+    from kube_arbitrator_tpu.capture import replay as cap_replay
+    import inspect
+
+    src = inspect.getsource(cap_replay.replay_differential)
+    assert "Overlay" in src and "_parse_queue_weights" not in src
+
+
+def test_overlay_apply_is_pure_and_validates():
+    _, snap = _world(seed=2, queues=2, nodes=4, jobs=2, tpj=2)
+    qnames = _queue_names(snap)
+    node0 = snap.index.nodes[0].name
+    before_qw = np.array(np.asarray(snap.tensors.queue_weight), copy=True)
+    before_un = np.array(np.asarray(snap.tensors.node_unsched), copy=True)
+    ov = Overlay(
+        queue_weights=((qnames[0], 2.0),), drain_nodes=(node0,),
+    )
+    out = ov.apply(snap)
+    assert out is not snap
+    # source untouched — the shadow_isolation contract at the array level
+    assert np.array_equal(np.asarray(snap.tensors.queue_weight), before_qw)
+    assert np.array_equal(np.asarray(snap.tensors.node_unsched), before_un)
+    assert bool(np.asarray(out.tensors.node_unsched)[0])
+    with pytest.raises(OverlayError):
+        Overlay(queue_weights=(("nope", 2.0),)).apply(snap)
+    with pytest.raises(OverlayError):
+        Overlay.parse(queue_weight=["qa=-1"])
+    with pytest.raises(OverlayError):
+        Overlay.from_dict({"node_scale": 0.0})
+
+
+def test_overlay_node_scale_masks_and_clones():
+    _, snap = _world(seed=4, queues=2, nodes=6, jobs=2, tpj=2)
+    n_valid = int(np.asarray(snap.tensors.node_valid).sum())
+    half = Overlay(node_scale=0.5).apply(snap)
+    assert int(np.asarray(half.tensors.node_valid).sum()) == n_valid // 2
+    grown = Overlay(node_scale=2.0).apply(snap)
+    assert int(np.asarray(grown.tensors.node_valid).sum()) == 2 * n_valid
+    assert any(
+        n.name.endswith("+whatif0") for n in grown.index.nodes
+    )
+
+
+# ---------------------------------------------------------------------------
+# ledger-driven admission
+
+
+class _FakeWindow:
+    def __init__(self, seq, tenants):
+        self.seq = seq
+        self.tenants = tenants
+
+
+class _FakeFleet:
+    def __init__(self):
+        self.window = None
+
+    def last_window(self):
+        return self.window
+
+
+def _row(tenant, delta, starvation_s=0.0):
+    return {"tenant": tenant, "delta": delta, "starvation_s": starvation_s}
+
+
+def _admission(**kw):
+    fleet = _FakeFleet()
+    adm = LedgerAdmission(
+        slo_ms=1000.0, fleet=fleet, starvation_slo_s=60.0,
+        enter_delta=0.10, exit_delta=0.02, min_hold=2,
+        registry=MetricsRegistry(), **kw,
+    )
+    return adm, fleet
+
+
+def test_admission_defers_over_entitled_tenant_while_others_starve():
+    adm, fleet = _admission()
+    fleet.window = _FakeWindow(1, [
+        _row("hog", delta=0.3), _row("victim", delta=-0.3, starvation_s=90.0),
+    ])
+    assert adm.should_shed("hog")
+    assert adm.shed_reason("hog") == "ledger_defer"
+    assert not adm.should_shed("victim")  # the starving side is admitted
+    log = adm.decision_log
+    assert [e["action"] for e in log] == ["defer"]
+    assert log[0]["starving"][0]["tenant"] == "victim"
+
+
+def test_admission_escalates_to_reject_past_reject_factor():
+    adm, fleet = _admission(reject_factor=2.0)
+    fleet.window = _FakeWindow(1, [
+        _row("hog", delta=0.5), _row("victim", delta=-0.5, starvation_s=150.0),
+    ])
+    assert adm.should_shed("hog")
+    assert adm.shed_reason("hog") == "ledger_reject"
+    assert adm.decision_log[-1]["action"] == "reject"
+
+
+def test_admission_verdict_cached_per_window():
+    adm, fleet = _admission()
+    fleet.window = _FakeWindow(7, [
+        _row("hog", delta=0.3), _row("victim", delta=-0.3, starvation_s=90.0),
+    ])
+    for _ in range(5):
+        assert adm.should_shed("hog")
+    # five calls, ONE evaluation -> one log entry for the window
+    assert len(adm.decision_log) == 1
+    assert adm.decision_log[0]["window"] == 7
+
+
+def test_admission_hysteresis_holds_then_resumes():
+    adm, fleet = _admission()
+    pressure = [
+        _row("hog", delta=0.3), _row("victim", delta=-0.3, starvation_s=90.0),
+    ]
+    clear = [_row("hog", delta=0.0), _row("victim", delta=0.0)]
+    fleet.window = _FakeWindow(1, pressure)
+    assert adm.should_shed("hog")          # enter (held=1)
+    fleet.window = _FakeWindow(2, clear)
+    assert adm.should_shed("hog")          # hold: held < min_hold
+    fleet.window = _FakeWindow(3, clear)
+    assert not adm.should_shed("hog")      # matured + clear -> resume
+    assert [e["action"] for e in adm.decision_log] == [
+        "defer", "defer", "resume",
+    ]
+    # resumed state is clean: pressure must re-enter from scratch
+    fleet.window = _FakeWindow(4, clear)
+    assert not adm.should_shed("hog")
+
+
+def test_admission_bounce_on_threshold_is_not_flapped():
+    """delta oscillating across enter_delta while starvation persists:
+    one enter, then holds — never defer/resume/defer churn."""
+    adm, fleet = _admission()
+    seq = [0.3, 0.05, 0.3, 0.05]  # exit_delta=0.02 < 0.05 < 0.10=enter
+    for i, d in enumerate(seq, start=1):
+        fleet.window = _FakeWindow(i, [
+            _row("hog", delta=d),
+            _row("victim", delta=-d, starvation_s=90.0),
+        ])
+        assert adm.should_shed("hog")
+    assert [e["action"] for e in adm.decision_log] == ["defer"] * 4
+
+
+def test_admission_never_defers_shadow_tenants():
+    adm, fleet = _admission()
+    fleet.window = _FakeWindow(1, [
+        _row("whatif:hog", delta=0.9),
+        _row("victim", delta=-0.9, starvation_s=900.0),
+    ])
+    assert not adm.should_shed("whatif:hog")
+    assert not adm.should_shed("whatif:hog#base")
+
+
+def test_admission_status_document():
+    adm, fleet = _admission()
+    fleet.window = _FakeWindow(1, [
+        _row("hog", delta=0.3), _row("victim", delta=-0.3, starvation_s=90.0),
+    ])
+    adm.should_shed("hog")
+    doc = adm.status()
+    assert doc["deferring"] == {"hog": 1}
+    assert doc["decisions_tail"][-1]["action"] == "defer"
+    assert doc["min_hold"] == 2
+
+
+# ---------------------------------------------------------------------------
+# capacity-planning replay (+ the plan CLI)
+
+
+@pytest.fixture(scope="module")
+def recorded(tmp_path_factory):
+    from kube_arbitrator_tpu.capture import SessionCapture
+    from kube_arbitrator_tpu.framework import Scheduler
+    from kube_arbitrator_tpu.framework.conf import dump_conf
+
+    path = str(tmp_path_factory.mktemp("whatif-cap") / "rec")
+    sim = generate_cluster(
+        num_nodes=4, num_jobs=8, tasks_per_job=5, num_queues=2, seed=0
+    )
+    sched = Scheduler(sim)
+    cap = SessionCapture(path, conf_yaml=dump_conf(sched.config))
+    sched.capture = cap
+    try:
+        sched.run(max_cycles=6, until_idle=False)
+    finally:
+        cap.close()
+    return path
+
+
+def test_plan_replay_rungs_and_baseline_deltas(recorded):
+    from kube_arbitrator_tpu.whatif.plan import plan_replay
+
+    rc, report = plan_replay(
+        recorded, rungs=["baseline", "node_scale=0.5", "w:queue-000=4.0"]
+    )
+    assert rc == 0
+    assert report["mode"] == "plan" and report["cycles"] == 6
+    rungs = {r["rung"]: r for r in report["rungs"]}
+    assert set(rungs) == {"baseline", "node_scale=0.5", "w:queue-000=4.0"}
+    base = rungs["baseline"]
+    assert "vs_baseline" not in base
+    for label in ("node_scale=0.5", "w:queue-000=4.0"):
+        assert set(rungs[label]["vs_baseline"]) == {
+            "binds", "evicts", "pending_depth_mean",
+        }
+    # a contended world on half the fleet cannot bind MORE than baseline
+    assert rungs["node_scale=0.5"]["vs_baseline"]["binds"] <= 0
+    for rung in report["rungs"]:
+        for q, row in rung["fairness"].items():
+            assert {"share_deserved", "share_allocated", "pending_mean",
+                    "pending_max", "starved_cycles_max",
+                    "starved_s_max"} <= set(row)
+
+
+def test_plan_cli_fresh_process(recorded, tmp_path):
+    out = str(tmp_path / "plan.json")
+    env = dict(os.environ)
+    env.update(PYTHONPATH=REPO, JAX_PLATFORMS="cpu", PYTHONHASHSEED="97")
+    r = subprocess.run(
+        [sys.executable, "-m", "kube_arbitrator_tpu.whatif",
+         "--plan", recorded, "--rung", "node_scale=0.5", "--json",
+         "--out", out],
+        capture_output=True, text=True, timeout=560, env=env,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    report = json.loads(r.stdout)
+    assert [x["rung"] for x in report["rungs"]] == [
+        "baseline", "node_scale=0.5",  # baseline auto-inserted first
+    ]
+    assert json.load(open(out)) == report
+
+
+def test_plan_cli_bad_rung_exits_2(recorded):
+    env = dict(os.environ)
+    env.update(PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "kube_arbitrator_tpu.whatif",
+         "--plan", recorded, "--rung", "bogus_knob=1"],
+        capture_output=True, text=True, timeout=560, env=env,
+    )
+    assert r.returncode == 2
+    assert "error:" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# /debug/whatif
+
+
+def test_debug_whatif_route_and_absent_plane():
+    from kube_arbitrator_tpu.obs import serve_obs
+
+    _, snap = _world(seed=6, queues=2, nodes=3, jobs=2, tpj=2)
+    pool = DecisionPool(replicas=1, threaded=False)
+    try:
+        engine = ShadowEngine(pool, CFG)
+        engine.serve("t0", snap, overlay=Overlay())
+        server, _t, url = serve_obs(whatif=engine)
+        try:
+            body = json.load(
+                urllib.request.urlopen(url + "/debug/whatif", timeout=10)
+            )
+            assert body["requests"][0]["outcome"] == "served"
+            assert body["answers_tail"][-1]["identical"] is True
+        finally:
+            server.shutdown()
+        server2, _t2, url2 = serve_obs()
+        try:
+            none = json.load(
+                urllib.request.urlopen(url2 + "/debug/whatif", timeout=10)
+            )
+            assert "error" in none
+        finally:
+            server2.shutdown()
+    finally:
+        pool.close()
